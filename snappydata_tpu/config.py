@@ -1,0 +1,103 @@
+"""Unified property system.
+
+Mirrors the reference's three-ring config (`Property` enumeration,
+core/src/main/scala/io/snappydata/Literals.scala:32-205): boot properties,
+cluster conf, and session-level SQL conf, with the same key knobs
+(ColumnBatchSize:129, ColumnMaxDeltaRows:138, HashJoinSize:153,
+PlanCaching:188, Tokenize:205, PlanCacheSize:126).
+
+TPU-first deltas: batch size is expressed in ROWS (static shapes are what
+XLA wants — a fixed row capacity per batch means one compiled kernel serves
+every batch), and there is a dtype policy for decimals because TPUs have no
+fast float64.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Dict, Optional
+
+
+def _env(name: str, default, cast=str):
+    raw = os.environ.get(name)
+    if raw is None:
+        return default
+    if cast is bool:
+        return raw.lower() in ("1", "true", "yes", "on")
+    return cast(raw)
+
+
+@dataclasses.dataclass
+class Properties:
+    """Session/cluster tunables. Names keep the reference's intent."""
+
+    # Storage (ref: Literals.scala:129 ColumnBatchSize ~24MB, :138 ColumnMaxDeltaRows 10000)
+    column_batch_rows: int = 1 << 17          # rows per column batch (static XLA shape)
+    column_max_delta_rows: int = 10000        # row-buffer rollover threshold
+    compression_codec: str = "none"           # "none" | "zlib" (lz4 absent in env)
+
+    # Planner (ref: Literals.scala:153 HashJoinSize 100MB, :161 HashAggregateSize)
+    hash_join_size: int = 100 * 1024 * 1024   # max build-side bytes for broadcast join
+    plan_caching: bool = True                 # ref: Literals.scala:188
+    plan_cache_size: int = 3000               # ref: Literals.scala:126
+    tokenize: bool = True                     # ref: Literals.scala:205 spark.sql.tokenize
+
+    # Execution
+    decimal_as_float64: Optional[bool] = None  # None → auto (x64 iff CPU backend)
+    max_groups: int = 1 << 16                 # static upper bound for generic group-by output
+    batches_pow2_bucketing: bool = True       # pad #batches to pow2 → fewer recompiles
+
+    # Cluster
+    num_buckets: int = 128                    # default buckets per partitioned table (ref DDL BUCKETS)
+    redundancy: int = 0
+    member_timeout_s: float = 5.0             # ref: ClusterManagerTestBase.scala:72
+    stats_interval_s: float = 5.0             # ref: Constant.DEFAULT_CALC_TABLE_SIZE_SERVICE_INTERVAL
+
+    # Streaming (ref: SnappySinkCallback.scala:49-360)
+    sink_state_table: str = "snappysys_internal____sink_state_table"
+    sink_max_retries: int = 3
+
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def set(self, key: str, value: Any) -> None:
+        key_norm = key.replace("spark.snappydata.", "").replace(
+            "snappydata.", "").replace("-", "_").replace(".", "_")
+        if hasattr(self, key_norm) and key_norm != "extra":
+            cur = getattr(self, key_norm)
+            if isinstance(cur, bool) and isinstance(value, str):
+                value = value.lower() in ("1", "true", "yes", "on")
+            elif isinstance(cur, int) and not isinstance(value, bool):
+                value = int(value)
+            setattr(self, key_norm, value)
+        else:
+            self.extra[key] = value
+
+    def get(self, key: str, default: Any = None) -> Any:
+        key_norm = key.replace("spark.snappydata.", "").replace(
+            "snappydata.", "").replace("-", "_").replace(".", "_")
+        if hasattr(self, key_norm) and key_norm != "extra":
+            return getattr(self, key_norm)
+        return self.extra.get(key, default)
+
+
+_global = Properties(
+    column_batch_rows=_env("SNAPPY_TPU_BATCH_ROWS", 1 << 17, int),
+    plan_caching=_env("SNAPPY_TPU_PLAN_CACHING", True, bool),
+)
+
+
+def global_properties() -> Properties:
+    return _global
+
+
+def use_float64() -> bool:
+    """Decimal/compute dtype policy: float64 on CPU (exact test oracle),
+    float32 on TPU (no fast f64 there). Integer width is NOT policy —
+    LONG/TIMESTAMP are always int64, which is why the package force-enables
+    jax x64 at import (int64 silently wraps to int32 otherwise)."""
+    if _global.decimal_as_float64 is not None:
+        return _global.decimal_as_float64
+    import jax
+
+    return jax.default_backend() == "cpu"

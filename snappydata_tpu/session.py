@@ -1,0 +1,17 @@
+"""SnappySession — the user entry point (ref: SnappySession.scala).
+
+Placeholder during bring-up; filled in with sql/DDL/DML API as the engine
+layers land.
+"""
+
+from __future__ import annotations
+
+
+class SnappySession:
+    def __init__(self, conf=None):
+        from snappydata_tpu import config
+
+        self.conf = conf or config.global_properties()
+
+    def stop(self):
+        pass

@@ -1,0 +1,17 @@
+"""Column/row storage format (TPU equivalent of the reference `encoders/` project).
+
+Physical layout is designed for XLA, not for JVM Unsafe (contrast
+encoders/.../encoding/ColumnEncoding.scala:37-53): fixed row-capacity
+column plates so every batch shares one compiled kernel shape; null bitmaps
+Arrow-packed on host, expanded to masks on device; dictionary/RLE encodings
+decodable on device with static output shapes
+(`jnp.repeat(..., total_repeat_length)` / gather).
+"""
+
+from snappydata_tpu.storage.encoding import (  # noqa: F401
+    Encoding, EncodedColumn, ColumnStats, encode_column, decode_to_numpy,
+)
+from snappydata_tpu.storage.batch import ColumnBatch  # noqa: F401
+from snappydata_tpu.storage.table_store import (  # noqa: F401
+    ColumnTableData, RowBuffer, Manifest, BatchView,
+)

@@ -1,0 +1,512 @@
+"""Mutable table storage with snapshot-isolation MVCC.
+
+Re-provides, TPU-style, what the reference splits between the store engine
+and the columnar layer:
+
+- Row delta buffer + rollover into column batches at `column_max_delta_rows`
+  (ref: ColumnBatchCreator.createAndStoreBatch core/.../columnar/
+  ColumnBatchCreator.scala:46, fired from StoreCallbacksImpl.createColumnBatch:77).
+- Update/delete deltas merged at scan time (ref: ColumnDeltaEncoder /
+  UpdatedColumnDecoder / delete mask column -3, encoders/.../impl/
+  ColumnFormatEntry.scala:89-95).
+- Snapshot isolation: readers pin an immutable Manifest version; writers
+  build a new Manifest and publish it atomically (ref: snapshot tx around
+  store writes, JDBCSourceAsColumnarStore.scala:124-233 beginTx/commitTx).
+  JAX arrays being immutable makes this design natural: a snapshot is just
+  a tuple of references.
+
+Device representation: per column a stacked [num_batches, capacity] jax
+array (device dtype) plus a shared bool valid mask — one static shape for
+the whole table so every query over it reuses one compiled executable.
+Batch count is padded to a power of two (shape bucketing) so ingest doesn't
+recompile every query (ref analogue: plan cache amortizing Janino codegen;
+XLA compile is costlier still, SURVEY.md §7 hard part (d)).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from snappydata_tpu import config
+from snappydata_tpu import types as T
+from snappydata_tpu.storage.batch import ColumnBatch
+from snappydata_tpu.storage.encoding import decode_to_numpy, decode_validity
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchView:
+    """One batch as visible in a particular Manifest version."""
+
+    batch: ColumnBatch
+    delete_mask: Optional[np.ndarray] = None     # bool[capacity]; True = deleted
+    # update deltas: col_idx -> (mask bool[capacity], values device-dtype[capacity])
+    deltas: Tuple[Tuple[int, np.ndarray, np.ndarray], ...] = ()
+
+    def decoded_column(self, col_idx: int, strings: bool = False) -> np.ndarray:
+        """Base decode + delta merge (ref UpdatedColumnDecoder semantics)."""
+        col = self.batch.columns[col_idx]
+        out = decode_to_numpy(col, self.batch.capacity, strings=strings)
+        for ci, mask, values in self.deltas:
+            if ci == col_idx:
+                out = np.where(mask, values, out)
+        return out
+
+    def live_mask(self) -> np.ndarray:
+        m = np.arange(self.batch.capacity) < self.batch.num_rows
+        if self.delete_mask is not None:
+            m = m & ~self.delete_mask
+        return m
+
+    def live_rows(self) -> int:
+        return int(self.batch.num_rows - (0 if self.delete_mask is None
+                                          else int(self.delete_mask.sum())))
+
+
+@dataclasses.dataclass(frozen=True)
+class Manifest:
+    """Immutable table snapshot (the MVCC unit)."""
+
+    version: int
+    views: Tuple[BatchView, ...]
+    # row-buffer snapshot: per-column host arrays of the delta rows
+    row_arrays: Tuple[np.ndarray, ...]
+    row_count: int
+
+    def total_rows(self) -> int:
+        return sum(v.live_rows() for v in self.views) + self.row_count
+
+
+class RowBuffer:
+    """Mutable per-table row delta buffer (ref: the table.SHADOW row table
+    that small inserts land in, SURVEY.md §3.3). Columnar numpy storage,
+    mutated in place under the table writer lock; snapshots copy (≤
+    column_max_delta_rows rows, so copies are cheap)."""
+
+    def __init__(self, schema: T.Schema, capacity: int):
+        self.schema = schema
+        self.capacity = capacity
+        self._cols: List[np.ndarray] = [
+            np.empty(capacity, dtype=f.dtype.np_dtype) for f in schema.fields]
+        self._valid = np.ones(capacity, dtype=np.bool_)  # False = deleted in place
+        self.count = 0
+
+    def append(self, arrays: Sequence[np.ndarray]) -> int:
+        n = int(np.asarray(arrays[0]).shape[0])
+        assert self.count + n <= self.capacity
+        for dst, src in zip(self._cols, arrays):
+            dst[self.count:self.count + n] = np.asarray(src)
+        self._valid[self.count:self.count + n] = True
+        self.count += n
+        return n
+
+    def snapshot(self) -> Tuple[Tuple[np.ndarray, ...], int]:
+        live = self._valid[:self.count]
+        if live.all():
+            arrs = tuple(c[:self.count].copy() for c in self._cols)
+            return arrs, self.count
+        arrs = tuple(c[:self.count][live].copy() for c in self._cols)
+        return arrs, int(live.sum())
+
+    def clear(self) -> None:
+        self.count = 0
+
+
+class ColumnTableData:
+    """Storage for one COLUMN table: immutable batches + row delta buffer +
+    manifest chain. Thread-safe: one writer lock, lock-free readers."""
+
+    def __init__(self, schema: T.Schema, capacity: Optional[int] = None,
+                 max_delta_rows: Optional[int] = None):
+        props = config.global_properties()
+        self.schema = schema
+        self.capacity = capacity or props.column_batch_rows
+        self.max_delta_rows = max_delta_rows or props.column_max_delta_rows
+        self._lock = threading.Lock()
+        self._batch_ids = itertools.count()
+        self._row_buffer = RowBuffer(schema, max(self.max_delta_rows * 2,
+                                                 self.capacity))
+        # table-level shared dictionaries for string columns: codes stay
+        # comparable across batches (device group-by/join runs on codes)
+        self._dicts: Dict[int, List] = {
+            i: [] for i, f in enumerate(schema.fields) if f.dtype.name == "string"}
+        self._dict_lookup: Dict[int, Dict] = {i: {} for i in self._dicts}
+        self._manifest = Manifest(0, (), tuple(
+            np.empty(0, dtype=f.dtype.np_dtype) for f in schema.fields), 0)
+        # device cache: manifest version -> {key: device arrays}. Keyed per
+        # version so concurrent readers of different snapshots never mix
+        # entries (review finding: clear+overwrite raced).
+        self._device_cache: Dict[int, Dict] = {}
+
+    # --- snapshots -------------------------------------------------------
+
+    def snapshot(self) -> Manifest:
+        return self._manifest
+
+    def _publish(self, views: Tuple[BatchView, ...]) -> Manifest:
+        row_arrays, row_count = self._row_buffer.snapshot()
+        m = Manifest(self._manifest.version + 1, views, row_arrays, row_count)
+        self._manifest = m
+        return m
+
+    # --- dictionaries ----------------------------------------------------
+
+    def _intern_strings(self, col_idx: int, values: np.ndarray) -> np.ndarray:
+        """Extend the shared dictionary with unseen values; old codes stay
+        valid because the dictionary is append-only."""
+        lookup = self._dict_lookup[col_idx]
+        store = self._dicts[col_idx]
+        for v in dict.fromkeys(values.tolist()):
+            if v is not None and v not in lookup:
+                lookup[v] = len(store)
+                store.append(v)
+        return np.array(store, dtype=object)
+
+    def dictionary(self, col_idx: int) -> Optional[np.ndarray]:
+        if col_idx in self._dicts:
+            return np.array(self._dicts[col_idx], dtype=object)
+        return None
+
+    # --- writes ----------------------------------------------------------
+
+    def insert_arrays(self, arrays: Sequence[np.ndarray]) -> int:
+        """Bulk/small insert. Large inserts cut column batches directly
+        (ref ColumnInsertExec bulk path); small ones land in the row buffer
+        and roll over when it exceeds max_delta_rows (ref §3.3)."""
+        arrays = [np.asarray(a) for a in arrays]
+        if len(arrays) != len(self.schema.fields):
+            raise ValueError(
+                f"expected {len(self.schema.fields)} columns, got {len(arrays)}")
+        n = int(arrays[0].shape[0])
+        for a, f in zip(arrays, self.schema.fields):
+            if int(a.shape[0]) != n:
+                raise ValueError(
+                    f"column {f.name}: length {a.shape[0]} != {n}")
+        with self._lock:
+            # intern string values up front so row-buffer rows resolve to
+            # dictionary codes at device-build time without mutation
+            for i in self._dicts:
+                arrays[i] = np.asarray(arrays[i], dtype=object)
+                self._intern_strings(i, arrays[i])
+            views = list(self._manifest.views)
+            pos = 0
+            if n >= self.max_delta_rows:
+                while n - pos >= self.max_delta_rows:
+                    take = min(self.capacity, n - pos)
+                    views.append(self._cut_batch(
+                        [a[pos:pos + take] for a in arrays]))
+                    pos += take
+            if pos < n:
+                self._row_buffer.append([a[pos:] for a in arrays])
+            if self._row_buffer.count >= self.max_delta_rows:
+                views.extend(self._rollover_locked())
+            self._publish(tuple(views))
+            return n
+
+    def _cut_batch(self, arrays: List[np.ndarray]) -> BatchView:
+        dicts = {}
+        for i in self._dicts:
+            dicts[i] = self._intern_strings(i, arrays[i])
+        batch = ColumnBatch.from_arrays(
+            next(self._batch_ids), 0, self.schema, arrays, self.capacity,
+            dictionaries=dicts)
+        return BatchView(batch)
+
+    def _rollover_locked(self) -> List[BatchView]:
+        arrays, cnt = self._row_buffer.snapshot()
+        self._row_buffer.clear()
+        out = []
+        pos = 0
+        while pos < cnt:
+            take = min(self.capacity, cnt - pos)
+            out.append(self._cut_batch([a[pos:pos + take] for a in arrays]))
+            pos += take
+        return out
+
+    def force_rollover(self) -> None:
+        with self._lock:
+            views = list(self._manifest.views)
+            views.extend(self._rollover_locked())
+            self._publish(tuple(views))
+
+    def update(self, predicate: Callable[[Dict[str, np.ndarray]], np.ndarray],
+               assignments: Dict[str, Callable[[Dict[str, np.ndarray]], np.ndarray]],
+               ) -> int:
+        """UPDATE ... SET: write per-batch replacement deltas
+        (ref ColumnUpdateExec → ColumnDelta entries) and mutate row-buffer
+        rows in place. `predicate`/assignment callables take {col_name:
+        decoded host values} and return bool mask / new values."""
+        with self._lock:
+            touched = 0
+            new_views = []
+            for view in self._manifest.views:
+                cols = self._decode_all(view)
+                hit = np.asarray(predicate(cols)) & view.live_mask()
+                if not hit.any():
+                    new_views.append(view)
+                    continue
+                touched += int(hit.sum())
+                deltas = list(view.deltas)
+                for name, fn in assignments.items():
+                    ci = self.schema.index(name)
+                    values = self._to_device_domain(ci, np.asarray(fn(cols)),
+                                                    cols[self.schema.fields[ci].name])
+                    deltas.append((ci, hit.copy(), values))
+                new_views.append(dataclasses.replace(view, deltas=tuple(deltas)))
+            # row buffer in place
+            rb_cols = self._row_buffer_dict()
+            if rb_cols is not None:
+                hit = np.asarray(predicate(rb_cols)) & \
+                    self._row_buffer._valid[:self._row_buffer.count]
+                if hit.any():
+                    touched += int(hit.sum())
+                    for name, fn in assignments.items():
+                        ci = self.schema.index(name)
+                        vals = np.asarray(fn(rb_cols))
+                        col = self._row_buffer._cols[ci][:self._row_buffer.count]
+                        new = np.broadcast_to(
+                            np.asarray(vals, dtype=col.dtype), col.shape)[hit] \
+                            if vals.shape == () else vals[hit]
+                        if ci in self._dicts:
+                            # intern so device build can resolve the codes
+                            self._intern_strings(
+                                ci, np.asarray(new, dtype=object))
+                        col[hit] = new
+            self._publish(tuple(new_views))
+            return touched
+
+    def delete(self, predicate) -> int:
+        """DELETE: new delete-mask arrays per batch (ref ColumnDeleteExec →
+        ColumnDeleteDelta bitmap, meta column -3)."""
+        with self._lock:
+            touched = 0
+            new_views = []
+            for view in self._manifest.views:
+                cols = self._decode_all(view)
+                hit = np.asarray(predicate(cols)) & view.live_mask()
+                if not hit.any():
+                    new_views.append(view)
+                    continue
+                touched += int(hit.sum())
+                mask = hit if view.delete_mask is None else (view.delete_mask | hit)
+                new_views.append(dataclasses.replace(view, delete_mask=mask))
+            rb_cols = self._row_buffer_dict()
+            if rb_cols is not None:
+                hit = np.asarray(predicate(rb_cols)) & \
+                    self._row_buffer._valid[:self._row_buffer.count]
+                if hit.any():
+                    touched += int(hit.sum())
+                    self._row_buffer._valid[:self._row_buffer.count][hit] = False
+            self._publish(tuple(new_views))
+            return touched
+
+    def truncate(self) -> None:
+        with self._lock:
+            self._row_buffer.clear()
+            self._publish(())
+
+    # --- helpers ---------------------------------------------------------
+
+    def _decode_all(self, view: BatchView) -> "LazyBatchColumns":
+        """Lazily-decoding column mapping for mutation predicates: only the
+        columns a predicate/assignment actually touches get decoded. String
+        columns decode in CODE domain first (so update deltas — stored as
+        codes — merge correctly), then map through the table dictionary."""
+        return LazyBatchColumns(self, view)
+
+    def _row_buffer_dict(self) -> Optional[Dict[str, np.ndarray]]:
+        if self._row_buffer.count == 0:
+            return None
+        return {f.name: self._row_buffer._cols[i][:self._row_buffer.count]
+                for i, f in enumerate(self.schema.fields)}
+
+    def _to_device_domain(self, col_idx: int, values: np.ndarray,
+                          like: np.ndarray) -> np.ndarray:
+        f = self.schema.fields[col_idx]
+        if f.dtype.name == "string":
+            vals = np.broadcast_to(values, like.shape) if values.shape == () \
+                else values
+            self._intern_strings(col_idx, np.asarray(vals, dtype=object))
+            lookup = self._dict_lookup[col_idx]
+            return np.fromiter((lookup[v] for v in vals), dtype=np.int32,
+                               count=len(vals))
+        dt = f.dtype.device_dtype()
+        if values.shape == ():
+            return np.full(like.shape, values, dtype=dt)
+        return values.astype(dt)
+
+
+class LazyBatchColumns:
+    """dict-like {column name -> decoded host values} that decodes on first
+    access (review finding: eager decode of every column made single-column
+    DELETEs O(num_cols))."""
+
+    def __init__(self, data: "ColumnTableData", view: BatchView):
+        self._data = data
+        self._view = view
+        self._cache: Dict[str, np.ndarray] = {}
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        got = self._cache.get(name)
+        if got is None:
+            i = self._data.schema.index(name)
+            f = self._data.schema.fields[i]
+            if f.dtype.name == "string":
+                codes = self._view.decoded_column(i, strings=False)
+                dictionary = self._data.dictionary(i)
+                if dictionary is None or dictionary.size == 0:
+                    got = np.full(codes.shape, None, dtype=object)
+                else:
+                    got = dictionary[np.clip(codes, 0, dictionary.size - 1)]
+            else:
+                got = self._view.decoded_column(i)
+            self._cache[name] = got
+        return got
+
+    def keys(self):
+        return self._data.schema.names()
+
+
+class RowTableData:
+    """Storage for a ROW table: pure host-RAM rows with optional primary-key
+    hash index for point ops that bypass the XLA engine entirely (ref:
+    ExecutionEngineArbiter routing, docs/architecture/
+    cluster_architecture.md:31-33; row store GemFireContainer rows)."""
+
+    def __init__(self, schema: T.Schema, key_columns: Sequence[str] = ()):
+        self.schema = schema
+        self.key_columns = [k.lower() for k in key_columns]
+        self._key_idx = [schema.index(k) for k in self.key_columns]
+        self._lock = threading.Lock()
+        self._cols: List[List] = [[] for _ in schema.fields]
+        self._live: List[bool] = []
+        self._pk: Dict[tuple, int] = {}
+        self._version = 0
+
+    @property
+    def version(self) -> int:
+        return self._version
+
+    def insert_arrays(self, arrays: Sequence[np.ndarray]) -> int:
+        arrays = [np.asarray(a) for a in arrays]
+        n = int(arrays[0].shape[0])
+        with self._lock:
+            if self._key_idx:
+                # validate the whole batch before touching state so a PK
+                # violation leaves the table unchanged (atomic insert)
+                seen = set()
+                for i in range(n):
+                    key = tuple(arrays[j][i] for j in self._key_idx)
+                    old = self._pk.get(key)
+                    if (old is not None and self._live[old]) or key in seen:
+                        raise ValueError(f"primary key violation: {key}")
+                    seen.add(key)
+            for i in range(n):
+                row = tuple(a[i] for a in arrays)
+                self._append_row(row, upsert=False)
+            self._version += 1
+        return n
+
+    def put_arrays(self, arrays: Sequence[np.ndarray]) -> int:
+        """PUT INTO upsert by primary key (ref: SnappySession.put:2024)."""
+        arrays = [np.asarray(a) for a in arrays]
+        n = int(arrays[0].shape[0])
+        with self._lock:
+            for i in range(n):
+                row = tuple(a[i] for a in arrays)
+                self._append_row(row, upsert=True)
+            self._version += 1
+        return n
+
+    def _append_row(self, row: tuple, upsert: bool) -> None:
+        if self._key_idx:
+            key = tuple(row[i] for i in self._key_idx)
+            old = self._pk.get(key)
+            if old is not None and self._live[old]:
+                if not upsert:
+                    raise ValueError(f"primary key violation: {key}")
+                self._live[old] = False
+            self._pk[key] = len(self._live)
+        for c, v in zip(self._cols, row):
+            c.append(v)
+        self._live.append(True)
+
+    def get(self, key: tuple):
+        """Point lookup — the fast path that never enters the query engine."""
+        ordinal = self._pk.get(tuple(key))
+        if ordinal is None or not self._live[ordinal]:
+            return None
+        return tuple(c[ordinal] for c in self._cols)
+
+    def to_arrays(self) -> Tuple[List[np.ndarray], int]:
+        with self._lock:
+            live = np.array(self._live, dtype=np.bool_)
+            out = []
+            for f, c in zip(self.schema.fields, self._cols):
+                arr = np.array(c, dtype=f.dtype.np_dtype)
+                out.append(arr[live] if len(live) else arr)
+            return out, int(live.sum()) if len(live) else 0
+
+    def update(self, predicate, assignments) -> int:
+        with self._lock:
+            cols = {f.name: np.array(c, dtype=f.dtype.np_dtype)
+                    for f, c in zip(self.schema.fields, self._cols)}
+            if not self._live:
+                return 0
+            hit = np.asarray(predicate(cols)) & np.array(self._live)
+            for name, fn in assignments.items():
+                ci = self.schema.index(name)
+                vals = np.asarray(fn(cols))
+                for ordinal in np.flatnonzero(hit):
+                    v = vals if vals.shape == () else vals[ordinal]
+                    self._cols[ci][ordinal] = v.item() if hasattr(v, "item") else v
+            if self._key_idx and any(self.schema.index(n) in self._key_idx
+                                     for n in assignments):
+                self._rebuild_pk_locked()
+            self._version += 1
+            return int(hit.sum())
+
+    def _rebuild_pk_locked(self) -> None:
+        """Key-column updates invalidate the hash index; rebuild and verify
+        uniqueness (raising restores nothing — callers treat it as a
+        constraint violation surfaced post-hoc, like the reference's row
+        store would on a key change)."""
+        pk: Dict[tuple, int] = {}
+        for ordinal, live in enumerate(self._live):
+            if not live:
+                continue
+            key = tuple(self._cols[i][ordinal] for i in self._key_idx)
+            if key in pk:
+                raise ValueError(f"primary key violation after update: {key}")
+            pk[key] = ordinal
+        self._pk = pk
+
+    def delete(self, predicate) -> int:
+        with self._lock:
+            if not self._live:
+                return 0
+            cols = {f.name: np.array(c, dtype=f.dtype.np_dtype)
+                    for f, c in zip(self.schema.fields, self._cols)}
+            hit = np.asarray(predicate(cols)) & np.array(self._live)
+            for ordinal in np.flatnonzero(hit):
+                self._live[ordinal] = False
+                if self._key_idx:
+                    key = tuple(self._cols[i][ordinal] for i in self._key_idx)
+                    if self._pk.get(key) == ordinal:
+                        del self._pk[key]
+            self._version += 1
+            return int(hit.sum())
+
+    def truncate(self) -> None:
+        with self._lock:
+            self._cols = [[] for _ in self.schema.fields]
+            self._live = []
+            self._pk = {}
+            self._version += 1
+
+    def count(self) -> int:
+        return int(sum(self._live))

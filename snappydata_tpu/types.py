@@ -1,0 +1,178 @@
+"""Data type system.
+
+Covers the SQL surface the reference supports for column/row tables
+(ref: SnappyDDLParser column data types; encoders/.../encoding/
+ColumnEncoding.scala typeId registry :766-774). Physical mapping is
+TPU-first: every type lowers to a fixed-width device dtype; variable-width
+types (STRING/DECIMAL) lower to dictionary codes / scaled integers so the
+hot loops stay vectorized with static shapes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DataType:
+    name: str
+
+    def __str__(self) -> str:  # pragma: no cover - trivial
+        return self.name
+
+    @property
+    def np_dtype(self) -> np.dtype:
+        return _NP[self.name]
+
+    def device_dtype(self) -> np.dtype:
+        """dtype of the decoded on-device representation."""
+        from snappydata_tpu import config
+
+        if self.name == "string":
+            return np.dtype(np.int32)  # dictionary codes
+        if self.name == "decimal":
+            return np.dtype(np.float64 if config.use_float64() else np.float32)
+        if self.name in ("double", "float") and not config.use_float64():
+            return np.dtype(np.float32)
+        return self.np_dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class DecimalType(DataType):
+    precision: int = 38
+    scale: int = 2
+
+    def __str__(self) -> str:  # pragma: no cover - trivial
+        return f"decimal({self.precision},{self.scale})"
+
+
+BOOLEAN = DataType("boolean")
+BYTE = DataType("byte")
+SHORT = DataType("short")
+INT = DataType("int")
+LONG = DataType("long")
+FLOAT = DataType("float")
+DOUBLE = DataType("double")
+STRING = DataType("string")
+DATE = DataType("date")          # int32 days since epoch
+TIMESTAMP = DataType("timestamp")  # int64 microseconds since epoch
+DECIMAL = DecimalType("decimal")
+
+_NP = {
+    "boolean": np.dtype(np.bool_),
+    "byte": np.dtype(np.int8),
+    "short": np.dtype(np.int16),
+    "int": np.dtype(np.int32),
+    "long": np.dtype(np.int64),
+    "float": np.dtype(np.float32),
+    "double": np.dtype(np.float64),
+    "string": np.dtype(object),
+    "date": np.dtype(np.int32),
+    "timestamp": np.dtype(np.int64),
+    "decimal": np.dtype(np.float64),
+}
+
+_BY_NAME = {
+    "boolean": BOOLEAN, "bool": BOOLEAN,
+    "byte": BYTE, "tinyint": BYTE,
+    "short": SHORT, "smallint": SHORT,
+    "int": INT, "integer": INT,
+    "long": LONG, "bigint": LONG,
+    "float": FLOAT, "real": FLOAT,
+    "double": DOUBLE,
+    "string": STRING, "varchar": STRING, "char": STRING, "clob": STRING,
+    "date": DATE,
+    "timestamp": TIMESTAMP,
+    "decimal": DECIMAL, "numeric": DECIMAL,
+}
+
+
+def parse_type(name: str, args: Optional[list] = None) -> DataType:
+    base = _BY_NAME.get(name.lower())
+    if base is None:
+        raise ValueError(f"unknown data type: {name}")
+    if base.name == "decimal" and args:
+        prec = int(args[0])
+        scale = int(args[1]) if len(args) > 1 else 0
+        return DecimalType("decimal", prec, scale)
+    return base
+
+
+def is_numeric(dt: DataType) -> bool:
+    return dt.name in ("byte", "short", "int", "long", "float", "double",
+                       "decimal", "date", "timestamp")
+
+
+def is_integral(dt: DataType) -> bool:
+    return dt.name in ("byte", "short", "int", "long", "date", "timestamp")
+
+
+def is_floating(dt: DataType) -> bool:
+    return dt.name in ("float", "double", "decimal")
+
+
+def common_type(a: DataType, b: DataType) -> DataType:
+    """Numeric type promotion for binary expressions."""
+    if a.name == b.name:
+        return a
+    order = ["boolean", "byte", "short", "int", "date", "long", "timestamp",
+             "float", "decimal", "double"]
+    if a.name in order and b.name in order:
+        return _BY_NAME[max(a.name, b.name, key=order.index)]
+    if STRING in (a, b):
+        return STRING
+    raise TypeError(f"incompatible types: {a} vs {b}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    name: str
+    dtype: DataType
+    nullable: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class Schema:
+    fields: tuple
+
+    def __init__(self, fields):
+        object.__setattr__(self, "fields", tuple(fields))
+
+    def names(self):
+        return [f.name for f in self.fields]
+
+    def field(self, name: str) -> Field:
+        name_l = name.lower()
+        for f in self.fields:
+            if f.name.lower() == name_l:
+                return f
+        raise KeyError(f"no such column: {name}")
+
+    def index(self, name: str) -> int:
+        name_l = name.lower()
+        for i, f in enumerate(self.fields):
+            if f.name.lower() == name_l:
+                return i
+        raise KeyError(f"no such column: {name}")
+
+    def __len__(self):
+        return len(self.fields)
+
+    def __iter__(self):
+        return iter(self.fields)
+
+
+def python_value(dt: DataType, v: Any) -> Any:
+    """Coerce a parsed literal to the column's python/numpy domain."""
+    if v is None:
+        return None
+    if dt.name in ("byte", "short", "int", "long", "date", "timestamp"):
+        return int(v)
+    if dt.name in ("float", "double", "decimal"):
+        return float(v)
+    if dt.name == "boolean":
+        return bool(v)
+    return str(v)

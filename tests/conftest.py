@@ -1,0 +1,32 @@
+"""Test fixture: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's tier-1 strategy (SnappyFunSuite boots a real
+embedded engine in one JVM — no mocks; core/src/test/scala/io/snappydata/
+SnappyFunSuite.scala:51-88): tests run the real engine in-process, with
+multi-"chip" behavior exercised via XLA host devices instead of real TPUs.
+
+Must set env before jax initializes its backend, hence module-level.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture()
+def session():
+    from snappydata_tpu import SnappySession
+
+    s = SnappySession()
+    yield s
+    s.stop()

@@ -1,0 +1,141 @@
+"""Storage format tests (ref analogue: encoders project unit coverage —
+ColumnEncoding/Dictionary/RunLength round-trips, delta merge, delete mask,
+snapshot visibility per ValidateMVCCDUnitTest semantics)."""
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import types as T
+from snappydata_tpu.storage import bitmask
+from snappydata_tpu.storage.encoding import (
+    Encoding, encode_column, decode_to_numpy, decode_validity)
+from snappydata_tpu.storage.table_store import ColumnTableData, RowTableData
+from snappydata_tpu.storage.device import build_device_table
+
+
+def test_bitmask_roundtrip():
+    rng = np.random.default_rng(0)
+    m = rng.random(1000) < 0.3
+    assert (bitmask.unpack(bitmask.pack(m), 1000) == m).all()
+    assert bitmask.popcount(bitmask.pack(m), 1000) == m.sum()
+
+
+def test_plain_roundtrip_and_stats():
+    vals = np.arange(100, dtype=np.int64) * 3
+    col = encode_column(vals, T.LONG)
+    assert col.encoding == Encoding.PLAIN
+    assert (decode_to_numpy(col) == vals).all()
+    assert col.stats.min == 0 and col.stats.max == 297
+    padded = decode_to_numpy(col, capacity=128)
+    assert padded.shape == (128,) and (padded[:100] == vals).all()
+
+
+def test_rle_selected_for_low_cardinality():
+    vals = np.repeat(np.array([5, 9, 5], dtype=np.int32), 200)
+    col = encode_column(vals, T.INT)
+    assert col.encoding == Encoding.RUN_LENGTH
+    assert col.data.shape == (3,)
+    assert (decode_to_numpy(col) == vals).all()
+
+
+def test_dictionary_strings():
+    vals = np.array(["A", "F", "A", "N", "F"], dtype=object)
+    col = encode_column(vals, T.STRING)
+    assert col.encoding == Encoding.DICTIONARY
+    assert (decode_to_numpy(col, strings=True) == vals).all()
+    assert decode_to_numpy(col).dtype == np.int32
+
+
+def test_dictionary_shared_hint():
+    hint = np.array(["N", "A", "F"], dtype=object)
+    vals = np.array(["A", "F", "A"], dtype=object)
+    col = encode_column(vals, T.STRING, dictionary_hint=hint)
+    assert (col.data == np.array([1, 2, 1])).all()
+
+
+def test_boolean_bitset():
+    vals = np.array([True, False, True] * 50)
+    col = encode_column(vals, T.BOOLEAN)
+    assert col.encoding == Encoding.BOOLEAN_BITSET
+    assert (decode_to_numpy(col) == vals).all()
+
+
+def test_nulls():
+    vals = np.array([1.0, 2.0, 3.0, 4.0])
+    validity = np.array([True, False, True, False])
+    col = encode_column(vals, T.DOUBLE, validity)
+    assert col.stats.null_count == 2
+    assert (decode_validity(col) == validity).all()
+
+
+def _make_table(n=1000, capacity=256, max_delta=100):
+    schema = T.Schema([
+        T.Field("k", T.LONG), T.Field("v", T.DOUBLE), T.Field("s", T.STRING)])
+    data = ColumnTableData(schema, capacity=capacity, max_delta_rows=max_delta)
+    rng = np.random.default_rng(1)
+    k = np.arange(n, dtype=np.int64)
+    v = rng.random(n)
+    s = np.array([["x", "y", "z"][i % 3] for i in range(n)], dtype=object)
+    data.insert_arrays([k, v, s])
+    return schema, data, (k, v, s)
+
+
+def test_bulk_insert_cuts_batches():
+    schema, data, (k, v, s) = _make_table()
+    m = data.snapshot()
+    assert m.total_rows() == 1000
+    assert len(m.views) >= 3  # bulk path cut real batches
+    dt = build_device_table(data, m, [0, 1, 2])
+    valid = np.asarray(dt.valid)
+    assert int(valid.sum()) == 1000
+    kk = np.asarray(dt.columns[0])[valid]
+    assert sorted(kk.tolist()) == k.tolist()
+
+
+def test_small_insert_row_buffer_and_rollover():
+    schema = T.Schema([T.Field("a", T.INT)])
+    data = ColumnTableData(schema, capacity=64, max_delta_rows=50)
+    for i in range(4):
+        data.insert_arrays([np.arange(10, dtype=np.int32) + i * 10])
+    m = data.snapshot()
+    assert m.row_count == 40 and len(m.views) == 0
+    data.insert_arrays([np.arange(10, dtype=np.int32) + 40])
+    m = data.snapshot()
+    assert m.row_count == 0 and len(m.views) == 1  # rollover fired at 50
+    assert m.total_rows() == 50
+
+
+def test_update_delete_and_snapshot_isolation():
+    schema, data, (k, v, s) = _make_table()
+    before = data.snapshot()
+    n_upd = data.update(lambda c: c["k"] < 10, {"v": lambda c: c["v"] * 0 + 7.0})
+    assert n_upd == 10
+    n_del = data.delete(lambda c: c["k"] >= 990)
+    assert n_del == 10
+    after = data.snapshot()
+    # old snapshot still sees original data (MVCC)
+    dt_old = build_device_table(data, before, [0, 1])
+    # note: device cache was invalidated by new version; rebuild old is fine
+    valid_old = np.asarray(dt_old.valid)
+    assert int(valid_old.sum()) == 1000
+    dt_new = build_device_table(data, after, [0, 1])
+    valid_new = np.asarray(dt_new.valid)
+    assert int(valid_new.sum()) == 990
+    vv = np.asarray(dt_new.columns[1])
+    kk = np.asarray(dt_new.columns[0])
+    assert (vv[(kk < 10) & valid_new] == 7.0).all()
+
+
+def test_row_table_pk_and_put():
+    schema = T.Schema([T.Field("id", T.INT), T.Field("name", T.STRING)])
+    rt = RowTableData(schema, key_columns=["id"])
+    rt.insert_arrays([np.array([1, 2, 3]), np.array(["a", "b", "c"], dtype=object)])
+    assert rt.get((2,)) == (2, "b")
+    with pytest.raises(ValueError):
+        rt.insert_arrays([np.array([1]), np.array(["dup"], dtype=object)])
+    rt.put_arrays([np.array([2, 4]), np.array(["B", "d"], dtype=object)])
+    assert rt.get((2,)) == (2, "B")
+    assert rt.count() == 4
+    rt.delete(lambda c: c["id"] == 1)
+    assert rt.get((1,)) is None
+    assert rt.count() == 3
