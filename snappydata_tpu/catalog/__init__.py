@@ -1,0 +1,1 @@
+from snappydata_tpu.catalog.catalog import Catalog, TableInfo  # noqa: F401

@@ -1,0 +1,12 @@
+"""Jitted physical operators + plan cache.
+
+The TPU replacement for the reference's whole-stage Janino codegen
+(ColumnTableScan / SnappyHashAggregateExec / HashJoinExec): a resolved
+logical plan compiles to ONE traced JAX function over stacked column-batch
+arrays — scan, filter, project, hash join (sort+searchsorted) and
+aggregation (segment ops) all fuse inside a single XLA executable, cached
+against the tokenized plan + table shape signature.
+"""
+
+from snappydata_tpu.engine.executor import Executor  # noqa: F401
+from snappydata_tpu.engine.result import Result  # noqa: F401

@@ -1,0 +1,654 @@
+"""Expression → traced JAX lowering with three-valued (SQL NULL) logic.
+
+Design notes (TPU-first):
+- Values are (value, null?) pairs; null masks are only materialized when a
+  source is nullable — the common all-non-null path emits zero extra ops.
+- Strings never exist on device: a string column is int32 dictionary codes.
+  Every predicate `str_col OP literal` is evaluated ONCE over the (host)
+  dictionary producing a bool lookup table, shipped as an aux input, and
+  applied as a gather — the device cost is O(rows) regardless of the
+  string operation's complexity (LIKE, <=, IN…). This generalizes the
+  reference's dictionary fast path (DictionaryOptimizedMapAccessor,
+  core/.../execution/DictionaryOptimizedMapAccessor.scala).
+- Tokenized literals (ParamLiteral) arrive as runtime scalars (numeric) or
+  bind-time LUT rebuilds (string), so changing a literal re-runs but never
+  re-compiles (ref plan-cache goal, SnappySession.sqlPlan:2571).
+
+Emission is two-phase: `ExprBuilder.emit` runs structurally (no arrays),
+registering aux-input builders and returning a closure; the closure runs
+inside the jit trace consuming runtime arrays. Builders run at bind time on
+host with the current table dictionaries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from snappydata_tpu import types as T
+from snappydata_tpu.sql import ast
+
+
+class CompileError(Exception):
+    pass
+
+
+@dataclasses.dataclass
+class DVal:
+    """A traced value: device array + optional null mask + static type info."""
+
+    value: object                 # traced jnp array
+    null: object = None           # traced bool array or None
+    dtype: T.DataType = None
+    dictionary: Optional[np.ndarray] = None   # static host dict for strings
+
+    @property
+    def is_string(self) -> bool:
+        return self.dtype is not None and self.dtype.name == "string"
+
+
+def _or_null(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a | b
+
+
+class Runtime:
+    """Runtime arrays handed to emitted closures inside the trace."""
+
+    def __init__(self, cols: Dict[int, DVal], params: Sequence,
+                 aux: Sequence):
+        self.cols = cols
+        self.params = params  # traced scalars, one per tokenized literal
+        self.aux = aux        # traced aux arrays, in registration order
+
+
+class ExprBuilder:
+    """Structural compiler for one scope.
+
+    col_types[i] — dtype of input ordinal i
+    col_nullable[i] — whether ordinal i can produce nulls
+    dict_getters[i] — bind-time callable returning the CURRENT host
+        dictionary for string ordinal i (dictionaries grow with ingest)
+    """
+
+    def __init__(self, col_types: Dict[int, T.DataType],
+                 col_nullable: Dict[int, bool],
+                 dict_getters: Dict[int, Callable[[], np.ndarray]]):
+        self.col_types = col_types
+        self.col_nullable = col_nullable
+        self.dict_getters = dict_getters
+        # aux builders: fn(params: tuple) -> np.ndarray, run at bind time
+        self.aux_builders: List[Callable] = []
+        self.param_dtypes: Dict[int, T.DataType] = {}
+
+    # -- aux registration --------------------------------------------------
+
+    def _register_aux(self, builder: Callable) -> int:
+        self.aux_builders.append(builder)
+        return len(self.aux_builders) - 1
+
+    def _string_pred_lut(self, col_idx: int, fn: Callable[[np.ndarray], np.ndarray]
+                         ) -> int:
+        """Register a bool LUT over the column's dictionary; padded to pow2
+        so dictionary growth rarely changes executable shapes."""
+        getter = self.dict_getters[col_idx]
+
+        def build(params):
+            d = getter()
+            lut = fn(d, params).astype(np.bool_)
+            n = max(1, len(lut))
+            padded = 1 << (n - 1).bit_length()
+            if padded > len(lut):
+                lut = np.concatenate([lut, np.zeros(padded - len(lut),
+                                                    dtype=np.bool_)])
+            return lut
+
+        return self._register_aux(build)
+
+    # -- literals ----------------------------------------------------------
+
+    def _param_value(self, e, params):
+        if isinstance(e, ast.ParamLiteral):
+            return params[e.pos]
+        if isinstance(e, ast.Lit):
+            return e.value
+        raise CompileError("expected literal")
+
+    def _is_literalish(self, e) -> bool:
+        return isinstance(e, (ast.Lit, ast.ParamLiteral))
+
+    # -- main emit ---------------------------------------------------------
+
+    def emit(self, e: ast.Expr) -> Callable[[Runtime], DVal]:
+        if isinstance(e, ast.Alias):
+            return self.emit(e.child)
+
+        if isinstance(e, ast.Col):
+            idx = e.index
+
+            def run_col(rt: Runtime) -> DVal:
+                return rt.cols[idx]
+
+            return run_col
+
+        if isinstance(e, ast.Lit):
+            return self._emit_literal(e.value, e.dtype)
+
+        if isinstance(e, (ast.ParamLiteral, ast.Param)):
+            pos, dtype = e.pos, e.dtype
+            if dtype is not None and dtype.name == "string":
+                # string params only appear inside string predicates, which
+                # are handled by LUTs; a bare string param can't be lowered
+                def run_strparam(rt: Runtime) -> DVal:
+                    raise CompileError(
+                        "string literal outside a dictionary predicate")
+
+                run_strparam.static_param = (pos, dtype)  # marker
+                return run_strparam
+
+            def run_param(rt: Runtime) -> DVal:
+                return DVal(rt.params[pos], None, dtype or T.DOUBLE)
+
+            run_param.static_param = (pos, dtype)
+            return run_param
+
+        if isinstance(e, ast.BinOp):
+            return self._emit_binop(e)
+
+        if isinstance(e, ast.UnaryOp):
+            child = self.emit(e.child)
+            if e.op == "not":
+                def run_not(rt: Runtime) -> DVal:
+                    c = child(rt)
+                    return DVal(~c.value, c.null, T.BOOLEAN)
+
+                return run_not
+
+            def run_neg(rt: Runtime) -> DVal:
+                c = child(rt)
+                return DVal(-c.value, c.null, c.dtype)
+
+            return run_neg
+
+        if isinstance(e, ast.IsNull):
+            child = self.emit(e.child)
+            negated = e.negated
+
+            def run_isnull(rt: Runtime) -> DVal:
+                c = child(rt)
+                null = c.null if c.null is not None else jnp.zeros(
+                    jnp.shape(c.value), dtype=bool)
+                v = ~null if negated else null
+                return DVal(v, None, T.BOOLEAN)
+
+            return run_isnull
+
+        if isinstance(e, ast.Between):
+            lo = ast.BinOp(">=", e.child, e.lo)
+            hi = ast.BinOp("<=", e.child, e.hi)
+            both = ast.BinOp("and", lo, hi)
+            if e.negated:
+                both = ast.UnaryOp("not", both)
+            return self.emit(both)
+
+        if isinstance(e, ast.InList):
+            return self._emit_in(e)
+
+        if isinstance(e, ast.Like):
+            return self._emit_like(e)
+
+        if isinstance(e, ast.Case):
+            return self._emit_case(e)
+
+        if isinstance(e, ast.Cast):
+            return self._emit_cast(e)
+
+        if isinstance(e, ast.Func):
+            return self._emit_func(e)
+
+        raise CompileError(f"cannot lower expression {type(e).__name__}")
+
+    # -- pieces ------------------------------------------------------------
+
+    def _emit_literal(self, value, dtype) -> Callable[[Runtime], DVal]:
+        if value is None:
+            def run_null(rt: Runtime) -> DVal:
+                z = jnp.zeros((), dtype=jnp.float32)
+                return DVal(z, jnp.ones((), dtype=bool), dtype or T.DOUBLE)
+
+            return run_null
+        if dtype is not None and dtype.name == "string":
+            def run_str(rt: Runtime) -> DVal:
+                raise CompileError(
+                    "string literal outside a dictionary predicate")
+
+            run_str.static_str = value
+            return run_str
+        np_dtype = (dtype or (T.DOUBLE if isinstance(value, float)
+                              else T.LONG)).device_dtype()
+        const = np.asarray(value, dtype=np_dtype)
+
+        def run_lit(rt: Runtime) -> DVal:
+            return DVal(jnp.asarray(const), None, dtype or T.LONG)
+
+        return run_lit
+
+    def _string_operand_info(self, e: ast.Expr) -> Optional[int]:
+        """If e is (an alias of) a raw string column, return its ordinal."""
+        if isinstance(e, ast.Alias):
+            return self._string_operand_info(e.child)
+        if isinstance(e, ast.Col) and e.dtype is not None \
+                and e.dtype.name == "string":
+            return e.index
+        return None
+
+    def _emit_binop(self, e: ast.BinOp) -> Callable[[Runtime], DVal]:
+        op = e.op
+        # --- string predicate vs literal → dictionary LUT ---
+        if op in ("=", "!=", "<", "<=", ">", ">="):
+            lcol = self._string_operand_info(e.left)
+            rcol = self._string_operand_info(e.right)
+            if lcol is not None and self._is_literalish(e.right):
+                return self._emit_string_cmp(lcol, op, e.right)
+            if rcol is not None and self._is_literalish(e.left):
+                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+                return self._emit_string_cmp(rcol, flip.get(op, op), e.left)
+            if lcol is not None and rcol is not None:
+                return self._emit_string_colcmp(lcol, rcol, op)
+
+        left = self.emit(e.left)
+        right = self.emit(e.right)
+
+        if op in ("and", "or"):
+            is_and = op == "and"
+
+            def run_logic(rt: Runtime) -> DVal:
+                a, b = left(rt), right(rt)
+                v = (a.value & b.value) if is_and else (a.value | b.value)
+                null = None
+                if a.null is not None or b.null is not None:
+                    an = a.null if a.null is not None else False
+                    bn = b.null if b.null is not None else False
+                    if is_and:  # Kleene: false and null = false
+                        null = (an & bn) | (an & b.value) | (bn & a.value)
+                    else:       # true or null = true
+                        null = (an & bn) | (an & ~b.value) | (bn & ~a.value)
+                    v = v & ~null if is_and else v
+                return DVal(v, null, T.BOOLEAN)
+
+            return run_logic
+
+        fns = {
+            "+": lambda a, b: a + b, "-": lambda a, b: a - b,
+            "*": lambda a, b: a * b, "%": lambda a, b: a % b,
+            "=": lambda a, b: a == b, "!=": lambda a, b: a != b,
+            "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+            ">": lambda a, b: a > b, ">=": lambda a, b: a >= b,
+        }
+        is_cmp = op in ("=", "!=", "<", "<=", ">", ">=")
+        if op == "/":
+            def run_div(rt: Runtime) -> DVal:
+                a, b = left(rt), right(rt)
+                av, bv = a.value, b.value
+                if jnp.issubdtype(jnp.asarray(av).dtype, jnp.integer):
+                    av = av.astype(_float_dtype())
+                if jnp.issubdtype(jnp.asarray(bv).dtype, jnp.integer):
+                    bv = bv.astype(_float_dtype())
+                null = _or_null(a.null, b.null)
+                null = _or_null(null, b.value == 0)
+                safe = jnp.where(b.value == 0, 1, bv)
+                return DVal(av / safe, null, T.DOUBLE)
+
+            return run_div
+
+        fn = fns[op]
+
+        def run_bin(rt: Runtime) -> DVal:
+            a, b = left(rt), right(rt)
+            v = fn(a.value, b.value)
+            dt = T.BOOLEAN if is_cmp else _promote(a.dtype, b.dtype)
+            return DVal(v, _or_null(a.null, b.null), dt)
+
+        return run_bin
+
+    def _emit_string_cmp(self, col_idx: int, op: str, lit_expr
+                         ) -> Callable[[Runtime], DVal]:
+        get_lit = (lambda params: self._param_value(lit_expr, params))
+        ops = {"=": np.equal, "!=": np.not_equal,
+               "<": np.less, "<=": np.less_equal,
+               ">": np.greater, ">=": np.greater_equal}
+        cmp = ops[op]
+        aux_i = self._string_pred_lut(
+            col_idx, lambda d, params: np.array(
+                [v is not None and bool(cmp(v, get_lit(params))) for v in d],
+                dtype=np.bool_) if len(d) else np.zeros(0, np.bool_))
+        return self._lut_runner(col_idx, aux_i)
+
+    def _emit_string_colcmp(self, li: int, ri: int, op: str
+                            ) -> Callable[[Runtime], DVal]:
+        """string col vs string col — same-dictionary equality only (the
+        realistic case: self-comparison or shared table dictionary)."""
+        if op not in ("=", "!="):
+            raise CompileError("ordering between two string columns "
+                               "is not supported on device")
+        lg, rg = self.dict_getters.get(li), self.dict_getters.get(ri)
+        neg = op == "!="
+
+        def run(rt: Runtime) -> DVal:
+            a, b = rt.cols[li], rt.cols[ri]
+            if a.dictionary is not None and b.dictionary is not None and \
+                    a.dictionary is not b.dictionary and \
+                    list(a.dictionary) != list(b.dictionary):
+                raise CompileError("cross-dictionary string comparison "
+                                   "not supported on device")
+            v = (a.value != b.value) if neg else (a.value == b.value)
+            return DVal(v, _or_null(a.null, b.null), T.BOOLEAN)
+
+        return run
+
+    def _lut_runner(self, col_idx: int, aux_i: int) -> Callable[[Runtime], DVal]:
+        def run(rt: Runtime) -> DVal:
+            c = rt.cols[col_idx]
+            lut = rt.aux[aux_i]
+            v = lut[c.value]
+            return DVal(v, c.null, T.BOOLEAN)
+
+        return run
+
+    def _emit_in(self, e: ast.InList) -> Callable[[Runtime], DVal]:
+        col_idx = self._string_operand_info(e.child)
+        if col_idx is not None:
+            getters = [(lambda params, x=v: self._param_value(x, params))
+                       for v in e.values]
+            negated = e.negated
+
+            aux_i = self._string_pred_lut(
+                col_idx,
+                lambda d, params: np.isin(
+                    np.array([x if x is not None else "" for x in d]),
+                    np.array([str(g(params)) for g in getters])))
+            base = self._lut_runner(col_idx, aux_i)
+            if not negated:
+                return base
+
+            def run_negated(rt: Runtime) -> DVal:
+                r = base(rt)
+                return DVal(~r.value, r.null, T.BOOLEAN)
+
+            return run_negated
+
+        child = self.emit(e.child)
+        values = [self.emit(v) for v in e.values]
+        negated = e.negated
+
+        def run_in(rt: Runtime) -> DVal:
+            c = child(rt)
+            acc = None
+            null = c.null
+            for v in values:
+                dv = v(rt)
+                hit = c.value == dv.value
+                null = _or_null(null, dv.null)
+                acc = hit if acc is None else (acc | hit)
+            if negated:
+                acc = ~acc
+            return DVal(acc, null, T.BOOLEAN)
+
+        return run_in
+
+    def _emit_like(self, e: ast.Like) -> Callable[[Runtime], DVal]:
+        col_idx = self._string_operand_info(e.child)
+        if col_idx is None:
+            raise CompileError("LIKE requires a string column")
+        # SQL LIKE: % = any run, _ = any single char
+        regex = re.compile(
+            "^" + re.escape(e.pattern).replace("%", ".*").replace("_", ".")
+            .replace("\\%", "%").replace("\\_", "_") + "$", re.DOTALL)
+        negated = e.negated
+        aux_i = self._string_pred_lut(
+            col_idx, lambda d, params: np.array(
+                [v is not None and regex.match(v) is not None for v in d],
+                dtype=np.bool_))
+        base = self._lut_runner(col_idx, aux_i)
+        if not negated:
+            return base
+
+        def run_neg(rt: Runtime) -> DVal:
+            r = base(rt)
+            return DVal(~r.value, r.null, T.BOOLEAN)
+
+        return run_neg
+
+    def _emit_case(self, e: ast.Case) -> Callable[[Runtime], DVal]:
+        whens = [(self.emit(c), self.emit(v)) for c, v in e.whens]
+        other = self.emit(e.otherwise) if e.otherwise is not None else None
+
+        def run_case(rt: Runtime) -> DVal:
+            branches = [(c(rt), v(rt)) for c, v in whens]
+            if other is not None:
+                out = other(rt)
+                acc_v, acc_n = out.value, out.null
+                dt = out.dtype
+            else:
+                first_v = branches[0][1]
+                acc_v = jnp.zeros_like(first_v.value)
+                acc_n = True  # no branch matched → NULL
+                dt = first_v.dtype
+            for cond, val in reversed(branches):
+                cv = cond.value
+                if cond.null is not None:
+                    cv = cv & ~cond.null
+                acc_v = jnp.where(cv, val.value, acc_v)
+                vn = val.null if val.null is not None else False
+                if acc_n is True:
+                    acc_n_arr = jnp.where(cv, vn, True)
+                    acc_n = acc_n_arr
+                elif acc_n is None and val.null is None:
+                    acc_n = None
+                else:
+                    an = acc_n if acc_n is not None else False
+                    acc_n = jnp.where(cv, vn, an)
+            if acc_n is True:
+                acc_n = jnp.ones(jnp.shape(acc_v), dtype=bool)
+            return DVal(acc_v, acc_n, dt)
+
+        return run_case
+
+    def _emit_cast(self, e: ast.Cast) -> Callable[[Runtime], DVal]:
+        child = self.emit(e.child)
+        to = e.to
+        if to.name == "string":
+            raise CompileError("CAST to string not supported on device")
+        np_dt = to.device_dtype()
+
+        def run_cast(rt: Runtime) -> DVal:
+            c = child(rt)
+            return DVal(c.value.astype(np_dt), c.null, to)
+
+        return run_cast
+
+    def _emit_func(self, e: ast.Func) -> Callable[[Runtime], DVal]:
+        name = e.name
+        if name in ast.AGG_FUNCS:
+            raise CompileError(
+                f"aggregate {name} outside aggregation context")
+        args = [self.emit(a) for a in e.args]
+
+        if name == "coalesce":
+            def run_coalesce(rt: Runtime) -> DVal:
+                vals = [a(rt) for a in args]
+                out = vals[-1]
+                acc_v, acc_n = out.value, out.null
+                for v in reversed(vals[:-1]):
+                    isnull = v.null if v.null is not None else \
+                        jnp.zeros(jnp.shape(v.value), dtype=bool)
+                    acc_v = jnp.where(isnull, acc_v, v.value)
+                    if acc_n is None:
+                        acc_n = None if v.null is None else None
+                    else:
+                        acc_n = isnull & acc_n
+                    if v.null is None:
+                        acc_n = None
+                return DVal(acc_v, acc_n, vals[0].dtype)
+
+            return run_coalesce
+
+        if name == "abs":
+            return self._unary_math(args[0], jnp.abs, keep_type=True)
+        if name == "sqrt":
+            return self._unary_math(args[0], lambda x: jnp.sqrt(
+                x.astype(_float_dtype())))
+        if name in ("ln", "log"):
+            return self._unary_math(args[0], lambda x: jnp.log(
+                x.astype(_float_dtype())))
+        if name == "exp":
+            return self._unary_math(args[0], lambda x: jnp.exp(
+                x.astype(_float_dtype())))
+        if name == "round":
+            digits = 0
+            if len(e.args) == 2 and isinstance(e.args[1], ast.Lit):
+                digits = int(e.args[1].value)
+            mult = 10.0 ** digits
+
+            def run_round(rt: Runtime) -> DVal:
+                c = args[0](rt)
+                return DVal(jnp.round(c.value * mult) / mult, c.null, c.dtype)
+
+            return run_round
+        if name in ("pow", "power"):
+            def run_pow(rt: Runtime) -> DVal:
+                a, b = args[0](rt), args[1](rt)
+                return DVal(jnp.power(a.value.astype(_float_dtype()),
+                                      b.value),
+                            _or_null(a.null, b.null), T.DOUBLE)
+
+            return run_pow
+
+        if name in ("year", "month", "day"):
+            part = name
+
+            def run_datepart(rt: Runtime) -> DVal:
+                c = args[0](rt)
+                days = c.value
+                if c.dtype is not None and c.dtype.name == "timestamp":
+                    days = (c.value // 86_400_000_000).astype(jnp.int32)
+                y, m, d = _civil_from_days(days)
+                out = {"year": y, "month": m, "day": d}[part]
+                return DVal(out, c.null, T.INT)
+
+            return run_datepart
+
+        # string functions via derived dictionaries
+        col_idx = self._string_operand_info(e.args[0]) if e.args else None
+        if col_idx is not None and name in ("upper", "lower", "trim",
+                                            "ltrim", "rtrim", "substr",
+                                            "substring", "length"):
+            return self._emit_string_func(e, col_idx)
+
+        raise CompileError(f"unsupported function on device: {name}")
+
+    def _unary_math(self, arg, fn, keep_type=False):
+        def run(rt: Runtime) -> DVal:
+            c = arg(rt)
+            return DVal(fn(c.value), c.null,
+                        c.dtype if keep_type else T.DOUBLE)
+
+        return run
+
+    def _emit_string_func(self, e: ast.Func, col_idx: int
+                          ) -> Callable[[Runtime], DVal]:
+        name = e.name
+        getter = self.dict_getters[col_idx]
+
+        if name == "length":
+            def build_len(params):
+                d = getter()
+                lut = np.array([len(v) if v is not None else 0 for v in d],
+                               dtype=np.int32)
+                n = max(1, len(lut))
+                padded = 1 << (n - 1).bit_length()
+                if padded > len(lut):
+                    lut = np.concatenate([lut, np.zeros(padded - len(lut),
+                                                        np.int32)])
+                return lut
+
+            aux_i = self._register_aux(build_len)
+
+            def run_len(rt: Runtime) -> DVal:
+                c = rt.cols[col_idx]
+                return DVal(rt.aux[aux_i][c.value], c.null, T.INT)
+
+            return run_len
+
+        # value-to-value string transforms: derived dictionary, same codes
+        extra = [a.value if isinstance(a, ast.Lit) else None
+                 for a in e.args[1:]]
+
+        def transform(v: str):
+            if v is None:
+                return None
+            if name == "upper":
+                return v.upper()
+            if name == "lower":
+                return v.lower()
+            if name == "trim":
+                return v.strip()
+            if name == "ltrim":
+                return v.lstrip()
+            if name == "rtrim":
+                return v.rstrip()
+            if name in ("substr", "substring"):
+                start = int(extra[0]) - 1 if extra and extra[0] is not None else 0
+                ln = int(extra[1]) if len(extra) > 1 and extra[1] is not None \
+                    else None
+                return v[start:start + ln] if ln is not None else v[start:]
+            raise CompileError(name)
+
+        def run_strfn(rt: Runtime) -> DVal:
+            c = rt.cols[col_idx]
+            d = getter()
+            derived = np.array([transform(v) for v in d], dtype=object)
+            return DVal(c.value, c.null, T.STRING, dictionary=derived)
+
+        return run_strfn
+
+
+def _promote(a: Optional[T.DataType], b: Optional[T.DataType]) -> T.DataType:
+    if a is None:
+        return b or T.DOUBLE
+    if b is None:
+        return a
+    try:
+        return T.common_type(a, b)
+    except TypeError:
+        return a
+
+
+def _float_dtype():
+    from snappydata_tpu import config
+
+    return jnp.float64 if config.use_float64() else jnp.float32
+
+
+def _civil_from_days(days):
+    """Days-since-epoch → (year, month, day), vectorized integer math
+    (Howard Hinnant's civil_from_days, public-domain algorithm)."""
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)

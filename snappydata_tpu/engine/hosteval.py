@@ -1,0 +1,668 @@
+"""Host (numpy/pandas) expression & plan evaluation.
+
+Three jobs:
+1. Post-ops over small materialized results (HAVING / ORDER BY / LIMIT /
+   DISTINCT / outer projects) — the reference does the same driver-side
+   (CollectAggregateExec, ExistingPlans.scala:106; executeTake,
+   CachedDataFrame.scala:766).
+2. Full-plan fallback when device lowering hits an unsupported construct
+   (ref: CodegenSparkFallback.scala:33-88 retries with the vanilla path).
+3. Mutation predicates/assignments over decoded host columns (UPDATE/
+   DELETE run host-side; they are OLTP-sized by design, §3.3).
+"""
+
+from __future__ import annotations
+
+import datetime
+import re
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from snappydata_tpu import types as T
+from snappydata_tpu.sql import ast
+from snappydata_tpu.sql.analyzer import expr_type, _expr_name
+
+
+class HostEvalError(Exception):
+    pass
+
+
+# --------------------------------------------------------------------------
+# Expression evaluation: (values, nullmask) over host arrays
+# --------------------------------------------------------------------------
+
+def eval_expr(e: ast.Expr, cols: Sequence[np.ndarray],
+              nulls: Sequence[Optional[np.ndarray]], params: Tuple,
+              n: int) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    if isinstance(e, ast.Alias):
+        return eval_expr(e.child, cols, nulls, params, n)
+    if isinstance(e, ast.Col):
+        return cols[e.index], nulls[e.index]
+    if isinstance(e, ast.Lit):
+        if e.value is None:
+            return np.zeros(n), np.ones(n, dtype=bool)
+        return np.broadcast_to(np.asarray(e.value), (n,)), None
+    if isinstance(e, (ast.ParamLiteral, ast.Param)):
+        v = params[e.pos]
+        if v is None:
+            return np.zeros(n), np.ones(n, dtype=bool)
+        return np.broadcast_to(np.asarray(v), (n,)), None
+    if isinstance(e, ast.Cast):
+        v, nl = eval_expr(e.child, cols, nulls, params, n)
+        if e.to.name == "string":
+            return np.asarray([_to_str(x) for x in v], dtype=object), nl
+        return np.asarray(v).astype(e.to.np_dtype), nl
+    if isinstance(e, ast.UnaryOp):
+        v, nl = eval_expr(e.child, cols, nulls, params, n)
+        if e.op == "not":
+            return ~v.astype(bool), nl
+        return -v, nl
+    if isinstance(e, ast.IsNull):
+        v, nl = eval_expr(e.child, cols, nulls, params, n)
+        isn = nl if nl is not None else np.zeros(n, dtype=bool)
+        if v.dtype == object:
+            isn = isn | np.array([x is None for x in v])
+        return (~isn if e.negated else isn), None
+    if isinstance(e, ast.Between):
+        return eval_expr(_between_to_and(e), cols, nulls, params, n)
+    if isinstance(e, ast.InList):
+        v, nl = eval_expr(e.child, cols, nulls, params, n)
+        acc = np.zeros(n, dtype=bool)
+        for val in e.values:
+            vv, vn = eval_expr(val, cols, nulls, params, n)
+            acc |= _safe_cmp(v, vv, "=")
+        if e.negated:
+            acc = ~acc
+        return acc, nl
+    if isinstance(e, ast.Like):
+        v, nl = eval_expr(e.child, cols, nulls, params, n)
+        regex = re.compile(
+            "^" + re.escape(e.pattern).replace("%", ".*").replace("_", ".")
+            + "$", re.DOTALL)
+        hit = np.array([x is not None and regex.match(str(x)) is not None
+                        for x in v])
+        if e.negated:
+            hit = ~hit
+        return hit, nl
+    if isinstance(e, ast.Case):
+        out_v = None
+        out_n = np.ones(n, dtype=bool)
+        if e.otherwise is not None:
+            out_v, out_n = eval_expr(e.otherwise, cols, nulls, params, n)
+            out_v = np.array(out_v, copy=True)
+            out_n = np.array(out_n, copy=True) if out_n is not None \
+                else np.zeros(n, dtype=bool)
+        done = np.zeros(n, dtype=bool)
+        branches = []
+        for c, val in e.whens:
+            cv, cn = eval_expr(c, cols, nulls, params, n)
+            take = cv.astype(bool) & ~done
+            if cn is not None:
+                take &= ~cn
+            vv, vn = eval_expr(val, cols, nulls, params, n)
+            branches.append((take, vv, vn))
+            done |= take
+        if out_v is None:
+            proto = branches[0][1] if branches else np.zeros(n)
+            out_v = np.zeros(n, dtype=proto.dtype if proto.dtype != object
+                             else object)
+            out_n = np.ones(n, dtype=bool)
+        for take, vv, vn in branches:
+            out_v[take] = np.broadcast_to(vv, (n,))[take]
+            out_n[take] = (np.broadcast_to(vn, (n,))[take]
+                           if vn is not None else False)
+        return out_v, out_n
+    if isinstance(e, ast.BinOp):
+        return _eval_binop(e, cols, nulls, params, n)
+    if isinstance(e, ast.Func):
+        return _eval_func(e, cols, nulls, params, n)
+    raise HostEvalError(f"cannot evaluate {type(e).__name__} on host")
+
+
+def _between_to_and(e: ast.Between) -> ast.Expr:
+    both = ast.BinOp("and", ast.BinOp(">=", e.child, e.lo),
+                     ast.BinOp("<=", e.child, e.hi))
+    return ast.UnaryOp("not", both) if e.negated else both
+
+
+def _safe_cmp(a, b, op):
+    if a.dtype == object or (hasattr(b, "dtype") and b.dtype == object):
+        a_l = [x if x is not None else "" for x in np.broadcast_to(a, a.shape)]
+        b_arr = np.broadcast_to(b, a.shape)
+        b_l = [x if x is not None else "" for x in b_arr]
+        pairs = zip(a_l, b_l)
+        fn = {"=": lambda x, y: x == y, "!=": lambda x, y: x != y,
+              "<": lambda x, y: x < y, "<=": lambda x, y: x <= y,
+              ">": lambda x, y: x > y, ">=": lambda x, y: x >= y}[op]
+        return np.array([fn(str(x), str(y)) for x, y in pairs])
+    fn = {"=": np.equal, "!=": np.not_equal, "<": np.less,
+          "<=": np.less_equal, ">": np.greater, ">=": np.greater_equal}[op]
+    return fn(a, b)
+
+
+def _eval_binop(e: ast.BinOp, cols, nulls, params, n):
+    a, an = eval_expr(e.left, cols, nulls, params, n)
+    b, bn = eval_expr(e.right, cols, nulls, params, n)
+    nl = _or_null(an, bn)
+    op = e.op
+    if op == "and":
+        av, bv = a.astype(bool), b.astype(bool)
+        v = av & bv
+        if nl is not None:
+            anx = an if an is not None else np.zeros(n, bool)
+            bnx = bn if bn is not None else np.zeros(n, bool)
+            nl = (anx & bnx) | (anx & bv) | (bnx & av)
+            v = v & ~nl
+        return v, nl
+    if op == "or":
+        av, bv = a.astype(bool), b.astype(bool)
+        v = av | bv
+        if nl is not None:
+            anx = an if an is not None else np.zeros(n, bool)
+            bnx = bn if bn is not None else np.zeros(n, bool)
+            nl = (anx & bnx) | (anx & ~bv) | (bnx & ~av)
+        return v, nl
+    if op in ("=", "!=", "<", "<=", ">", ">="):
+        return _safe_cmp(np.broadcast_to(a, (n,)),
+                         np.broadcast_to(b, (n,)), op), nl
+    if op == "/":
+        af = a.astype(np.float64)
+        bf = b.astype(np.float64)
+        zero = bf == 0
+        nl = _or_null(nl, zero if zero.any() else None)
+        return af / np.where(zero, 1, bf), nl
+    fn = {"+": np.add, "-": np.subtract, "*": np.multiply,
+          "%": np.mod}[op]
+    return fn(a, b), nl
+
+
+def _eval_func(e: ast.Func, cols, nulls, params, n):
+    name = e.name
+    args = [eval_expr(a, cols, nulls, params, n) for a in e.args]
+    if name == "coalesce":
+        out_v = np.array(np.broadcast_to(args[-1][0], (n,)), copy=True)
+        out_n = args[-1][1]
+        out_n = np.array(np.broadcast_to(out_n, (n,)), copy=True) \
+            if out_n is not None else np.zeros(n, dtype=bool)
+        for v, nl in reversed(args[:-1]):
+            use = ~nl if nl is not None else np.ones(n, dtype=bool)
+            out_v[use] = np.broadcast_to(v, (n,))[use]
+            out_n[use] = False
+        return out_v, (out_n if out_n.any() else None)
+    if name == "abs":
+        return np.abs(args[0][0]), args[0][1]
+    if name in ("sqrt", "exp", "ln", "log"):
+        fn = {"sqrt": np.sqrt, "exp": np.exp, "ln": np.log,
+              "log": np.log}[name]
+        return fn(args[0][0].astype(np.float64)), args[0][1]
+    if name == "round":
+        digits = int(e.args[1].value) if len(e.args) > 1 and \
+            isinstance(e.args[1], ast.Lit) else 0
+        return np.round(args[0][0].astype(np.float64), digits), args[0][1]
+    if name in ("pow", "power"):
+        return np.power(args[0][0].astype(np.float64), args[1][0]), \
+            _or_null(args[0][1], args[1][1])
+    if name in ("year", "month", "day"):
+        v, nl = args[0]
+        dt_in = expr_type(e.args[0])
+        days = (v // 86_400_000_000).astype(np.int64) \
+            if dt_in.name == "timestamp" else v.astype(np.int64)
+        dates = np.array([datetime.date.fromordinal(
+            int(d) + datetime.date(1970, 1, 1).toordinal()) for d in days])
+        part = np.array([getattr(d, name) for d in dates], dtype=np.int32)
+        return part, nl
+    if name in ("upper", "lower", "trim", "ltrim", "rtrim"):
+        fn = {"upper": str.upper, "lower": str.lower, "trim": str.strip,
+              "ltrim": str.lstrip, "rtrim": str.rstrip}[name]
+        v, nl = args[0]
+        return np.array([fn(str(x)) if x is not None else None for x in v],
+                        dtype=object), nl
+    if name in ("substr", "substring"):
+        v, nl = args[0]
+        start = int(np.asarray(args[1][0]).flat[0]) - 1 if len(args) > 1 else 0
+        ln = int(np.asarray(args[2][0]).flat[0]) if len(args) > 2 else None
+        def sub(x):
+            if x is None:
+                return None
+            s = str(x)
+            return s[start:start + ln] if ln is not None else s[start:]
+        return np.array([sub(x) for x in v], dtype=object), nl
+    if name == "length":
+        v, nl = args[0]
+        return np.array([len(str(x)) if x is not None else 0 for x in v],
+                        dtype=np.int32), nl
+    if name == "concat":
+        vs = [np.broadcast_to(a[0], (n,)) for a in args]
+        nl = None
+        for a in args:
+            nl = _or_null(nl, a[1])
+        return np.array(["".join(str(x) for x in row)
+                         for row in zip(*vs)], dtype=object), nl
+    raise HostEvalError(f"unsupported host function {name}")
+
+
+def _to_str(x):
+    return None if x is None else str(x)
+
+
+def _or_null(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a | b
+
+
+# --------------------------------------------------------------------------
+# Result-level ops
+# --------------------------------------------------------------------------
+
+from snappydata_tpu.engine.result import Result  # noqa: E402
+
+
+def limit(result: Result, k: int) -> Result:
+    return Result(result.names,
+                  [c[:k] for c in result.columns],
+                  [nm[:k] if nm is not None else None for nm in result.nulls],
+                  result.dtypes)
+
+
+def distinct(result: Result) -> Result:
+    seen = set()
+    keep = []
+    for i, row in enumerate(result.rows()):
+        if row not in seen:
+            seen.add(row)
+            keep.append(i)
+    idx = np.array(keep, dtype=np.int64)
+    return _take(result, idx)
+
+
+def _take(result: Result, idx: np.ndarray) -> Result:
+    return Result(result.names,
+                  [c[idx] for c in result.columns],
+                  [nm[idx] if nm is not None else None for nm in result.nulls],
+                  result.dtypes)
+
+
+def sort(result: Result, orders, params) -> Result:
+    n = result.num_rows
+    if n == 0:
+        return result
+    keys = []
+    for e, asc in reversed(list(orders)):
+        v, nl = eval_expr(e, result.columns, result.nulls, params, n)
+        v = np.broadcast_to(v, (n,))
+        isnull = np.broadcast_to(nl, (n,)).copy() if nl is not None \
+            else np.zeros(n, dtype=bool)
+        if v.dtype == object:
+            isnull = isnull | np.array([x is None for x in v])
+            v = np.array([("" if x is None else str(x)) for x in v])
+        if not asc:
+            if v.dtype.kind in "OUS":
+                # lexsort is ascending-only: invert via rank
+                order_idx = np.argsort(v, kind="stable")
+                rank = np.empty(n, dtype=np.int64)
+                rank[order_idx] = np.arange(n)
+                v = -rank
+            else:
+                v = -v
+        keys.append(v)
+        # Spark semantics: ASC → NULLS FIRST, DESC → NULLS LAST; in both
+        # cases nulls carry indicator 0(first)/1(last) sorted ascending
+        keys.append(~isnull if asc else isnull.astype(np.int8))
+    idx = np.lexsort(keys) if keys else np.arange(n)
+    return _take(result, idx)
+
+
+def filter_result(result: Result, cond: ast.Expr, params) -> Result:
+    n = result.num_rows
+    v, nl = eval_expr(cond, result.columns, result.nulls, params, n)
+    keep = np.broadcast_to(v, (n,)).astype(bool)
+    if nl is not None:
+        keep = keep & ~nl
+    return _take(result, np.nonzero(keep)[0])
+
+
+def project_result(result: Result, exprs, params) -> Result:
+    n = result.num_rows
+    names, cols, nulls, dtypes = [], [], [], []
+    for e in exprs:
+        v, nl = eval_expr(e, result.columns, result.nulls, params, n)
+        names.append(_expr_name(e))
+        cols.append(np.broadcast_to(v, (n,)))
+        nulls.append(np.broadcast_to(nl, (n,)) if nl is not None else None)
+        dtypes.append(expr_type(e))
+    return Result(names, cols, nulls, dtypes)
+
+
+def union(a: Result, b: Result) -> Result:
+    cols = []
+    nulls = []
+    for i in range(len(a.columns)):
+        ca, cb = a.columns[i], b.columns[i]
+        if ca.dtype != cb.dtype:
+            ca = ca.astype(object)
+            cb = cb.astype(object)
+        cols.append(np.concatenate([ca, cb]))
+        na = a.nulls[i] if a.nulls[i] is not None else np.zeros(
+            a.num_rows, dtype=bool)
+        nb = b.nulls[i] if b.nulls[i] is not None else np.zeros(
+            b.num_rows, dtype=bool)
+        merged = np.concatenate([na, nb])
+        nulls.append(merged if merged.any() else None)
+    return Result(a.names, cols, nulls, a.dtypes)
+
+
+def eval_values(node: ast.Values, params) -> Result:
+    nrows = len(node.rows)
+    ncols = len(node.rows[0])
+    names = [f"col{i + 1}" for i in range(ncols)]
+    cols, nulls, dtypes = [], [], []
+    for c in range(ncols):
+        vals = []
+        nmask = np.zeros(nrows, dtype=bool)
+        dt = expr_type(node.rows[0][c])
+        for r in range(nrows):
+            e = node.rows[r][c]
+            if isinstance(e, (ast.ParamLiteral, ast.Param)):
+                v = params[e.pos]
+            elif isinstance(e, ast.Lit):
+                v = e.value
+            else:
+                v, nl = eval_expr(e, [], [], params, 1)
+                v = v[0]
+            if v is None:
+                nmask[r] = True
+                vals.append(None)
+            else:
+                vals.append(v)
+        if dt.name == "string":
+            arr = np.array(vals, dtype=object)
+        else:
+            arr = np.array([0 if v is None else v for v in vals],
+                           dtype=dt.np_dtype)
+        cols.append(arr)
+        nulls.append(nmask if nmask.any() else None)
+        dtypes.append(dt)
+    return Result(names, cols, nulls, dtypes)
+
+
+# --------------------------------------------------------------------------
+# Full-plan host fallback (pandas-based relational interpreter)
+# --------------------------------------------------------------------------
+
+def eval_plan(plan: ast.Plan, params, executor) -> Result:
+    cols, nulls, names, dtypes, n = _eval_rel(plan, params, executor)
+    return Result(names, cols, nulls, dtypes)
+
+
+def _eval_rel(plan: ast.Plan, params, executor):
+    """Returns (cols, nulls, names, dtypes, n) with host arrays."""
+    if isinstance(plan, ast.Relation):
+        info = executor.catalog.lookup_table(plan.name)
+        from snappydata_tpu.storage.table_store import RowTableData
+
+        if isinstance(info.data, RowTableData):
+            arrays, cnt = info.data.to_arrays()
+            cols = [np.asarray(a) for a in arrays]
+        else:
+            m = info.data.snapshot()
+            chunks: List[List[np.ndarray]] = [[] for _ in info.schema.fields]
+            for view in m.views:
+                live = view.live_mask()
+                lazy = info.data._decode_all(view)
+                for i, f in enumerate(info.schema.fields):
+                    chunks[i].append(lazy[f.name][live])
+            if m.row_count:
+                for i, f in enumerate(info.schema.fields):
+                    chunks[i].append(np.asarray(m.row_arrays[i]))
+            cols = [np.concatenate(ch) if ch else
+                    np.empty(0, dtype=f.dtype.np_dtype)
+                    for ch, f in zip(chunks, info.schema.fields)]
+        n = int(cols[0].shape[0]) if cols else 0
+        names = info.schema.names()
+        dtypes = [f.dtype for f in info.schema.fields]
+        return cols, [None] * len(cols), names, dtypes, n
+
+    if isinstance(plan, ast.SubqueryAlias):
+        return _eval_rel(plan.child, params, executor)
+
+    if isinstance(plan, ast.Filter):
+        cols, nulls, names, dtypes, n = _eval_rel(plan.child, params, executor)
+        v, nl = eval_expr(plan.condition, cols, nulls, params, n)
+        keep = np.broadcast_to(v, (n,)).astype(bool)
+        if nl is not None:
+            keep &= ~nl
+        idx = np.nonzero(keep)[0]
+        return ([c[idx] for c in cols],
+                [nm[idx] if nm is not None else None for nm in nulls],
+                names, dtypes, len(idx))
+
+    if isinstance(plan, ast.Project):
+        cols, nulls, names, dtypes, n = _eval_rel(plan.child, params, executor)
+        out_c, out_n, out_names, out_t = [], [], [], []
+        for e in plan.exprs:
+            v, nl = eval_expr(e, cols, nulls, params, n)
+            out_c.append(np.broadcast_to(v, (n,)))
+            out_n.append(np.broadcast_to(nl, (n,)) if nl is not None else None)
+            out_names.append(_expr_name(e))
+            out_t.append(expr_type(e))
+        return out_c, out_n, out_names, out_t, n
+
+    if isinstance(plan, ast.Join):
+        return _eval_join(plan, params, executor)
+
+    if isinstance(plan, ast.Aggregate):
+        return _eval_aggregate(plan, params, executor)
+
+    if isinstance(plan, (ast.Sort, ast.Limit, ast.Distinct, ast.Union,
+                         ast.Values)):
+        r = executor.execute(plan, params)
+        return r.columns, r.nulls, r.names, r.dtypes, r.num_rows
+
+    raise HostEvalError(f"host fallback: {type(plan).__name__}")
+
+
+def _eval_join(plan: ast.Join, params, executor):
+    import pandas as pd
+
+    lc, ln, lnames, lt, nl_ = _eval_rel(plan.left, params, executor)
+    rc, rn, rnames, rt, nr_ = _eval_rel(plan.right, params, executor)
+    ldf = pd.DataFrame({f"l{i}": c for i, c in enumerate(lc)})
+    rdf = pd.DataFrame({f"r{i}": c for i, c in enumerate(rc)})
+    nleft = len(lc)
+
+    equi = []
+    residual = None
+
+    def flatten(e):
+        nonlocal residual
+        if e is None:
+            return
+        if isinstance(e, ast.BinOp) and e.op == "and":
+            flatten(e.left)
+            flatten(e.right)
+            return
+        if isinstance(e, ast.BinOp) and e.op == "=" \
+                and isinstance(e.left, ast.Col) and isinstance(e.right, ast.Col):
+            li, ri = e.left.index, e.right.index
+            if li < nleft <= ri:
+                equi.append((li, ri - nleft))
+                return
+            if ri < nleft <= li:
+                equi.append((ri, li - nleft))
+                return
+        residual = e if residual is None else ast.BinOp("and", residual, e)
+
+    flatten(plan.condition)
+    how = {"inner": "inner", "left": "left", "right": "right",
+           "full": "outer", "cross": "cross"}.get(plan.how)
+    if how is None:  # semi/anti
+        lk = [f"l{i}" for i, _ in equi]
+        rk = [f"r{j}" for _, j in equi]
+        merged = ldf.merge(rdf[rk].drop_duplicates(), left_on=lk,
+                           right_on=rk, how="left", indicator=True)
+        hit = merged["_merge"] == "both"
+        keep = hit if plan.how == "semi" else ~hit
+        idx = np.nonzero(keep.to_numpy())[0]
+        return ([c[idx] for c in lc],
+                [nm[idx] if nm is not None else None for nm in ln],
+                lnames, lt, len(idx))
+    if how == "cross":
+        merged = ldf.merge(rdf, how="cross")
+    else:
+        merged = ldf.merge(rdf, left_on=[f"l{i}" for i, _ in equi],
+                           right_on=[f"r{j}" for _, j in equi], how=how)
+    n = len(merged)
+    cols, nulls = [], []
+    for i, dt in enumerate(lt):
+        s = merged[f"l{i}"]
+        cols.append(_from_pandas(s, dt))
+        nulls.append(s.isna().to_numpy() if s.isna().any() else None)
+    for j, dt in enumerate(rt):
+        s = merged[f"r{j}"]
+        cols.append(_from_pandas(s, dt))
+        nulls.append(s.isna().to_numpy() if s.isna().any() else None)
+    names = lnames + rnames
+    dtypes = lt + rt
+    res_cols, res_nulls, res_n = cols, nulls, n
+    if residual is not None:
+        v, nl2 = eval_expr(residual, cols, nulls, params, n)
+        keep = np.broadcast_to(v, (n,)).astype(bool)
+        if nl2 is not None:
+            keep &= ~nl2
+        idx = np.nonzero(keep)[0]
+        res_cols = [c[idx] for c in cols]
+        res_nulls = [nm[idx] if nm is not None else None for nm in nulls]
+        res_n = len(idx)
+    return res_cols, res_nulls, names, dtypes, res_n
+
+
+def _from_pandas(s, dt):
+    if dt.name == "string":
+        return s.astype(object).where(~s.isna(), None).to_numpy(dtype=object)
+    arr = s.to_numpy()
+    if arr.dtype == object or np.issubdtype(arr.dtype, np.floating):
+        filled = np.where(s.isna().to_numpy(), 0, arr)
+        try:
+            return filled.astype(dt.np_dtype)
+        except (ValueError, TypeError):
+            return filled
+    return arr
+
+
+def _eval_aggregate(plan: ast.Aggregate, params, executor):
+    import pandas as pd
+
+    cols, nulls, names, dtypes, n = _eval_rel(plan.child, params, executor)
+
+    groups = list(plan.group_exprs)
+    gvals = []
+    for g in groups:
+        v, nl = eval_expr(g, cols, nulls, params, n)
+        v = np.broadcast_to(v, (n,))
+        gvals.append(np.array([None if (nl is not None and nl[i]) else
+                               (v[i] if v.dtype != object else v[i])
+                               for i in range(n)], dtype=object)
+                     if nl is not None else v)
+
+    if groups:
+        df = pd.DataFrame({f"g{i}": g for i, g in enumerate(gvals)})
+        grouped = df.groupby([f"g{i}" for i in range(len(groups))],
+                             sort=True, dropna=False)
+        group_indices = [idx.to_numpy() if hasattr(idx, "to_numpy")
+                         else np.asarray(idx)
+                         for _, idx in grouped.indices.items()]
+        group_keys = list(grouped.indices.keys())
+        if len(groups) == 1:
+            group_keys = [(k,) for k in group_keys]
+    else:
+        group_indices = [np.arange(n)]
+        group_keys = [()]
+
+    out_names, out_cols, out_nulls, out_types = [], [], [], []
+    for e in plan.agg_exprs:
+        out_names.append(_expr_name(e))
+        out_types.append(expr_type(e))
+        vals, nmask = [], []
+        for key, idx in zip(group_keys, group_indices):
+            v = _agg_one(e, key, groups, idx, cols, nulls, params, n)
+            nmask.append(v is None)
+            vals.append(v)
+        dt = out_types[-1]
+        if dt.name == "string":
+            arr = np.array(vals, dtype=object)
+        else:
+            arr = np.array([0 if v is None else v for v in vals],
+                           dtype=dt.np_dtype if dt.name != "decimal"
+                           else np.float64)
+        out_cols.append(arr)
+        nm = np.array(nmask)
+        out_nulls.append(nm if nm.any() else None)
+    return out_cols, out_nulls, out_names, out_types, len(group_indices)
+
+
+def _agg_one(e: ast.Expr, key, groups, idx, cols, nulls, params, n):
+    """Evaluate one select-list expression for one group (host, exact)."""
+    if isinstance(e, ast.Alias):
+        return _agg_one(e.child, key, groups, idx, cols, nulls, params, n)
+    for gi, g in enumerate(groups):
+        if e == g:
+            return key[gi]
+    if isinstance(e, ast.Func) and e.name in ast.AGG_FUNCS:
+        if e.name == "count" and not e.args:
+            return len(idx)
+        v, nl = eval_expr(e.args[0], cols, nulls, params, n)
+        v = np.broadcast_to(v, (n,))[idx]
+        if nl is not None:
+            keep = ~np.broadcast_to(nl, (n,))[idx]
+            v = v[keep]
+        if v.dtype == object:
+            v = np.array([x for x in v if x is not None], dtype=object)
+        if len(v) == 0:
+            return 0 if e.name.startswith("count") else None
+        if e.name == "count":
+            return len(v)
+        if e.name == "count_distinct":
+            return len(set(v.tolist()))
+        if e.name == "approx_count_distinct":
+            return len(set(v.tolist()))
+        if e.name == "sum":
+            return v.sum()
+        if e.name == "avg":
+            return v.astype(np.float64).mean() if v.dtype != object else None
+        if e.name == "min" or e.name == "first":
+            return v.min() if v.dtype != object else min(v.tolist())
+        if e.name == "max" or e.name == "last":
+            return v.max() if v.dtype != object else max(v.tolist())
+        if e.name == "stddev":
+            return float(np.std(v.astype(np.float64)))
+        if e.name == "variance":
+            return float(np.var(v.astype(np.float64)))
+        raise HostEvalError(e.name)
+    if isinstance(e, ast.Lit):
+        return e.value
+    if isinstance(e, (ast.ParamLiteral, ast.Param)):
+        return params[e.pos]
+    if isinstance(e, ast.BinOp):
+        a = _agg_one(e.left, key, groups, idx, cols, nulls, params, n)
+        b = _agg_one(e.right, key, groups, idx, cols, nulls, params, n)
+        if a is None or b is None:
+            return None
+        return {"+": lambda: a + b, "-": lambda: a - b, "*": lambda: a * b,
+                "/": lambda: a / b if b else None,
+                "%": lambda: a % b}[e.op]()
+    if isinstance(e, ast.Func):
+        a = [_agg_one(x, key, groups, idx, cols, nulls, params, n)
+             for x in e.args]
+        if e.name == "sqrt":
+            return float(np.sqrt(a[0])) if a[0] is not None else None
+        if e.name == "round":
+            return round(a[0], int(a[1]) if len(a) > 1 else 0) \
+                if a[0] is not None else None
+    if isinstance(e, ast.Cast):
+        v = _agg_one(e.child, key, groups, idx, cols, nulls, params, n)
+        return T.python_value(e.to, v)
+    raise HostEvalError(f"post-agg expression {type(e).__name__}")

@@ -1,0 +1,62 @@
+"""Query results: named host columns with null masks."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+from snappydata_tpu import types as T
+
+
+@dataclasses.dataclass
+class Result:
+    names: List[str]
+    columns: List[np.ndarray]          # host arrays (strings materialized)
+    nulls: List[Optional[np.ndarray]]  # bool masks or None
+    dtypes: List[T.DataType]
+
+    @property
+    def num_rows(self) -> int:
+        return int(self.columns[0].shape[0]) if self.columns else 0
+
+    def rows(self) -> List[tuple]:
+        out = []
+        for i in range(self.num_rows):
+            row = []
+            for c, nmask in zip(self.columns, self.nulls):
+                if nmask is not None and nmask[i]:
+                    row.append(None)
+                else:
+                    v = c[i]
+                    row.append(v.item() if hasattr(v, "item") else v)
+            out.append(tuple(row))
+        return out
+
+    def column(self, name: str) -> np.ndarray:
+        return self.columns[[n.lower() for n in self.names].index(name.lower())]
+
+    def to_pandas(self):
+        import pandas as pd
+
+        data = {}
+        for name, c, nmask in zip(self.names, self.columns, self.nulls):
+            if nmask is not None and nmask.any():
+                obj = c.astype(object)
+                obj[nmask] = None
+                data[name] = obj
+            else:
+                data[name] = c
+        return pd.DataFrame(data)
+
+    def __repr__(self):
+        head = self.rows()[:20]
+        return (f"Result({self.num_rows} rows: {', '.join(self.names)})\n"
+                + "\n".join(str(r) for r in head))
+
+
+def empty_result(names, dtypes) -> Result:
+    cols = [np.empty(0, dtype=dt.np_dtype if dt.name != "string" else object)
+            for dt in dtypes]
+    return Result(list(names), cols, [None] * len(names), list(dtypes))

@@ -1,0 +1,13 @@
+"""SQL front end: lexer, recursive-descent parser, logical plans, analyzer.
+
+Covers the dialect surface the reference defines with its parboiled2 PEG
+grammar (core/.../SnappyParser.scala:73, SnappyDDLParser.scala:301-1056):
+full SELECT (joins, group-by, having, order, limit, case, in/between/like),
+DDL (CREATE TABLE ... USING COLUMN|ROW OPTIONS (...), DROP, TRUNCATE),
+DML (INSERT INTO ... VALUES/SELECT, PUT INTO, UPDATE, DELETE), and literal
+tokenization into ParamLiteral for plan-cache reuse (ref: ParamLiteral.scala,
+SnappySession.sqlPlan:2571).
+"""
+
+from snappydata_tpu.sql.parser import parse  # noqa: F401
+from snappydata_tpu.sql import ast  # noqa: F401

@@ -1,0 +1,556 @@
+"""Analyzer: resolve names, expand stars, infer types, fold constants,
+tokenize literals.
+
+Plays the role of the reference's SnappyAnalyzer batches
+(core/.../hive/SnappySessionState.scala:59 — incl. TokenizedLiteralFolding
+:171) plus the literal-tokenization trick from SnappySession.sqlPlan:2571:
+after folding, every remaining literal in expression position is replaced
+by a positional ParamLiteral so textually-different queries share one
+compiled XLA executable; the values ride along as runtime scalars.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Sequence, Tuple
+
+from snappydata_tpu import types as T
+from snappydata_tpu.sql import ast
+from snappydata_tpu.sql.lexer import SQLSyntaxError
+
+
+class AnalysisError(Exception):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class ScopeEntry:
+    qualifier: Optional[str]
+    name: str
+    dtype: T.DataType
+    nullable: bool = True
+
+
+class Scope:
+    def __init__(self, entries: Sequence[ScopeEntry]):
+        self.entries = list(entries)
+
+    def resolve(self, name: str, qualifier: Optional[str]) -> Tuple[int, ScopeEntry]:
+        name_l = name.lower()
+        qual_l = qualifier.lower() if qualifier else None
+        hits = [(i, e) for i, e in enumerate(self.entries)
+                if e.name.lower() == name_l
+                and (qual_l is None or (e.qualifier or "").lower() == qual_l)]
+        if not hits:
+            raise AnalysisError(
+                f"cannot resolve column {qualifier + '.' if qualifier else ''}{name}")
+        if len(hits) > 1:
+            raise AnalysisError(f"ambiguous column reference: {name}")
+        return hits[0]
+
+    def schema(self) -> T.Schema:
+        return T.Schema([T.Field(e.name, e.dtype, e.nullable)
+                         for e in self.entries])
+
+
+def _expr_name(e: ast.Expr) -> str:
+    if isinstance(e, ast.Alias):
+        return e.name
+    if isinstance(e, ast.Col):
+        return e.name
+    if isinstance(e, ast.Func):
+        return f"{e.name}({', '.join(_expr_name(a) for a in e.args)})" \
+            if e.args else f"{e.name}()"
+    if isinstance(e, ast.Cast):
+        return _expr_name(e.child)
+    if isinstance(e, (ast.Lit, ast.ParamLiteral)):
+        return "literal"
+    return "expr"
+
+
+def expr_type(e: ast.Expr) -> T.DataType:
+    """Type of a RESOLVED expression."""
+    if isinstance(e, ast.Col):
+        return e.dtype
+    if isinstance(e, (ast.Lit, ast.ParamLiteral, ast.Param)):
+        if e.dtype is not None:
+            return e.dtype
+        v = e.value if isinstance(e, ast.Lit) else None
+        if isinstance(v, bool):
+            return T.BOOLEAN
+        if isinstance(v, int):
+            return T.LONG
+        if isinstance(v, float):
+            return T.DOUBLE
+        if isinstance(v, str):
+            return T.STRING
+        return T.STRING
+    if isinstance(e, ast.Alias):
+        return expr_type(e.child)
+    if isinstance(e, ast.Cast):
+        return e.to
+    if isinstance(e, ast.UnaryOp):
+        return T.BOOLEAN if e.op == "not" else expr_type(e.child)
+    if isinstance(e, (ast.IsNull, ast.InList, ast.Between, ast.Like)):
+        return T.BOOLEAN
+    if isinstance(e, ast.Case):
+        for _, v in e.whens:
+            return expr_type(v)
+        return expr_type(e.otherwise)
+    if isinstance(e, ast.BinOp):
+        if e.op in ("and", "or", "=", "!=", "<", "<=", ">", ">="):
+            return T.BOOLEAN
+        lt, rt = expr_type(e.left), expr_type(e.right)
+        if e.op == "/":
+            return T.DOUBLE if lt.name not in ("decimal",) else lt
+        return T.common_type(lt, rt)
+    if isinstance(e, ast.Func):
+        low = e.name
+        if low in ("count", "count_distinct", "approx_count_distinct"):
+            return T.LONG
+        if low in ("avg", "stddev", "variance"):
+            at = expr_type(e.args[0]) if e.args else T.DOUBLE
+            return at if at.name == "decimal" else T.DOUBLE
+        if low in ("sum", "min", "max", "first", "last", "abs", "coalesce"):
+            return expr_type(e.args[0])
+        if low in ("year", "month", "day", "length", "instr"):
+            return T.INT
+        if low in ("substr", "substring", "upper", "lower", "trim", "concat",
+                   "ltrim", "rtrim"):
+            return T.STRING
+        if low in ("sqrt", "exp", "ln", "log", "pow", "power", "round"):
+            return T.DOUBLE
+        if e.dtype is not None:
+            return e.dtype
+        raise AnalysisError(f"unknown function: {e.name}")
+    raise AnalysisError(f"cannot type expression {e!r}")
+
+
+def fold_constants(e: ast.Expr) -> ast.Expr:
+    """Evaluate literal-only subtrees (ref TokenizedLiteralFolding)."""
+
+    def fold(node: ast.Expr) -> ast.Expr:
+        if isinstance(node, ast.BinOp) and isinstance(node.left, ast.Lit) \
+                and isinstance(node.right, ast.Lit) \
+                and node.left.value is not None and node.right.value is not None:
+            a, b = node.left.value, node.right.value
+            try:
+                v = {
+                    "+": lambda: a + b, "-": lambda: a - b,
+                    "*": lambda: a * b, "%": lambda: a % b,
+                    "/": lambda: a / b if not (
+                        isinstance(a, int) and isinstance(b, int)) else a / b,
+                }[node.op]()
+            except (KeyError, ZeroDivisionError):
+                return node
+            dt = node.left.dtype or node.right.dtype
+            if node.left.dtype and node.right.dtype \
+                    and node.left.dtype != node.right.dtype:
+                try:
+                    dt = T.common_type(node.left.dtype, node.right.dtype)
+                except TypeError:
+                    dt = None
+            if isinstance(v, float) and dt is not None and T.is_integral(dt):
+                dt = T.DOUBLE
+            return ast.Lit(v, dt)
+        if isinstance(node, ast.UnaryOp) and node.op == "neg" \
+                and isinstance(node.child, ast.Lit) \
+                and node.child.value is not None:
+            return ast.Lit(-node.child.value, node.child.dtype)
+        if isinstance(node, ast.Cast) and isinstance(node.child, ast.Lit):
+            return ast.Lit(T.python_value(node.to, node.child.value), node.to)
+        return node
+
+    return ast.transform(e, fold)
+
+
+class Analyzer:
+    """Single-pass resolver. `catalog` must provide lookup_table(name) ->
+    object with .schema/.name and lookup_view(name) -> Optional[Plan]."""
+
+    def __init__(self, catalog):
+        self.catalog = catalog
+
+    # --- plans -----------------------------------------------------------
+
+    def analyze_plan(self, plan: ast.Plan) -> Tuple[ast.Plan, Scope]:
+        if isinstance(plan, ast.UnresolvedRelation):
+            view = self.catalog.lookup_view(plan.name)
+            if view is not None:
+                child, scope = self.analyze_plan(view)
+                alias = plan.alias or plan.name.split(".")[-1]
+                scope = Scope([dataclasses.replace(e, qualifier=alias)
+                               for e in scope.entries])
+                return ast.SubqueryAlias(child, alias), scope
+            info = self.catalog.lookup_table(plan.name)
+            if info is None:
+                raise AnalysisError(f"table or view not found: {plan.name}")
+            alias = plan.alias or plan.name.split(".")[-1]
+            scope = Scope([ScopeEntry(alias, f.name, f.dtype, f.nullable)
+                           for f in info.schema.fields])
+            return ast.Relation(info.name, info.schema, alias), scope
+
+        if isinstance(plan, ast.SubqueryAlias):
+            child, scope = self.analyze_plan(plan.child)
+            scope = Scope([dataclasses.replace(e, qualifier=plan.alias)
+                           for e in scope.entries])
+            return ast.SubqueryAlias(child, plan.alias), scope
+
+        if isinstance(plan, ast.Values):
+            rows = tuple(tuple(fold_constants(self.resolve_expr(e, Scope([])))
+                               for e in row) for row in plan.rows)
+            first = rows[0]
+            entries = [ScopeEntry(None, f"col{i + 1}", expr_type(e))
+                       for i, e in enumerate(first)]
+            return ast.Values(rows), Scope(entries)
+
+        if isinstance(plan, ast.Filter):
+            child, scope = self.analyze_plan(plan.child)
+            if isinstance(child, ast.Aggregate) and ast.is_aggregate(
+                    plan.condition):
+                return self._resolve_having(plan.condition, child, scope)
+            cond = fold_constants(self.resolve_expr(plan.condition, scope))
+            if expr_type(cond).name != "boolean":
+                raise AnalysisError("WHERE/HAVING must be boolean")
+            return ast.Filter(child, cond), scope
+
+        if isinstance(plan, ast.Project):
+            child, scope = self.analyze_plan(plan.child)
+            exprs = self._resolve_select_list(plan.exprs, scope)
+            out_scope = Scope([ScopeEntry(None, _expr_name(e), expr_type(e))
+                               for e in exprs])
+            return ast.Project(child, tuple(exprs)), out_scope
+
+        if isinstance(plan, ast.Aggregate):
+            child, scope = self.analyze_plan(plan.child)
+            groups = tuple(fold_constants(self.resolve_expr(g, scope))
+                           for g in plan.group_exprs)
+            # allow GROUP BY <ordinal> and GROUP BY <select alias>
+            select = self._resolve_select_list(plan.agg_exprs, scope,
+                                               allow_missing=True)
+            groups = tuple(self._bind_group_expr(g, select) for g in groups)
+            self._check_agg(select, groups)
+            out_scope = Scope([ScopeEntry(None, _expr_name(e), expr_type(e))
+                               for e in select])
+            return ast.Aggregate(child, groups, tuple(select)), out_scope
+
+        if isinstance(plan, ast.Join):
+            left, ls = self.analyze_plan(plan.left)
+            right, rs = self.analyze_plan(plan.right)
+            joint = Scope(ls.entries + rs.entries)
+            cond = None
+            if plan.condition is not None:
+                cond = fold_constants(self.resolve_expr(plan.condition, joint))
+                if expr_type(cond).name != "boolean":
+                    raise AnalysisError("JOIN condition must be boolean")
+            how = plan.how
+            if how == "cross" and cond is not None:
+                how = "inner"
+            out = joint if how not in ("semi", "anti") else ls
+            return ast.Join(left, right, how, cond), out
+
+        if isinstance(plan, ast.Sort):
+            child, scope = self.analyze_plan(plan.child)
+            orders = []
+            hidden: List[ast.Expr] = []
+            for e, asc in plan.orders:
+                try:
+                    orders.append(
+                        (self._resolve_order_expr(e, scope, child), asc))
+                except AnalysisError:
+                    # ORDER BY an input column absent from the select list:
+                    # append a hidden projection, sort, then trim
+                    if not isinstance(child, ast.Project):
+                        raise
+                    in_scope = Scope(self._scope_of(child.child))
+                    resolved = fold_constants(self.resolve_expr(e, in_scope))
+                    hidden.append(resolved)
+                    orders.append((ast.Col(
+                        f"__sort{len(hidden) - 1}", None,
+                        len(child.exprs) + len(hidden) - 1,
+                        expr_type(resolved)), asc))
+            if hidden:
+                widened = ast.Project(
+                    child.child, child.exprs + tuple(
+                        ast.Alias(h, f"__sort{j}")
+                        for j, h in enumerate(hidden)))
+                visible = tuple(
+                    ast.Col(s.name, None, i, s.dtype)
+                    for i, s in enumerate(scope.entries))
+                return ast.Project(ast.Sort(widened, tuple(orders)),
+                                   visible), scope
+            return ast.Sort(child, tuple(orders)), scope
+
+        if isinstance(plan, ast.Limit):
+            child, scope = self.analyze_plan(plan.child)
+            return ast.Limit(child, plan.n), scope
+
+        if isinstance(plan, ast.Distinct):
+            child, scope = self.analyze_plan(plan.child)
+            return ast.Distinct(child), scope
+
+        if isinstance(plan, ast.Union):
+            left, ls = self.analyze_plan(plan.left)
+            right, rs = self.analyze_plan(plan.right)
+            if len(ls.entries) != len(rs.entries):
+                raise AnalysisError("UNION children must have equal arity")
+            return ast.Union(left, right, plan.all), ls
+
+        raise AnalysisError(f"cannot analyze plan node {type(plan).__name__}")
+
+    def _resolve_having(self, cond: ast.Expr, agg: ast.Aggregate,
+                        out_scope: Scope):
+        """HAVING with aggregate calls: resolve against the aggregate's
+        INPUT, then rewrite each aggregate/group subexpression to a
+        reference into the select list — appending hidden columns for
+        aggregates the select list doesn't already compute (projected away
+        afterwards)."""
+        in_scope = Scope(self._scope_of(agg.child))
+        resolved = fold_constants(self.resolve_expr(cond, in_scope))
+        bases = [e.child if isinstance(e, ast.Alias) else e
+                 for e in agg.agg_exprs]
+        hidden: List[ast.Expr] = []
+
+        def repl(e: ast.Expr) -> ast.Expr:
+            if (isinstance(e, ast.Func) and e.name in ast.AGG_FUNCS) \
+                    or any(e == g for g in agg.group_exprs):
+                for i, b in enumerate(bases):
+                    if e == b:
+                        return ast.Col(_expr_name(agg.agg_exprs[i]), None, i,
+                                       expr_type(b))
+                for j, h in enumerate(hidden):
+                    if e == h:
+                        return ast.Col(f"__having{j}", None,
+                                       len(bases) + j, expr_type(h))
+                hidden.append(e)
+                return ast.Col(f"__having{len(hidden) - 1}", None,
+                               len(bases) + len(hidden) - 1, expr_type(e))
+            return e.map_children(repl)
+
+        rewritten = repl(resolved)
+        if expr_type(rewritten).name != "boolean":
+            raise AnalysisError("HAVING must be boolean")
+        if hidden:
+            new_agg = ast.Aggregate(
+                agg.child, agg.group_exprs,
+                agg.agg_exprs + tuple(
+                    ast.Alias(h, f"__having{j}")
+                    for j, h in enumerate(hidden)))
+            filtered = ast.Filter(new_agg, rewritten)
+            visible = tuple(
+                ast.Col(e.name, None, i, e.dtype)
+                for i, e in enumerate(out_scope.entries))
+            return ast.Project(filtered, visible), out_scope
+        return ast.Filter(agg, rewritten), out_scope
+
+    # --- expressions -----------------------------------------------------
+
+    def resolve_expr(self, e: ast.Expr, scope: Scope) -> ast.Expr:
+        def rec(node: ast.Expr) -> ast.Expr:
+            if isinstance(node, ast.Col):
+                idx, entry = scope.resolve(node.name, node.qualifier)
+                return ast.Col(entry.name, entry.qualifier, idx, entry.dtype)
+            if isinstance(node, ast.Star):
+                raise AnalysisError("* is only allowed in a select list")
+            return node.map_children(rec)
+
+        return rec(e)
+
+    def _resolve_select_list(self, exprs, scope: Scope,
+                             allow_missing: bool = False) -> List[ast.Expr]:
+        out: List[ast.Expr] = []
+        for e in exprs:
+            if isinstance(e, ast.Star):
+                qual = e.qualifier.lower() if e.qualifier else None
+                for i, entry in enumerate(scope.entries):
+                    if qual is None or (entry.qualifier or "").lower() == qual:
+                        out.append(ast.Col(entry.name, entry.qualifier, i,
+                                           entry.dtype))
+                continue
+            out.append(fold_constants(self.resolve_expr(e, scope)))
+        return out
+
+    def _bind_group_expr(self, g: ast.Expr, select: List[ast.Expr]) -> ast.Expr:
+        # GROUP BY ordinal (1-based) refers to the select list
+        if isinstance(g, ast.Lit) and isinstance(g.value, int) \
+                and not isinstance(g.value, bool):
+            k = g.value
+            if 1 <= k <= len(select):
+                e = select[k - 1]
+                return e.child if isinstance(e, ast.Alias) else e
+        return g
+
+    def _check_agg(self, select: List[ast.Expr], groups) -> None:
+        group_set = {g for g in groups}
+
+        def ok(e: ast.Expr) -> bool:
+            base = e.child if isinstance(e, ast.Alias) else e
+            if base in group_set or isinstance(base, (ast.Lit, ast.ParamLiteral)):
+                return True
+            if isinstance(base, ast.Func) and base.name in ast.AGG_FUNCS:
+                return True
+            if isinstance(base, ast.Col):
+                return base in group_set
+            return all(ok(c) for c in base.children()) and bool(base.children())
+
+        for e in select:
+            if not ok(e):
+                raise AnalysisError(
+                    f"expression {_expr_name(e)} is neither grouped nor aggregated")
+
+    def _resolve_order_expr(self, e: ast.Expr, scope: Scope,
+                            child: ast.Plan) -> ast.Expr:
+        # ORDER BY ordinal
+        if isinstance(e, ast.Lit) and isinstance(e.value, int) \
+                and not isinstance(e.value, bool):
+            k = e.value
+            if 1 <= k <= len(scope.entries):
+                entry = scope.entries[k - 1]
+                return ast.Col(entry.name, entry.qualifier, k - 1, entry.dtype)
+        try:
+            return self.resolve_expr(e, scope)
+        except AnalysisError:
+            # structural match against aggregate/project output, e.g.
+            # ORDER BY sum(x) when select list has Alias(sum(x), 'revenue')
+            if isinstance(child, (ast.Aggregate, ast.Project)):
+                outs = child.agg_exprs if isinstance(child, ast.Aggregate) \
+                    else child.exprs
+                target = fold_constants(self.resolve_expr(
+                    e, self._child_scope(child)))
+                for i, oe in enumerate(outs):
+                    base = oe.child if isinstance(oe, ast.Alias) else oe
+                    if base == target:
+                        entry = scope.entries[i]
+                        return ast.Col(entry.name, entry.qualifier, i,
+                                       entry.dtype)
+            raise
+
+    def _child_scope(self, plan: ast.Plan) -> Scope:
+        """Scope of a resolved plan's input (for late order-by binding)."""
+        child = plan.children()[0]
+        return Scope(self._scope_of(child))
+
+    def _scope_of(self, plan: ast.Plan) -> List[ScopeEntry]:
+        if isinstance(plan, ast.Relation):
+            alias = plan.alias or plan.name
+            return [ScopeEntry(alias, f.name, f.dtype, f.nullable)
+                    for f in plan.schema.fields]
+        if isinstance(plan, ast.SubqueryAlias):
+            return [dataclasses.replace(e, qualifier=plan.alias)
+                    for e in self._scope_of(plan.child)]
+        if isinstance(plan, ast.Project):
+            return [ScopeEntry(None, _expr_name(e), expr_type(e))
+                    for e in plan.exprs]
+        if isinstance(plan, ast.Aggregate):
+            return [ScopeEntry(None, _expr_name(e), expr_type(e))
+                    for e in plan.agg_exprs]
+        if isinstance(plan, (ast.Filter, ast.Sort, ast.Limit, ast.Distinct)):
+            return self._scope_of(plan.children()[0])
+        if isinstance(plan, ast.Join):
+            if plan.how in ("semi", "anti"):
+                return self._scope_of(plan.left)
+            return self._scope_of(plan.left) + self._scope_of(plan.right)
+        if isinstance(plan, ast.Union):
+            return self._scope_of(plan.left)
+        if isinstance(plan, ast.Values):
+            return [ScopeEntry(None, f"col{i + 1}", expr_type(e))
+                    for i, e in enumerate(plan.rows[0])]
+        raise AnalysisError(f"no scope for {type(plan).__name__}")
+
+
+# --------------------------------------------------------------------------
+# Literal tokenization (plan-cache key normalization)
+# --------------------------------------------------------------------------
+
+def tokenize_plan(plan: ast.Plan) -> Tuple[ast.Plan, Tuple[Any, ...]]:
+    """Replace every Lit in expression position with ParamLiteral(pos),
+    collecting values — the tokenized plan is the plan-cache key and the
+    values are runtime inputs (ref: ParamLiteral/replaceParamLiterals,
+    SnappySession.scala:2631). Values rows and LIMIT counts stay literal
+    (they determine shapes/table contents, not expression scalars)."""
+    params: List[Any] = []
+
+    def tok_expr(e: ast.Expr) -> ast.Expr:
+        def rec(node: ast.Expr) -> ast.Expr:
+            if isinstance(node, ast.Lit) and node.value is not None:
+                params.append(T.python_value(node.dtype, node.value)
+                              if node.dtype else node.value)
+                return ast.ParamLiteral(len(params) - 1, node.dtype)
+            return node.map_children(rec)
+
+        return rec(e)
+
+    def tok(p: ast.Plan) -> ast.Plan:
+        if isinstance(p, ast.Filter):
+            return ast.Filter(tok(p.child), tok_expr(p.condition))
+        if isinstance(p, ast.Project):
+            return ast.Project(tok(p.child), tuple(tok_expr(e) for e in p.exprs))
+        if isinstance(p, ast.Aggregate):
+            return ast.Aggregate(tok(p.child),
+                                 tuple(tok_expr(g) for g in p.group_exprs),
+                                 tuple(tok_expr(e) for e in p.agg_exprs))
+        if isinstance(p, ast.Join):
+            cond = tok_expr(p.condition) if p.condition is not None else None
+            return ast.Join(tok(p.left), tok(p.right), p.how, cond)
+        if isinstance(p, ast.Sort):
+            return ast.Sort(tok(p.child), tuple((tok_expr(e), a)
+                                                for e, a in p.orders))
+        if isinstance(p, ast.Limit):
+            return ast.Limit(tok(p.child), p.n)
+        if isinstance(p, ast.Distinct):
+            return ast.Distinct(tok(p.child))
+        if isinstance(p, ast.Union):
+            return ast.Union(tok(p.left), tok(p.right), p.all)
+        if isinstance(p, ast.SubqueryAlias):
+            return ast.SubqueryAlias(tok(p.child), p.alias)
+        return p
+
+    return assign_param_positions(tok(plan), len(params)), tuple(params)
+
+
+def assign_param_positions(plan: ast.Plan, offset: int) -> ast.Plan:
+    """Number prepared-statement '?' params in deterministic DFS order,
+    offset past the tokenized literals (execution-time params tuple is
+    lit_values + user_values)."""
+    counter = [offset]
+
+    def fix_expr(e: ast.Expr) -> ast.Expr:
+        def rec(node: ast.Expr) -> ast.Expr:
+            if isinstance(node, ast.Param) and node.pos < 0:
+                p = ast.Param(counter[0], node.dtype)
+                counter[0] += 1
+                return p
+            return node.map_children(rec)
+
+        return rec(e)
+
+    def fix(p: ast.Plan) -> ast.Plan:
+        if isinstance(p, ast.Filter):
+            return ast.Filter(fix(p.child), fix_expr(p.condition))
+        if isinstance(p, ast.Project):
+            return ast.Project(fix(p.child),
+                               tuple(fix_expr(e) for e in p.exprs))
+        if isinstance(p, ast.Aggregate):
+            return ast.Aggregate(fix(p.child),
+                                 tuple(fix_expr(g) for g in p.group_exprs),
+                                 tuple(fix_expr(e) for e in p.agg_exprs))
+        if isinstance(p, ast.Join):
+            cond = fix_expr(p.condition) if p.condition is not None else None
+            return ast.Join(fix(p.left), fix(p.right), p.how, cond)
+        if isinstance(p, ast.Sort):
+            return ast.Sort(fix(p.child),
+                            tuple((fix_expr(e), a) for e, a in p.orders))
+        if isinstance(p, ast.Limit):
+            return ast.Limit(fix(p.child), p.n)
+        if isinstance(p, ast.Distinct):
+            return ast.Distinct(fix(p.child))
+        if isinstance(p, ast.Union):
+            return ast.Union(fix(p.left), fix(p.right), p.all)
+        if isinstance(p, ast.SubqueryAlias):
+            return ast.SubqueryAlias(fix(p.child), p.alias)
+        if isinstance(p, ast.Values):
+            return ast.Values(tuple(tuple(fix_expr(e) for e in row)
+                                    for row in p.rows))
+        return p
+
+    return fix(plan)

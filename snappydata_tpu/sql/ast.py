@@ -1,0 +1,436 @@
+"""Expression and logical-plan AST.
+
+The logical layer the reference gets from Catalyst; kept deliberately
+small and immutable (dataclasses) — the analyzer annotates by rebuilding.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Optional, Tuple
+
+from snappydata_tpu import types as T
+
+
+# --------------------------------------------------------------------------
+# Expressions
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Expr:
+    def children(self) -> Tuple["Expr", ...]:
+        return ()
+
+    def map_children(self, fn) -> "Expr":
+        return self
+
+
+@dataclasses.dataclass(frozen=True)
+class Col(Expr):
+    name: str
+    qualifier: Optional[str] = None
+    # filled by analyzer:
+    index: Optional[int] = None       # ordinal in child output
+    dtype: Optional[T.DataType] = None
+
+    def __str__(self):
+        return f"{self.qualifier}.{self.name}" if self.qualifier else self.name
+
+
+@dataclasses.dataclass(frozen=True)
+class Lit(Expr):
+    value: Any
+    dtype: Optional[T.DataType] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ParamLiteral(Expr):
+    """Tokenized literal: positional slot bound at execution time so
+    textually-different queries share one compiled plan (ref:
+    ParamLiteral.scala, TokenLiteral.PARAMLITERAL_START)."""
+
+    pos: int
+    dtype: Optional[T.DataType] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Param(Expr):
+    """Prepared-statement '?' parameter."""
+
+    pos: int
+    dtype: Optional[T.DataType] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Star(Expr):
+    qualifier: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Alias(Expr):
+    child: Expr
+    name: str
+
+    def children(self):
+        return (self.child,)
+
+    def map_children(self, fn):
+        return dataclasses.replace(self, child=fn(self.child))
+
+
+@dataclasses.dataclass(frozen=True)
+class BinOp(Expr):
+    op: str  # + - * / % and or = != < <= > >=
+    left: Expr
+    right: Expr
+
+    def children(self):
+        return (self.left, self.right)
+
+    def map_children(self, fn):
+        return dataclasses.replace(self, left=fn(self.left), right=fn(self.right))
+
+
+@dataclasses.dataclass(frozen=True)
+class UnaryOp(Expr):
+    op: str  # not, neg
+    child: Expr
+
+    def children(self):
+        return (self.child,)
+
+    def map_children(self, fn):
+        return dataclasses.replace(self, child=fn(self.child))
+
+
+@dataclasses.dataclass(frozen=True)
+class IsNull(Expr):
+    child: Expr
+    negated: bool = False
+
+    def children(self):
+        return (self.child,)
+
+    def map_children(self, fn):
+        return dataclasses.replace(self, child=fn(self.child))
+
+
+@dataclasses.dataclass(frozen=True)
+class InList(Expr):
+    child: Expr
+    values: Tuple[Expr, ...]
+    negated: bool = False
+
+    def children(self):
+        return (self.child,) + tuple(self.values)
+
+    def map_children(self, fn):
+        return dataclasses.replace(self, child=fn(self.child),
+                                   values=tuple(fn(v) for v in self.values))
+
+
+@dataclasses.dataclass(frozen=True)
+class Between(Expr):
+    child: Expr
+    lo: Expr
+    hi: Expr
+    negated: bool = False
+
+    def children(self):
+        return (self.child, self.lo, self.hi)
+
+    def map_children(self, fn):
+        return dataclasses.replace(self, child=fn(self.child), lo=fn(self.lo),
+                                   hi=fn(self.hi))
+
+
+@dataclasses.dataclass(frozen=True)
+class Like(Expr):
+    child: Expr
+    pattern: str
+    negated: bool = False
+
+    def children(self):
+        return (self.child,)
+
+    def map_children(self, fn):
+        return dataclasses.replace(self, child=fn(self.child))
+
+
+@dataclasses.dataclass(frozen=True)
+class Case(Expr):
+    whens: Tuple[Tuple[Expr, Expr], ...]
+    otherwise: Optional[Expr] = None
+
+    def children(self):
+        out = []
+        for c, v in self.whens:
+            out += [c, v]
+        if self.otherwise is not None:
+            out.append(self.otherwise)
+        return tuple(out)
+
+    def map_children(self, fn):
+        return dataclasses.replace(
+            self, whens=tuple((fn(c), fn(v)) for c, v in self.whens),
+            otherwise=fn(self.otherwise) if self.otherwise is not None else None)
+
+
+@dataclasses.dataclass(frozen=True)
+class Cast(Expr):
+    child: Expr
+    to: T.DataType
+
+    def children(self):
+        return (self.child,)
+
+    def map_children(self, fn):
+        return dataclasses.replace(self, child=fn(self.child))
+
+
+@dataclasses.dataclass(frozen=True)
+class Func(Expr):
+    """Scalar or aggregate function call; analyzer decides which."""
+
+    name: str
+    args: Tuple[Expr, ...]
+    distinct: bool = False
+    dtype: Optional[T.DataType] = None
+
+    def children(self):
+        return tuple(self.args)
+
+    def map_children(self, fn):
+        return dataclasses.replace(self, args=tuple(fn(a) for a in self.args))
+
+
+AGG_FUNCS = {"sum", "avg", "count", "min", "max", "first", "last",
+             "stddev", "variance", "count_distinct", "approx_count_distinct"}
+
+
+def is_aggregate(e: Expr) -> bool:
+    if isinstance(e, Func) and e.name.lower() in AGG_FUNCS:
+        return True
+    return any(is_aggregate(c) for c in e.children())
+
+
+def walk(e: Expr):
+    yield e
+    for c in e.children():
+        yield from walk(c)
+
+
+def transform(e: Expr, fn):
+    """Bottom-up expression rewrite."""
+    rebuilt = e.map_children(lambda c: transform(c, fn))
+    return fn(rebuilt)
+
+
+# --------------------------------------------------------------------------
+# Logical plans
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Plan:
+    def children(self) -> Tuple["Plan", ...]:
+        return ()
+
+
+@dataclasses.dataclass(frozen=True)
+class UnresolvedRelation(Plan):
+    name: str
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class Relation(Plan):
+    """Resolved scan over a catalog table (filled by analyzer)."""
+
+    name: str
+    schema: T.Schema = None
+    alias: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class SubqueryAlias(Plan):
+    child: Plan
+    alias: str
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Project(Plan):
+    child: Plan
+    exprs: Tuple[Expr, ...]
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Filter(Plan):
+    child: Plan
+    condition: Expr
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Aggregate(Plan):
+    child: Plan
+    group_exprs: Tuple[Expr, ...]
+    agg_exprs: Tuple[Expr, ...]  # full select list incl. group cols
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Join(Plan):
+    left: Plan
+    right: Plan
+    how: str  # inner, left, right, full, cross, semi, anti
+    condition: Optional[Expr] = None
+
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclasses.dataclass(frozen=True)
+class Sort(Plan):
+    child: Plan
+    orders: Tuple[Tuple[Expr, bool], ...]  # (expr, ascending)
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Limit(Plan):
+    child: Plan
+    n: int
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Distinct(Plan):
+    child: Plan
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
+class Union(Plan):
+    left: Plan
+    right: Plan
+    all: bool = True
+
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclasses.dataclass(frozen=True)
+class Values(Plan):
+    rows: Tuple[Tuple[Expr, ...], ...]
+
+
+# --------------------------------------------------------------------------
+# Statements (DDL/DML — executed by the session, not the query engine)
+# --------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class Statement:
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class Query(Statement):
+    plan: Plan
+    params: Tuple[Any, ...] = ()  # tokenized literal values, by position
+
+
+@dataclasses.dataclass(frozen=True)
+class ColumnDef:
+    name: str
+    dtype: T.DataType
+    nullable: bool = True
+    primary_key: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateTable(Statement):
+    name: str
+    columns: Tuple[ColumnDef, ...]
+    provider: str = "column"          # column | row | sample
+    options: dict = dataclasses.field(default_factory=dict)
+    as_select: Optional[Plan] = None
+    if_not_exists: bool = False
+    temporary: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropTable(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class TruncateTable(Statement):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class InsertInto(Statement):
+    table: str
+    columns: Tuple[str, ...]
+    source: Plan                      # Values or query plan
+    put: bool = False                 # PUT INTO upsert (ref SnappySession.put)
+    overwrite: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class UpdateStmt(Statement):
+    table: str
+    assignments: Tuple[Tuple[str, Expr], ...]
+    where: Optional[Expr] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class DeleteStmt(Statement):
+    table: str
+    where: Optional[Expr] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class ShowTables(Statement):
+    pass
+
+
+@dataclasses.dataclass(frozen=True)
+class DescribeTable(Statement):
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class SetConf(Statement):
+    key: str
+    value: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateView(Statement):
+    name: str
+    query: Plan
+    or_replace: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropView(Statement):
+    name: str
+    if_exists: bool = False

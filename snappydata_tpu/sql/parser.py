@@ -1,0 +1,730 @@
+"""Recursive-descent SQL parser producing ast.Statement / ast.Plan.
+
+Dialect surface mirrors the reference's grammar (core/.../SnappyParser.scala
+DML; SnappyDDLParser.scala:301 createTable, :716 createStream, :1051 ddl
+dispatch): SELECT with joins/group/having/order/limit, CREATE TABLE ...
+USING COLUMN|ROW OPTIONS(...), INSERT/PUT INTO, UPDATE, DELETE, DROP/
+TRUNCATE, SHOW/DESCRIBE, SET. Date/interval literals and CASE/CAST/IN/
+BETWEEN/LIKE are first-class since TPC-H needs them.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import List, Optional, Tuple
+
+from snappydata_tpu import types as T
+from snappydata_tpu.sql import ast
+from snappydata_tpu.sql.lexer import SQLSyntaxError, Token, tokenize
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def _date_to_days(s: str) -> int:
+    return (datetime.date.fromisoformat(s.strip()) - _EPOCH).days
+
+
+def _ts_to_micros(s: str) -> int:
+    dt = datetime.datetime.fromisoformat(s.strip())
+    return int(dt.replace(tzinfo=datetime.timezone.utc).timestamp() * 1_000_000)
+
+
+class Parser:
+    def __init__(self, sql: str):
+        self.sql = sql
+        self.tokens = tokenize(sql)
+        self.i = 0
+
+    # --- token helpers ----------------------------------------------------
+
+    def peek(self, ahead: int = 0) -> Token:
+        return self.tokens[min(self.i + ahead, len(self.tokens) - 1)]
+
+    def next(self) -> Token:
+        t = self.tokens[self.i]
+        if t.kind != "EOF":
+            self.i += 1
+        return t
+
+    def at_kw(self, *words: str) -> bool:
+        t = self.peek()
+        return t.kind == "KW" and t.value.lower() in words
+
+    def accept_kw(self, *words: str) -> bool:
+        if self.at_kw(*words):
+            self.next()
+            return True
+        return False
+
+    def expect_kw(self, word: str) -> None:
+        if not self.accept_kw(word):
+            t = self.peek()
+            raise SQLSyntaxError(
+                f"expected {word.upper()} but found {t.value!r} at {t.pos}")
+
+    def at_op(self, *ops: str) -> bool:
+        t = self.peek()
+        return t.kind == "OP" and t.value in ops
+
+    def accept_op(self, *ops: str) -> bool:
+        if self.at_op(*ops):
+            self.next()
+            return True
+        return False
+
+    def expect_op(self, op: str) -> None:
+        if not self.accept_op(op):
+            t = self.peek()
+            raise SQLSyntaxError(
+                f"expected {op!r} but found {t.value!r} at {t.pos}")
+
+    def ident(self) -> str:
+        t = self.peek()
+        # allow non-reserved keywords as identifiers in name position
+        if t.kind in ("IDENT", "KW"):
+            self.next()
+            return t.value
+        raise SQLSyntaxError(f"expected identifier at {t.pos}, found {t.value!r}")
+
+    def qualified_name(self) -> str:
+        name = self.ident()
+        while self.accept_op("."):
+            name += "." + self.ident()
+        return name
+
+    # --- entry ------------------------------------------------------------
+
+    def parse_statement(self) -> ast.Statement:
+        t = self.peek()
+        low = t.value.lower() if t.kind == "KW" else ""
+        if low == "select" or self.at_op("("):
+            plan = self.query_expr()
+            self._finish()
+            return ast.Query(plan)
+        if low == "create":
+            return self._finishing(self.create_stmt())
+        if low == "drop":
+            return self._finishing(self.drop_stmt())
+        if low == "truncate":
+            self.next()
+            self.expect_kw("table")
+            return self._finishing(ast.TruncateTable(self.qualified_name()))
+        if low in ("insert", "put"):
+            return self._finishing(self.insert_stmt())
+        if low == "update":
+            return self._finishing(self.update_stmt())
+        if low == "delete":
+            return self._finishing(self.delete_stmt())
+        if low == "show":
+            self.next()
+            self.expect_kw("tables")
+            return self._finishing(ast.ShowTables())
+        if low == "describe":
+            self.next()
+            return self._finishing(ast.DescribeTable(self.qualified_name()))
+        if low == "set":
+            return self._finishing(self.set_stmt())
+        if low == "values":
+            plan = self.values_clause()
+            return self._finishing(ast.Query(plan))
+        raise SQLSyntaxError(f"cannot parse statement starting at {t.value!r}")
+
+    def _finishing(self, stmt: ast.Statement) -> ast.Statement:
+        self._finish()
+        return stmt
+
+    def _finish(self) -> None:
+        self.accept_op(";")
+        t = self.peek()
+        if t.kind != "EOF":
+            raise SQLSyntaxError(f"unexpected trailing input at {t.pos}: {t.value!r}")
+
+    # --- queries ----------------------------------------------------------
+
+    def query_expr(self) -> ast.Plan:
+        left = self.query_term()
+        while self.at_kw("union"):
+            self.next()
+            all_ = self.accept_kw("all")
+            if not all_:
+                self.accept_kw("distinct")
+            right = self.query_term()
+            left = ast.Union(left, right, all=all_)
+            if not all_:
+                left = ast.Distinct(left)
+        # trailing ORDER BY / LIMIT apply to the union result
+        left = self._order_limit(left)
+        return left
+
+    def query_term(self) -> ast.Plan:
+        if self.at_op("("):
+            self.next()
+            q = self.query_expr()
+            self.expect_op(")")
+            return q
+        if self.at_kw("values"):
+            return self.values_clause()
+        return self.select_stmt()
+
+    def values_clause(self) -> ast.Plan:
+        self.expect_kw("values")
+        rows = []
+        while True:
+            self.expect_op("(")
+            row = [self.expr()]
+            while self.accept_op(","):
+                row.append(self.expr())
+            self.expect_op(")")
+            rows.append(tuple(row))
+            if not self.accept_op(","):
+                break
+        return ast.Values(tuple(rows))
+
+    def select_stmt(self) -> ast.Plan:
+        self.expect_kw("select")
+        distinct = False
+        if self.accept_kw("distinct"):
+            distinct = True
+        else:
+            self.accept_kw("all")
+        select_list = [self.select_item()]
+        while self.accept_op(","):
+            select_list.append(self.select_item())
+
+        plan: ast.Plan
+        if self.accept_kw("from"):
+            plan = self.from_clause()
+        else:
+            plan = ast.Values(((ast.Lit(1),),))  # SELECT without FROM
+
+        if self.accept_kw("where"):
+            plan = ast.Filter(plan, self.expr())
+
+        group_exprs: List[ast.Expr] = []
+        if self.at_kw("group"):
+            self.next()
+            self.expect_kw("by")
+            group_exprs.append(self.expr())
+            while self.accept_op(","):
+                group_exprs.append(self.expr())
+
+        having = None
+        if self.accept_kw("having"):
+            having = self.expr()
+
+        has_agg = any(ast.is_aggregate(e) for e in select_list)
+        if group_exprs or has_agg or having is not None:
+            plan = ast.Aggregate(plan, tuple(group_exprs), tuple(select_list))
+            if having is not None:
+                plan = ast.Filter(plan, having)
+        else:
+            plan = ast.Project(plan, tuple(select_list))
+
+        if distinct:
+            plan = ast.Distinct(plan)
+        plan = self._order_limit(plan)
+        return plan
+
+    def _order_limit(self, plan: ast.Plan) -> ast.Plan:
+        if self.at_kw("order"):
+            self.next()
+            self.expect_kw("by")
+            orders = [self.sort_item()]
+            while self.accept_op(","):
+                orders.append(self.sort_item())
+            plan = ast.Sort(plan, tuple(orders))
+        if self.accept_kw("limit"):
+            t = self.next()
+            if t.kind != "NUM":
+                raise SQLSyntaxError(f"LIMIT expects a number at {t.pos}")
+            plan = ast.Limit(plan, int(t.value))
+        return plan
+
+    def sort_item(self) -> Tuple[ast.Expr, bool]:
+        e = self.expr()
+        asc = True
+        if self.accept_kw("desc"):
+            asc = False
+        else:
+            self.accept_kw("asc")
+        if self.accept_kw("nulls"):
+            if not (self.accept_kw("first") or self.accept_kw("last")):
+                raise SQLSyntaxError("expected FIRST or LAST after NULLS")
+        return (e, asc)
+
+    def select_item(self) -> ast.Expr:
+        if self.at_op("*"):
+            self.next()
+            return ast.Star()
+        # qualified star: t.*
+        if self.peek().kind in ("IDENT",) and self.peek(1).kind == "OP" \
+                and self.peek(1).value == "." and self.peek(2).kind == "OP" \
+                and self.peek(2).value == "*":
+            q = self.ident()
+            self.next()
+            self.next()
+            return ast.Star(qualifier=q)
+        e = self.expr()
+        if self.accept_kw("as"):
+            return ast.Alias(e, self.ident())
+        t = self.peek()
+        if t.kind == "IDENT":
+            self.next()
+            return ast.Alias(e, t.value)
+        return e
+
+    def from_clause(self) -> ast.Plan:
+        plan = self.table_factor()
+        while True:
+            if self.accept_op(","):
+                plan = ast.Join(plan, self.table_factor(), "cross", None)
+                continue
+            how = self._join_type()
+            if how is None:
+                break
+            right = self.table_factor()
+            cond = None
+            if self.accept_kw("on"):
+                cond = self.expr()
+            elif how != "cross":
+                if self.at_kw("using"):
+                    raise SQLSyntaxError("JOIN ... USING not supported yet")
+            plan = ast.Join(plan, right, how, cond)
+        return plan
+
+    def _join_type(self) -> Optional[str]:
+        if self.accept_kw("cross"):
+            self.expect_kw("join")
+            return "cross"
+        if self.accept_kw("inner"):
+            self.expect_kw("join")
+            return "inner"
+        for how in ("left", "right", "full"):
+            if self.at_kw(how):
+                self.next()
+                self.accept_kw("outer") or self.accept_kw("semi") or \
+                    self.accept_kw("anti")
+                self.expect_kw("join")
+                return how
+        if self.accept_kw("join"):
+            return "inner"
+        return None
+
+    def table_factor(self) -> ast.Plan:
+        if self.at_op("("):
+            self.next()
+            sub = self.query_expr()
+            self.expect_op(")")
+            alias = self._table_alias()
+            if alias is None:
+                raise SQLSyntaxError("subquery in FROM requires an alias")
+            return ast.SubqueryAlias(sub, alias)
+        name = self.qualified_name()
+        alias = self._table_alias()
+        return ast.UnresolvedRelation(name, alias)
+
+    def _table_alias(self) -> Optional[str]:
+        if self.accept_kw("as"):
+            return self.ident()
+        t = self.peek()
+        if t.kind == "IDENT":
+            self.next()
+            return t.value
+        return None
+
+    # --- expressions (Pratt) ---------------------------------------------
+
+    def expr(self) -> ast.Expr:
+        return self.or_expr()
+
+    def or_expr(self) -> ast.Expr:
+        left = self.and_expr()
+        while self.accept_kw("or"):
+            left = ast.BinOp("or", left, self.and_expr())
+        return left
+
+    def and_expr(self) -> ast.Expr:
+        left = self.not_expr()
+        while self.accept_kw("and"):
+            left = ast.BinOp("and", left, self.not_expr())
+        return left
+
+    def not_expr(self) -> ast.Expr:
+        if self.accept_kw("not"):
+            return ast.UnaryOp("not", self.not_expr())
+        return self.predicate()
+
+    def predicate(self) -> ast.Expr:
+        left = self.add_expr()
+        if self.at_op("=", "!=", "<>", "<", "<=", ">", ">="):
+            op = self.next().value
+            if op == "<>":
+                op = "!="
+            return ast.BinOp(op, left, self.add_expr())
+        negated = False
+        if self.at_kw("not"):
+            # NOT IN / NOT BETWEEN / NOT LIKE
+            nxt = self.peek(1)
+            if nxt.kind == "KW" and nxt.value.lower() in ("in", "between", "like"):
+                self.next()
+                negated = True
+        if self.accept_kw("is"):
+            neg = self.accept_kw("not")
+            self.expect_kw("null")
+            return ast.IsNull(left, negated=neg)
+        if self.accept_kw("in"):
+            self.expect_op("(")
+            if self.at_kw("select"):
+                raise SQLSyntaxError("IN (subquery) not supported yet")
+            vals = [self.expr()]
+            while self.accept_op(","):
+                vals.append(self.expr())
+            self.expect_op(")")
+            return ast.InList(left, tuple(vals), negated=negated)
+        if self.accept_kw("between"):
+            lo = self.add_expr()
+            self.expect_kw("and")
+            hi = self.add_expr()
+            return ast.Between(left, lo, hi, negated=negated)
+        if self.accept_kw("like"):
+            t = self.next()
+            if t.kind != "STR":
+                raise SQLSyntaxError("LIKE expects a string literal")
+            return ast.Like(left, t.value, negated=negated)
+        return left
+
+    def add_expr(self) -> ast.Expr:
+        left = self.mul_expr()
+        while True:
+            if self.at_op("+", "-"):
+                op = self.next().value
+                left = ast.BinOp(op, left, self.mul_expr())
+            elif self.at_op("||"):
+                self.next()
+                left = ast.Func("concat", (left, self.mul_expr()))
+            else:
+                return left
+
+    def mul_expr(self) -> ast.Expr:
+        left = self.unary()
+        while self.at_op("*", "/", "%"):
+            op = self.next().value
+            left = ast.BinOp(op, left, self.unary())
+        return left
+
+    def unary(self) -> ast.Expr:
+        if self.accept_op("-"):
+            return ast.UnaryOp("neg", self.unary())
+        if self.accept_op("+"):
+            return self.unary()
+        return self.primary()
+
+    def primary(self) -> ast.Expr:
+        t = self.peek()
+        if t.kind == "NUM":
+            self.next()
+            if "." in t.value or "e" in t.value.lower():
+                return ast.Lit(float(t.value), T.DOUBLE)
+            v = int(t.value)
+            return ast.Lit(v, T.LONG if abs(v) > 2**31 - 1 else T.INT)
+        if t.kind == "STR":
+            self.next()
+            return ast.Lit(t.value, T.STRING)
+        if t.kind == "OP" and t.value == "?":
+            self.next()
+            return ast.Param(pos=-1)  # positions assigned by analyzer
+        if t.kind == "OP" and t.value == "(":
+            self.next()
+            e = self.expr()
+            self.expect_op(")")
+            return e
+        if t.kind == "KW":
+            low = t.value.lower()
+            if low == "null":
+                self.next()
+                return ast.Lit(None)
+            if low in ("true", "false"):
+                self.next()
+                return ast.Lit(low == "true", T.BOOLEAN)
+            if low == "date" and self.peek(1).kind == "STR":
+                self.next()
+                return ast.Lit(_date_to_days(self.next().value), T.DATE)
+            if low == "timestamp" and self.peek(1).kind == "STR":
+                self.next()
+                return ast.Lit(_ts_to_micros(self.next().value), T.TIMESTAMP)
+            if low == "interval":
+                return self.interval_literal()
+            if low == "case":
+                return self.case_expr()
+            if low == "cast":
+                self.next()
+                self.expect_op("(")
+                e = self.expr()
+                self.expect_kw("as")
+                dt = self.type_name()
+                self.expect_op(")")
+                return ast.Cast(e, dt)
+            if low == "exists":
+                raise SQLSyntaxError("EXISTS subqueries not supported yet")
+            if low in ("left", "right"):  # string funcs shadowed by keywords
+                if self.peek(1).kind == "OP" and self.peek(1).value == "(":
+                    name = self.next().value
+                    return self.func_call(name)
+        # identifier: column ref or function call
+        if t.kind in ("IDENT", "KW"):
+            name = self.ident()
+            if self.at_op("(") :
+                return self.func_call(name)
+            if self.accept_op("."):
+                col = self.ident()
+                return ast.Col(col, qualifier=name)
+            return ast.Col(name)
+        raise SQLSyntaxError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def func_call(self, name: str) -> ast.Expr:
+        self.expect_op("(")
+        if self.at_op("*"):
+            self.next()
+            self.expect_op(")")
+            return ast.Func("count", ())  # count(*)
+        distinct = self.accept_kw("distinct")
+        args: List[ast.Expr] = []
+        if not self.at_op(")"):
+            args.append(self.expr())
+            while self.accept_op(","):
+                args.append(self.expr())
+        self.expect_op(")")
+        low = name.lower()
+        if distinct and low == "count":
+            return ast.Func("count_distinct", tuple(args))
+        return ast.Func(low, tuple(args), distinct=distinct)
+
+    def interval_literal(self) -> ast.Expr:
+        """INTERVAL '90' DAY → Lit(days) tagged DATE-delta (int)."""
+        self.expect_kw("interval")
+        t = self.next()
+        if t.kind not in ("STR", "NUM"):
+            raise SQLSyntaxError("INTERVAL expects a quantity")
+        qty = int(float(t.value))
+        unit_t = self.next()
+        unit = unit_t.value.lower().rstrip("s")
+        if unit == "day":
+            return ast.Lit(qty, T.DATE)  # day-granularity delta
+        if unit == "month":
+            return ast.Lit(qty * 30, T.DATE)  # calendar-naive, documented
+        if unit == "year":
+            return ast.Lit(qty * 365, T.DATE)
+        if unit in ("hour", "minute", "second"):
+            mult = {"hour": 3600, "minute": 60, "second": 1}[unit]
+            return ast.Lit(qty * mult * 1_000_000, T.TIMESTAMP)
+        raise SQLSyntaxError(f"unsupported interval unit {unit_t.value!r}")
+
+    def case_expr(self) -> ast.Expr:
+        self.expect_kw("case")
+        operand = None
+        if not self.at_kw("when"):
+            operand = self.expr()
+        whens = []
+        while self.accept_kw("when"):
+            cond = self.expr()
+            if operand is not None:
+                cond = ast.BinOp("=", operand, cond)
+            self.expect_kw("then")
+            whens.append((cond, self.expr()))
+        otherwise = None
+        if self.accept_kw("else"):
+            otherwise = self.expr()
+        self.expect_kw("end")
+        return ast.Case(tuple(whens), otherwise)
+
+    def type_name(self) -> T.DataType:
+        name = self.ident()
+        args = []
+        if self.accept_op("("):
+            while not self.at_op(")"):
+                args.append(self.next().value)
+                self.accept_op(",")
+            self.expect_op(")")
+        return T.parse_type(name, args)
+
+    # --- DDL / DML --------------------------------------------------------
+
+    def create_stmt(self) -> ast.Statement:
+        self.expect_kw("create")
+        or_replace = False
+        if self.accept_kw("or"):
+            self.expect_kw("replace")
+            or_replace = True
+        temporary = self.accept_kw("temporary")
+        if self.accept_kw("view"):
+            name = self.qualified_name()
+            self.expect_kw("as")
+            return ast.CreateView(name, self.query_expr(), or_replace=or_replace)
+        self.accept_kw("external")
+        sample = self.accept_kw("sample")
+        self.expect_kw("table")
+        if_not_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("not")
+            self.expect_kw("exists")
+            if_not_exists = True
+        name = self.qualified_name()
+        columns: List[ast.ColumnDef] = []
+        if self.at_op("("):
+            columns = self.column_defs()
+        provider = "sample" if sample else "column"
+        if self.accept_kw("using"):
+            provider = self.ident().lower()
+            if sample:
+                provider = "sample"
+        options = {}
+        if self.accept_kw("options"):
+            options = self.options_clause()
+        as_select = None
+        if self.accept_kw("as"):
+            as_select = self.query_expr()
+        return ast.CreateTable(name, tuple(columns), provider, options,
+                               as_select, if_not_exists, temporary)
+
+    def column_defs(self) -> List[ast.ColumnDef]:
+        self.expect_op("(")
+        out: List[ast.ColumnDef] = []
+        pk_cols: List[str] = []
+        while True:
+            if self.accept_kw("primary"):
+                self.expect_kw("key")
+                self.expect_op("(")
+                while not self.at_op(")"):
+                    pk_cols.append(self.ident())
+                    self.accept_op(",")
+                self.expect_op(")")
+            else:
+                cname = self.ident()
+                dt = self.type_name()
+                nullable = True
+                primary = False
+                while True:
+                    if self.accept_kw("not"):
+                        self.expect_kw("null")
+                        nullable = False
+                    elif self.accept_kw("primary"):
+                        self.expect_kw("key")
+                        primary = True
+                        nullable = False
+                    else:
+                        break
+                out.append(ast.ColumnDef(cname, dt, nullable, primary))
+            if not self.accept_op(","):
+                break
+        self.expect_op(")")
+        if pk_cols:
+            pk_set = {c.lower() for c in pk_cols}
+            out = [ast.ColumnDef(c.name, c.dtype,
+                                 c.nullable and c.name.lower() not in pk_set,
+                                 c.primary_key or c.name.lower() in pk_set)
+                   for c in out]
+        return out
+
+    def options_clause(self) -> dict:
+        self.expect_op("(")
+        opts = {}
+        while not self.at_op(")"):
+            key = self.ident()
+            while self.accept_op("."):
+                key += "." + self.ident()
+            t = self.next()
+            if t.kind not in ("STR", "NUM", "IDENT", "KW"):
+                raise SQLSyntaxError(f"bad option value at {t.pos}")
+            opts[key.lower()] = t.value
+            self.accept_op(",")
+        self.expect_op(")")
+        return opts
+
+    def drop_stmt(self) -> ast.Statement:
+        self.expect_kw("drop")
+        is_view = self.accept_kw("view")
+        if not is_view:
+            self.expect_kw("table")
+        if_exists = False
+        if self.accept_kw("if"):
+            self.expect_kw("exists")
+            if_exists = True
+        name = self.qualified_name()
+        if is_view:
+            return ast.DropView(name, if_exists)
+        return ast.DropTable(name, if_exists)
+
+    def insert_stmt(self) -> ast.Statement:
+        put = self.accept_kw("put")
+        if not put:
+            self.expect_kw("insert")
+        overwrite = False
+        if self.accept_kw("overwrite"):
+            overwrite = True
+            self.accept_kw("into") or self.accept_kw("table")
+        else:
+            self.expect_kw("into")
+            self.accept_kw("table")
+        table = self.qualified_name()
+        columns: Tuple[str, ...] = ()
+        if self.at_op("(") and self._looks_like_column_list():
+            self.next()
+            cols = [self.ident()]
+            while self.accept_op(","):
+                cols.append(self.ident())
+            self.expect_op(")")
+            columns = tuple(cols)
+        if self.at_kw("values"):
+            source = self.values_clause()
+        else:
+            source = self.query_expr()
+        return ast.InsertInto(table, columns, source, put=put,
+                              overwrite=overwrite)
+
+    def _looks_like_column_list(self) -> bool:
+        """Disambiguate INSERT INTO t (a, b) VALUES… from INSERT INTO t
+        (SELECT…): scan ahead for a SELECT right after '('."""
+        return not (self.peek(1).kind == "KW"
+                    and self.peek(1).value.lower() in ("select", "values"))
+
+    def update_stmt(self) -> ast.Statement:
+        self.expect_kw("update")
+        table = self.qualified_name()
+        self.expect_kw("set")
+        assigns = []
+        while True:
+            col = self.ident()
+            if self.accept_op("."):
+                col = self.ident()
+            self.expect_op("=")
+            assigns.append((col, self.expr()))
+            if not self.accept_op(","):
+                break
+        where = None
+        if self.accept_kw("where"):
+            where = self.expr()
+        return ast.UpdateStmt(table, tuple(assigns), where)
+
+    def delete_stmt(self) -> ast.Statement:
+        self.expect_kw("delete")
+        self.expect_kw("from")
+        table = self.qualified_name()
+        where = None
+        if self.accept_kw("where"):
+            where = self.expr()
+        return ast.DeleteStmt(table, where)
+
+    def set_stmt(self) -> ast.Statement:
+        self.expect_kw("set")
+        key = self.ident()
+        while self.accept_op(".") or self.accept_op("-"):
+            key += "." + self.ident()
+        self.expect_op("=")
+        parts = []
+        while self.peek().kind != "EOF" and not self.at_op(";"):
+            parts.append(self.next().value)
+        return ast.SetConf(key, " ".join(parts))
+
+
+def parse(sql: str) -> ast.Statement:
+    return Parser(sql).parse_statement()

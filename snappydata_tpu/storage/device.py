@@ -41,6 +41,8 @@ class DeviceTable:
     stats_min: Dict[int, np.ndarray]         # numeric col -> host [B]
     stats_max: Dict[int, np.ndarray]
     total_rows: int
+    nulls: Dict[int, Optional[jnp.ndarray]] = dataclasses.field(
+        default_factory=dict)                # col_idx -> bool [B, C] or None
 
     def column(self, idx: int) -> jnp.ndarray:
         return self.columns[idx]
@@ -86,6 +88,7 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
     dicts: Dict[int, np.ndarray] = {}
     stats_min: Dict[int, np.ndarray] = {}
     stats_max: Dict[int, np.ndarray] = {}
+    nulls: Dict[int, Optional[jnp.ndarray]] = {}
     for ci in col_indices:
         f = schema.fields[ci]
         is_str = f.dtype.name == "string"
@@ -93,13 +96,21 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
             dicts[ci] = data.dictionary(ci)
         key = ("col", ci)
         if key not in cache:
+            from snappydata_tpu.storage.encoding import decode_validity
+
             dt = f.dtype.device_dtype()
             stacked = np.zeros((b, cap), dtype=dt)
+            null_mask = np.zeros((b, cap), dtype=np.bool_)
+            any_null = False
             smin = np.full(b, np.nan)
             smax = np.full(b, np.nan)
             for i, v in enumerate(views):
                 decoded = v.decoded_column(ci)
                 stacked[i] = decoded
+                nm = v.null_mask(ci)  # delta-aware (updates can set/clear)
+                if nm is not None:
+                    null_mask[i] = nm
+                    any_null = True
                 st = v.batch.columns[ci].stats
                 if st is not None and not v.deltas and not is_str \
                         and st.min is not None:
@@ -110,6 +121,9 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                         smin[i], smax[i] = float(live.min()), float(live.max())
             for j, (pos, take) in enumerate(row_chunks):
                 src = manifest.row_arrays[ci][pos:pos + take]
+                chunk_nulls = None
+                if manifest.row_nulls and manifest.row_nulls[ci] is not None:
+                    chunk_nulls = manifest.row_nulls[ci][pos:pos + take]
                 if is_str:
                     lookup = data._dict_lookup[ci]
                     # None (SQL NULL) maps to code 0; nullability is carried
@@ -117,17 +131,25 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                     vals = np.fromiter(
                         (lookup[x] if x is not None else 0 for x in src),
                         dtype=np.int32, count=take)
+                    none_mask = np.fromiter((x is None for x in src),
+                                            dtype=np.bool_, count=take)
+                    chunk_nulls = none_mask if chunk_nulls is None \
+                        else (chunk_nulls | none_mask)
                 else:
                     vals = np.asarray(src).astype(dt)
+                if chunk_nulls is not None and chunk_nulls.any():
+                    null_mask[len(views) + j, :take] = chunk_nulls
+                    any_null = True
                 stacked[len(views) + j, :take] = vals
                 if not is_str and take:
                     smin[len(views) + j] = float(vals.min())
                     smax[len(views) + j] = float(vals.max())
-            cache[key] = (jnp.asarray(stacked), smin, smax)
-        columns[ci], stats_min[ci], stats_max[ci] = cache[key]
+            cache[key] = (jnp.asarray(stacked), smin, smax,
+                          jnp.asarray(null_mask) if any_null else None)
+        columns[ci], stats_min[ci], stats_max[ci], nulls[ci] = cache[key]
 
     return DeviceTable(schema, b, cap, cache["valid"], columns, dicts,
-                       stats_min, stats_max, manifest.total_rows())
+                       stats_min, stats_max, manifest.total_rows(), nulls)
 
 
 def data_pow2() -> bool:

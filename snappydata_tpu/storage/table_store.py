@@ -44,17 +44,36 @@ class BatchView:
 
     batch: ColumnBatch
     delete_mask: Optional[np.ndarray] = None     # bool[capacity]; True = deleted
-    # update deltas: col_idx -> (mask bool[capacity], values device-dtype[capacity])
-    deltas: Tuple[Tuple[int, np.ndarray, np.ndarray], ...] = ()
+    # update deltas: col_idx -> (hit mask bool[capacity],
+    #   values device-dtype[capacity], value-null mask bool[capacity] | None)
+    deltas: Tuple[Tuple[int, np.ndarray, np.ndarray,
+                        Optional[np.ndarray]], ...] = ()
 
     def decoded_column(self, col_idx: int, strings: bool = False) -> np.ndarray:
         """Base decode + delta merge (ref UpdatedColumnDecoder semantics)."""
         col = self.batch.columns[col_idx]
         out = decode_to_numpy(col, self.batch.capacity, strings=strings)
-        for ci, mask, values in self.deltas:
+        for ci, mask, values, _ in self.deltas:
             if ci == col_idx:
                 out = np.where(mask, values, out)
         return out
+
+    def null_mask(self, col_idx: int) -> Optional[np.ndarray]:
+        """Effective null mask after delta merge (a delta can both clear a
+        NULL by assigning a value and set one by assigning NULL)."""
+        base = decode_validity(self.batch.columns[col_idx],
+                               self.batch.capacity)
+        mask = (~base) if base is not None else None
+        for ci, hit, _, value_nulls in self.deltas:
+            if ci != col_idx:
+                continue
+            if mask is None:
+                mask = np.zeros(self.batch.capacity, dtype=np.bool_)
+            vn = value_nulls if value_nulls is not None else False
+            mask = np.where(hit, vn, mask)
+        if mask is not None and not mask.any():
+            return None
+        return mask
 
     def live_mask(self) -> np.ndarray:
         m = np.arange(self.batch.capacity) < self.batch.num_rows
@@ -76,6 +95,8 @@ class Manifest:
     # row-buffer snapshot: per-column host arrays of the delta rows
     row_arrays: Tuple[np.ndarray, ...]
     row_count: int
+    # per-column bool null masks for the row-buffer rows (None = no nulls)
+    row_nulls: Tuple[Optional[np.ndarray], ...] = ()
 
     def total_rows(self) -> int:
         return sum(v.live_rows() for v in self.views) + self.row_count
@@ -92,28 +113,43 @@ class RowBuffer:
         self.capacity = capacity
         self._cols: List[np.ndarray] = [
             np.empty(capacity, dtype=f.dtype.np_dtype) for f in schema.fields]
+        self._nulls: List[Optional[np.ndarray]] = [None] * len(schema.fields)
         self._valid = np.ones(capacity, dtype=np.bool_)  # False = deleted in place
         self.count = 0
 
-    def append(self, arrays: Sequence[np.ndarray]) -> int:
+    def append(self, arrays: Sequence[np.ndarray],
+               nulls: Optional[Sequence[Optional[np.ndarray]]] = None) -> int:
         n = int(np.asarray(arrays[0]).shape[0])
         assert self.count + n <= self.capacity
-        for dst, src in zip(self._cols, arrays):
+        for i, (dst, src) in enumerate(zip(self._cols, arrays)):
             dst[self.count:self.count + n] = np.asarray(src)
+            nm = nulls[i] if nulls is not None else None
+            if nm is not None and nm.any():
+                if self._nulls[i] is None:
+                    self._nulls[i] = np.zeros(self.capacity, dtype=np.bool_)
+                self._nulls[i][self.count:self.count + n] = nm
+            elif self._nulls[i] is not None:
+                self._nulls[i][self.count:self.count + n] = False
         self._valid[self.count:self.count + n] = True
         self.count += n
         return n
 
-    def snapshot(self) -> Tuple[Tuple[np.ndarray, ...], int]:
+    def snapshot(self) -> Tuple[Tuple[np.ndarray, ...],
+                                Tuple[Optional[np.ndarray], ...], int]:
         live = self._valid[:self.count]
         if live.all():
             arrs = tuple(c[:self.count].copy() for c in self._cols)
-            return arrs, self.count
+            nls = tuple(m[:self.count].copy() if m is not None else None
+                        for m in self._nulls)
+            return arrs, nls, self.count
         arrs = tuple(c[:self.count][live].copy() for c in self._cols)
-        return arrs, int(live.sum())
+        nls = tuple(m[:self.count][live].copy() if m is not None else None
+                    for m in self._nulls)
+        return arrs, nls, int(live.sum())
 
     def clear(self) -> None:
         self.count = 0
+        self._nulls = [None] * len(self.schema.fields)
 
 
 class ColumnTableData:
@@ -135,8 +171,10 @@ class ColumnTableData:
         self._dicts: Dict[int, List] = {
             i: [] for i, f in enumerate(schema.fields) if f.dtype.name == "string"}
         self._dict_lookup: Dict[int, Dict] = {i: {} for i in self._dicts}
-        self._manifest = Manifest(0, (), tuple(
-            np.empty(0, dtype=f.dtype.np_dtype) for f in schema.fields), 0)
+        self._manifest = Manifest(
+            0, (), tuple(np.empty(0, dtype=f.dtype.np_dtype)
+                         for f in schema.fields), 0,
+            tuple(None for _ in schema.fields))
         # device cache: manifest version -> {key: device arrays}. Keyed per
         # version so concurrent readers of different snapshots never mix
         # entries (review finding: clear+overwrite raced).
@@ -148,8 +186,9 @@ class ColumnTableData:
         return self._manifest
 
     def _publish(self, views: Tuple[BatchView, ...]) -> Manifest:
-        row_arrays, row_count = self._row_buffer.snapshot()
-        m = Manifest(self._manifest.version + 1, views, row_arrays, row_count)
+        row_arrays, row_nulls, row_count = self._row_buffer.snapshot()
+        m = Manifest(self._manifest.version + 1, views, row_arrays, row_count,
+                     row_nulls)
         self._manifest = m
         return m
 
@@ -173,10 +212,15 @@ class ColumnTableData:
 
     # --- writes ----------------------------------------------------------
 
-    def insert_arrays(self, arrays: Sequence[np.ndarray]) -> int:
+    def insert_arrays(self, arrays: Sequence[np.ndarray],
+                      nulls: Optional[Sequence[Optional[np.ndarray]]] = None
+                      ) -> int:
         """Bulk/small insert. Large inserts cut column batches directly
         (ref ColumnInsertExec bulk path); small ones land in the row buffer
-        and roll over when it exceeds max_delta_rows (ref §3.3)."""
+        and roll over when it exceeds max_delta_rows (ref §3.3).
+
+        `nulls[i]` is an optional bool mask marking SQL NULLs in column i
+        (values at those positions are fillers)."""
         arrays = [np.asarray(a) for a in arrays]
         if len(arrays) != len(self.schema.fields):
             raise ValueError(
@@ -186,6 +230,8 @@ class ColumnTableData:
             if int(a.shape[0]) != n:
                 raise ValueError(
                     f"column {f.name}: length {a.shape[0]} != {n}")
+        if nulls is None:
+            nulls = [None] * len(arrays)
         with self._lock:
             # intern string values up front so row-buffer rows resolve to
             # dictionary codes at device-build time without mutation
@@ -197,33 +243,45 @@ class ColumnTableData:
             if n >= self.max_delta_rows:
                 while n - pos >= self.max_delta_rows:
                     take = min(self.capacity, n - pos)
+                    sl = slice(pos, pos + take)
                     views.append(self._cut_batch(
-                        [a[pos:pos + take] for a in arrays]))
+                        [a[sl] for a in arrays],
+                        [m[sl] if m is not None else None for m in nulls]))
                     pos += take
             if pos < n:
-                self._row_buffer.append([a[pos:] for a in arrays])
+                self._row_buffer.append(
+                    [a[pos:] for a in arrays],
+                    [m[pos:] if m is not None else None for m in nulls])
             if self._row_buffer.count >= self.max_delta_rows:
                 views.extend(self._rollover_locked())
             self._publish(tuple(views))
             return n
 
-    def _cut_batch(self, arrays: List[np.ndarray]) -> BatchView:
+    def _cut_batch(self, arrays: List[np.ndarray],
+                   nulls: Optional[List[Optional[np.ndarray]]] = None
+                   ) -> BatchView:
         dicts = {}
         for i in self._dicts:
             dicts[i] = self._intern_strings(i, arrays[i])
+        validities = None
+        if nulls is not None and any(m is not None and m.any() for m in nulls):
+            validities = [~m if m is not None else None for m in nulls]
         batch = ColumnBatch.from_arrays(
             next(self._batch_ids), 0, self.schema, arrays, self.capacity,
-            dictionaries=dicts)
+            validities=validities, dictionaries=dicts)
         return BatchView(batch)
 
     def _rollover_locked(self) -> List[BatchView]:
-        arrays, cnt = self._row_buffer.snapshot()
+        arrays, nulls, cnt = self._row_buffer.snapshot()
         self._row_buffer.clear()
         out = []
         pos = 0
         while pos < cnt:
             take = min(self.capacity, cnt - pos)
-            out.append(self._cut_batch([a[pos:pos + take] for a in arrays]))
+            sl = slice(pos, pos + take)
+            out.append(self._cut_batch(
+                [a[sl] for a in arrays],
+                [m[sl] if m is not None else None for m in nulls]))
             pos += take
         return out
 
@@ -253,9 +311,10 @@ class ColumnTableData:
                 deltas = list(view.deltas)
                 for name, fn in assignments.items():
                     ci = self.schema.index(name)
-                    values = self._to_device_domain(ci, np.asarray(fn(cols)),
-                                                    cols[self.schema.fields[ci].name])
-                    deltas.append((ci, hit.copy(), values))
+                    raw = fn(cols)
+                    values, vnulls = self._to_device_domain(
+                        ci, raw, cols[self.schema.fields[ci].name])
+                    deltas.append((ci, hit.copy(), values, vnulls))
                 new_views.append(dataclasses.replace(view, deltas=tuple(deltas)))
             # row buffer in place
             rb_cols = self._row_buffer_dict()
@@ -264,10 +323,18 @@ class ColumnTableData:
                     self._row_buffer._valid[:self._row_buffer.count]
                 if hit.any():
                     touched += int(hit.sum())
+                    rb = self._row_buffer
                     for name, fn in assignments.items():
                         ci = self.schema.index(name)
-                        vals = np.asarray(fn(rb_cols))
-                        col = self._row_buffer._cols[ci][:self._row_buffer.count]
+                        col = rb._cols[ci][:rb.count]
+                        raw = fn(rb_cols)
+                        if raw is None:  # SQL NULL assignment
+                            if rb._nulls[ci] is None:
+                                rb._nulls[ci] = np.zeros(rb.capacity,
+                                                         dtype=np.bool_)
+                            rb._nulls[ci][:rb.count][hit] = True
+                            continue
+                        vals = np.asarray(raw)
                         new = np.broadcast_to(
                             np.asarray(vals, dtype=col.dtype), col.shape)[hit] \
                             if vals.shape == () else vals[hit]
@@ -276,6 +343,8 @@ class ColumnTableData:
                             self._intern_strings(
                                 ci, np.asarray(new, dtype=object))
                         col[hit] = new
+                        if rb._nulls[ci] is not None:
+                            rb._nulls[ci][:rb.count][hit] = False
             self._publish(tuple(new_views))
             return touched
 
@@ -324,20 +393,36 @@ class ColumnTableData:
         return {f.name: self._row_buffer._cols[i][:self._row_buffer.count]
                 for i, f in enumerate(self.schema.fields)}
 
-    def _to_device_domain(self, col_idx: int, values: np.ndarray,
-                          like: np.ndarray) -> np.ndarray:
+    def _to_device_domain(self, col_idx: int, values,
+                          like: np.ndarray
+                          ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Assignment values → (device-domain array, null mask | None).
+        Accepts python scalars (incl. None = SQL NULL) or arrays with
+        None entries for string columns."""
         f = self.schema.fields[col_idx]
+        shape = like.shape
+        if values is None:
+            dt = np.int32 if f.dtype.name == "string" \
+                else f.dtype.device_dtype()
+            return (np.zeros(shape, dtype=dt),
+                    np.ones(shape, dtype=np.bool_))
+        values = np.asarray(values)
         if f.dtype.name == "string":
-            vals = np.broadcast_to(values, like.shape) if values.shape == () \
+            vals = np.broadcast_to(values, shape) if values.shape == () \
                 else values
-            self._intern_strings(col_idx, np.asarray(vals, dtype=object))
+            vals = np.asarray(vals, dtype=object)
+            self._intern_strings(col_idx, vals)
             lookup = self._dict_lookup[col_idx]
-            return np.fromiter((lookup[v] for v in vals), dtype=np.int32,
-                               count=len(vals))
+            codes = np.fromiter(
+                (lookup[v] if v is not None else 0 for v in vals),
+                dtype=np.int32, count=len(vals))
+            vnulls = np.fromiter((v is None for v in vals), dtype=np.bool_,
+                                 count=len(vals))
+            return codes, (vnulls if vnulls.any() else None)
         dt = f.dtype.device_dtype()
         if values.shape == ():
-            return np.full(like.shape, values, dtype=dt)
-        return values.astype(dt)
+            return np.full(shape, values, dtype=dt), None
+        return values.astype(dt), None
 
 
 class LazyBatchColumns:
@@ -510,3 +595,20 @@ class RowTableData:
 
     def count(self) -> int:
         return int(sum(self._live))
+
+    def string_dict(self, col_idx: int) -> "np.ndarray":
+        """Version-cached sorted dictionary for a string column, so device
+        binding and result assembly agree on codes within one version."""
+        with self._lock:
+            cache = getattr(self, "_sdict_cache", None)
+            if cache is None or cache[0] != self._version:
+                cache = (self._version, {})
+                self._sdict_cache = cache
+            if col_idx not in cache[1]:
+                vals = [v for v, live in zip(self._cols[col_idx], self._live)
+                        if live]
+                d = np.unique(np.array(
+                    [v if v is not None else "" for v in vals],
+                    dtype=object)) if vals else np.empty(0, dtype=object)
+                cache[1][col_idx] = d
+            return cache[1][col_idx]

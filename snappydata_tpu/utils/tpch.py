@@ -1,0 +1,168 @@
+"""TPC-H-shaped data generator (statistical, not spec-dbgen) + query text.
+
+Used by the correctness tests and bench.py, mirroring the reference's
+in-tree TPC-H harness (cluster/src/test/scala/io/snappydata/benchmark/
+TPCH_Queries.scala, TPCHColumnPartitionedTable.scala): lineitem/orders/
+customer with the columns, domains and correlations the headline queries
+(Q1/Q3/Q6) touch.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import Dict
+
+import numpy as np
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+def _days(iso: str) -> int:
+    return (datetime.date.fromisoformat(iso) - _EPOCH).days
+
+
+LINEITEM_ROWS_PER_SF = 6_000_000
+ORDERS_ROWS_PER_SF = 1_500_000
+CUSTOMER_ROWS_PER_SF = 150_000
+
+RETURNFLAGS = np.array(["A", "N", "R"], dtype=object)
+LINESTATUS = np.array(["F", "O"], dtype=object)
+SEGMENTS = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD",
+                     "MACHINERY"], dtype=object)
+SHIPMODES = np.array(["AIR", "FOB", "MAIL", "RAIL", "REG AIR", "SHIP",
+                      "TRUCK"], dtype=object)
+
+
+def gen_lineitem(num_rows: int, seed: int = 0) -> Dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    orderkey = rng.integers(1, max(2, num_rows // 4), num_rows,
+                            dtype=np.int64)
+    ship = rng.integers(_days("1992-01-02"), _days("1998-12-01"), num_rows,
+                        dtype=np.int32)
+    qty = rng.integers(1, 51, num_rows).astype(np.float64)
+    price = np.round(rng.uniform(900.0, 105_000.0, num_rows), 2)
+    disc = np.round(rng.integers(0, 11, num_rows) * 0.01, 2)
+    tax = np.round(rng.integers(0, 9, num_rows) * 0.01, 2)
+    # linestatus correlates with shipdate in real dbgen (O after 1995-06)
+    status = np.where(ship > _days("1995-06-17"), "O", "F").astype(object)
+    flag = RETURNFLAGS[rng.integers(0, 3, num_rows)]
+    flag[status == "O"] = "N"
+    return {
+        "l_orderkey": orderkey,
+        "l_partkey": rng.integers(1, 200_000, num_rows, dtype=np.int64),
+        "l_suppkey": rng.integers(1, 10_000, num_rows, dtype=np.int64),
+        "l_linenumber": rng.integers(1, 8, num_rows).astype(np.int32),
+        "l_quantity": qty,
+        "l_extendedprice": price,
+        "l_discount": disc,
+        "l_tax": tax,
+        "l_returnflag": flag,
+        "l_linestatus": status,
+        "l_shipdate": ship,
+        "l_commitdate": ship + rng.integers(-30, 30, num_rows,
+                                            dtype=np.int32),
+        "l_receiptdate": ship + rng.integers(1, 30, num_rows,
+                                             dtype=np.int32),
+        "l_shipmode": SHIPMODES[rng.integers(0, len(SHIPMODES), num_rows)],
+    }
+
+
+def gen_orders(num_rows: int, num_customers: int, seed: int = 1
+               ) -> Dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    return {
+        "o_orderkey": np.arange(1, num_rows + 1, dtype=np.int64),
+        "o_custkey": rng.integers(1, max(2, num_customers + 1), num_rows,
+                                  dtype=np.int64),
+        "o_orderstatus": np.array(["F", "O", "P"], dtype=object)[
+            rng.integers(0, 3, num_rows)],
+        "o_totalprice": np.round(rng.uniform(850.0, 560_000.0, num_rows), 2),
+        "o_orderdate": rng.integers(_days("1992-01-01"), _days("1998-08-02"),
+                                    num_rows, dtype=np.int32),
+        "o_orderpriority": np.array(
+            ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED", "5-LOW"],
+            dtype=object)[rng.integers(0, 5, num_rows)],
+        "o_shippriority": np.zeros(num_rows, dtype=np.int32),
+    }
+
+
+def gen_customer(num_rows: int, seed: int = 2) -> Dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    return {
+        "c_custkey": np.arange(1, num_rows + 1, dtype=np.int64),
+        "c_name": np.array([f"Customer#{i:09d}" for i in
+                            range(1, num_rows + 1)], dtype=object),
+        "c_nationkey": rng.integers(0, 25, num_rows, dtype=np.int32),
+        "c_acctbal": np.round(rng.uniform(-999.99, 9999.99, num_rows), 2),
+        "c_mktsegment": SEGMENTS[rng.integers(0, len(SEGMENTS), num_rows)],
+    }
+
+
+LINEITEM_DDL = """CREATE TABLE lineitem (
+    l_orderkey BIGINT, l_partkey BIGINT, l_suppkey BIGINT,
+    l_linenumber INT, l_quantity DOUBLE, l_extendedprice DOUBLE,
+    l_discount DOUBLE, l_tax DOUBLE, l_returnflag STRING,
+    l_linestatus STRING, l_shipdate DATE, l_commitdate DATE,
+    l_receiptdate DATE, l_shipmode STRING
+) USING column OPTIONS (partition_by 'l_orderkey')"""
+
+ORDERS_DDL = """CREATE TABLE orders (
+    o_orderkey BIGINT, o_custkey BIGINT, o_orderstatus STRING,
+    o_totalprice DOUBLE, o_orderdate DATE, o_orderpriority STRING,
+    o_shippriority INT
+) USING column OPTIONS (partition_by 'o_orderkey', colocate_with 'lineitem')"""
+
+CUSTOMER_DDL = """CREATE TABLE customer (
+    c_custkey BIGINT, c_name STRING, c_nationkey INT, c_acctbal DOUBLE,
+    c_mktsegment STRING
+) USING column OPTIONS (partition_by 'c_custkey')"""
+
+Q1 = """SELECT l_returnflag, l_linestatus,
+    sum(l_quantity) AS sum_qty,
+    sum(l_extendedprice) AS sum_base_price,
+    sum(l_extendedprice * (1 - l_discount)) AS sum_disc_price,
+    sum(l_extendedprice * (1 - l_discount) * (1 + l_tax)) AS sum_charge,
+    avg(l_quantity) AS avg_qty,
+    avg(l_extendedprice) AS avg_price,
+    avg(l_discount) AS avg_disc,
+    count(*) AS count_order
+FROM lineitem
+WHERE l_shipdate <= DATE '1998-12-01' - INTERVAL '90' DAY
+GROUP BY l_returnflag, l_linestatus
+ORDER BY l_returnflag, l_linestatus"""
+
+Q6 = """SELECT sum(l_extendedprice * l_discount) AS revenue
+FROM lineitem
+WHERE l_shipdate >= DATE '1994-01-01'
+  AND l_shipdate < DATE '1995-01-01'
+  AND l_discount BETWEEN 0.05 AND 0.07
+  AND l_quantity < 24"""
+
+Q3 = """SELECT l_orderkey,
+    sum(l_extendedprice * (1 - l_discount)) AS revenue,
+    o_orderdate, o_shippriority
+FROM customer, orders, lineitem
+WHERE c_mktsegment = 'BUILDING'
+  AND c_custkey = o_custkey
+  AND l_orderkey = o_orderkey
+  AND o_orderdate < DATE '1995-03-15'
+  AND l_shipdate > DATE '1995-03-15'
+GROUP BY l_orderkey, o_orderdate, o_shippriority
+ORDER BY revenue DESC, o_orderdate
+LIMIT 10"""
+
+
+def load_tpch(session, sf: float = 0.001, seed: int = 0) -> None:
+    """Create + populate the three tables at the given scale factor."""
+    n_l = max(1000, int(LINEITEM_ROWS_PER_SF * sf))
+    n_o = max(250, int(ORDERS_ROWS_PER_SF * sf))
+    n_c = max(25, int(CUSTOMER_ROWS_PER_SF * sf))
+    session.sql(LINEITEM_DDL)
+    session.sql(ORDERS_DDL)
+    session.sql(CUSTOMER_DDL)
+    li = gen_lineitem(n_l, seed)
+    li["l_orderkey"] = np.minimum(li["l_orderkey"], n_o)  # FK into orders
+    session.insert_arrays("lineitem", list(li.values()))
+    session.insert_arrays("orders",
+                          list(gen_orders(n_o, n_c, seed + 1).values()))
+    session.insert_arrays("customer", list(gen_customer(n_c, seed + 2).values()))

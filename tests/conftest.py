@@ -5,12 +5,13 @@ embedded engine in one JVM — no mocks; core/src/test/scala/io/snappydata/
 SnappyFunSuite.scala:51-88): tests run the real engine in-process, with
 multi-"chip" behavior exercised via XLA host devices instead of real TPUs.
 
-Must set env before jax initializes its backend, hence module-level.
+Note: this machine's TPU bootstrap (sitecustomize) force-selects the
+`axon` platform at interpreter start, overriding JAX_PLATFORMS env — so we
+override the *config* after import, before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -18,7 +19,9 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+assert jax.default_backend() == "cpu", jax.default_backend()
 
 import pytest  # noqa: E402
 
@@ -26,7 +29,8 @@ import pytest  # noqa: E402
 @pytest.fixture()
 def session():
     from snappydata_tpu import SnappySession
+    from snappydata_tpu.catalog import Catalog
 
-    s = SnappySession()
+    s = SnappySession(catalog=Catalog())
     yield s
     s.stop()

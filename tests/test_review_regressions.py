@@ -1,0 +1,84 @@
+"""Regressions for code-review findings: NULL fidelity through insert/
+update, prepared-statement params, plan-cache invalidation on DDL,
+self-join aliasing, CTAS IF NOT EXISTS idempotence."""
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+
+
+@pytest.fixture()
+def s():
+    sess = SnappySession(catalog=Catalog())
+    yield sess
+    sess.stop()
+
+
+def test_numeric_null_insert_roundtrip(s):
+    s.sql("CREATE TABLE t (a INT, b DOUBLE) USING column")
+    s.sql("INSERT INTO t VALUES (1, 1.5), (NULL, 2.5), (3, NULL)")
+    assert s.sql("SELECT count(*) FROM t WHERE a IS NULL").rows()[0][0] == 1
+    assert s.sql("SELECT count(a) FROM t").rows()[0][0] == 2
+    assert s.sql("SELECT sum(b) FROM t").rows()[0][0] == pytest.approx(4.0)
+    rows = s.sql("SELECT a, b FROM t ORDER BY b").rows()
+    # Spark semantics: ASC → NULLS FIRST
+    assert rows[0] == (3, None)
+    assert rows[1] == (1, 1.5) and rows[2] == (None, 2.5)
+
+
+def test_null_survives_rollover(s):
+    s.sql("CREATE TABLE t (a INT) USING column "
+          "OPTIONS (column_max_delta_rows '3')")
+    s.sql("INSERT INTO t VALUES (1), (NULL), (2), (NULL), (5)")
+    assert s.sql("SELECT count(*) FROM t WHERE a IS NULL").rows()[0][0] == 2
+    assert s.sql("SELECT sum(a) FROM t").rows()[0][0] == 8
+
+
+def test_update_to_null_and_back(s):
+    s.sql("CREATE TABLE t (k INT, name STRING) USING column "
+          "OPTIONS (column_max_delta_rows '2')")
+    s.sql("INSERT INTO t VALUES (1, 'x'), (2, 'y'), (3, 'z')")
+    s.sql("UPDATE t SET name = NULL WHERE k = 2")
+    assert s.sql("SELECT count(*) FROM t WHERE name IS NULL").rows()[0][0] == 1
+    s.sql("UPDATE t SET name = 'restored' WHERE k = 2")
+    assert s.sql("SELECT count(*) FROM t WHERE name IS NULL").rows()[0][0] == 0
+    rows = {r[0]: r[1] for r in s.sql("SELECT k, name FROM t").rows()}
+    assert rows[2] == "restored"
+
+
+def test_prepared_statement_params(s):
+    s.sql("CREATE TABLE t (a INT, b INT) USING column")
+    s.sql("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")
+    out = s.sql("SELECT a FROM t WHERE a >= ? AND b <= ?", params=(2, 20))
+    assert [r[0] for r in out.rows()] == [2]
+    out = s.sql("SELECT a FROM t WHERE a >= ? AND b <= ?", params=(1, 30))
+    assert sorted(r[0] for r in out.rows()) == [1, 2, 3]
+
+
+def test_plan_cache_invalidated_on_recreate(s):
+    s.sql("CREATE TABLE t (a INT) USING column")
+    s.sql("INSERT INTO t VALUES (1), (2)")
+    assert s.sql("SELECT count(*) FROM t").rows()[0][0] == 2
+    s.sql("DROP TABLE t")
+    s.sql("CREATE TABLE t (a INT) USING column")
+    s.sql("INSERT INTO t VALUES (7)")
+    assert s.sql("SELECT count(*) FROM t").rows()[0][0] == 1
+
+
+def test_self_join_not_collapsed(s):
+    s.sql("CREATE TABLE t (a INT) USING column")
+    s.sql("INSERT INTO t VALUES (1), (2), (3)")
+    out = s.sql("SELECT count(*) FROM t x, t y WHERE x.a = y.a")
+    assert out.rows()[0][0] == 3
+    out = s.sql("SELECT count(*) FROM t x, t y")
+    assert out.rows()[0][0] == 9
+
+
+def test_ctas_if_not_exists_idempotent(s):
+    s.sql("CREATE TABLE src (a INT) USING column")
+    s.sql("INSERT INTO src VALUES (1), (2)")
+    s.sql("CREATE TABLE IF NOT EXISTS dst USING column AS SELECT a FROM src")
+    s.sql("CREATE TABLE IF NOT EXISTS dst USING column AS SELECT a FROM src")
+    assert s.sql("SELECT count(*) FROM dst").rows()[0][0] == 2

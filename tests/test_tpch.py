@@ -1,0 +1,92 @@
+"""TPC-H Q1/Q6/Q3 correctness against a pandas oracle (ref analogue:
+TPCHDUnitTest validating results; tests/benchmark harness §4.5)."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.utils import tpch
+
+
+@pytest.fixture(scope="module")
+def s():
+    sess = SnappySession(catalog=Catalog())
+    tpch.load_tpch(sess, sf=0.002, seed=7)
+    yield sess
+    sess.stop()
+
+
+@pytest.fixture(scope="module")
+def dfs(s):
+    li = pd.DataFrame(tpch.gen_lineitem(
+        max(1000, int(tpch.LINEITEM_ROWS_PER_SF * 0.002)), 7))
+    n_o = max(250, int(tpch.ORDERS_ROWS_PER_SF * 0.002))
+    li["l_orderkey"] = np.minimum(li["l_orderkey"], n_o)
+    orders = pd.DataFrame(tpch.gen_orders(
+        n_o, max(25, int(tpch.CUSTOMER_ROWS_PER_SF * 0.002)), 8))
+    cust = pd.DataFrame(tpch.gen_customer(
+        max(25, int(tpch.CUSTOMER_ROWS_PER_SF * 0.002)), 9))
+    return li, orders, cust
+
+
+def _days(iso):
+    import datetime
+
+    return (datetime.date.fromisoformat(iso) - datetime.date(1970, 1, 1)).days
+
+
+def test_q1(s, dfs):
+    li, _, _ = dfs
+    out = s.sql(tpch.Q1)
+    cut = _days("1998-12-01") - 90
+    sel = li[li.l_shipdate <= cut]
+    grouped = sel.groupby(["l_returnflag", "l_linestatus"], sort=True)
+    rows = out.rows()
+    assert len(rows) == len(grouped)
+    for row, ((rf, ls), g) in zip(rows, grouped):
+        assert row[0] == rf and row[1] == ls
+        assert row[2] == pytest.approx(g.l_quantity.sum())
+        assert row[3] == pytest.approx(g.l_extendedprice.sum())
+        disc_price = g.l_extendedprice * (1 - g.l_discount)
+        assert row[4] == pytest.approx(disc_price.sum())
+        assert row[5] == pytest.approx((disc_price * (1 + g.l_tax)).sum())
+        assert row[6] == pytest.approx(g.l_quantity.mean())
+        assert row[7] == pytest.approx(g.l_extendedprice.mean())
+        assert row[8] == pytest.approx(g.l_discount.mean())
+        assert row[9] == len(g)
+
+
+def test_q6(s, dfs):
+    li, _, _ = dfs
+    out = s.sql(tpch.Q6)
+    sel = li[(li.l_shipdate >= _days("1994-01-01"))
+             & (li.l_shipdate < _days("1995-01-01"))
+             & (li.l_discount >= 0.05) & (li.l_discount <= 0.07)
+             & (li.l_quantity < 24)]
+    expected = (sel.l_extendedprice * sel.l_discount).sum()
+    assert out.rows()[0][0] == pytest.approx(expected)
+
+
+def test_q3(s, dfs):
+    li, orders, cust = dfs
+    out = s.sql(tpch.Q3)
+    cutoff = _days("1995-03-15")
+    c = cust[cust.c_mktsegment == "BUILDING"]
+    o = orders[orders.o_orderdate < cutoff]
+    l = li[li.l_shipdate > cutoff]
+    j = l.merge(o, left_on="l_orderkey", right_on="o_orderkey") \
+        .merge(c, left_on="o_custkey", right_on="c_custkey")
+    j["revenue"] = j.l_extendedprice * (1 - j.l_discount)
+    g = j.groupby(["l_orderkey", "o_orderdate", "o_shippriority"],
+                  as_index=False).revenue.sum()
+    g = g.sort_values(["revenue", "o_orderdate"],
+                      ascending=[False, True]).head(10)
+    rows = out.rows()
+    assert len(rows) == len(g)
+    for row, (_, exp) in zip(rows, g.iterrows()):
+        assert row[0] == exp.l_orderkey
+        assert row[1] == pytest.approx(exp.revenue)
+        assert row[2] == exp.o_orderdate
+        assert row[3] == exp.o_shippriority
