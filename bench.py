@@ -1,0 +1,69 @@
+"""Headline benchmark: TPC-H Q1 + Q6 scan+aggregate throughput on one chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline context (BASELINE.md): the reference's headline claim is the
+quickstart scan+group-by over a 100M-row column table at 16-20x a Spark
+2.1.1 cached DataFrame on a laptop-class JVM (docs/quickstart/
+performance_apache_spark.md:2-6). No absolute rows/sec is published
+in-repo; we peg the baseline at 66M rows/s (100M rows in ~1.5s, the
+midpoint implied by that scenario) and report vs_baseline against it.
+
+Scale via SNAPPY_BENCH_SF (default 2.0 → 12M lineitem rows ≈ 700MB of
+touched columns).
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    sf = float(os.environ.get("SNAPPY_BENCH_SF", "2.0"))
+    repeats = int(os.environ.get("SNAPPY_BENCH_REPEATS", "5"))
+
+    from snappydata_tpu import SnappySession
+    from snappydata_tpu.catalog import Catalog
+    from snappydata_tpu.utils import tpch
+
+    s = SnappySession(catalog=Catalog())
+    t0 = time.time()
+    tpch.load_tpch(s, sf=sf, seed=17)
+    load_s = time.time() - t0
+    n_rows = s.catalog.lookup_table("lineitem").data.snapshot().total_rows()
+
+    timings = {}
+    for name, q in (("q1", tpch.Q1), ("q6", tpch.Q6)):
+        s.sql(q)  # compile + first run
+        best = float("inf")
+        for _ in range(repeats):
+            t0 = time.time()
+            s.sql(q)
+            best = min(best, time.time() - t0)
+        timings[name] = best
+
+    rows_per_s = {k: n_rows / v for k, v in timings.items()}
+    geomean = float(np.sqrt(rows_per_s["q1"] * rows_per_s["q6"]))
+    baseline = 66e6  # see module docstring
+    print(json.dumps({
+        "metric": "rows/sec scanned+aggregated (TPC-H Q1/Q6 geomean, "
+                  f"{n_rows}-row column table)",
+        "value": round(geomean, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(geomean / baseline, 3),
+        "detail": {
+            "sf": sf,
+            "rows": n_rows,
+            "load_s": round(load_s, 2),
+            "q1_s": round(timings["q1"], 4),
+            "q6_s": round(timings["q6"], 4),
+            "q1_rows_per_s": round(rows_per_s["q1"], 1),
+            "q6_rows_per_s": round(rows_per_s["q6"], 1),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

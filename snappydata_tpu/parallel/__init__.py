@@ -1,0 +1,13 @@
+"""Distribution: partitioner, bucket map, device mesh execution.
+
+The reference's parallelism inventory (SURVEY.md §2.6) maps here:
+- PARTITION_BY + murmur3 buckets (StoreHashFunction)  → hashing/buckets
+- replicated tables / collocated joins                → GSPMD shardings
+- partial aggregation + driver merge                  → psum via GSPMD
+"""
+
+from snappydata_tpu.parallel.hashing import murmur3_hash_np  # noqa: F401
+from snappydata_tpu.parallel.mesh import (  # noqa: F401
+    data_mesh, shard_batches, MeshContext,
+)
+from snappydata_tpu.parallel.buckets import BucketMap  # noqa: F401

@@ -1,0 +1,53 @@
+"""Bucket map: table → buckets → placement.
+
+Equivalent of the reference's partitioned-region bucket metadata
+(StoreUtils.getPartitionsPartitionedTable core/.../store/StoreUtils.scala:
+179-196, MultiBucketExecutorPartition): a PARTITION_BY table hashes rows
+into `num_buckets` murmur3 buckets; buckets are assigned round-robin to
+members with `redundancy` extra copies; COLOCATE_WITH = share the bucket
+map.
+
+STATUS: placement metadata layer only. Single-host query execution shards
+stacked batches positionally over the mesh (storage/device.py) — batch-
+position sharding is placement-equivalent for scans/aggregates under
+GSPMD. BucketMap becomes load-bearing with the multi-host cluster runtime
+(ingest routing + bucket-aligned batch cutting for exchange-free
+collocated joins); until then it backs the catalog metadata and tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Sequence, Tuple
+
+import numpy as np
+
+from snappydata_tpu.parallel.hashing import bucket_of_np
+
+
+@dataclasses.dataclass
+class BucketMap:
+    num_buckets: int
+    num_members: int
+    redundancy: int = 0
+
+    def primary_member(self, bucket: int) -> int:
+        return bucket % self.num_members
+
+    def members_of(self, bucket: int) -> List[int]:
+        return [(bucket + r) % self.num_members
+                for r in range(self.redundancy + 1)]
+
+    def buckets_of_member(self, member: int) -> List[int]:
+        return [b for b in range(self.num_buckets)
+                if member in self.members_of(b)]
+
+    def bucket_for_rows(self, key_values: np.ndarray) -> np.ndarray:
+        return bucket_of_np(key_values, self.num_buckets)
+
+    def member_for_rows(self, key_values: np.ndarray) -> np.ndarray:
+        return self.bucket_for_rows(key_values) % self.num_members
+
+    def collocated_with(self, other: "BucketMap") -> bool:
+        return (self.num_buckets == other.num_buckets
+                and self.num_members == other.num_members)

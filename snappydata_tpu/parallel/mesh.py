@@ -1,0 +1,91 @@
+"""Device mesh execution: shard stacked column batches, let GSPMD insert
+the collectives.
+
+TPU-first replacement for the reference's executor fan-out + GemFire P2P
+exchange (SURVEY.md §5 "Distributed communication backend"): instead of
+shipping serialized rows between JVMs, the stacked [num_batches, capacity]
+column arrays are laid out across a `jax.sharding.Mesh` along the batch
+axis (batch ≈ bucket: the unit of data placement). The SAME compiled
+query function then runs under jit with sharded inputs — XLA GSPMD
+partitions the scan/filter locally and inserts psum/all_gather for the
+aggregate/join exchange, which is exactly the CollectAggregateExec partial
+merge and the replicated-table HashJoinExec build-side broadcast
+(SnappyStrategies.scala:347, joins/HashJoinExec.scala:63) done by the
+compiler instead of hand-written messaging.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class MeshContext:
+    """Process-wide data mesh. When active, device tables bind with their
+    batch axis sharded over 'data' and query jits produce SPMD programs.
+
+    Each context carries a process-unique `token` (monotonic counter) used
+    by device caches instead of id(mesh) — ids get reused after GC, which
+    would let a 4-device run hit arrays placed for a dead 8-device mesh."""
+
+    _current: Optional["MeshContext"] = None
+    _stack: list = []          # supports nested/reentrant `with`
+    _lock = threading.Lock()
+    _next_token = 0
+
+    def __init__(self, mesh: Mesh):
+        self.mesh = mesh
+        self.batch_sharding = NamedSharding(mesh, P("data", None))
+        self.replicated = NamedSharding(mesh, P())
+        with MeshContext._lock:
+            MeshContext._next_token += 1
+            self.token = MeshContext._next_token
+
+    @property
+    def num_devices(self) -> int:
+        return self.mesh.devices.size
+
+    @classmethod
+    def current(cls) -> Optional["MeshContext"]:
+        return cls._current
+
+    @classmethod
+    def activate(cls, mesh: Optional[Mesh]) -> Optional["MeshContext"]:
+        with cls._lock:
+            cls._current = MeshContext(mesh) if mesh is not None else None
+            return cls._current
+
+    def __enter__(self):
+        with MeshContext._lock:
+            MeshContext._stack.append(MeshContext._current)
+            MeshContext._current = self
+        return self
+
+    def __exit__(self, *exc):
+        with MeshContext._lock:
+            MeshContext._current = MeshContext._stack.pop() \
+                if MeshContext._stack else None
+        return False
+
+
+def data_mesh(num_devices: Optional[int] = None) -> Mesh:
+    devices = jax.devices()
+    n = num_devices or len(devices)
+    return Mesh(np.array(devices[:n]), ("data",))
+
+
+def shard_batches(array, ctx: Optional[MeshContext]):
+    """Place a stacked [B, C] array: batch-sharded under a mesh, default
+    placement otherwise. B is padded to a multiple of the mesh size by the
+    device builder (pow2 bucketing covers pow2 meshes)."""
+    if ctx is None:
+        return array
+    return jax.device_put(array, ctx.batch_sharding)
+
+
+def round_up_to(n: int, mult: int) -> int:
+    return ((n + mult - 1) // mult) * mult

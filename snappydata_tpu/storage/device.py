@@ -19,6 +19,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, Optional, Sequence, Tuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -53,13 +54,22 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
     """Materialize `col_indices` of a snapshot on device, with caching keyed
     on manifest version (so repeated queries over an unchanged table upload
     nothing)."""
+    from snappydata_tpu.parallel.mesh import MeshContext
+
+    ctx = MeshContext.current()
     if manifest is None:
         manifest = data.snapshot()
-    cache = data._device_cache.setdefault(manifest.version, {})
-    # prune stale versions (readers of a pruned version keep their local
-    # reference; dict-of-dicts keying means versions never mix)
-    for v in [v for v in data._device_cache if v < manifest.version - 1]:
-        data._device_cache.pop(v, None)
+    # cache key includes the mesh token (placement differs under a mesh;
+    # token is process-unique, unlike id() which gets reused after GC)
+    cache_key = (manifest.version, ctx.token if ctx else None)
+    cache = data._device_cache.setdefault(cache_key, {})
+    # prune stale versions AND stale mesh placements (keep only this exact
+    # placement + the previous version of it) so a loop that recreates
+    # meshes doesn't pin duplicate device copies of every column
+    for k in [k for k in data._device_cache
+              if k != cache_key and not (k[1] == cache_key[1]
+                                         and k[0] >= manifest.version - 1)]:
+        data._device_cache.pop(k, None)
 
     schema = data.schema
     cap = data.capacity
@@ -75,6 +85,17 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
     b_actual = len(views) + len(row_chunks)
     b = _next_pow2(b_actual) if data_pow2() else max(1, b_actual)
     b = max(b, 1)
+    if ctx is not None:
+        # batch axis is the sharded axis: pad to a mesh multiple
+        from snappydata_tpu.parallel.mesh import round_up_to
+
+        b = round_up_to(b, ctx.num_devices)
+
+    def _place(host_array):
+        from snappydata_tpu.parallel.mesh import shard_batches
+
+        return shard_batches(host_array, ctx) if ctx is not None \
+            else jnp.asarray(host_array)
 
     if "valid" not in cache:
         valid = np.zeros((b, cap), dtype=np.bool_)
@@ -82,7 +103,7 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
             valid[i] = v.live_mask()
         for j, (_, take) in enumerate(row_chunks):
             valid[len(views) + j, :take] = True
-        cache["valid"] = jnp.asarray(valid)
+        cache["valid"] = _place(valid)
 
     columns: Dict[int, jnp.ndarray] = {}
     dicts: Dict[int, np.ndarray] = {}
@@ -144,8 +165,8 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                 if not is_str and take:
                     smin[len(views) + j] = float(vals.min())
                     smax[len(views) + j] = float(vals.max())
-            cache[key] = (jnp.asarray(stacked), smin, smax,
-                          jnp.asarray(null_mask) if any_null else None)
+            cache[key] = (_place(stacked), smin, smax,
+                          _place(null_mask) if any_null else None)
         columns[ci], stats_min[ci], stats_max[ci], nulls[ci] = cache[key]
 
     return DeviceTable(schema, b, cap, cache["valid"], columns, dicts,
