@@ -1,0 +1,3 @@
+from snappydata_tpu.cli import main
+
+raise SystemExit(main())

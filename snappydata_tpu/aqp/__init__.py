@@ -1,0 +1,14 @@
+"""Approximate Query Processing (AQP/SDE).
+
+The reference ships this as a closed-source plug-in behind
+SnappyContextFunctions hooks (core/.../SnappyContextFunctions.scala:29-94;
+docs/aqp.md): stratified samples (CREATE SAMPLE TABLE ... OPTIONS (qcs,
+fraction)), error-bounded SUM/AVG/COUNT rewrites, and TopK structures
+backed by CountMinSketch + StreamSummary (the clearspring utilities
+vendored in core). Same shape here: a plug-in package the session calls
+into, nothing in the core engine depends on it.
+"""
+
+from snappydata_tpu.aqp.sampling import StratifiedReservoir  # noqa: F401
+from snappydata_tpu.aqp.sketches import CountMinSketch, TopKSummary  # noqa: F401
+from snappydata_tpu.aqp.rewrite import approx_rewrite  # noqa: F401

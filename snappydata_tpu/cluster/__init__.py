@@ -1,0 +1,17 @@
+"""Cluster runtime: locator / lead / server roles.
+
+Reference topology (docs/architecture/cluster_architecture.md:3-9):
+locators do discovery + membership, the lead hosts the (HA) query planner
+and job/REST services, data servers host buckets and answer simple queries
+directly. Here the same roles over a TCP membership protocol
+(locator.py), an Arrow Flight data/query front door per node
+(flight_server.py — the thrift/DRDA network-server analogue,
+cluster/README-thrift.md), and a REST status/metrics/jobs surface on the
+lead (rest.py — the jobserver + /status/api/v1 analogue).
+"""
+
+from snappydata_tpu.cluster.locator import Locator, MemberInfo  # noqa: F401
+from snappydata_tpu.cluster.node import (  # noqa: F401
+    LocatorNode, LeadNode, ServerNode,
+)
+from snappydata_tpu.cluster.client import SnappyClient  # noqa: F401

@@ -1,0 +1,103 @@
+"""Client: failover-aware Flight connection (the snappydata JDBC-driver
+analogue — jdbc:snappydata://host:port with locator-based failover,
+jdbc/.../Constant.scala:29-33)."""
+
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.flight as flight
+
+
+class SnappyClient:
+    def __init__(self, address: Optional[str] = None,
+                 locator: Optional[str] = None):
+        """Connect directly (`address`='host:port') or discover query
+        servers through a locator ('host:port' of the locator service)."""
+        self._addresses: List[str] = []
+        if address:
+            self._addresses.append(address)
+        self._locator = locator
+        self._conn: Optional[flight.FlightClient] = None
+        if locator and not address:
+            self._refresh_from_locator()
+
+    def _refresh_from_locator(self) -> None:
+        from snappydata_tpu.cluster.locator import LocatorClient
+
+        lc = LocatorClient(self._locator, member_id="client", role="client")
+        try:
+            members = lc.members()
+        finally:
+            lc.close()
+        self._addresses = [f"{m.host}:{m.port}" for m in members
+                           if m.port and m.role in ("server", "lead")]
+
+    def _client(self) -> flight.FlightClient:
+        if self._conn is not None:
+            return self._conn
+        last_err: Optional[Exception] = None
+        for addr in list(self._addresses):
+            try:
+                conn = flight.connect(f"grpc://{addr}")
+                list(conn.do_action(flight.Action("ping", b"")))
+                self._conn = conn
+                return conn
+            except Exception as e:  # failover to the next member
+                last_err = e
+        if self._locator:
+            self._refresh_from_locator()
+            for addr in self._addresses:
+                try:
+                    conn = flight.connect(f"grpc://{addr}")
+                    list(conn.do_action(flight.Action("ping", b"")))
+                    self._conn = conn
+                    return conn
+                except Exception as e:
+                    last_err = e
+        raise ConnectionError(f"no reachable member: {last_err}")
+
+    def _invalidate(self) -> None:
+        self._conn = None
+
+    def sql(self, sql: str, params: Sequence = ()) -> pa.Table:
+        """Query → Arrow table (record-batch paged by Flight)."""
+        ticket = flight.Ticket(json.dumps(
+            {"sql": sql, "params": list(params)}).encode("utf-8"))
+        try:
+            return self._client().do_get(ticket).read_all()
+        except (flight.FlightUnavailableError, ConnectionError):
+            self._invalidate()
+            return self._client().do_get(ticket).read_all()
+
+    def execute(self, sql: str, params: Sequence = ()) -> dict:
+        """DDL/DML via action (no result paging needed)."""
+        body = json.dumps({"sql": sql, "params": list(params)}).encode()
+        try:
+            results = list(self._client().do_action(
+                flight.Action("sql", body)))
+        except (flight.FlightUnavailableError, ConnectionError):
+            self._invalidate()
+            results = list(self._client().do_action(
+                flight.Action("sql", body)))
+        return json.loads(results[0].body.to_pybytes().decode("utf-8"))
+
+    def insert(self, table: str, columns: dict) -> None:
+        """Bulk columnar ingest via do_put."""
+        arrow = pa.table(columns)
+        descriptor = flight.FlightDescriptor.for_path(table)
+        writer, _ = self._client().do_put(descriptor, arrow.schema)
+        writer.write_table(arrow)
+        writer.close()
+
+    def stats(self) -> dict:
+        results = list(self._client().do_action(flight.Action("stats", b"")))
+        return json.loads(results[0].body.to_pybytes().decode("utf-8"))
+
+    def close(self) -> None:
+        if self._conn is not None:
+            self._conn.close()
+            self._conn = None

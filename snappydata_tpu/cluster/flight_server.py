@@ -1,0 +1,159 @@
+"""Arrow Flight front door: SQL + bulk ingest per node.
+
+The reference's network server is a thrift/DRDA listener on every data
+server with failover-aware drivers (cluster/README-thrift.md:20-35), with
+an ExecutionEngineArbiter that answers simple/point queries locally and
+routes analytics to the lead (docs/architecture/cluster_architecture.md:
+31-33). TPU-first choice per SURVEY.md §7.7: Arrow Flight — columnar
+result paging for free, off-the-shelf clients:
+
+- do_get(Ticket{sql, params})   → query as one Arrow table (record-batch
+                                  paged by Flight itself)
+- do_put(descriptor=table name) → bulk columnar ingest straight into the
+                                  column store (the 1M events/s path —
+                                  no per-row protocol overhead)
+- do_action(sql|checkpoint|stats|ping) → DDL/DML + ops
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.flight as flight
+
+from snappydata_tpu import types as T
+
+
+def result_to_arrow(result) -> pa.Table:
+    arrays = []
+    names = []
+    for name, col, nmask, dtype in zip(result.names, result.columns,
+                                       result.nulls, result.dtypes):
+        names.append(name)
+        mask = pa.array(nmask) if nmask is not None else None
+        if dtype.name == "string" or col.dtype == object:
+            arrays.append(pa.array(
+                [None if (nmask is not None and nmask[i]) or v is None
+                 else str(v) for i, v in enumerate(col)], type=pa.string()))
+        else:
+            arrays.append(pa.array(col, mask=np.asarray(nmask)
+                          if nmask is not None else None))
+    return pa.table(dict(zip(names, arrays)))
+
+
+def arrow_to_arrays(table: pa.Table):
+    """Arrow table → (arrays, null_masks) in storage domain."""
+    arrays = []
+    nulls = []
+    for col in table.columns:
+        combined = col.combine_chunks()
+        if pa.types.is_string(combined.type) or \
+                pa.types.is_large_string(combined.type):
+            arrays.append(np.array(combined.to_pylist(), dtype=object))
+            nulls.append(np.array([v is None for v in combined.to_pylist()])
+                         if combined.null_count else None)
+        else:
+            np_arr = combined.to_numpy(zero_copy_only=False)
+            if combined.null_count:
+                mask = np.array([not v for v in
+                                 combined.is_valid().to_pylist()])
+                np_arr = np.where(mask, 0, np_arr)
+                nulls.append(mask)
+            else:
+                nulls.append(None)
+            arrays.append(np_arr)
+    return arrays, nulls
+
+
+class SnappyFlightServer(flight.FlightServerBase):
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
+        location = f"grpc://{host}:{port}"
+        super().__init__(location)
+        self.session = session
+        self.host = host
+        self._location = location
+
+    @property
+    def actual_port(self) -> int:
+        return self.port
+
+    # -- queries ----------------------------------------------------------
+
+    def do_get(self, context, ticket: flight.Ticket):
+        req = json.loads(ticket.ticket.decode("utf-8"))
+        result = self.session.sql(req["sql"],
+                                  params=tuple(req.get("params", ())))
+        return flight.RecordBatchStream(result_to_arrow(result))
+
+    def get_flight_info(self, context, descriptor):
+        req = json.loads(descriptor.command.decode("utf-8"))
+        # execute eagerly to learn the schema (plan-cache makes re-exec in
+        # do_get cheap); proper lazy schema derivation is a later round
+        result = self.session.sql(req["sql"],
+                                  params=tuple(req.get("params", ())))
+        table = result_to_arrow(result)
+        endpoint = flight.FlightEndpoint(
+            descriptor.command, [flight.Location(self._location)])
+        return flight.FlightInfo(table.schema, descriptor, [endpoint],
+                                 table.num_rows, -1)
+
+    # -- bulk ingest ------------------------------------------------------
+
+    def do_put(self, context, descriptor, reader, writer):
+        target = descriptor.path[0].decode("utf-8") if descriptor.path \
+            else json.loads(descriptor.command.decode("utf-8"))["table"]
+        table = reader.read_all()
+        arrays, nulls = arrow_to_arrays(table)
+        info = self.session.catalog.describe(target)
+        from snappydata_tpu.storage.table_store import RowTableData
+
+        if isinstance(info.data, RowTableData):
+            info.data.insert_arrays(arrays)
+        else:
+            info.data.insert_arrays(
+                arrays, nulls=nulls if any(m is not None for m in nulls)
+                else None)
+        if self.session.disk_store is not None:
+            self.session.disk_store.wal_append(target.lower(), "insert",
+                                               arrays=arrays)
+
+    # -- ops --------------------------------------------------------------
+
+    def do_action(self, context, action: flight.Action):
+        name = action.type
+        body = json.loads(action.body.to_pybytes().decode("utf-8")) \
+            if action.body else {}
+        if name == "sql":
+            result = self.session.sql(body["sql"],
+                                      params=tuple(body.get("params", ())))
+            payload = {"names": result.names,
+                       "rows": [[_json_val(v) for v in r]
+                                for r in result.rows()[:1000]]}
+            yield flight.Result(json.dumps(payload).encode("utf-8"))
+        elif name == "checkpoint":
+            self.session.checkpoint()
+            yield flight.Result(b"{}")
+        elif name == "stats":
+            from snappydata_tpu.observability import TableStatsService
+
+            stats = TableStatsService(self.session.catalog).collect_once()
+            yield flight.Result(json.dumps(stats).encode("utf-8"))
+        elif name == "ping":
+            yield flight.Result(b'{"ok": true}')
+        else:
+            raise flight.FlightServerError(f"unknown action {name}")
+
+    def list_actions(self, context):
+        return [("sql", "execute a statement"),
+                ("checkpoint", "persist all tables"),
+                ("stats", "table stats"), ("ping", "liveness")]
+
+
+def _json_val(v):
+    if v is None or isinstance(v, (int, float, str, bool)):
+        return v
+    return str(v)

@@ -1,0 +1,250 @@
+"""Locator: membership, discovery, failure detection, lead election.
+
+Re-provides the store engine's P2P membership surface the reference relies
+on (SURVEY.md §2.5: locators + view management + `MembershipListener.
+memberDeparted` that ExecutorInitiator.scala:71-90 uses to re-point
+executors; `member-timeout` 5s default; the `__PRIMARY_LEADER_LS`
+distributed lock LeadImpl.scala:100) — as a small TCP JSON-line service:
+
+- members REGISTER (role, host, port) and HEARTBEAT; missing heartbeats
+  past `member_timeout_s` → member departed, view version bumps, waiters
+  notified on next poll.
+- LOCK/UNLOCK implements lease-based named locks; the primary-lead lock is
+  just the name "__PRIMARY_LEADER_LS" (standby leads block on it, exactly
+  the reference's election).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from snappydata_tpu import config
+
+PRIMARY_LEAD_LOCK = "__PRIMARY_LEADER_LS"
+
+
+@dataclasses.dataclass
+class MemberInfo:
+    member_id: str
+    role: str          # locator | lead | server
+    host: str
+    port: int          # member's flight port (0 = none)
+    last_heartbeat: float = 0.0
+
+
+class _State:
+    def __init__(self, timeout_s: float):
+        self.lock = threading.Lock()
+        self.members: Dict[str, MemberInfo] = {}
+        self.view_version = 0
+        self.locks: Dict[str, Tuple[str, float]] = {}  # name -> (owner, expiry)
+        self.timeout_s = timeout_s
+        self.departed_log: List[str] = []
+
+    def sweep(self) -> None:
+        now = time.time()
+        with self.lock:
+            dead = [m for m, info in self.members.items()
+                    if info.role != "locator"
+                    and now - info.last_heartbeat > self.timeout_s]
+            for m in dead:
+                del self.members[m]
+                self.departed_log.append(m)
+                self.view_version += 1
+            # expire locks owned by departed members or past lease
+            for name in list(self.locks):
+                owner, expiry = self.locks[name]
+                if owner not in self.members or now > expiry:
+                    del self.locks[name]
+
+
+class Locator:
+    """The discovery/membership service (one per cluster; standby locators
+    are a later round)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 member_timeout_s: Optional[float] = None):
+        timeout = member_timeout_s or \
+            config.global_properties().member_timeout_s
+        self.state = _State(timeout)
+        state = self.state
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                for line in self.rfile:
+                    try:
+                        req = json.loads(line.decode("utf-8"))
+                    except ValueError:
+                        break
+                    resp = _dispatch(state, req)
+                    self.wfile.write(
+                        (json.dumps(resp) + "\n").encode("utf-8"))
+                    self.wfile.flush()
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.server = Server((host, port), Handler)
+        self.host, self.port = self.server.server_address
+        self._thread: Optional[threading.Thread] = None
+        self._sweeper: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+
+    def start(self) -> "Locator":
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+        def sweep_loop():
+            while not self._stop.wait(self.state.timeout_s / 4):
+                self.state.sweep()
+
+        self._sweeper = threading.Thread(target=sweep_loop, daemon=True)
+        self._sweeper.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.server.shutdown()
+        self.server.server_close()
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+
+def _dispatch(state: _State, req: dict) -> dict:
+    op = req.get("op")
+    now = time.time()
+    if op == "register":
+        with state.lock:
+            info = MemberInfo(req["member_id"], req["role"], req["host"],
+                              req.get("port", 0), now)
+            state.members[req["member_id"]] = info
+            state.view_version += 1
+            return {"ok": True, "view": state.view_version}
+    if op == "heartbeat":
+        with state.lock:
+            m = state.members.get(req["member_id"])
+            if m is None:
+                return {"ok": False, "rejoin": True}
+            m.last_heartbeat = now
+            return {"ok": True, "view": state.view_version}
+    if op == "members":
+        with state.lock:
+            return {"ok": True, "view": state.view_version,
+                    "members": [dataclasses.asdict(m)
+                                for m in state.members.values()],
+                    "departed": list(state.departed_log)}
+    if op == "lock":
+        name = req["name"]
+        lease = float(req.get("lease_s", 30.0))
+        with state.lock:
+            cur = state.locks.get(name)
+            if cur is not None and cur[0] != req["member_id"] \
+                    and cur[1] > now and cur[0] in state.members:
+                return {"ok": True, "acquired": False, "owner": cur[0]}
+            state.locks[name] = (req["member_id"], now + lease)
+            return {"ok": True, "acquired": True}
+    if op == "unlock":
+        with state.lock:
+            cur = state.locks.get(req["name"])
+            if cur is not None and cur[0] == req["member_id"]:
+                del state.locks[req["name"]]
+            return {"ok": True}
+    if op == "deregister":
+        with state.lock:
+            state.members.pop(req["member_id"], None)
+            state.view_version += 1
+            return {"ok": True}
+    return {"ok": False, "error": f"unknown op {op}"}
+
+
+class LocatorClient:
+    """A member's handle to the locator (persistent connection +
+    heartbeat thread)."""
+
+    def __init__(self, address: str, member_id: str, role: str,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.address = address
+        self.member_id = member_id
+        self.role = role
+        self.host = host
+        self.port = port
+        self._lock = threading.Lock()
+        self._sock: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self._hb: Optional[threading.Thread] = None
+        self.last_view = -1
+
+    def _request(self, payload: dict) -> dict:
+        with self._lock:
+            if self._sock is None:
+                h, p = self.address.rsplit(":", 1)
+                self._sock = socket.create_connection((h, int(p)), timeout=5)
+                self._fh = self._sock.makefile("rwb")
+            self._fh.write((json.dumps(payload) + "\n").encode("utf-8"))
+            self._fh.flush()
+            line = self._fh.readline()
+            if not line:
+                self._sock.close()
+                self._sock = None
+                raise ConnectionError("locator connection lost")
+            return json.loads(line.decode("utf-8"))
+
+    def register(self) -> dict:
+        resp = self._request({"op": "register", "member_id": self.member_id,
+                              "role": self.role, "host": self.host,
+                              "port": self.port})
+        self.last_view = resp.get("view", -1)
+        return resp
+
+    def start_heartbeats(self, interval_s: float = 1.0) -> None:
+        def loop():
+            while not self._stop.wait(interval_s):
+                try:
+                    resp = self._request({"op": "heartbeat",
+                                          "member_id": self.member_id})
+                    if resp.get("rejoin"):
+                        self.register()
+                    self.last_view = resp.get("view", self.last_view)
+                except (ConnectionError, OSError):
+                    try:
+                        self.register()
+                    except (ConnectionError, OSError):
+                        pass
+
+        self._hb = threading.Thread(target=loop, daemon=True)
+        self._hb.start()
+
+    def members(self) -> List[MemberInfo]:
+        resp = self._request({"op": "members"})
+        return [MemberInfo(**m) for m in resp["members"]]
+
+    def try_lock(self, name: str, lease_s: float = 30.0) -> bool:
+        resp = self._request({"op": "lock", "name": name,
+                              "member_id": self.member_id,
+                              "lease_s": lease_s})
+        return bool(resp.get("acquired"))
+
+    def unlock(self, name: str) -> None:
+        self._request({"op": "unlock", "name": name,
+                       "member_id": self.member_id})
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._request({"op": "deregister", "member_id": self.member_id})
+        except (ConnectionError, OSError):
+            pass
+        with self._lock:
+            if self._sock is not None:
+                self._sock.close()
+                self._sock = None

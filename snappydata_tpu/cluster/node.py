@@ -1,0 +1,180 @@
+"""Node roles + lifecycle (the ServiceManager / LeadImpl / ServerImpl
+analogue, cluster/.../ServiceManager.scala, impl/LeadImpl.scala:94,
+core/.../impl/ServerImpl.scala:34).
+
+- LocatorNode: runs the membership/locator service.
+- ServerNode:  data host — Flight front door over a session (the embedded
+  network-server-in-the-data-JVM design), registers + heartbeats.
+- LeadNode:    acquires the primary-lead lock (standby blocks and takes
+  over on primary death — LeadImpl.scala:100 election), then runs the
+  stats service + REST/jobs + its own Flight endpoint.
+
+Single-host round: nodes share the process's catalog/storage (embedded
+mode); the multi-host data plane (bucket placement over DCN) layers on the
+same membership surface in a later round.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Optional
+
+from snappydata_tpu.cluster.locator import (Locator, LocatorClient,
+                                            PRIMARY_LEAD_LOCK)
+
+
+class LocatorNode:
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self.locator = Locator(host, port)
+
+    def start(self) -> "LocatorNode":
+        self.locator.start()
+        return self
+
+    def stop(self) -> None:
+        self.locator.stop()
+
+    @property
+    def address(self) -> str:
+        return self.locator.address
+
+
+class _MemberNode:
+    role = "member"
+
+    def __init__(self, locator_address: str, session,
+                 host: str = "127.0.0.1", flight_port: int = 0,
+                 member_id: Optional[str] = None):
+        self.session = session
+        self.member_id = member_id or f"{self.role}-{uuid.uuid4().hex[:8]}"
+        self.host = host
+        self.locator_address = locator_address
+        self._flight_port = flight_port
+        self.flight = None
+        self.membership: Optional[LocatorClient] = None
+
+    def _start_flight(self) -> int:
+        from snappydata_tpu.cluster.flight_server import SnappyFlightServer
+
+        self.flight = SnappyFlightServer(self.session, self.host,
+                                         self._flight_port)
+        self._flight_thread = threading.Thread(target=self.flight.serve,
+                                               daemon=True)
+        self._flight_thread.start()
+        # wait for the port to materialize
+        deadline = time.time() + 5
+        while self.flight.port == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        return self.flight.port
+
+    def _join(self, port: int) -> None:
+        self.membership = LocatorClient(self.locator_address,
+                                        self.member_id, self.role,
+                                        self.host, port)
+        self.membership.register()
+        self.membership.start_heartbeats()
+
+    def stop(self) -> None:
+        if self.membership is not None:
+            self.membership.close()
+        if self.flight is not None:
+            self.flight.shutdown()
+
+
+class ServerNode(_MemberNode):
+    """Data server: storage + Flight query/ingest endpoint."""
+
+    role = "server"
+
+    def start(self) -> "ServerNode":
+        port = self._start_flight()
+        self._join(port)
+        return self
+
+    @property
+    def flight_address(self) -> str:
+        return f"{self.host}:{self.flight.port}"
+
+
+class LeadNode(_MemberNode):
+    """Lead: primary/standby election, then planner-side services."""
+
+    role = "lead"
+
+    def __init__(self, locator_address: str, session,
+                 host: str = "127.0.0.1", flight_port: int = 0,
+                 rest_port: int = 0, lease_s: float = 5.0,
+                 member_id: Optional[str] = None):
+        super().__init__(locator_address, session, host, flight_port,
+                         member_id)
+        self.rest_port = rest_port
+        self.lease_s = lease_s
+        self.is_primary = False
+        self.rest = None
+        self.stats_service = None
+        self._stop_event = threading.Event()
+        self._election: Optional[threading.Thread] = None
+
+    def start(self, wait_for_primary: bool = False) -> "LeadNode":
+        port = self._start_flight()
+        self._join(port)
+        self._election = threading.Thread(target=self._election_loop,
+                                          daemon=True)
+        self._election.start()
+        if wait_for_primary:
+            deadline = time.time() + 30
+            while not self.is_primary and time.time() < deadline:
+                time.sleep(0.05)
+        return self
+
+    def _election_loop(self) -> None:
+        """Standby blocks on the primary lock; the holder renews its lease
+        (half-life cadence). Exactly the reference's dlock election."""
+        while not self._stop_event.is_set():
+            try:
+                acquired = self.membership.try_lock(PRIMARY_LEAD_LOCK,
+                                                    lease_s=self.lease_s)
+            except (ConnectionError, OSError):
+                acquired = False
+            if acquired and not self.is_primary:
+                self._become_primary()
+            elif not acquired and self.is_primary:
+                self._step_down()
+            self._stop_event.wait(self.lease_s / 2)
+
+    def _become_primary(self) -> None:
+        from snappydata_tpu.cluster.rest import RestService
+        from snappydata_tpu.observability import TableStatsService
+
+        self.stats_service = TableStatsService(self.session.catalog).start()
+        self.rest = RestService(self.session, self.stats_service,
+                                membership=self.membership,
+                                host=self.host, port=self.rest_port).start()
+        self.is_primary = True
+
+    def _step_down(self) -> None:
+        self.is_primary = False
+        if self.rest is not None:
+            self.rest.stop()
+            self.rest = None
+        if self.stats_service is not None:
+            self.stats_service.stop()
+            self.stats_service = None
+
+    def stop(self) -> None:
+        self._stop_event.set()
+        if self.is_primary:
+            try:
+                self.membership.unlock(PRIMARY_LEAD_LOCK)
+            except (ConnectionError, OSError):
+                pass
+            self._step_down()
+        super().stop()
+
+    @property
+    def rest_address(self) -> Optional[str]:
+        if self.rest is None:
+            return None
+        return f"{self.rest.host}:{self.rest.port}"

@@ -1,0 +1,138 @@
+"""REST surface on the lead: status API, metrics, job submission.
+
+Reference: `/status/api/v1` JSON resources (cluster/.../status/api/v1/
+snappyapi.scala), MetricsServlet at lead:5050/metrics/json
+(docs/monitoring/metrics.md:8), and the spark-jobserver REST contract
+(SnappySQLJob.runSnappyJob, cluster/.../SnappySessionFactory.scala:112-136).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+from snappydata_tpu.observability.metrics import global_registry
+
+
+class JobRegistry:
+    """Async SQL jobs (the jobserver analogue): submit → job id → poll."""
+
+    def __init__(self, session):
+        self.session = session
+        self._jobs: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def submit_sql(self, sql: str, params=()) -> str:
+        job_id = uuid.uuid4().hex[:12]
+        with self._lock:
+            self._jobs[job_id] = {"status": "RUNNING", "sql": sql}
+
+        def run():
+            try:
+                result = self.session.sql(sql, params=params)
+                with self._lock:
+                    self._jobs[job_id].update(
+                        status="FINISHED",
+                        rows=[[_j(v) for v in r] for r in
+                              result.rows()[:1000]],
+                        names=result.names)
+            except Exception as e:
+                with self._lock:
+                    self._jobs[job_id].update(status="ERROR", error=str(e))
+
+        threading.Thread(target=run, daemon=True).start()
+        return job_id
+
+    def status(self, job_id: str) -> Optional[dict]:
+        with self._lock:
+            return dict(self._jobs.get(job_id) or {}) or None
+
+    def list(self) -> dict:
+        with self._lock:
+            return {jid: j["status"] for jid, j in self._jobs.items()}
+
+
+def _j(v):
+    if v is None or isinstance(v, (int, float, str, bool)):
+        return v
+    return str(v)
+
+
+class RestService:
+    def __init__(self, session, stats_service, membership=None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.session = session
+        self.stats_service = stats_service
+        self.membership = membership
+        self.jobs = JobRegistry(session)
+        svc = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *args):  # quiet
+                pass
+
+            def _send(self, payload, code=200, content_type="application/json"):
+                body = payload if isinstance(payload, bytes) else \
+                    json.dumps(payload).encode("utf-8")
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                path = self.path.split("?")[0].rstrip("/")
+                if path == "/status/api/v1/cluster":
+                    members = []
+                    if svc.membership is not None:
+                        try:
+                            members = [vars(m) for m in
+                                       svc.membership.members()]
+                        except Exception:
+                            members = []
+                    self._send({"members": members,
+                                "tables": svc.stats_service.current()})
+                elif path == "/status/api/v1/tables":
+                    self._send(svc.stats_service.current())
+                elif path == "/metrics/json":
+                    self._send(global_registry().snapshot())
+                elif path == "/metrics/prometheus":
+                    self._send(global_registry().to_prometheus().encode(),
+                               content_type="text/plain")
+                elif path.startswith("/jobs/"):
+                    st = svc.jobs.status(path.split("/")[-1])
+                    self._send(st if st else {"error": "no such job"},
+                               200 if st else 404)
+                elif path == "/jobs":
+                    self._send(svc.jobs.list())
+                else:
+                    self._send({"error": "not found"}, 404)
+
+            def do_POST(self):
+                path = self.path.rstrip("/")
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                if path == "/jobs":
+                    job_id = svc.jobs.submit_sql(body["sql"],
+                                                 tuple(body.get("params",
+                                                                ())))
+                    self._send({"jobId": job_id, "status": "STARTED"})
+                else:
+                    self._send({"error": "not found"}, 404)
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.host, self.port = self.server.server_address
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "RestService":
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()

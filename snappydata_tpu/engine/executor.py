@@ -941,11 +941,28 @@ class Executor:
         self.catalog = catalog
         self.props = props or config.global_properties()
         self._plan_cache: Dict = {}
+        self._depth = 0
 
     def clear_cache(self):
         self._plan_cache.clear()
 
     def execute(self, plan: ast.Plan, params: Tuple = ()) -> Result:
+        from snappydata_tpu.observability.metrics import global_registry
+
+        if self._depth:  # nested calls (unions, host fallback) count once
+            return self._execute_with_host_ops(plan, params)
+        reg = global_registry()
+        reg.inc("queries")
+        self._depth += 1
+        try:
+            with reg.time("query"):
+                result = self._execute_with_host_ops(plan, params)
+        finally:
+            self._depth -= 1
+        reg.inc("rows_returned", result.num_rows)
+        return result
+
+    def _execute_with_host_ops(self, plan: ast.Plan, params: Tuple) -> Result:
         host_ops: List = []
         node = plan
         while True:
@@ -979,16 +996,25 @@ class Executor:
             right = self.execute(node.right, params)
             return hosteval.union(left, right)
 
+        from snappydata_tpu.observability.metrics import global_registry
+
+        reg = global_registry()
         key = (_plan_key(node, self.catalog), self.catalog.generation)
         compiled = self._plan_cache.get(key)
         if compiled is None:
+            reg.inc("plan_cache_misses")
             try:
-                compiled = Compiler(self.catalog, self.props).compile(node)
+                with reg.time("plan_compile"):
+                    compiled = Compiler(self.catalog,
+                                        self.props).compile(node)
             except CompileError:
+                reg.inc("host_fallbacks")
                 return self._host_fallback(node, params)
             if len(self._plan_cache) >= self.props.plan_cache_size:
                 self._plan_cache.clear()
             self._plan_cache[key] = compiled
+        else:
+            reg.inc("plan_cache_hits")
         try:
             return compiled.execute(params)
         except CompileError:

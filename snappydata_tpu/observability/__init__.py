@@ -1,0 +1,6 @@
+from snappydata_tpu.observability.metrics import (  # noqa: F401
+    MetricsRegistry, global_registry,
+)
+from snappydata_tpu.observability.stats_service import (  # noqa: F401
+    TableStatsService,
+)

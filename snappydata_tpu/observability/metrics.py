@@ -1,0 +1,125 @@
+"""Metrics: counters, gauges, timers with a JSON snapshot surface.
+
+Reference equivalents: per-operator SQLMetrics (ColumnTableScan.getMetrics
+:115-130 — columnBatchesSeen/Skipped, numRowsBuffer), the Spark
+MetricsSystem JSON servlet (docs/monitoring/metrics.md:8 — lead:5050/
+metrics/json), and SnappyMetricsSystem's 5s gauge push
+(cluster/.../metrics/SnappyMetricsSystem.scala:36-212).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Dict, Optional
+
+
+class Timer:
+    __slots__ = ("count", "total_s", "min_s", "max_s")
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.min_s = float("inf")
+        self.max_s = 0.0
+
+    def record(self, seconds: float) -> None:
+        self.count += 1
+        self.total_s += seconds
+        self.min_s = min(self.min_s, seconds)
+        self.max_s = max(self.max_s, seconds)
+
+    def to_dict(self) -> dict:
+        return {
+            "count": self.count,
+            "total_s": round(self.total_s, 6),
+            "mean_s": round(self.total_s / self.count, 6) if self.count else 0,
+            "min_s": round(self.min_s, 6) if self.count else 0,
+            "max_s": round(self.max_s, 6),
+        }
+
+
+class MetricsRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = defaultdict(int)
+        self._gauges: Dict[str, Callable[[], float]] = {}
+        self._timers: Dict[str, Timer] = defaultdict(Timer)
+
+    def inc(self, name: str, value: int = 1) -> None:
+        with self._lock:
+            self._counters[name] += value
+
+    def gauge(self, name: str, fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._gauges[name] = fn
+
+    def time(self, name: str):
+        registry = self
+
+        class _Ctx:
+            def __enter__(self):
+                self.t0 = time.time()
+                return self
+
+            def __exit__(self, *exc):
+                registry.record_time(name, time.time() - self.t0)
+                return False
+
+        return _Ctx()
+
+    def record_time(self, name: str, seconds: float) -> None:
+        with self._lock:
+            self._timers[name].record(seconds)
+
+    def counter(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            gauges = {}
+            for name, fn in self._gauges.items():
+                try:
+                    gauges[name] = fn()
+                except Exception:
+                    gauges[name] = None
+            return {
+                "counters": dict(self._counters),
+                "gauges": gauges,
+                "timers": {k: t.to_dict() for k, t in self._timers.items()},
+                "ts": time.time(),
+            }
+
+    def to_json(self) -> str:
+        return json.dumps(self.snapshot())
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition (the modern sink next to the
+        reference's JSON/JMX/CSV/Graphite list)."""
+        snap = self.snapshot()
+        lines = []
+        for k, v in snap["counters"].items():
+            lines.append(f"snappy_tpu_{_sanitize(k)}_total {v}")
+        for k, v in snap["gauges"].items():
+            if v is not None:
+                lines.append(f"snappy_tpu_{_sanitize(k)} {v}")
+        for k, t in snap["timers"].items():
+            lines.append(f"snappy_tpu_{_sanitize(k)}_seconds_count "
+                         f"{t['count']}")
+            lines.append(f"snappy_tpu_{_sanitize(k)}_seconds_sum "
+                         f"{t['total_s']}")
+        return "\n".join(lines) + "\n"
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if c.isalnum() else "_" for c in name)
+
+
+_global = MetricsRegistry()
+
+
+def global_registry() -> MetricsRegistry:
+    return _global

@@ -1,0 +1,82 @@
+"""Periodic table stats service.
+
+Reference: SnappyTableStatsProviderService gathers per-table/member row
+counts and sizes on a 5s cadence via store function execution
+(io/snappydata/SnappyTableStatsProviderService.scala:59-185, interval
+Constant.DEFAULT_CALC_TABLE_SIZE_SERVICE_INTERVAL) and feeds the
+dashboard/metrics. Here: a daemon thread snapshotting the catalog.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional
+
+from snappydata_tpu import config
+from snappydata_tpu.observability.metrics import global_registry
+from snappydata_tpu.storage.table_store import RowTableData
+
+
+class TableStatsService:
+    def __init__(self, catalog, interval_s: Optional[float] = None,
+                 registry=None):
+        self.catalog = catalog
+        self.interval_s = interval_s or \
+            config.global_properties().stats_interval_s
+        self.registry = registry or global_registry()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stats: Dict[str, dict] = {}
+        self._lock = threading.Lock()
+
+    def collect_once(self) -> Dict[str, dict]:
+        stats: Dict[str, dict] = {}
+        for info in self.catalog.list_tables():
+            if isinstance(info.data, RowTableData):
+                rows = info.data.count()
+                batches = 0
+                in_memory_bytes = 0
+            else:
+                m = info.data.snapshot()
+                rows = m.total_rows()
+                batches = len(m.views)
+                in_memory_bytes = sum(v.batch.nbytes for v in m.views)
+            stats[info.name] = {
+                "provider": info.provider,
+                "row_count": rows,
+                "batches": batches,
+                "in_memory_bytes": in_memory_bytes,
+                "buckets": info.buckets,
+                "redundancy": info.redundancy,
+            }
+        with self._lock:
+            self._stats = stats
+        self.registry.gauge("tables_total",
+                            lambda c=len(stats): float(c))
+        total_rows = sum(s["row_count"] for s in stats.values())
+        self.registry.gauge("rows_total",
+                            lambda r=total_rows: float(r))
+        return stats
+
+    def current(self) -> Dict[str, dict]:
+        with self._lock:
+            return dict(self._stats)
+
+    def start(self) -> "TableStatsService":
+        def loop():
+            while not self._stop.wait(self.interval_s):
+                try:
+                    self.collect_once()
+                except Exception:
+                    pass
+
+        self.collect_once()
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2)

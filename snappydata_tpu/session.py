@@ -32,7 +32,18 @@ class SnappySession:
     _default_catalog: Optional[Catalog] = None
     _default_lock = threading.Lock()
 
-    def __init__(self, catalog: Optional[Catalog] = None, conf=None):
+    def __init__(self, catalog: Optional[Catalog] = None, conf=None,
+                 data_dir: Optional[str] = None, recover: bool = True):
+        """`data_dir` attaches a DiskStore (ref: sys-disk-dir): DML becomes
+        WAL-durable, `checkpoint()` persists batches/manifests, and when
+        `recover` the catalog+data are rebuilt from disk at startup."""
+        self.disk_store = None
+        if data_dir is not None:
+            from snappydata_tpu.storage.persistence import DiskStore
+
+            self.disk_store = DiskStore(data_dir)
+            if catalog is None and recover:
+                catalog = self.disk_store.recover_catalog()
         if catalog is None:
             with SnappySession._default_lock:
                 if SnappySession._default_catalog is None:
@@ -43,13 +54,60 @@ class SnappySession:
         self.analyzer = Analyzer(catalog)
         self.executor = Executor(catalog, self.conf)
 
+    def checkpoint(self) -> None:
+        """Persist all tables + catalog to the attached disk store and fold
+        the WAL (ref: disk-store flush / backup base image)."""
+        if self.disk_store is None:
+            raise ValueError("no data_dir configured on this session")
+        self.disk_store.checkpoint(self.catalog)
+
     # ------------------------------------------------------------------
     # SQL entry (ref SnappySession.sql:179)
     # ------------------------------------------------------------------
 
     def sql(self, sql_text: str, params: Sequence[Any] = ()) -> Result:
         stmt = parse(sql_text)
-        return self.execute_statement(stmt, tuple(params))
+        ds = self.disk_store
+        if ds is not None and isinstance(
+                stmt, (ast.InsertInto, ast.UpdateStmt, ast.DeleteStmt,
+                       ast.TruncateTable)):
+            # journal BEFORE applying, under the mutation lock shared with
+            # checkpoints (WAL invariant: on-disk log ≥ in-memory state)
+            table = getattr(stmt, "table", None) or stmt.name
+            from snappydata_tpu.catalog.catalog import _norm
+
+            with ds.mutation_lock:
+                ds.wal_append(_norm(table), "sql", sql=sql_text,
+                              params=tuple(params))
+                return self.execute_statement(stmt, tuple(params))
+        result = self.execute_statement(stmt, tuple(params))
+        if ds is not None:
+            from snappydata_tpu.catalog.catalog import _norm
+
+            if isinstance(stmt, ast.CreateTable):
+                if not hasattr(self.catalog, "_view_ddl"):
+                    self.catalog._view_ddl = {}
+                ds.save_catalog(self.catalog)
+                if stmt.as_select is not None:
+                    # CTAS rows exist only in memory: checkpoint the new
+                    # table immediately (they were never WAL'd)
+                    info = self.catalog.lookup_table(stmt.name)
+                    if info is not None:
+                        with ds.mutation_lock:
+                            ds.checkpoint_table(info, ds.current_wal_seq())
+            elif isinstance(stmt, ast.DropTable):
+                ds.drop_table_dir(_norm(stmt.name))
+                ds.save_catalog(self.catalog)
+            elif isinstance(stmt, ast.CreateView):
+                if not hasattr(self.catalog, "_view_ddl"):
+                    self.catalog._view_ddl = {}
+                self.catalog._view_ddl[_norm(stmt.name)] = sql_text
+                ds.save_catalog(self.catalog)
+            elif isinstance(stmt, ast.DropView):
+                getattr(self.catalog, "_view_ddl", {}).pop(
+                    _norm(stmt.name), None)
+                ds.save_catalog(self.catalog)
+        return result
 
     def execute_statement(self, stmt: ast.Statement, user_params=()) -> Result:
         if isinstance(stmt, ast.Query):
@@ -99,6 +157,8 @@ class SnappySession:
         raise ValueError(f"unsupported statement {type(stmt).__name__}")
 
     def _run_query(self, plan: ast.Plan, user_params=()) -> Result:
+        if getattr(self.catalog, "_sample_maintainers", None):
+            self._refresh_samples()
         from snappydata_tpu.sql.optimizer import optimize
 
         plan = optimize(plan, self.catalog)
@@ -129,38 +189,90 @@ class SnappySession:
     def table_rows(self, name: str) -> Result:
         return self.sql(f"SELECT * FROM {name}")
 
+    def _journal_then(self, info, kind: str, arrays, nulls, apply_fn):
+        """WAL-then-apply under the mutation lock (no-op without a store)."""
+        if self.disk_store is None:
+            return apply_fn()
+        with self.disk_store.mutation_lock:
+            self.disk_store.wal_append(info.name, kind, arrays=arrays,
+                                       nulls=nulls)
+            return apply_fn()
+
     def insert(self, table: str, *rows) -> int:
         info = self.catalog.describe(table)
         arrays, nulls = _rows_to_arrays(info.schema, rows)
         if isinstance(info.data, RowTableData):
-            return info.data.insert_arrays(arrays)
-        return info.data.insert_arrays(arrays, nulls=nulls)
+            return self._journal_then(info, "insert", arrays, None,
+                                      lambda: info.data.insert_arrays(arrays))
+        return self._journal_then(
+            info, "insert", arrays, nulls,
+            lambda: info.data.insert_arrays(arrays, nulls=nulls))
 
     def insert_arrays(self, table: str, arrays: Sequence[np.ndarray]) -> int:
-        return self.catalog.describe(table).data.insert_arrays(list(arrays))
+        info = self.catalog.describe(table)
+        arrays = [np.asarray(a) for a in arrays]
+        return self._journal_then(info, "insert", arrays, None,
+                                  lambda: info.data.insert_arrays(arrays))
 
     def put(self, table: str, *rows) -> int:
         info = self.catalog.describe(table)
         arrays, _ = _rows_to_arrays(info.schema, rows)
-        if isinstance(info.data, RowTableData):
-            return info.data.put_arrays(arrays)
-        return self._column_put(info, arrays)
+        return self.put_arrays(table, arrays)
+
+    def put_arrays(self, table: str, arrays: Sequence[np.ndarray]) -> int:
+        info = self.catalog.describe(table)
+        arrays = [np.asarray(a) for a in arrays]
+
+        def apply():
+            if isinstance(info.data, RowTableData):
+                return info.data.put_arrays(arrays)
+            return self._column_put(info, arrays)
+
+        return self._journal_then(info, "put", arrays, None, apply)
+
+    def delete_keys(self, table: str, key_columns: Sequence[str],
+                    key_arrays: Sequence[np.ndarray]) -> int:
+        """Delete rows whose key tuple appears in `key_arrays` (CDC delete
+        path; WAL kind 'delete_keys')."""
+        info = self.catalog.describe(table)
+        key_arrays = [np.asarray(a) for a in key_arrays]
+        keys = {tuple(c[i] for c in key_arrays)
+                for i in range(len(key_arrays[0]))}
+
+        def pred(cols):
+            stacked = [np.asarray(cols[k]) for k in key_columns]
+            n = stacked[0].shape[0]
+            hits = np.zeros(n, dtype=bool)
+            for r in range(n):
+                if tuple(c[r] for c in stacked) in keys:
+                    hits[r] = True
+            return hits
+
+        def apply():
+            return info.data.delete(pred)
+
+        if self.disk_store is None:
+            return apply()
+        with self.disk_store.mutation_lock:
+            self.disk_store.wal_append(
+                info.name, "delete_keys", arrays=key_arrays,
+                extra={"key_columns": list(key_columns)})
+            return apply()
 
     def update(self, table: str, where_sql: str, new_values: Dict[str, Any]
                ) -> int:
-        assigns = tuple((k, ast.Lit(v)) for k, v in new_values.items())
-        where = None
-        if where_sql:
-            where = parse(f"SELECT 1 FROM {table} WHERE {where_sql}")
-            where = where.plan.children()[0].condition \
-                if isinstance(where.plan, ast.Project) else None
-        stmt = ast.UpdateStmt(table, assigns, where)
-        return self._update(stmt, ())
+        """Programmatic UPDATE — routed through sql() so it is journaled
+        like any statement (review finding: it used to bypass the WAL)."""
+        sets = ", ".join(f"{k} = {_sql_literal(v)}"
+                         for k, v in new_values.items())
+        text = f"UPDATE {table} SET {sets}" + \
+            (f" WHERE {where_sql}" if where_sql else "")
+        return int(self.sql(text).rows()[0][0])
 
     def delete(self, table: str, where_sql: str) -> int:
-        stmt = parse(f"DELETE FROM {table}" +
-                     (f" WHERE {where_sql}" if where_sql else ""))
-        return self._delete(stmt, ())
+        text = f"DELETE FROM {table}" + \
+            (f" WHERE {where_sql}" if where_sql else "")
+        return int(self.sql(text).rows()[0][0])
 
     def get(self, table: str, key: tuple):
         """Point lookup on a row table's primary key — never enters the
@@ -181,6 +293,8 @@ class SnappySession:
     # ------------------------------------------------------------------
 
     def _create_table(self, stmt: ast.CreateTable) -> Result:
+        if stmt.provider == "sample":
+            return self._create_sample_table(stmt)
         if stmt.as_select is not None:
             if stmt.if_not_exists and \
                     self.catalog.lookup_table(stmt.name) is not None:
@@ -204,6 +318,119 @@ class SnappySession:
                                   stmt.options, stmt.if_not_exists,
                                   key_columns=keys)
         return _status()
+
+    # ------------------------------------------------------------------
+    # AQP (plug-in surface; ref SnappyContextFunctions :42-78)
+    # ------------------------------------------------------------------
+
+    def _create_sample_table(self, stmt: ast.CreateTable) -> Result:
+        """CREATE SAMPLE TABLE s ON base OPTIONS (qcs 'a,b', buckets...,
+        reservoir_size 'n') — stratified reservoir over the base table,
+        schema = base schema + snappy_sampler_weight."""
+        from snappydata_tpu.aqp.sampling import (
+            RESERVOIR_WEIGHT_COLUMN, SampleTableMaintainer,
+            StratifiedReservoir)
+
+        opts = {k.lower(): str(v) for k, v in stmt.options.items()}
+        base_name = opts.get("basetable") or opts.get("base_table")
+        if not base_name:
+            raise ValueError("sample table requires OPTIONS (baseTable ...)")
+        if stmt.if_not_exists and \
+                self.catalog.lookup_table(stmt.name) is not None:
+            return _status()  # don't double-register the maintainer
+        base = self.catalog.describe(base_name)
+        schema = T.Schema(list(base.schema.fields)
+                          + [T.Field(RESERVOIR_WEIGHT_COLUMN, T.DOUBLE,
+                                     False)])
+        info = self.catalog.create_table(stmt.name, schema, "sample",
+                                         stmt.options, stmt.if_not_exists)
+        self.register_sample(info)
+        return _status()
+
+    def register_sample(self, info) -> None:
+        """(Re)wire a sample table's reservoir + base-table feed — also
+        called on recovery (review finding: samples froze after restart)."""
+        from snappydata_tpu.aqp.sampling import (SampleTableMaintainer,
+                                                 StratifiedReservoir)
+
+        opts = info.options
+        base = self.catalog.describe(opts.get("basetable")
+                                     or opts.get("base_table"))
+        qcs = [c.strip().lower() for c in opts.get("qcs", "").split(",")
+               if c.strip()]
+        reservoir = StratifiedReservoir(
+            [base.schema.index(c) for c in qcs], len(base.schema),
+            reservoir_size=int(opts.get("reservoir_size", 50)))
+        maintainer = SampleTableMaintainer(info, base, reservoir)
+        base.data.on_insert.append(maintainer.on_insert)
+        if not hasattr(self.catalog, "_sample_maintainers"):
+            self.catalog._sample_maintainers = {}
+        self.catalog._sample_maintainers[info.name] = maintainer
+        # seed with existing base content
+        from snappydata_tpu.engine.hosteval import _eval_rel
+
+        cols, _, _, _, n = _eval_rel(
+            ast.Relation(base.name, base.schema), (), self.executor)
+        if n:
+            reservoir.observe(cols)
+
+    def _refresh_samples(self) -> None:
+        for m in getattr(self.catalog, "_sample_maintainers", {}).values():
+            m.refresh()
+
+    def approx_sql(self, sql_text: str, params: Sequence[Any] = ()) -> Result:
+        """Run an aggregate approximately over registered sample tables
+        (ref: AQP error-bounded rewrite, docs/aqp.md:43)."""
+        from snappydata_tpu.aqp.rewrite import approx_rewrite
+
+        stmt = parse(sql_text)
+        if not isinstance(stmt, ast.Query):
+            raise ValueError("approx_sql expects a query")
+        rewritten = approx_rewrite(stmt.plan, self.catalog)
+        if rewritten is None:
+            return self._run_query(stmt.plan, tuple(params))
+        self._refresh_samples()
+        return self._run_query(rewritten, tuple(params))
+
+    def create_topk(self, name: str, base_table: str, key_column: str,
+                    k: int = 50) -> None:
+        """Register a TopK structure fed by base-table inserts (ref:
+        SnappyContextFunctions.createTopK :42)."""
+        from snappydata_tpu.aqp.sketches import TopKSummary
+
+        base = self.catalog.describe(base_table)
+        ci = base.schema.index(key_column)
+        topk = TopKSummary(k=k)
+        if not hasattr(self.catalog, "_topks"):
+            self.catalog._topks = {}
+            self.catalog._topk_defs = {}
+        self.catalog._topks[name.lower()] = topk
+        self.catalog._topk_defs[name.lower()] = {
+            "base_table": base.name, "key_column": key_column.lower(), "k": k}
+        if self.disk_store is not None:
+            self.disk_store.save_catalog(self.catalog)
+
+        def feed(arrays, nulls=None, _ci=ci, _t=topk):
+            _t.observe(np.asarray(arrays[_ci]))
+
+        base.data.on_insert.append(feed)
+        from snappydata_tpu.engine.hosteval import _eval_rel
+
+        cols, _, _, _, n = _eval_rel(
+            ast.Relation(base.name, base.schema), (), self.executor)
+        if n:
+            topk.observe(cols[ci])
+
+    def query_topk(self, name: str, n: Optional[int] = None) -> Result:
+        topk = getattr(self.catalog, "_topks", {}).get(name.lower())
+        if topk is None:
+            raise ValueError(f"no such TopK: {name}")
+        items = topk.top(n)
+        return Result(
+            ["key", "estimated_count"],
+            [np.array([k for k, _ in items], dtype=object),
+             np.array([c for _, c in items], dtype=np.int64)],
+            [None, None], [T.STRING, T.LONG])
 
     def _insert(self, stmt: ast.InsertInto, user_params) -> int:
         info = self.catalog.describe(stmt.table)
@@ -423,3 +650,16 @@ def _coerce(col: np.ndarray, nmask, dtype: T.DataType):
 
 def _s(v):
     return None if v is None else str(v)
+
+
+def _sql_literal(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    if hasattr(v, "item"):
+        return repr(v.item())
+    escaped = str(v).replace("'", "''")
+    return f"'{escaped}'"

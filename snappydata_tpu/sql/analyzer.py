@@ -190,6 +190,14 @@ class Analyzer:
                            for f in info.schema.fields])
             return ast.Relation(info.name, info.schema, alias), scope
 
+        if isinstance(plan, ast.Relation):
+            # already-resolved scan (stored view bodies re-enter analysis);
+            # resolution is idempotent
+            alias = plan.alias or plan.name.split(".")[-1]
+            scope = Scope([ScopeEntry(alias, f.name, f.dtype, f.nullable)
+                           for f in plan.schema.fields])
+            return plan, scope
+
         if isinstance(plan, ast.SubqueryAlias):
             child, scope = self.analyze_plan(plan.child)
             scope = Scope([dataclasses.replace(e, qualifier=plan.alias)

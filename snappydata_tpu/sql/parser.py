@@ -569,6 +569,9 @@ class Parser:
             self.expect_kw("exists")
             if_not_exists = True
         name = self.qualified_name()
+        base_table = None
+        if sample and self.accept_kw("on"):
+            base_table = self.qualified_name()
         columns: List[ast.ColumnDef] = []
         if self.at_op("("):
             columns = self.column_defs()
@@ -580,6 +583,8 @@ class Parser:
         options = {}
         if self.accept_kw("options"):
             options = self.options_clause()
+        if base_table is not None:
+            options.setdefault("basetable", base_table)
         as_select = None
         if self.accept_kw("as"):
             as_select = self.query_expr()

@@ -175,6 +175,9 @@ class ColumnTableData:
             0, (), tuple(np.empty(0, dtype=f.dtype.np_dtype)
                          for f in schema.fields), 0,
             tuple(None for _ in schema.fields))
+        # post-insert observers (AQP sample/TopK maintainers; ref:
+        # SampleInsertExec keeps samples in sync with base inserts)
+        self.on_insert = []
         # device cache: manifest version -> {key: device arrays}. Keyed per
         # version so concurrent readers of different snapshots never mix
         # entries (review finding: clear+overwrite raced).
@@ -255,7 +258,9 @@ class ColumnTableData:
             if self._row_buffer.count >= self.max_delta_rows:
                 views.extend(self._rollover_locked())
             self._publish(tuple(views))
-            return n
+        for cb in self.on_insert:
+            cb(arrays, nulls)
+        return n
 
     def _cut_batch(self, arrays: List[np.ndarray],
                    nulls: Optional[List[Optional[np.ndarray]]] = None
@@ -471,6 +476,7 @@ class RowTableData:
         self._live: List[bool] = []
         self._pk: Dict[tuple, int] = {}
         self._version = 0
+        self.on_insert = []
 
     @property
     def version(self) -> int:
@@ -494,6 +500,8 @@ class RowTableData:
                 row = tuple(a[i] for a in arrays)
                 self._append_row(row, upsert=False)
             self._version += 1
+        for cb in self.on_insert:
+            cb(arrays, None)
         return n
 
     def put_arrays(self, arrays: Sequence[np.ndarray]) -> int:

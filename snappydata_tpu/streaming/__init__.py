@@ -1,0 +1,4 @@
+from snappydata_tpu.streaming.sink import SnappySink, EventType  # noqa: F401
+from snappydata_tpu.streaming.query import (  # noqa: F401
+    StreamingQuery, MemorySource, FileSource,
+)

@@ -1,0 +1,147 @@
+"""Micro-batch streaming queries over pluggable sources.
+
+The reference runs Spark structured streaming (micro-batches from Kafka/
+file/socket sources) into the snappy sink (SURVEY.md §3.5) plus a legacy
+DStream layer (SchemaDStream). Here: a thread-driven micro-batch loop with
+the same progress/exactly-once contract, and sources for in-memory queues
+and growing files. A Kafka consumer slots in behind the same Source
+interface when a client library is present (none in this image)."""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from snappydata_tpu.streaming.sink import SnappySink
+
+
+class Source:
+    """One micro-batch source: next_batch(from_offset) → (columns, new
+    offset) or None when no data is pending."""
+
+    def next_batch(self, offset):
+        raise NotImplementedError
+
+
+class MemorySource(Source):
+    """In-memory list of pending batches (tests / programmatic feeds)."""
+
+    def __init__(self):
+        self._batches: List[Dict[str, np.ndarray]] = []
+        self._lock = threading.Lock()
+
+    def add_batch(self, columns: Dict[str, np.ndarray]) -> None:
+        with self._lock:
+            self._batches.append(columns)
+
+    def next_batch(self, offset):
+        with self._lock:
+            if offset < len(self._batches):
+                return self._batches[offset], offset + 1
+        return None
+
+
+class FileSource(Source):
+    """Tails a directory of JSON-lines files (ref: file stream source).
+    Each new file is one micro-batch; offset = count of consumed files."""
+
+    def __init__(self, directory: str, schema_names: List[str]):
+        self.directory = directory
+        self.names = schema_names
+
+    def next_batch(self, offset):
+        files = sorted(f for f in os.listdir(self.directory)
+                       if not f.startswith("."))
+        if offset >= len(files):
+            return None
+        path = os.path.join(self.directory, files[offset])
+        rows = []
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    rows.append(json.loads(line))
+        cols = {n: np.array([r.get(n) for r in rows]) for n in self.names}
+        for extra in ("_eventType",):
+            if rows and extra in rows[0]:
+                cols[extra] = np.array([r[extra] for r in rows])
+        return cols, offset + 1
+
+
+class StreamingQuery:
+    """One running micro-batch pipeline: source → optional transform →
+    exactly-once sink. Progress (batch id) restarts from the sink state
+    table, so a restarted query resumes where it left off."""
+
+    def __init__(self, session, name: str, source: Source, table: str,
+                 transform: Optional[Callable] = None,
+                 conflation: bool = False, interval_s: float = 0.05):
+        self.session = session
+        self.name = name
+        self.source = source
+        self.sink = SnappySink(session, name, table, conflation=conflation)
+        self.transform = transform
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.batches_processed = 0
+        self.last_error: Optional[BaseException] = None
+
+    # offset == batch id: deterministic replay after restart
+    def start(self) -> "StreamingQuery":
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        offset = self.sink.last_batch_id() + 1
+        while not self._stop.is_set():
+            try:
+                got = self.source.next_batch(offset)
+            except Exception as e:  # source hiccup: retry next tick
+                self.last_error = e
+                got = None
+            if got is None:
+                time.sleep(self.interval_s)
+                continue
+            columns, new_offset = got
+            if self.transform is not None:
+                columns = self.transform(columns)
+            try:
+                self.sink.process_batch(offset, columns)
+                self.batches_processed += 1
+                offset = new_offset
+            except Exception as e:
+                self.last_error = e
+                time.sleep(self.interval_s)
+
+    def process_available(self) -> int:
+        """Synchronous drain (tests / backfills): consume until the source
+        is empty. Returns number of batches applied."""
+        offset = self.sink.last_batch_id() + 1
+        applied = 0
+        while True:
+            got = self.source.next_batch(offset)
+            if got is None:
+                return applied
+            columns, new_offset = got
+            if self.transform is not None:
+                columns = self.transform(columns)
+            if self.sink.process_batch(offset, columns):
+                applied += 1
+            self.batches_processed += 1
+            offset = new_offset
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+
+    @property
+    def is_active(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()

@@ -1,0 +1,115 @@
+"""AQP tests: stratified samples with unbiased estimates, approx rewrite,
+CMS/TopK sketches (ref analogue: the aqp module's sample/TopK surface via
+SnappyContextFunctions; docs/aqp.md scope)."""
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.aqp import CountMinSketch, TopKSummary, StratifiedReservoir
+
+
+@pytest.fixture()
+def s():
+    sess = SnappySession(catalog=Catalog())
+    yield sess
+    sess.stop()
+
+
+def _load_base(s, n=20000, seed=4):
+    s.sql("CREATE TABLE tx (region STRING, amount DOUBLE) USING column")
+    rng = np.random.default_rng(seed)
+    regions = np.array(["east", "west", "north", "rare"], dtype=object)
+    probs = np.array([0.5, 0.3, 0.198, 0.002])
+    reg = regions[rng.choice(4, n, p=probs)]
+    amt = np.round(rng.random(n) * 100, 2)
+    s.insert_arrays("tx", [reg, amt])
+    return reg, amt
+
+
+def test_stratified_reservoir_keeps_rare_strata():
+    r = StratifiedReservoir([0], 2, reservoir_size=20)
+    rng = np.random.default_rng(0)
+    keys = np.array(["common"] * 9990 + ["rare"] * 10, dtype=object)
+    vals = rng.random(10000)
+    r.observe([keys, vals])
+    stats = r.stats()
+    assert stats[("rare",)][0] == 10      # all rare rows kept
+    assert stats[("common",)] == (20, 9990)
+
+
+def test_create_sample_table_and_weighted_estimates(s):
+    reg, amt = _load_base(s)
+    s.sql("CREATE SAMPLE TABLE tx_sample ON tx OPTIONS "
+          "(qcs 'region', reservoir_size '200')")
+    exact = s.sql("SELECT count(*), sum(amount) FROM tx").rows()[0]
+    approx = s.approx_sql("SELECT count(*), sum(amount) FROM tx").rows()[0]
+    assert approx[0] == pytest.approx(exact[0], rel=0.05)
+    assert approx[1] == pytest.approx(exact[1], rel=0.1)
+    # rare stratum survives in the grouped estimate
+    grouped = dict((r[0], r[1]) for r in s.approx_sql(
+        "SELECT region, count(*) FROM tx GROUP BY region").rows())
+    exact_g = dict((r[0], r[1]) for r in s.sql(
+        "SELECT region, count(*) FROM tx GROUP BY region").rows())
+    assert set(grouped) == set(exact_g)
+    assert grouped["rare"] == exact_g["rare"]  # fully-kept stratum is exact
+
+
+def test_sample_table_direct_query_and_avg_rewrite(s):
+    _load_base(s)
+    s.sql("CREATE SAMPLE TABLE tx_sample ON tx OPTIONS "
+          "(qcs 'region', reservoir_size '100')")
+    direct = s.sql("SELECT count(*) FROM tx_sample").rows()[0][0]
+    assert 0 < direct <= 500
+    exact_avg = s.sql("SELECT avg(amount) FROM tx").rows()[0][0]
+    approx_avg = s.approx_sql("SELECT avg(amount) FROM tx").rows()[0][0]
+    assert approx_avg == pytest.approx(exact_avg, rel=0.15)
+
+
+def test_sample_follows_new_inserts(s):
+    _load_base(s, n=5000)
+    s.sql("CREATE SAMPLE TABLE tx_sample ON tx OPTIONS (qcs 'region')")
+    before = s.approx_sql("SELECT count(*) FROM tx").rows()[0][0]
+    s.insert_arrays("tx", [np.array(["south"] * 5000, dtype=object),
+                           np.ones(5000)])
+    after = s.approx_sql("SELECT count(*) FROM tx").rows()[0][0]
+    assert after == pytest.approx(10000, rel=0.05)
+    assert after > before
+
+
+def test_count_min_sketch():
+    cms = CountMinSketch(depth=5, width=4096)
+    rng = np.random.default_rng(1)
+    keys = rng.zipf(1.5, 50000).astype(np.int64)
+    keys = keys[keys < 1000]
+    cms.add(keys)
+    from collections import Counter
+
+    truth = Counter(keys.tolist())
+    for k in list(truth)[:50]:
+        est = int(cms.estimate(np.array([k], dtype=np.int64))[0])
+        assert est >= truth[k]                  # never undercounts
+        assert est <= truth[k] + 0.02 * cms.total
+    merged = cms.merge(cms)
+    k0 = list(truth)[0]
+    assert int(merged.estimate(np.array([k0], dtype=np.int64))[0]) >= \
+        2 * truth[k0]
+
+
+def test_topk_summary_and_session_api(s):
+    s.sql("CREATE TABLE clicks (page STRING, n INT) USING column")
+    rng = np.random.default_rng(2)
+    pages = np.array([f"page{i}" for i in range(100)], dtype=object)
+    weights = 1.0 / np.arange(1, 101)
+    weights /= weights.sum()
+    data = pages[rng.choice(100, 30000, p=weights)]
+    s.create_topk("hot_pages", "clicks", "page", k=10)
+    s.insert_arrays("clicks", [data, np.ones(len(data), dtype=np.int32)])
+    top = s.query_topk("hot_pages", 5).rows()
+    assert len(top) == 5
+    from collections import Counter
+
+    truth = [k for k, _ in Counter(data.tolist()).most_common(5)]
+    got = [r[0] for r in top]
+    assert set(got[:3]) <= set(truth[:6])  # heavy hitters found

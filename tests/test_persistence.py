@@ -1,0 +1,132 @@
+"""Durability tests: checkpoint/restore, WAL replay, crash recovery,
+recovery-mode extraction (ref analogue: disk-store recovery on boot,
+PrimaryDUnitRecoveryTest data-extractor tier)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+
+
+def _fresh(tmp_path, recover=True):
+    return SnappySession(catalog=None if recover else Catalog(),
+                         data_dir=str(tmp_path), recover=recover)
+
+
+def test_checkpoint_restore_column_table(tmp_path):
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE t (k INT, v DOUBLE, name STRING) USING column "
+          "OPTIONS (column_max_delta_rows '4')")
+    s.sql("INSERT INTO t VALUES (1, 1.5, 'a'), (2, 2.5, 'b'), "
+          "(3, NULL, NULL), (4, 4.5, 'd'), (5, 5.5, 'e')")
+    s.sql("UPDATE t SET v = 99.0 WHERE k = 2")
+    s.sql("DELETE FROM t WHERE k = 4")
+    before = s.sql("SELECT k, v, name FROM t ORDER BY k").rows()
+    s.checkpoint()
+    s.disk_store.close()
+
+    s2 = _fresh(tmp_path)
+    after = s2.sql("SELECT k, v, name FROM t ORDER BY k").rows()
+    assert after == before
+    # encodings survive: string predicate + aggregate still work
+    assert s2.sql("SELECT count(*) FROM t WHERE name = 'a'").rows()[0][0] == 1
+    assert s2.sql("SELECT count(*) FROM t WHERE v IS NULL").rows()[0][0] == 1
+
+
+def test_wal_replay_without_checkpoint(tmp_path):
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE t (k INT, v INT) USING column")
+    s.sql("INSERT INTO t VALUES (1, 10), (2, 20)")
+    s.sql("UPDATE t SET v = 0 WHERE k = 1")
+    # no checkpoint — simulate crash (drop in-memory state)
+    s.disk_store.close()
+
+    s2 = _fresh(tmp_path)
+    rows = s2.sql("SELECT k, v FROM t ORDER BY k").rows()
+    assert rows == [(1, 0), (2, 20)]
+
+
+def test_checkpoint_then_wal_tail(tmp_path):
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE t (k INT) USING column")
+    s.sql("INSERT INTO t VALUES (1), (2)")
+    s.checkpoint()
+    s.sql("INSERT INTO t VALUES (3)")          # WAL tail after checkpoint
+    s.sql("DELETE FROM t WHERE k = 1")
+    s.disk_store.close()
+
+    s2 = _fresh(tmp_path)
+    assert sorted(r[0] for r in s2.sql("SELECT k FROM t").rows()) == [2, 3]
+
+
+def test_row_table_and_bulk_arrays_roundtrip(tmp_path):
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE kv (k INT PRIMARY KEY, v STRING) USING row")
+    s.sql("INSERT INTO kv VALUES (1, 'a'), (2, 'b')")
+    s.sql("CREATE TABLE big (x BIGINT, y DOUBLE) USING column")
+    s.insert_arrays("big", [np.arange(5000, dtype=np.int64),
+                            np.linspace(0, 1, 5000)])
+    s.checkpoint()
+    s.sql("PUT INTO kv VALUES (2, 'B')")       # WAL tail on row table
+    s.disk_store.close()
+
+    s2 = _fresh(tmp_path)
+    assert s2.sql("SELECT v FROM kv WHERE k = 2").rows() == [("B",)]
+    assert s2.sql("SELECT count(*), sum(x) FROM big").rows()[0] == \
+        (5000, sum(range(5000)))
+    assert s2.get("kv", (1,)) == (1, "a")      # PK index rebuilt
+
+
+def test_torn_wal_tail_ignored(tmp_path):
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE t (k INT) USING column")
+    s.sql("INSERT INTO t VALUES (1), (2)")
+    s.disk_store.close()
+    wal = os.path.join(str(tmp_path), "wal.log")  # global WAL
+    with open(wal, "ab") as fh:               # simulate crash mid-write
+        fh.write(b"SNTP\x50\x00\x00\x00partial-garbage")
+
+    s2 = _fresh(tmp_path)
+    assert sorted(r[0] for r in s2.sql("SELECT k FROM t").rows()) == [1, 2]
+
+
+def test_restore_row_buffer_strings_queryable(tmp_path):
+    """Regression: strings living only in the row buffer at checkpoint time
+    must re-enter the shared dictionary on restore (device build used to
+    KeyError)."""
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE trades (sym STRING, qty INT) USING column")
+    s.sql("INSERT INTO trades VALUES ('AAPL', 10), ('GOOG', 20)")
+    s.checkpoint()
+    s.disk_store.close()
+    s2 = _fresh(tmp_path)
+    rows = s2.sql("SELECT sym, sum(qty) FROM trades GROUP BY sym "
+                  "ORDER BY sym").rows()
+    assert rows == [("AAPL", 10), ("GOOG", 20)]
+
+
+def test_recovery_mode_offline_extraction(tmp_path):
+    """Data-extractor: rebuild from disk bytes alone (RecoveryService
+    analogue) using a plain DiskStore, no prior session."""
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE t (k INT, s STRING) USING column")
+    s.sql("INSERT INTO t VALUES (1, 'x'), (2, 'y')")
+    s.checkpoint()
+    s.disk_store.close()
+
+    from snappydata_tpu.storage.persistence import DiskStore
+
+    catalog = DiskStore(str(tmp_path)).recover_catalog()
+    info = catalog.lookup_table("t")
+    assert info is not None
+    assert info.data.snapshot().total_rows() == 2

@@ -242,6 +242,20 @@ def test_date_functions_and_literals(s):
     assert out.rows()[0][0] == 1  # only 2020-03-15 precedes 2020-12-02
 
 
+def test_views(s):
+    s.sql("CREATE TABLE t (a INT, b STRING) USING column")
+    s.sql("INSERT INTO t VALUES (1, 'x'), (5, 'y'), (9, 'z')")
+    s.sql("CREATE VIEW big AS SELECT a, b FROM t WHERE a > 2")
+    assert s.sql("SELECT count(*) FROM big").rows()[0][0] == 2
+    out = s.sql("SELECT v.b FROM big v WHERE v.a = 9")
+    assert out.rows() == [("z",)]
+    s.sql("CREATE OR REPLACE VIEW big AS SELECT a FROM t WHERE a > 8")
+    assert s.sql("SELECT count(*) FROM big").rows()[0][0] == 1
+    s.sql("DROP VIEW big")
+    with pytest.raises(Exception):
+        s.sql("SELECT * FROM big")
+
+
 def test_mutation_then_query_sees_new_version(s):
     s.sql("CREATE TABLE t (k INT, v INT) USING column "
           "OPTIONS (column_max_delta_rows '2')")
