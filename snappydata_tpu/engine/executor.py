@@ -159,11 +159,15 @@ class CompiledPlan:
         # single bulk device→host transfer (per-array .asarray costs one
         # round trip each — painful over a remote/tunneled TPU link)
         outs = jax.device_get(outs)
+        if bool(np.asarray(outs[2])):
+            raise CompileError(
+                "group-by cardinality exceeded max_groups on device")
         return self._assemble(outs, tables)
 
     def _assemble(self, outs, tables) -> Result:
-        """Device outputs → host Result. outs = (mask, [(val, null)...])."""
-        mask_dev, pairs = outs
+        """Device outputs → host Result.
+        outs = (mask, [(val, null)...], overflow_flag)."""
+        mask_dev, pairs, _overflow = outs
         mask = np.asarray(mask_dev).reshape(-1)
         names, cols, nulls, dtypes = [], [], [], []
         for oc, (v, nl) in zip(self.out_scope, pairs):
@@ -280,7 +284,7 @@ class Compiler:
                 v = _broadcast_to_mask(dv.value, out.valid)
                 nl = dv.null
                 pairs.append((v, nl))
-            return out.valid, tuple(pairs)
+            return out.valid, tuple(pairs), jnp.asarray(False)
 
         return run_root, scope
 
@@ -517,6 +521,7 @@ class Compiler:
             n = valid.shape[0]
 
             # --- group index ---
+            overflow = jnp.asarray(False)
             if not groups:
                 gidx = jnp.zeros(n, dtype=jnp.int32)
                 num_groups = 1
@@ -543,13 +548,21 @@ class Compiler:
                     key_vals = kdvals
                 else:
                     fast = False
-                    num_groups = max_groups
+                    # bound segments by the (static) padded row count: a
+                    # table smaller than max_groups can never overflow
+                    num_groups = min(max_groups, n)
                     combined = _combine_keys(
                         [DVal(_broadcast_to_mask(k.value, out.valid)
                               .reshape(-1), None, k.dtype) for k in kdvals])
                     combined = jnp.where(valid, combined, _I64_MAX)
-                    uniq = jnp.unique(combined, size=max_groups + 1,
+                    uniq = jnp.unique(combined, size=num_groups + 1,
                                       fill_value=_I64_MAX)
+                    # overflow ⟺ the sentinel got pushed out of the
+                    # (size num_groups+1) unique set ⟺ > num_groups real
+                    # keys — silent truncation would return WRONG results,
+                    # so the executor reruns on the exact host path
+                    if num_groups < n:
+                        overflow = uniq[-1] != _I64_MAX
                     gidx = jnp.searchsorted(uniq, combined)
                     key_vals = kdvals
                 # rows with any NULL group key: SQL groups them together —
@@ -640,7 +653,7 @@ class Compiler:
             for run, dt in zip(post_runs, out_types):
                 dv = run(post_rt)
                 pairs.append((dv.value, dv.null))
-            return gvalid, tuple(pairs)
+            return gvalid, tuple(pairs), overflow
 
         return run_agg, out_cols
 

@@ -385,9 +385,52 @@ class ExprBuilder:
 
             return run_negated
 
+        negated = e.negated
+        # large literal lists (IN-subquery results): sorted aux array +
+        # searchsorted — O(log k) compute, O(1) graph size (a chained-OR
+        # lowering took minutes of XLA compile at a few thousand values)
+        if len(e.values) > 8 and all(self._is_literalish(v)
+                                     for v in e.values):
+            getters = [(lambda params, x=v: self._param_value(x, params))
+                       for v in e.values]
+
+            def build_sorted(params):
+                vals = np.asarray([g(params) for g in getters])
+                vals = np.sort(vals.astype(np.float64)
+                               if vals.dtype == object else vals)
+                pad = (1 << (len(vals) - 1).bit_length()) - len(vals)
+                if pad:
+                    vals = np.concatenate(
+                        [vals, np.full(pad, vals[-1])])
+                return vals
+
+            aux_i = self._register_aux(build_sorted)
+            child = self.emit(e.child)
+
+            def run_in_sorted(rt: Runtime) -> DVal:
+                c = child(rt)
+                table = rt.aux[aux_i]
+                # compare in the PROMOTED dtype: truncating a float probe
+                # to an int table produced false positives (review finding)
+                if jnp.issubdtype(jnp.asarray(c.value).dtype, jnp.floating) \
+                        or jnp.issubdtype(table.dtype, jnp.floating):
+                    # f64 even on TPU: f32 would alias distinct int keys
+                    table_c = table.astype(jnp.float64)
+                    cv = c.value.astype(jnp.float64)
+                else:
+                    table_c = table.astype(jnp.int64)
+                    cv = c.value.astype(jnp.int64)
+                pos = jnp.clip(jnp.searchsorted(table_c, cv), 0,
+                               table_c.shape[0] - 1)
+                hit = table_c[pos] == cv
+                if negated:
+                    hit = ~hit
+                return DVal(hit, c.null, T.BOOLEAN)
+
+            return run_in_sorted
+
         child = self.emit(e.child)
         values = [self.emit(v) for v in e.values]
-        negated = e.negated
 
         def run_in(rt: Runtime) -> DVal:
             c = child(rt)
@@ -433,15 +476,19 @@ class ExprBuilder:
 
         def run_case(rt: Runtime) -> DVal:
             branches = [(c(rt), v(rt)) for c, v in whens]
+            # result type promotes across ALL branches (ELSE 0 must not
+            # demote a double CASE to int — it truncated aggregates)
+            dt = None
+            for _, v_dv in branches:
+                dt = _promote(dt, v_dv.dtype)
             if other is not None:
                 out = other(rt)
+                dt = _promote(dt, out.dtype)
                 acc_v, acc_n = out.value, out.null
-                dt = out.dtype
             else:
                 first_v = branches[0][1]
                 acc_v = jnp.zeros_like(first_v.value)
                 acc_n = True  # no branch matched → NULL
-                dt = first_v.dtype
             for cond, val in reversed(branches):
                 cv = cond.value
                 if cond.null is not None:

@@ -1,0 +1,114 @@
+"""Native (C++) kernels with build-on-first-use and pure-Python fallback.
+
+Compiles native/_fastingest.cpp with the system compiler on first import
+(cached under native/build/). Everything keeps working without a compiler:
+`fast_encode_strings` falls back to a vectorized pandas implementation.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+import threading
+from typing import Optional, Tuple
+
+import numpy as np
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "_fastingest.cpp")
+_BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
+
+_lock = threading.Lock()
+_native = None
+_tried = False
+
+
+def _build() -> Optional[str]:
+    os.makedirs(_BUILD_DIR, exist_ok=True)
+    so_path = os.path.join(
+        _BUILD_DIR,
+        f"_fastingest.cpython-{sys.version_info.major}"
+        f"{sys.version_info.minor}.so")
+    if os.path.exists(so_path) and \
+            os.path.getmtime(so_path) >= os.path.getmtime(_SRC):
+        return so_path
+    cc = os.environ.get("CXX", "g++")
+    cmd = [
+        cc, "-O3", "-shared", "-fPIC", "-std=c++17",
+        f"-I{sysconfig.get_paths()['include']}",
+        f"-I{np.get_include()}",
+        _SRC, "-o", so_path,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+    except (subprocess.CalledProcessError, FileNotFoundError,
+            subprocess.TimeoutExpired):
+        return None
+    return so_path
+
+
+def _load():
+    global _native, _tried
+    with _lock:
+        if _tried:
+            return _native
+        _tried = True
+        if not os.path.exists(_SRC):
+            return None
+        so_path = _build()
+        if so_path is None:
+            return None
+        try:
+            spec = importlib.util.spec_from_file_location("_fastingest",
+                                                          so_path)
+            mod = importlib.util.module_from_spec(spec)
+            spec.loader.exec_module(mod)
+            _native = mod
+        except Exception:
+            _native = None
+        return _native
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def fast_encode_strings(values: np.ndarray, lookup: dict, store: list
+                        ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """One pass: intern `values` into (lookup, store) and return
+    (int32 codes, null mask | None)."""
+    values = np.ascontiguousarray(np.asarray(values, dtype=object))
+    # normalize pandas-style missing markers (float NaN, pd.NA) to None so
+    # native and fallback paths agree (NaN != NaN would otherwise mint one
+    # dictionary entry per NaN object in the C kernel)
+    import pandas as pd
+
+    na = pd.isna(values)
+    if na.any():
+        values = values.copy()
+        values[na] = None
+    mod = _load()
+    if mod is not None:
+        return mod.encode_strings(values, lookup, store)
+    # vectorized fallback: factorize in C, walk only the uniques in Python
+    import pandas as pd
+
+    inverse, uniques = pd.factorize(values, use_na_sentinel=True)
+    trans = np.empty(max(1, len(uniques)), dtype=np.int32)
+    for j, v in enumerate(uniques.tolist()):
+        code = lookup.get(v)
+        if code is None:
+            code = len(store)
+            lookup[v] = code
+            store.append(v)
+        trans[j] = code
+    nulls = inverse < 0
+    codes = trans[np.maximum(inverse, 0)].astype(np.int32)
+    if nulls.any():
+        codes = np.where(nulls, 0, codes)
+        return codes, nulls
+    return codes, None

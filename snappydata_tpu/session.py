@@ -121,6 +121,9 @@ class SnappySession:
             self.catalog.describe(stmt.name).data.truncate()
             return _status()
         if isinstance(stmt, ast.CreateView):
+            if _contains_subquery(stmt.query):
+                raise AnalysisError(
+                    "subqueries in view definitions are not supported yet")
             plan, _ = self.analyzer.analyze_plan(stmt.query)
             self.catalog.create_view(stmt.name, plan, stmt.or_replace)
             return _status()
@@ -159,6 +162,7 @@ class SnappySession:
     def _run_query(self, plan: ast.Plan, user_params=()) -> Result:
         if getattr(self.catalog, "_sample_maintainers", None):
             self._refresh_samples()
+        plan = self._rewrite_subqueries(plan, user_params)
         from snappydata_tpu.sql.optimizer import optimize
 
         plan = optimize(plan, self.catalog)
@@ -318,6 +322,60 @@ class SnappySession:
                                   stmt.options, stmt.if_not_exists,
                                   key_columns=keys)
         return _status()
+
+    def _rewrite_subqueries(self, plan: ast.Plan, user_params) -> ast.Plan:
+        """Pre-evaluate UNCORRELATED subqueries and substitute literals
+        (scalar → Lit, IN → InList, EXISTS → bool). Correlated subqueries
+        surface a clear error (reference supports them via Catalyst; a
+        later round here)."""
+        return ast.transform_plan_exprs(plan, self._subquery_fn(user_params))
+
+    def _subquery_fn(self, user_params):
+        def fn(e: ast.Expr) -> ast.Expr:
+            if isinstance(e, ast.ScalarSubquery):
+                res = self._run_subquery(e.plan, user_params)
+                if res.num_rows == 0:
+                    return ast.Lit(None, res.dtypes[0])
+                if res.num_rows > 1:
+                    raise AnalysisError(
+                        "scalar subquery returned more than one row")
+                v = res.columns[0][0]
+                if res.nulls[0] is not None and res.nulls[0][0]:
+                    return ast.Lit(None, res.dtypes[0])
+                return ast.Lit(v.item() if hasattr(v, "item") else v,
+                               res.dtypes[0])
+            if isinstance(e, ast.InSubquery):
+                res = self._run_subquery(e.plan, user_params)
+                dtype = res.dtypes[0]
+                has_null = res.nulls[0] is not None and bool(
+                    res.nulls[0].any())
+                if e.negated and has_null:
+                    # SQL: x NOT IN (set containing NULL) is never TRUE
+                    return ast.Lit(False, T.BOOLEAN)
+                vals = tuple(
+                    ast.Lit(v.item() if hasattr(v, "item") else v, dtype)
+                    for i, v in enumerate(res.columns[0])
+                    if not (res.nulls[0] is not None and res.nulls[0][i]))
+                if not vals:
+                    return ast.Lit(e.negated, T.BOOLEAN)
+                return ast.InList(e.child, vals, negated=e.negated)
+            if isinstance(e, ast.ExistsSubquery):
+                res = self._run_subquery(ast.Limit(e.plan, 1), user_params)
+                return ast.Lit(res.num_rows > 0, T.BOOLEAN)
+            return e
+
+        return fn
+
+    def _run_subquery(self, subplan: ast.Plan, user_params) -> Result:
+        from snappydata_tpu.sql.analyzer import AnalysisError as AErr
+
+        try:
+            return self._run_query(subplan, user_params)
+        except AErr as e:
+            if "cannot resolve column" in str(e):
+                raise AnalysisError(
+                    f"correlated subqueries are not supported yet ({e})")
+            raise
 
     # ------------------------------------------------------------------
     # AQP (plug-in surface; ref SnappyContextFunctions :42-78)
@@ -501,15 +559,16 @@ class SnappySession:
         return info.data.insert_arrays(arrays)
 
     def _resolve_where(self, table_info, where, user_params):
-        scope_entries = []
-        from snappydata_tpu.sql.analyzer import Scope, ScopeEntry
+        from snappydata_tpu.sql.analyzer import (Scope, ScopeEntry,
+                                                 fold_constants)
 
+        # UPDATE/DELETE WHERE may carry subqueries: pre-evaluate them like
+        # queries do (review finding: they used to leak to host eval)
+        where = ast.transform(where, self._subquery_fn(user_params))
         alias = table_info.name.split(".")[-1]
         scope = Scope([ScopeEntry(alias, f.name, f.dtype, f.nullable)
                        for f in table_info.schema.fields])
         resolved = self.analyzer.resolve_expr(where, scope)
-        from snappydata_tpu.sql.analyzer import fold_constants
-
         return fold_constants(resolved)
 
     def _update(self, stmt: ast.UpdateStmt, user_params) -> int:
@@ -650,6 +709,19 @@ def _coerce(col: np.ndarray, nmask, dtype: T.DataType):
 
 def _s(v):
     return None if v is None else str(v)
+
+
+def _contains_subquery(plan: ast.Plan) -> bool:
+    found = [False]
+
+    def fn(e: ast.Expr) -> ast.Expr:
+        if isinstance(e, (ast.ScalarSubquery, ast.InSubquery,
+                          ast.ExistsSubquery)):
+            found[0] = True
+        return e
+
+    ast.transform_plan_exprs(plan, fn)
+    return found[0]
 
 
 def _sql_literal(v) -> str:

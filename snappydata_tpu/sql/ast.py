@@ -67,6 +67,34 @@ class Star(Expr):
 
 
 @dataclasses.dataclass(frozen=True)
+class ScalarSubquery(Expr):
+    """(SELECT single value). Uncorrelated: evaluated before planning and
+    substituted as a literal (correlated subqueries are a later round)."""
+
+    plan: object = None  # ast.Plan
+    dtype: Optional["T.DataType"] = None
+
+
+@dataclasses.dataclass(frozen=True)
+class InSubquery(Expr):
+    child: Expr = None
+    plan: object = None
+    negated: bool = False
+
+    def children(self):
+        return (self.child,)
+
+    def map_children(self, fn):
+        return dataclasses.replace(self, child=fn(self.child))
+
+
+@dataclasses.dataclass(frozen=True)
+class ExistsSubquery(Expr):
+    plan: object = None
+    negated: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class Alias(Expr):
     child: Expr
     name: str
@@ -343,6 +371,40 @@ class Values(Plan):
 # --------------------------------------------------------------------------
 # Statements (DDL/DML — executed by the session, not the query engine)
 # --------------------------------------------------------------------------
+
+def transform_plan_exprs(p: Plan, fn) -> Plan:
+    """Rebuild a plan applying `fn` to every embedded expression
+    (bottom-up within each expression)."""
+    t = lambda e: transform(e, fn)  # noqa: E731
+    if isinstance(p, Filter):
+        return Filter(transform_plan_exprs(p.child, fn), t(p.condition))
+    if isinstance(p, Project):
+        return Project(transform_plan_exprs(p.child, fn),
+                       tuple(t(e) for e in p.exprs))
+    if isinstance(p, Aggregate):
+        return Aggregate(transform_plan_exprs(p.child, fn),
+                         tuple(t(g) for g in p.group_exprs),
+                         tuple(t(e) for e in p.agg_exprs))
+    if isinstance(p, Join):
+        return Join(transform_plan_exprs(p.left, fn),
+                    transform_plan_exprs(p.right, fn), p.how,
+                    t(p.condition) if p.condition is not None else None)
+    if isinstance(p, Sort):
+        return Sort(transform_plan_exprs(p.child, fn),
+                    tuple((t(e), a) for e, a in p.orders))
+    if isinstance(p, Limit):
+        return Limit(transform_plan_exprs(p.child, fn), p.n)
+    if isinstance(p, Distinct):
+        return Distinct(transform_plan_exprs(p.child, fn))
+    if isinstance(p, Union):
+        return Union(transform_plan_exprs(p.left, fn),
+                     transform_plan_exprs(p.right, fn), p.all)
+    if isinstance(p, SubqueryAlias):
+        return SubqueryAlias(transform_plan_exprs(p.child, fn), p.alias)
+    if isinstance(p, Values):
+        return Values(tuple(tuple(t(e) for e in row) for row in p.rows))
+    return p
+
 
 @dataclasses.dataclass(frozen=True)
 class Statement:

@@ -375,7 +375,9 @@ class Parser:
         if self.accept_kw("in"):
             self.expect_op("(")
             if self.at_kw("select"):
-                raise SQLSyntaxError("IN (subquery) not supported yet")
+                sub = self.query_expr()
+                self.expect_op(")")
+                return ast.InSubquery(left, sub, negated=negated)
             vals = [self.expr()]
             while self.accept_op(","):
                 vals.append(self.expr())
@@ -435,6 +437,10 @@ class Parser:
             return ast.Param(pos=-1)  # positions assigned by analyzer
         if t.kind == "OP" and t.value == "(":
             self.next()
+            if self.at_kw("select"):
+                sub = self.query_expr()
+                self.expect_op(")")
+                return ast.ScalarSubquery(sub)
             e = self.expr()
             self.expect_op(")")
             return e
@@ -465,7 +471,11 @@ class Parser:
                 self.expect_op(")")
                 return ast.Cast(e, dt)
             if low == "exists":
-                raise SQLSyntaxError("EXISTS subqueries not supported yet")
+                self.next()
+                self.expect_op("(")
+                sub = self.query_expr()
+                self.expect_op(")")
+                return ast.ExistsSubquery(sub)
             if low in ("left", "right"):  # string funcs shadowed by keywords
                 if self.peek(1).kind == "OP" and self.peek(1).value == "(":
                     name = self.next().value

@@ -40,16 +40,21 @@ class ColumnBatch:
     def from_arrays(batch_id: int, bucket_id: int, schema: T.Schema,
                     arrays: List[np.ndarray], capacity: int,
                     validities: Optional[List[Optional[np.ndarray]]] = None,
-                    dictionaries: Optional[dict] = None) -> "ColumnBatch":
+                    dictionaries: Optional[dict] = None,
+                    precoded: Optional[dict] = None) -> "ColumnBatch":
         """Encode one batch from per-column host arrays (ref
         ColumnInsertExec's per-column encoder loop, ColumnInsertExec.scala:92).
 
         `dictionaries` maps column index → shared table-level dictionary for
-        string columns (codes comparable across batches)."""
+        string columns (codes comparable across batches); `precoded` maps
+        column index → ready EncodedColumn (fused native encode path)."""
         n = int(arrays[0].shape[0])
         assert n <= capacity, (n, capacity)
         cols = []
         for i, (f, arr) in enumerate(zip(schema.fields, arrays)):
+            if precoded and i in precoded:
+                cols.append(precoded[i])
+                continue
             validity = validities[i] if validities else None
             hint = dictionaries.get(i) if dictionaries else None
             cols.append(encode_column(np.asarray(arr), f.dtype, validity,

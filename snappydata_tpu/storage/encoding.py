@@ -54,6 +54,16 @@ class ColumnStats:
         if valid.size == 0:
             return ColumnStats(None, None, nulls, int(values.shape[0]))
         if valid.dtype == object:
+            if valid.shape[0] > 1024:
+                import pandas as pd
+
+                s = pd.Series(valid, dtype=object).dropna()
+                nulls += int(valid.shape[0] - s.shape[0])
+                if s.empty:
+                    return ColumnStats(None, None, nulls,
+                                       int(values.shape[0]))
+                lo, hi = s.min(), s.max()
+                return ColumnStats(lo, hi, nulls, int(values.shape[0]))
             non_null = [v for v in valid.tolist() if v is not None]
             nulls += len(valid) - len(non_null)
             if not non_null:
@@ -109,8 +119,8 @@ def encode_column(values: np.ndarray, dtype: T.DataType,
     """
     n = int(values.shape[0])
     if dtype.name == "string" and validity is None:
-        # derive validity from SQL NULL (None) values
-        nulls = np.fromiter((v is None for v in values), dtype=np.bool_, count=n)
+        # derive validity from SQL NULL (None) values (vectorized)
+        nulls = np.asarray(values) == None  # noqa: E711 elementwise
         if nulls.any():
             validity = ~nulls
     packed_validity = None
@@ -120,14 +130,41 @@ def encode_column(values: np.ndarray, dtype: T.DataType,
         packed_validity = bitmask.pack(validity)
     else:
         validity = None
-    stats = ColumnStats.of(values, validity)
+    if dtype.name == "string":
+        # no min/max for strings: string predicates run through dictionary
+        # LUTs, never stats-based batch skipping — computing object-array
+        # min/max was pure ingest overhead
+        nulls = int((~validity).sum()) if validity is not None else 0
+        stats = ColumnStats(None, None, nulls, n)
+    else:
+        stats = ColumnStats.of(values, validity)
 
     if dtype.name == "string":
         if dictionary_hint is not None:
             dictionary = dictionary_hint
-            lookup = {v: i for i, v in enumerate(dictionary.tolist())}
-            codes = np.fromiter((lookup[v] if v is not None else 0 for v in values),
-                                dtype=np.int32, count=n)
+            if n > 1024:
+                # vectorized code assignment (C-side hash join)
+                import pandas as pd
+
+                obj = np.asarray(values, dtype=object)
+                codes = pd.Categorical(
+                    obj, categories=dictionary).codes.astype(np.int32)
+                missing = codes < 0
+                if missing.any():
+                    # only NULLs may be absent from the hint; a real value
+                    # missing means a broken interning invariant — fail
+                    # loudly like the small-batch path (review finding)
+                    bad = missing & ~pd.isna(obj)
+                    if bad.any():
+                        raise KeyError(
+                            f"value not in dictionary hint: "
+                            f"{obj[bad][:3].tolist()}")
+                    codes = np.where(missing, 0, codes)
+            else:
+                lookup = {v: i for i, v in enumerate(dictionary.tolist())}
+                codes = np.fromiter(
+                    (lookup[v] if v is not None else 0 for v in values),
+                    dtype=np.int32, count=n)
         else:
             vals_list = values.tolist()
             filler = next((v for v in vals_list if v is not None), "")

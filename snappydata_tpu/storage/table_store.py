@@ -199,14 +199,15 @@ class ColumnTableData:
 
     def _intern_strings(self, col_idx: int, values: np.ndarray) -> np.ndarray:
         """Extend the shared dictionary with unseen values; old codes stay
-        valid because the dictionary is append-only."""
-        lookup = self._dict_lookup[col_idx]
-        store = self._dicts[col_idx]
-        for v in dict.fromkeys(values.tolist()):
-            if v is not None and v not in lookup:
-                lookup[v] = len(store)
-                store.append(v)
-        return np.array(store, dtype=object)
+        valid because the dictionary is append-only. Delegates to the
+        native fused encoder (single implementation of the intern
+        protocol — review finding)."""
+        from snappydata_tpu.native import fast_encode_strings
+
+        fast_encode_strings(np.asarray(values, dtype=object),
+                            self._dict_lookup[col_idx],
+                            self._dicts[col_idx])
+        return np.array(self._dicts[col_idx], dtype=object)
 
     def dictionary(self, col_idx: int) -> Optional[np.ndarray]:
         if col_idx in self._dicts:
@@ -236,11 +237,21 @@ class ColumnTableData:
         if nulls is None:
             nulls = [None] * len(arrays)
         with self._lock:
-            # intern string values up front so row-buffer rows resolve to
-            # dictionary codes at device-build time without mutation
+            # intern + dictionary-encode strings in ONE fused pass (native
+            # C++ kernel when available; vectorized pandas otherwise) so
+            # batch cutting below just slices the precomputed codes
+            from snappydata_tpu.native import fast_encode_strings
+
+            nulls = list(nulls)
+            str_codes: Dict[int, np.ndarray] = {}
             for i in self._dicts:
                 arrays[i] = np.asarray(arrays[i], dtype=object)
-                self._intern_strings(i, arrays[i])
+                codes, cnulls = fast_encode_strings(
+                    arrays[i], self._dict_lookup[i], self._dicts[i])
+                str_codes[i] = codes
+                if cnulls is not None:
+                    nulls[i] = cnulls if nulls[i] is None \
+                        else (nulls[i] | cnulls)
             views = list(self._manifest.views)
             pos = 0
             if n >= self.max_delta_rows:
@@ -249,7 +260,8 @@ class ColumnTableData:
                     sl = slice(pos, pos + take)
                     views.append(self._cut_batch(
                         [a[sl] for a in arrays],
-                        [m[sl] if m is not None else None for m in nulls]))
+                        [m[sl] if m is not None else None for m in nulls],
+                        {i: c[sl] for i, c in str_codes.items()}))
                     pos += take
             if pos < n:
                 self._row_buffer.append(
@@ -263,17 +275,40 @@ class ColumnTableData:
         return n
 
     def _cut_batch(self, arrays: List[np.ndarray],
-                   nulls: Optional[List[Optional[np.ndarray]]] = None
+                   nulls: Optional[List[Optional[np.ndarray]]] = None,
+                   str_codes: Optional[Dict[int, np.ndarray]] = None
                    ) -> BatchView:
+        from snappydata_tpu.storage import bitmask
+        from snappydata_tpu.storage.encoding import (ColumnStats,
+                                                     EncodedColumn, Encoding)
+
         dicts = {}
+        precoded: Dict[int, EncodedColumn] = {}
         for i in self._dicts:
-            dicts[i] = self._intern_strings(i, arrays[i])
+            if str_codes is not None and i in str_codes:
+                # fused-encode fast path: codes are ready, just wrap them
+                codes = np.ascontiguousarray(str_codes[i], dtype=np.int32)
+                cn = nulls[i] if nulls is not None else None
+                n_rows = int(codes.shape[0])
+                packed = bitmask.pack(~cn) \
+                    if cn is not None and cn.any() else None
+                precoded[i] = EncodedColumn(
+                    Encoding.DICTIONARY, self.schema.fields[i].dtype,
+                    n_rows, codes,
+                    dictionary=np.array(self._dicts[i], dtype=object),
+                    validity=packed,
+                    stats=ColumnStats(None, None,
+                                      int(cn.sum()) if cn is not None else 0,
+                                      n_rows))
+            else:
+                dicts[i] = self._intern_strings(i, arrays[i])
         validities = None
         if nulls is not None and any(m is not None and m.any() for m in nulls):
             validities = [~m if m is not None else None for m in nulls]
         batch = ColumnBatch.from_arrays(
             next(self._batch_ids), 0, self.schema, arrays, self.capacity,
-            validities=validities, dictionaries=dicts)
+            validities=validities, dictionaries=dicts,
+            precoded=precoded)
         return BatchView(batch)
 
     def _rollover_locked(self) -> List[BatchView]:

@@ -98,6 +98,86 @@ def gen_customer(num_rows: int, seed: int = 2) -> Dict[str, np.ndarray]:
     }
 
 
+NATIONS = np.array(
+    ["ALGERIA", "ARGENTINA", "BRAZIL", "CANADA", "EGYPT", "ETHIOPIA",
+     "FRANCE", "GERMANY", "INDIA", "INDONESIA", "IRAN", "IRAQ", "JAPAN",
+     "JORDAN", "KENYA", "MOROCCO", "MOZAMBIQUE", "PERU", "CHINA",
+     "ROMANIA", "SAUDI ARABIA", "VIETNAM", "RUSSIA", "UNITED KINGDOM",
+     "UNITED STATES"], dtype=object)
+REGIONS = np.array(["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"],
+                   dtype=object)
+_NATION_REGION = np.array([0, 1, 1, 1, 4, 0, 3, 3, 2, 2, 4, 4, 2, 4, 0, 0,
+                           0, 1, 2, 3, 4, 2, 3, 3, 1], dtype=np.int32)
+
+
+def gen_supplier(num_rows: int, seed: int = 3):
+    rng = np.random.default_rng(seed)
+    return {
+        "s_suppkey": np.arange(1, num_rows + 1, dtype=np.int64),
+        "s_name": np.array([f"Supplier#{i:09d}" for i in
+                            range(1, num_rows + 1)], dtype=object),
+        "s_nationkey": rng.integers(0, 25, num_rows, dtype=np.int32),
+        "s_acctbal": np.round(rng.uniform(-999.99, 9999.99, num_rows), 2),
+    }
+
+
+def gen_part(num_rows: int, seed: int = 4):
+    rng = np.random.default_rng(seed)
+    types = np.array([f"{a} {b} {c}" for a in
+                      ("STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY",
+                       "PROMO")
+                      for b in ("ANODIZED", "BURNISHED", "PLATED")
+                      for c in ("TIN", "NICKEL", "BRASS", "STEEL",
+                                "COPPER")], dtype=object)
+    containers = np.array([f"{a} {b}" for a in ("SM", "LG", "MED", "JUMBO",
+                                                "WRAP")
+                           for b in ("CASE", "BOX", "BAG", "JAR", "PKG",
+                                     "PACK", "CAN", "DRUM")], dtype=object)
+    brands = np.array([f"Brand#{i}{j}" for i in range(1, 6)
+                       for j in range(1, 6)], dtype=object)
+    return {
+        "p_partkey": np.arange(1, num_rows + 1, dtype=np.int64),
+        "p_brand": brands[rng.integers(0, len(brands), num_rows)],
+        "p_type": types[rng.integers(0, len(types), num_rows)],
+        "p_size": rng.integers(1, 51, num_rows).astype(np.int32),
+        "p_container": containers[rng.integers(0, len(containers),
+                                               num_rows)],
+        "p_retailprice": np.round(rng.uniform(900, 2000, num_rows), 2),
+    }
+
+
+def gen_nation():
+    return {
+        "n_nationkey": np.arange(25, dtype=np.int64),
+        "n_name": NATIONS.copy(),
+        "n_regionkey": _NATION_REGION.astype(np.int64),
+    }
+
+
+def gen_region():
+    return {
+        "r_regionkey": np.arange(5, dtype=np.int64),
+        "r_name": REGIONS.copy(),
+    }
+
+
+SUPPLIER_DDL = """CREATE TABLE supplier (
+    s_suppkey BIGINT, s_name STRING, s_nationkey INT, s_acctbal DOUBLE
+) USING column"""
+
+PART_DDL = """CREATE TABLE part (
+    p_partkey BIGINT, p_brand STRING, p_type STRING, p_size INT,
+    p_container STRING, p_retailprice DOUBLE
+) USING column"""
+
+NATION_DDL = """CREATE TABLE nation (
+    n_nationkey BIGINT, n_name STRING, n_regionkey BIGINT
+) USING row"""
+
+REGION_DDL = """CREATE TABLE region (
+    r_regionkey BIGINT, r_name STRING
+) USING row"""
+
 LINEITEM_DDL = """CREATE TABLE lineitem (
     l_orderkey BIGINT, l_partkey BIGINT, l_suppkey BIGINT,
     l_linenumber INT, l_quantity DOUBLE, l_extendedprice DOUBLE,
@@ -152,17 +232,88 @@ ORDER BY revenue DESC, o_orderdate
 LIMIT 10"""
 
 
-def load_tpch(session, sf: float = 0.001, seed: int = 0) -> None:
-    """Create + populate the three tables at the given scale factor."""
+def load_tpch(session, sf: float = 0.001, seed: int = 0,
+              all_tables: bool = False) -> None:
+    """Create + populate the TPC-H tables at the given scale factor.
+    Default: the three headline-benchmark tables; all_tables adds
+    supplier/part/nation/region for the wider query set."""
     n_l = max(1000, int(LINEITEM_ROWS_PER_SF * sf))
     n_o = max(250, int(ORDERS_ROWS_PER_SF * sf))
     n_c = max(25, int(CUSTOMER_ROWS_PER_SF * sf))
+    n_s = max(10, int(10_000 * sf))
+    n_p = max(50, int(200_000 * sf))
     session.sql(LINEITEM_DDL)
     session.sql(ORDERS_DDL)
     session.sql(CUSTOMER_DDL)
     li = gen_lineitem(n_l, seed)
     li["l_orderkey"] = np.minimum(li["l_orderkey"], n_o)  # FK into orders
+    li["l_suppkey"] = (li["l_suppkey"] % n_s) + 1
+    li["l_partkey"] = (li["l_partkey"] % n_p) + 1
     session.insert_arrays("lineitem", list(li.values()))
     session.insert_arrays("orders",
                           list(gen_orders(n_o, n_c, seed + 1).values()))
     session.insert_arrays("customer", list(gen_customer(n_c, seed + 2).values()))
+    if all_tables:
+        session.sql(SUPPLIER_DDL)
+        session.sql(PART_DDL)
+        session.sql(NATION_DDL)
+        session.sql(REGION_DDL)
+        session.insert_arrays("supplier",
+                              list(gen_supplier(n_s, seed + 3).values()))
+        session.insert_arrays("part", list(gen_part(n_p, seed + 4).values()))
+        session.insert_arrays("nation", list(gen_nation().values()))
+        session.insert_arrays("region", list(gen_region().values()))
+
+
+Q5 = """SELECT n_name, sum(l_extendedprice * (1 - l_discount)) AS revenue
+FROM customer, orders, lineitem, supplier, nation, region
+WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey
+  AND l_suppkey = s_suppkey AND c_nationkey = s_nationkey
+  AND s_nationkey = n_nationkey AND n_regionkey = r_regionkey
+  AND r_name = 'ASIA'
+  AND o_orderdate >= DATE '1994-01-01'
+  AND o_orderdate < DATE '1995-01-01'
+GROUP BY n_name ORDER BY revenue DESC"""
+
+Q10 = """SELECT c_custkey, c_name,
+    sum(l_extendedprice * (1 - l_discount)) AS revenue, c_acctbal, n_name
+FROM customer, orders, lineitem, nation
+WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey
+  AND o_orderdate >= DATE '1993-10-01'
+  AND o_orderdate < DATE '1994-01-01'
+  AND l_returnflag = 'R' AND c_nationkey = n_nationkey
+GROUP BY c_custkey, c_name, c_acctbal, n_name
+ORDER BY revenue DESC LIMIT 20"""
+
+Q12 = """SELECT l_shipmode,
+    sum(CASE WHEN o_orderpriority = '1-URGENT'
+             OR o_orderpriority = '2-HIGH' THEN 1 ELSE 0 END)
+        AS high_line_count,
+    sum(CASE WHEN o_orderpriority != '1-URGENT'
+             AND o_orderpriority != '2-HIGH' THEN 1 ELSE 0 END)
+        AS low_line_count
+FROM orders, lineitem
+WHERE o_orderkey = l_orderkey
+  AND l_shipmode IN ('MAIL', 'SHIP')
+  AND l_receiptdate >= DATE '1994-01-01'
+  AND l_receiptdate < DATE '1995-01-01'
+GROUP BY l_shipmode ORDER BY l_shipmode"""
+
+Q14 = """SELECT 100.00 *
+    sum(CASE WHEN p_type LIKE 'PROMO%'
+        THEN l_extendedprice * (1 - l_discount) ELSE 0 END) /
+    sum(l_extendedprice * (1 - l_discount)) AS promo_revenue
+FROM lineitem, part
+WHERE l_partkey = p_partkey
+  AND l_shipdate >= DATE '1995-09-01'
+  AND l_shipdate < DATE '1995-10-01'"""
+
+Q18 = """SELECT c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice,
+    sum(l_quantity) AS total_qty
+FROM customer, orders, lineitem
+WHERE o_orderkey IN (
+    SELECT l_orderkey FROM lineitem
+    GROUP BY l_orderkey HAVING sum(l_quantity) > 150)
+  AND c_custkey = o_custkey AND o_orderkey = l_orderkey
+GROUP BY c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
+ORDER BY o_totalprice DESC, o_orderdate LIMIT 100"""

@@ -1,0 +1,96 @@
+"""Subquery support + review regressions (float-vs-int IN lists, NOT IN
+with NULL, DML subqueries, NaN string normalization)."""
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+
+
+@pytest.fixture()
+def s():
+    sess = SnappySession(catalog=Catalog())
+    yield sess
+    sess.stop()
+
+
+def test_scalar_subquery(s):
+    s.sql("CREATE TABLE t (a INT) USING column")
+    s.sql("INSERT INTO t VALUES (1), (5), (9)")
+    r = s.sql("SELECT a FROM t WHERE a = (SELECT max(a) FROM t)")
+    assert r.rows() == [(9,)]
+    r = s.sql("SELECT a FROM t WHERE a > (SELECT avg(a) FROM t)")
+    assert r.rows() == [(9,)]
+
+
+def test_in_and_exists_subqueries(s):
+    s.sql("CREATE TABLE a (x INT) USING column")
+    s.sql("CREATE TABLE b (y INT) USING column")
+    s.sql("INSERT INTO a VALUES (1), (2), (3)")
+    s.sql("INSERT INTO b VALUES (2), (3), (4)")
+    assert sorted(r[0] for r in s.sql(
+        "SELECT x FROM a WHERE x IN (SELECT y FROM b)").rows()) == [2, 3]
+    assert s.sql("SELECT x FROM a WHERE x NOT IN (SELECT y FROM b)"
+                 ).rows() == [(1,)]
+    assert s.sql("SELECT count(*) FROM a WHERE EXISTS (SELECT 1 FROM b)"
+                 ).rows()[0][0] == 3
+    s.sql("DELETE FROM b WHERE y IS NOT NULL")
+    assert s.sql("SELECT count(*) FROM a WHERE EXISTS (SELECT 1 FROM b)"
+                 ).rows()[0][0] == 0
+
+
+def test_not_in_with_null_is_never_true(s):
+    s.sql("CREATE TABLE a (x INT) USING column")
+    s.sql("CREATE TABLE b (y INT) USING column")
+    s.sql("INSERT INTO a VALUES (1), (2)")
+    s.sql("INSERT INTO b VALUES (1), (NULL)")
+    assert s.sql("SELECT x FROM a WHERE x NOT IN (SELECT y FROM b)"
+                 ).rows() == []
+
+
+def test_float_column_in_large_int_list(s):
+    s.sql("CREATE TABLE t (id INT, d DOUBLE) USING column")
+    s.sql("INSERT INTO t VALUES (1, 1.5), (2, 2.0), (3, 9.5)")
+    r = s.sql("SELECT id FROM t WHERE d IN (1,2,3,4,5,6,7,8,9)")
+    assert r.rows() == [(2,)]  # 1.5/9.5 must NOT truncate-match
+
+
+def test_large_in_list_sorted_lowering(s):
+    s.sql("CREATE TABLE t (k BIGINT) USING column")
+    s.insert_arrays("t", [np.arange(2000, dtype=np.int64)])
+    vals = ",".join(str(v) for v in range(0, 2000, 7))
+    r = s.sql(f"SELECT count(*) FROM t WHERE k IN ({vals})")
+    assert r.rows()[0][0] == len(range(0, 2000, 7))
+    r = s.sql(f"SELECT count(*) FROM t WHERE k NOT IN ({vals})")
+    assert r.rows()[0][0] == 2000 - len(range(0, 2000, 7))
+
+
+def test_dml_where_subquery(s):
+    s.sql("CREATE TABLE a (x INT) USING column")
+    s.sql("CREATE TABLE b (y INT) USING column")
+    s.sql("INSERT INTO a VALUES (1), (2), (3)")
+    s.sql("INSERT INTO b VALUES (1), (2)")
+    n = s.sql("DELETE FROM a WHERE x IN (SELECT y FROM b)").rows()[0][0]
+    assert n == 2
+    n = s.sql("UPDATE a SET x = (SELECT max(y) FROM b) WHERE x = 3"
+              ).rows()[0][0]
+    assert n == 1
+    assert s.sql("SELECT x FROM a").rows() == [(2,)]
+
+
+def test_view_with_subquery_rejected(s):
+    s.sql("CREATE TABLE a (x INT) USING column")
+    with pytest.raises(Exception, match="view definitions"):
+        s.sql("CREATE VIEW v AS SELECT x FROM a "
+              "WHERE x IN (SELECT x FROM a)")
+
+
+def test_nan_strings_normalize_to_null(s):
+    from snappydata_tpu.native import fast_encode_strings
+
+    lookup, store = {}, []
+    vals = np.array(["a", np.nan, None, "b"], dtype=object)
+    codes, nulls = fast_encode_strings(vals, lookup, store)
+    assert store == ["a", "b"]
+    assert nulls.tolist() == [False, True, True, False]
