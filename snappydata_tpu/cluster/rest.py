@@ -61,6 +61,44 @@ def _j(v):
     return str(v)
 
 
+def _render_dashboard(svc) -> str:
+    """Minimal HTML dashboard (ref: SnappyDashboardPage cluster overview +
+    member grid + table stats)."""
+    from html import escape as esc
+
+    members = []
+    if svc.membership is not None:
+        try:
+            members = svc.membership.members()
+        except Exception:
+            members = []
+    tables = svc.stats_service.current()
+    snap = global_registry().snapshot()
+    rows_m = "".join(
+        f"<tr><td>{esc(str(m.role))}</td><td>{esc(str(m.member_id))}</td>"
+        f"<td>{esc(str(m.host))}:{m.port}</td></tr>" for m in members)
+    rows_t = "".join(
+        f"<tr><td>{esc(str(name))}</td><td>{esc(str(t['provider']))}</td>"
+        f"<td>{t['row_count']:,}</td><td>{t['batches']}</td>"
+        f"<td>{t['in_memory_bytes']:,}</td></tr>"
+        for name, t in sorted(tables.items()))
+    counters = "".join(
+        f"<tr><td>{esc(str(k))}</td><td>{v}</td></tr>"
+        for k, v in sorted(snap["counters"].items()))
+    return f"""<!doctype html><html><head><title>snappydata_tpu</title>
+<style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:
+collapse;margin:1em 0}}td,th{{border:1px solid #ccc;padding:4px 10px;
+text-align:left}}h2{{margin-top:1.5em}}</style></head><body>
+<h1>snappydata_tpu cluster</h1>
+<h2>Members ({len(members)})</h2>
+<table><tr><th>role</th><th>member</th><th>address</th></tr>{rows_m}</table>
+<h2>Tables ({len(tables)})</h2>
+<table><tr><th>table</th><th>provider</th><th>rows</th><th>batches</th>
+<th>bytes</th></tr>{rows_t}</table>
+<h2>Counters</h2><table>{counters}</table>
+</body></html>"""
+
+
 class RestService:
     def __init__(self, session, stats_service, membership=None,
                  host: str = "127.0.0.1", port: int = 0):
@@ -102,6 +140,9 @@ class RestService:
                 elif path == "/metrics/prometheus":
                     self._send(global_registry().to_prometheus().encode(),
                                content_type="text/plain")
+                elif path in ("", "/dashboard"):
+                    self._send(_render_dashboard(svc).encode(),
+                               content_type="text/html")
                 elif path.startswith("/jobs/"):
                     st = svc.jobs.status(path.split("/")[-1])
                     self._send(st if st else {"error": "no such job"},

@@ -62,11 +62,17 @@ class RelOut:
 
 
 class _RelationInput:
-    """One base-table leaf: binds current snapshot arrays at exec time."""
+    """One base-table leaf: binds current snapshot arrays at exec time.
+
+    `sargs` holds sargable conjuncts (col ordinal, op, literal-getter) the
+    binder evaluates against per-batch min/max stats to skip whole batches
+    before they reach the device kernel (ref: stats-row batch skipping +
+    columnBatchesSkipped metric, ColumnTableScan.scala:115-130)."""
 
     def __init__(self, info, used: List[int]):
         self.info = info
         self.used = used
+        self.sargs: List[Tuple[int, str, Callable]] = []
 
     def bind(self):
         from snappydata_tpu.storage.device import build_device_table
@@ -75,6 +81,33 @@ class _RelationInput:
         if isinstance(self.info.data, RowTableData):
             return _row_table_device(self.info, self.used)
         return build_device_table(self.info.data, None, self.used)
+
+    def keep_mask(self, dt, params) -> Optional[np.ndarray]:
+        """bool [B] of batches that can contain matches; None = keep all."""
+        if not self.sargs:
+            return None
+        keep = None
+        for ci, op, get_lit in self.sargs:
+            smin = dt.stats_min.get(ci)
+            smax = dt.stats_max.get(ci)
+            if smin is None:
+                continue
+            try:
+                v = float(get_lit(params))
+            except (TypeError, ValueError):
+                continue
+            # unknown stats (NaN) always keep
+            if op in (">", ">="):
+                k = ~(smax < v) if op == ">=" else ~(smax <= v)
+            elif op in ("<", "<="):
+                k = ~(smin > v) if op == "<=" else ~(smin >= v)
+            elif op == "=":
+                k = ~((smin > v) | (smax < v))
+            else:
+                continue
+            k = k | np.isnan(smin)
+            keep = k if keep is None else (keep & k)
+        return keep
 
 
 def _row_table_device(info, used):
@@ -141,12 +174,40 @@ class CompiledPlan:
         self._jitted: Dict[tuple, Callable] = {}
 
     def execute(self, params: Tuple) -> Result:
+        from snappydata_tpu.observability.metrics import global_registry
+
+        reg = global_registry()
         tables = [r.bind() for r in self.relations]
         arrays: List = []
         for r, dt in zip(self.relations, tables):
+            keep = r.keep_mask(dt, params)
+            take_idx = None
+            if keep is not None and not keep.all():
+                # batch skipping: gather only qualifying batches (padded to
+                # a pow2 bucket so executable shapes stay stable)
+                kept = np.flatnonzero(keep)
+                reg.inc("column_batches_skipped",
+                        int(dt.num_batches - len(kept)))
+                b_new = max(1, 1 << (max(1, len(kept)) - 1).bit_length())
+                pad_valid = np.zeros(b_new, dtype=bool)
+                pad_valid[:len(kept)] = True
+                idx = np.zeros(b_new, dtype=np.int64)
+                idx[:len(kept)] = kept
+                take_idx = jnp.asarray(idx)
+                pad_mask = jnp.asarray(pad_valid)[:, None]
+            reg.inc("column_batches_seen", int(dt.num_batches))
             for ci in r.used:
-                arrays.append((dt.columns[ci], dt.nulls.get(ci)))
-            arrays.append(dt.valid)
+                col = dt.columns[ci]
+                nl = dt.nulls.get(ci)
+                if take_idx is not None:
+                    col = jnp.take(col, take_idx, axis=0)
+                    nl = jnp.take(nl, take_idx, axis=0) \
+                        if nl is not None else None
+                arrays.append((col, nl))
+            valid = dt.valid
+            if take_idx is not None:
+                valid = jnp.take(valid, take_idx, axis=0) & pad_mask
+            arrays.append(valid)
         aux = [jnp.asarray(b(params)) for b in self.aux_builders]
         static = tuple(p() for p in self.static_providers)
         pvals = tuple(_param_scalar(v) for v in params)
@@ -315,6 +376,14 @@ class Compiler:
 
         if isinstance(plan, ast.Filter):
             child, scope = self._emit_rel(plan.child)
+            # sargable conjuncts directly over a base scan feed per-batch
+            # stats skipping at bind time (optimizer pushdown puts
+            # single-table predicates right here)
+            inner = plan.child
+            while isinstance(inner, ast.SubqueryAlias):
+                inner = inner.child
+            if isinstance(inner, ast.Relation) and self.relations:
+                _collect_sargs(plan.condition, self.relations[-1])
             builder = self._builder_for(scope)
             pred = builder.emit(plan.condition)
 
@@ -843,6 +912,38 @@ def _broadcast_to_mask(v, mask):
     if jnp.shape(v) == jnp.shape(mask):
         return v
     return jnp.broadcast_to(v, jnp.shape(mask))
+
+
+def _collect_sargs(cond: ast.Expr, rel: _RelationInput) -> None:
+    """Extract `numeric_col OP literal` conjuncts for stats skipping."""
+    conjuncts: List[ast.Expr] = []
+
+    def flatten(e):
+        if isinstance(e, ast.BinOp) and e.op == "and":
+            flatten(e.left)
+            flatten(e.right)
+        else:
+            conjuncts.append(e)
+
+    flatten(cond)
+    flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=", "=": "="}
+    for c in conjuncts:
+        if not (isinstance(c, ast.BinOp) and c.op in flip):
+            continue
+        col, lit, op = None, None, c.op
+        if isinstance(c.left, ast.Col) and isinstance(
+                c.right, (ast.Lit, ast.ParamLiteral)):
+            col, lit = c.left, c.right
+        elif isinstance(c.right, ast.Col) and isinstance(
+                c.left, (ast.Lit, ast.ParamLiteral)):
+            col, lit, op = c.right, c.left, flip[c.op]
+        if col is None or col.dtype is None or not T.is_numeric(col.dtype):
+            continue
+        if isinstance(lit, ast.ParamLiteral):
+            get = (lambda params, p=lit.pos: params[p])
+        else:
+            get = (lambda params, v=lit.value: v)
+        rel.sargs.append((col.index, op, get))
 
 
 def _expr_cols(e: Optional[ast.Expr]) -> set:

@@ -157,7 +157,41 @@ class SnappySession:
         if isinstance(stmt, ast.SetConf):
             self.conf.set(stmt.key, stmt.value)
             return _status()
+        if isinstance(stmt, ast.ExecCode):
+            return self._exec_code(stmt.code)
         raise ValueError(f"unsupported statement {type(stmt).__name__}")
+
+    def _exec_code(self, code: str) -> Result:
+        """EXEC PYTHON: per-session interpreter namespace persisting across
+        statements (ref: RemoteInterpreterStateHolder holds a Scala REPL
+        per connection on the lead). The namespace binds `session` and
+        `np`; set `result` to a Result or list of rows to return data,
+        otherwise stdout is returned."""
+        import contextlib
+        import io
+
+        if not hasattr(self, "_interp_ns"):
+            self._interp_ns = {"session": self, "np": np}
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            exec(code, self._interp_ns)  # noqa: S102 — interpreter feature
+        out = self._interp_ns.pop("result", None)
+        if isinstance(out, Result):
+            return out
+        if isinstance(out, (list, tuple)) and out:
+            rows = [r if isinstance(r, (list, tuple)) else (r,)
+                    for r in out]
+            width = len(rows[0])
+            if any(len(r) != width for r in rows):
+                raise ValueError("EXEC result rows have uneven arity")
+            cols = list(zip(*rows))
+            return Result(
+                [f"c{i}" for i in range(len(cols))],
+                [np.array(c, dtype=object) for c in cols],
+                [None] * len(cols), [T.STRING] * len(cols))
+        text = buf.getvalue()
+        return Result(["output"], [np.array([text], dtype=object)], [None],
+                      [T.STRING])
 
     def _run_query(self, plan: ast.Plan, user_params=()) -> Result:
         if getattr(self.catalog, "_sample_maintainers", None):

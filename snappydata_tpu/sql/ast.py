@@ -496,3 +496,11 @@ class CreateView(Statement):
 class DropView(Statement):
     name: str
     if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecCode(Statement):
+    """EXEC PYTHON '<code>' — per-session remote interpreter (ref: EXEC
+    SCALA, cluster/.../remote/interpreter/SnappyInterpreterExecute)."""
+
+    code: str

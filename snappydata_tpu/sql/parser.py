@@ -124,6 +124,21 @@ class Parser:
             return self._finishing(ast.DescribeTable(self.qualified_name()))
         if low == "set":
             return self._finishing(self.set_stmt())
+        if low == "exec":
+            self.next()
+            lang = self.peek()
+            # EXEC PYTHON, plus EXEC SCALA for dialect parity (both run
+            # python); anything else is rejected by name
+            if lang.kind in ("IDENT", "KW") and \
+                    lang.value.lower() in ("python", "scala"):
+                self.next()
+            else:
+                raise SQLSyntaxError(
+                    f"EXEC expects PYTHON or SCALA, found {lang.value!r}")
+            t = self.next()
+            if t.kind != "STR":
+                raise SQLSyntaxError("EXEC expects a quoted code string")
+            return self._finishing(ast.ExecCode(t.value))
         if low == "values":
             plan = self.values_clause()
             return self._finishing(ast.Query(plan))

@@ -242,6 +242,29 @@ def test_date_functions_and_literals(s):
     assert out.rows()[0][0] == 1  # only 2020-03-15 precedes 2020-12-02
 
 
+def test_batch_skipping_stats(s):
+    """Stats-based batch pruning (ref columnBatchesSkipped) must not
+    change results and must actually skip."""
+    from snappydata_tpu.observability.metrics import global_registry
+
+    s.sql("CREATE TABLE ev (d INT, v DOUBLE) USING column "
+          "OPTIONS (column_batch_rows '1024', column_max_delta_rows '512')")
+    s.insert_arrays("ev", [np.arange(50_000, dtype=np.int32),
+                           np.ones(50_000)])
+    before = global_registry().counter("column_batches_skipped")
+    r = s.sql("SELECT count(*), sum(v) FROM ev "
+              "WHERE d >= 40000 AND d < 45000")
+    assert r.rows() == [(5000, 5000.0)]
+    assert global_registry().counter("column_batches_skipped") > before
+    # literal change reuses the plan but re-prunes
+    r2 = s.sql("SELECT count(*), sum(v) FROM ev WHERE d >= 0 AND d < 100")
+    assert r2.rows() == [(100, 100.0)]
+    # mutations must not be masked by stale stats
+    s.sql("UPDATE ev SET d = 49999 WHERE d = 0")
+    r3 = s.sql("SELECT count(*) FROM ev WHERE d = 49999")
+    assert r3.rows() == [(2,)]
+
+
 def test_views(s):
     s.sql("CREATE TABLE t (a INT, b STRING) USING column")
     s.sql("INSERT INTO t VALUES (1, 'x'), (5, 'y'), (9, 'z')")
