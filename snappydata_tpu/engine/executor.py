@@ -892,15 +892,29 @@ def _extreme(np_dtype, positive: bool):
     return info.max if positive else info.min
 
 
+def _key_bits(v):
+    """Exact int64 representation of a grouping/join key: floats BITCAST
+    (a plain cast truncated 2.1 and 2.9 both to 2, collapsing float
+    groups), with ±0.0 normalized so they group together."""
+    arr = jnp.asarray(v)
+    if jnp.issubdtype(arr.dtype, jnp.floating):
+        arr = jnp.where(arr == 0, jnp.zeros((), dtype=arr.dtype), arr)
+        if arr.dtype == jnp.float64:
+            return jax.lax.bitcast_convert_type(arr, jnp.int64)
+        return jax.lax.bitcast_convert_type(
+            arr.astype(jnp.float32), jnp.int32).astype(jnp.int64)
+    return arr.astype(jnp.int64)
+
+
 def _combine_keys(dvals: List[DVal]):
     """Combine N key DVals into one int64 key. Single key: exact. Multiple:
     mixed via a 64-bit hash (documented collision risk ~ n²/2⁻⁶⁴; exact
     multi-key via packing/sort lands with the generic hash table)."""
     if len(dvals) == 1:
-        return dvals[0].value.astype(jnp.int64)
+        return _key_bits(dvals[0].value)
     acc = jnp.zeros(jnp.shape(dvals[0].value), dtype=jnp.uint64)
     for d in dvals:
-        k = d.value.astype(jnp.int64).astype(jnp.uint64)
+        k = _key_bits(d.value).astype(jnp.uint64)
         k = (k ^ (k >> 30)) * jnp.uint64(0xbf58476d1ce4e5b9)
         k = (k ^ (k >> 27)) * jnp.uint64(0x94d049bb133111eb)
         k = k ^ (k >> 31)
@@ -1113,6 +1127,10 @@ class Executor:
         from snappydata_tpu.observability.metrics import global_registry
 
         reg = global_registry()
+        fast = self._try_point_lookup(node, params)
+        if fast is not None:
+            return fast
+
         key = (_plan_key(node, self.catalog), self.catalog.generation)
         compiled = self._plan_cache.get(key)
         if compiled is None:
@@ -1133,6 +1151,96 @@ class Executor:
             return compiled.execute(params)
         except CompileError:
             return self._host_fallback(node, params)
+
+    def _try_point_lookup(self, node: ast.Plan, params: Tuple
+                          ) -> Optional[Result]:
+        """Point/key queries on row tables answer straight from the PK or
+        a secondary index, never entering the XLA engine (ref:
+        ExecutionEngineArbiter routing simple queries to the store's own
+        engine, docs/architecture/cluster_architecture.md:31-33)."""
+        from snappydata_tpu.storage.table_store import RowTableData
+
+        proj = None
+        n = node
+        if isinstance(n, ast.Project):
+            proj, n = n, n.child
+        while isinstance(n, ast.SubqueryAlias):
+            n = n.child
+        if not isinstance(n, ast.Filter):
+            return None
+        inner = n.child
+        while isinstance(inner, ast.SubqueryAlias):
+            inner = inner.child
+        if not isinstance(inner, ast.Relation):
+            return None
+        info = self.catalog.lookup_table(inner.name)
+        if info is None or not isinstance(info.data, RowTableData):
+            return None
+        # all conjuncts must be col = literal
+        pairs: Dict[str, object] = {}
+
+        def flatten(e) -> bool:
+            if isinstance(e, ast.BinOp) and e.op == "and":
+                return flatten(e.left) and flatten(e.right)
+            if isinstance(e, ast.BinOp) and e.op == "=" \
+                    and isinstance(e.left, ast.Col) \
+                    and isinstance(e.right, (ast.Lit, ast.ParamLiteral)):
+                v = params[e.right.pos] \
+                    if isinstance(e.right, ast.ParamLiteral) else e.right.value
+                name = e.left.name.lower()
+                if name in pairs and pairs[name] != v:
+                    return False  # contradictory k=1 AND k=2: engine path
+                pairs[name] = v
+                return True
+            return False
+
+        if not flatten(n.condition):
+            return None
+        # projection must be plain columns (or absent = all)
+        if proj is not None and not all(
+                isinstance(e.child if isinstance(e, ast.Alias) else e,
+                           ast.Col) for e in proj.exprs):
+            return None
+        key_set = frozenset(pairs)
+        rows: Optional[List[tuple]] = None
+        if info.key_columns and key_set == frozenset(info.key_columns):
+            got = info.data.get(tuple(pairs[k] for k in info.key_columns))
+            rows = [got] if got is not None else []
+        else:
+            idx = info.data.index_for_columns(sorted(key_set))
+            if idx is None:
+                return None
+            cols_order = info.data._indexes[idx]
+            rows = info.data.index_lookup(
+                idx, tuple(pairs[c] for c in cols_order))
+        from snappydata_tpu.observability.metrics import global_registry
+
+        global_registry().inc("point_lookups")
+        schema = info.schema
+        if proj is not None:
+            sel = [(e.child if isinstance(e, ast.Alias) else e)
+                   for e in proj.exprs]
+            names = [_expr_name(e) for e in proj.exprs]
+            idxs = [c.index for c in sel]
+            dtypes = [schema.fields[i].dtype for i in idxs]
+            out_rows = [tuple(r[i] for i in idxs) for r in rows]
+        else:
+            names = schema.names()
+            dtypes = [f.dtype for f in schema.fields]
+            out_rows = rows
+        cols = []
+        nulls = []
+        for j, dt in enumerate(dtypes):
+            vals = [r[j] for r in out_rows]
+            nmask = np.array([v is None for v in vals]) if vals else None
+            if dt.name == "string":
+                cols.append(np.array(vals, dtype=object))
+            else:
+                cols.append(np.array([0 if v is None else v for v in vals],
+                                     dtype=dt.np_dtype))
+            nulls.append(nmask if nmask is not None and nmask.any()
+                         else None)
+        return Result(names, cols, nulls, dtypes)
 
     def _host_fallback(self, node: ast.Plan, params: Tuple) -> Result:
         """CodegenSparkFallback analogue (core/.../execution/

@@ -561,13 +561,31 @@ class ExprBuilder:
                 x.astype(_float_dtype())))
         if name == "round":
             digits = 0
-            if len(e.args) == 2 and isinstance(e.args[1], ast.Lit):
-                digits = int(e.args[1].value)
-            mult = 10.0 ** digits
-
+            if len(e.args) == 2 and isinstance(e.args[1],
+                                               (ast.Lit, ast.ParamLiteral)):
+                if isinstance(e.args[1], ast.Lit):
+                    digits = int(e.args[1].value)
+                else:
+                    digits_pos = e.args[1].pos
+                    digits = None
+            # negative digits: divide by the exact integer power (0.001 is
+            # not binary-exact; round(x*0.001)/0.001 drifted sums)
             def run_round(rt: Runtime) -> DVal:
                 c = args[0](rt)
-                return DVal(jnp.round(c.value * mult) / mult, c.null, c.dtype)
+                if digits is not None:  # static digits
+                    if digits >= 0:
+                        mult = float(10 ** digits)
+                        v = jnp.round(c.value * mult) / mult
+                    else:
+                        scale = float(10 ** (-digits))
+                        v = jnp.round(c.value / scale) * scale
+                else:  # tokenized digits: traced scalar
+                    d = rt.params[digits_pos].astype(jnp.float64)
+                    scale = jnp.round(jnp.power(10.0, jnp.abs(d)))
+                    v = jnp.where(d >= 0,
+                                  jnp.round(c.value * scale) / scale,
+                                  jnp.round(c.value / scale) * scale)
+                return DVal(v, c.null, c.dtype)
 
             return run_round
         if name in ("pow", "power"):

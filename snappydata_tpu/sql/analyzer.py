@@ -188,7 +188,17 @@ class Analyzer:
             alias = plan.alias or plan.name.split(".")[-1]
             scope = Scope([ScopeEntry(alias, f.name, f.dtype, f.nullable)
                            for f in info.schema.fields])
-            return ast.Relation(info.name, info.schema, alias), scope
+            resolved: ast.Plan = ast.Relation(info.name, info.schema, alias)
+            # row-level security: inject policy predicates AT RESOLUTION so
+            # every path to the table — including through views, which are
+            # re-analyzed per query — is filtered (ref: RowLevelSecurity
+            # rule, SnappySessionState.scala:422)
+            for pol_table, pred in getattr(self.catalog, "_policies",
+                                           {}).values():
+                if pol_table == info.name:
+                    cond = fold_constants(self.resolve_expr(pred, scope))
+                    resolved = ast.Filter(resolved, cond)
+            return resolved, scope
 
         if isinstance(plan, ast.Relation):
             # already-resolved scan (stored view bodies re-enter analysis);
@@ -494,9 +504,23 @@ def tokenize_plan(plan: ast.Plan) -> Tuple[ast.Plan, Tuple[Any, ...]]:
         if isinstance(p, ast.Project):
             return ast.Project(tok(p.child), tuple(tok_expr(e) for e in p.exprs))
         if isinstance(p, ast.Aggregate):
-            return ast.Aggregate(tok(p.child),
-                                 tuple(tok_expr(g) for g in p.group_exprs),
-                                 tuple(tok_expr(e) for e in p.agg_exprs))
+            # tokenize group exprs FIRST, then substitute each occurrence
+            # of a group expr inside the select list with its tokenized
+            # twin — otherwise GROUP BY age/10 and select-list age/10 get
+            # different param slots and no longer match structurally
+            # (breaking the key-reference rewrite at compile time)
+            groups_src = p.group_exprs
+            groups_tok = tuple(tok_expr(g) for g in groups_src)
+
+            def sub_groups(e: ast.Expr) -> ast.Expr:
+                for gs, gt in zip(groups_src, groups_tok):
+                    if e == gs:
+                        return gt
+                return e.map_children(sub_groups)
+
+            return ast.Aggregate(
+                tok(p.child), groups_tok,
+                tuple(tok_expr(sub_groups(e)) for e in p.agg_exprs))
         if isinstance(p, ast.Join):
             cond = tok_expr(p.condition) if p.condition is not None else None
             return ast.Join(tok(p.left), tok(p.right), p.how, cond)

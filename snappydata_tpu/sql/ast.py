@@ -499,6 +499,40 @@ class DropView(Statement):
 
 
 @dataclasses.dataclass(frozen=True)
+class CreatePolicy(Statement):
+    """CREATE POLICY name ON table USING (pred) — row-level security
+    filter injected into every scan of the table (ref: RowLevelSecurity
+    analyzer rule, SnappySessionState.scala:422; core/.../policy)."""
+
+    name: str
+    table: str
+    using: Expr = None
+
+
+@dataclasses.dataclass(frozen=True)
+class DropPolicy(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class CreateIndex(Statement):
+    """CREATE INDEX name ON table (cols) — secondary index (ref:
+    CreateIndexTest; row-store indexes)."""
+
+    name: str
+    table: str
+    columns: tuple = ()
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropIndex(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class ExecCode(Statement):
     """EXEC PYTHON '<code>' — per-session remote interpreter (ref: EXEC
     SCALA, cluster/.../remote/interpreter/SnappyInterpreterExecute)."""

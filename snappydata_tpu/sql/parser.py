@@ -585,6 +585,36 @@ class Parser:
             name = self.qualified_name()
             self.expect_kw("as")
             return ast.CreateView(name, self.query_expr(), or_replace=or_replace)
+        if self.accept_kw("policy"):
+            name = self.qualified_name()
+            self.expect_kw("on")
+            table = self.qualified_name()
+            # optional FOR SELECT TO current_user (ref dialect); ignored
+            if self.accept_kw("for"):
+                self.ident()
+                if self.accept_kw("to"):
+                    self.ident()
+            self.expect_kw("using")
+            had_paren = self.accept_op("(")
+            pred = self.expr()
+            if had_paren:
+                self.expect_op(")")
+            return ast.CreatePolicy(name, table, pred)
+        if self.accept_kw("index"):
+            if_not_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                if_not_exists = True
+            name = self.qualified_name()
+            self.expect_kw("on")
+            table = self.qualified_name()
+            self.expect_op("(")
+            cols = [self.ident()]
+            while self.accept_op(","):
+                cols.append(self.ident())
+            self.expect_op(")")
+            return ast.CreateIndex(name, table, tuple(cols), if_not_exists)
         self.accept_kw("external")
         sample = self.accept_kw("sample")
         self.expect_kw("table")
@@ -672,16 +702,24 @@ class Parser:
 
     def drop_stmt(self) -> ast.Statement:
         self.expect_kw("drop")
-        is_view = self.accept_kw("view")
-        if not is_view:
+        kind = "table"
+        for k in ("view", "policy", "index"):
+            if self.accept_kw(k):
+                kind = k
+                break
+        else:
             self.expect_kw("table")
         if_exists = False
         if self.accept_kw("if"):
             self.expect_kw("exists")
             if_exists = True
         name = self.qualified_name()
-        if is_view:
+        if kind == "view":
             return ast.DropView(name, if_exists)
+        if kind == "policy":
+            return ast.DropPolicy(name, if_exists)
+        if kind == "index":
+            return ast.DropIndex(name, if_exists)
         return ast.DropTable(name, if_exists)
 
     def insert_stmt(self) -> ast.Statement:

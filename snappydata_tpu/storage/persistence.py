@@ -233,10 +233,11 @@ class DiskStore:
         # reference stores view text in its metastore the same way)
         views = dict(getattr(catalog, "_view_ddl", {}))
         topks = dict(getattr(catalog, "_topk_defs", {}))
+        aux = dict(getattr(catalog, "_aux_ddl", {}))  # policies/indexes
         tmp = os.path.join(self.path, "catalog.json.tmp")
         with open(tmp, "w") as fh:
             json.dump({"version": 1, "tables": tables, "views": views,
-                       "topks": topks}, fh, indent=1)
+                       "topks": topks, "aux_ddl": aux}, fh, indent=1)
         os.replace(tmp, os.path.join(self.path, "catalog.json"))
 
     # -- checkpoint ------------------------------------------------------
@@ -438,6 +439,13 @@ class DiskStore:
             except Exception:
                 pass  # view over a dropped table: skip, like a stale view
         catalog._view_ddl = dict(meta.get("views") or {})
+        # policies/indexes: re-execute their DDL
+        for name, ddl in (meta.get("aux_ddl") or {}).items():
+            try:
+                session.sql(ddl)
+            except Exception:
+                pass
+        catalog._aux_ddl = dict(meta.get("aux_ddl") or {})
         # AQP re-registration (review finding: maintainers/TopKs froze
         # silently after restart)
         for info in sample_tables:

@@ -639,6 +639,43 @@ class RowTableData:
     def count(self) -> int:
         return int(sum(self._live))
 
+    def create_index(self, name: str, columns: Sequence[str]) -> None:
+        """Secondary index (ref: row-store indexes, CreateIndexTest).
+        Lazily rebuilt per version — point lookups are O(1) after the
+        first access following a mutation."""
+        if not hasattr(self, "_indexes"):
+            self._indexes: Dict[str, tuple] = {}
+            self._index_maps: Dict[str, tuple] = {}
+        self._indexes[name.lower()] = tuple(c.lower() for c in columns)
+
+    def drop_index(self, name: str) -> None:
+        getattr(self, "_indexes", {}).pop(name.lower(), None)
+        getattr(self, "_index_maps", {}).pop(name.lower(), None)
+
+    def index_for_columns(self, columns: Sequence[str]):
+        want = {c.lower() for c in columns}
+        for name, cols in getattr(self, "_indexes", {}).items():
+            if set(cols) == want:
+                return name
+        return None
+
+    def index_lookup(self, name: str, key: tuple) -> List[tuple]:
+        """All live rows whose indexed columns equal `key`."""
+        cols = self._indexes[name.lower()]
+        cached = getattr(self, "_index_maps", {}).get(name.lower())
+        if cached is None or cached[0] != self._version:
+            idx_cols = [self.schema.index(c) for c in cols]
+            mapping: Dict[tuple, List[int]] = {}
+            with self._lock:
+                for ordinal, live in enumerate(self._live):
+                    if live:
+                        k = tuple(self._cols[i][ordinal] for i in idx_cols)
+                        mapping.setdefault(k, []).append(ordinal)
+                cached = (self._version, mapping)
+            self._index_maps[name.lower()] = cached
+        ordinals = cached[1].get(tuple(key), [])
+        return [tuple(c[o] for c in self._cols) for o in ordinals]
+
     def string_dict(self, col_idx: int) -> "np.ndarray":
         """Version-cached sorted dictionary for a string column, so device
         binding and result assembly agree on codes within one version."""

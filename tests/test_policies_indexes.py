@@ -1,0 +1,129 @@
+"""Row-level security policies + secondary indexes (ref: CREATE POLICY /
+RowLevelSecurity rule; CreateIndexTest; ExecutionEngineArbiter point
+routing)."""
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.observability.metrics import global_registry
+
+
+@pytest.fixture()
+def s():
+    sess = SnappySession(catalog=Catalog())
+    yield sess
+    sess.stop()
+
+
+def test_policy_filters_scans(s):
+    s.sql("CREATE TABLE accounts (id INT, region STRING, bal DOUBLE) "
+          "USING column")
+    s.sql("INSERT INTO accounts VALUES (1, 'us', 10.0), (2, 'eu', 20.0), "
+          "(3, 'us', 30.0)")
+    assert s.sql("SELECT count(*) FROM accounts").rows()[0][0] == 3
+    s.sql("CREATE POLICY us_only ON accounts USING region = 'us'")
+    assert s.sql("SELECT count(*) FROM accounts").rows()[0][0] == 2
+    assert s.sql("SELECT sum(bal) FROM accounts").rows()[0][0] == 40.0
+    # applies through joins and aliases too
+    s.sql("CREATE TABLE regions (r STRING) USING column")
+    s.sql("INSERT INTO regions VALUES ('us'), ('eu')")
+    out = s.sql("SELECT count(*) FROM accounts a JOIN regions g "
+                "ON a.region = g.r")
+    assert out.rows()[0][0] == 2
+    s.sql("DROP POLICY us_only")
+    assert s.sql("SELECT count(*) FROM accounts").rows()[0][0] == 3
+
+
+def test_policy_composition(s):
+    s.sql("CREATE TABLE t (a INT) USING column")
+    s.sql("INSERT INTO t VALUES (1), (5), (9)")
+    s.sql("CREATE POLICY p1 ON t USING a > 2")
+    s.sql("CREATE POLICY p2 ON t USING a < 8")
+    assert s.sql("SELECT a FROM t").rows() == [(5,)]
+
+
+def test_secondary_index_point_path(s):
+    s.sql("CREATE TABLE users (id INT PRIMARY KEY, email STRING, "
+          "org INT) USING row")
+    s.sql("INSERT INTO users VALUES (1, 'a@x.com', 10), (2, 'b@x.com', 10), "
+          "(3, 'c@y.com', 20)")
+    s.sql("CREATE INDEX by_org ON users (org)")
+    before = global_registry().counter("point_lookups")
+    out = s.sql("SELECT id, email FROM users WHERE org = 10")
+    assert sorted(r[0] for r in out.rows()) == [1, 2]
+    # PK equality also routes through the fast path
+    out = s.sql("SELECT email FROM users WHERE id = 3")
+    assert out.rows() == [("c@y.com",)]
+    assert global_registry().counter("point_lookups") >= before + 2
+    # index stays correct across mutations
+    s.sql("PUT INTO users VALUES (4, 'd@y.com', 20)")
+    s.sql("DELETE FROM users WHERE id = 3")
+    out = s.sql("SELECT id FROM users WHERE org = 20")
+    assert [r[0] for r in out.rows()] == [4]
+    s.sql("DROP INDEX by_org")
+    out = s.sql("SELECT id FROM users WHERE org = 10")  # engine path now
+    assert sorted(r[0] for r in out.rows()) == [1, 2]
+
+
+def test_index_on_column_table_rejected(s):
+    s.sql("CREATE TABLE c (a INT) USING column")
+    with pytest.raises(Exception, match="row tables"):
+        s.sql("CREATE INDEX i ON c (a)")
+
+
+def test_policy_applies_through_views(s):
+    s.sql("CREATE TABLE t (k INT, region STRING) USING row")
+    s.sql("INSERT INTO t VALUES (1, 'east'), (2, 'west')")
+    s.sql("CREATE VIEW v AS SELECT * FROM t")
+    s.sql("CREATE POLICY p ON t USING region = 'east'")
+    assert s.sql("SELECT k FROM t").rows() == [(1,)]
+    assert s.sql("SELECT k FROM v").rows() == [(1,)]  # no view bypass
+    s.sql("DROP POLICY p")
+    assert len(s.sql("SELECT k FROM v").rows()) == 2  # applies at query time
+
+
+def test_point_path_contradictory_equalities(s):
+    s.sql("CREATE TABLE pt (k INT PRIMARY KEY, v STRING) USING row")
+    s.sql("INSERT INTO pt VALUES (1, 'a'), (2, 'b')")
+    assert s.sql("SELECT * FROM pt WHERE k = 1 AND k = 2").rows() == []
+    assert s.sql("SELECT * FROM pt WHERE k = 1 AND k = 1").rows() == \
+        [(1, "a")]
+
+
+def test_drop_table_cascades_policies_and_indexes(s):
+    s.sql("CREATE TABLE dt (a INT, b INT) USING row")
+    s.sql("CREATE POLICY dp ON dt USING a < 5")
+    s.sql("CREATE INDEX di ON dt (b)")
+    s.sql("DROP TABLE dt")
+    s.sql("CREATE TABLE dt (c INT) USING column")
+    s.sql("INSERT INTO dt VALUES (9)")
+    assert s.sql("SELECT * FROM dt").rows() == [(9,)]  # no ghost policy
+    assert "di" not in getattr(s.catalog, "_indexes", {})
+
+
+def test_policy_index_name_collision_persists_both(tmp_path):
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE t (a INT, region STRING) USING row")
+    s.sql("INSERT INTO t VALUES (1, 'east'), (2, 'west')")
+    s.sql("CREATE POLICY shared ON t USING region = 'east'")
+    s.sql("CREATE INDEX shared ON t (a)")
+    s.disk_store.close()
+    s2 = SnappySession(data_dir=str(tmp_path))
+    assert s2.sql("SELECT count(*) FROM t").rows()[0][0] == 1  # policy alive
+    assert "shared" in s2.catalog._indexes
+
+
+def test_policy_and_index_survive_restart(tmp_path):
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE t (a INT, region STRING) USING row")
+    s.sql("INSERT INTO t VALUES (1, 'us'), (2, 'eu')")
+    s.sql("CREATE POLICY p ON t USING region = 'us'")
+    s.sql("CREATE INDEX i ON t (a)")
+    s.disk_store.close()
+    s2 = SnappySession(data_dir=str(tmp_path))
+    assert s2.sql("SELECT count(*) FROM t").rows()[0][0] == 1
+    assert "i" in s2.catalog._indexes
