@@ -449,13 +449,43 @@ class Compiler:
         def run_join(ctx) -> RelOut:
             lo = left(ctx)
             ro = right(ctx)
-            # flatten build side
+            lpairs = [lo.cols[k] for k, _ in equi]
+            rpairs = [ro.cols[k - nleft] for _, k in equi]
+            # mixed int/float key pairs compare in a common float64 domain
+            # (bitcasting one side against a value-cast other never matched)
+            def coerce_pair(a: DVal, b: DVal):
+                a_f = jnp.issubdtype(jnp.asarray(a.value).dtype, jnp.floating)
+                b_f = jnp.issubdtype(jnp.asarray(b.value).dtype, jnp.floating)
+                if a_f != b_f:
+                    return (DVal(a.value.astype(jnp.float64), a.null, a.dtype),
+                            DVal(b.value.astype(jnp.float64), b.null, b.dtype))
+                return a, b
+
+            coerced = [coerce_pair(a, b) for a, b in zip(lpairs, rpairs)]
+            lpairs = [a for a, _ in coerced]
+            rpairs = [b for _, b in coerced]
+            # flatten build side; NULL keys never match (SQL semantics):
+            # build-side nulls collapse into the invalid sentinel, probe-
+            # side nulls get a distinct sentinel absent from the build
             bvalid = ro.valid.reshape(-1)
-            bkeys = _combine_keys([ro.cols[k - nleft] for _, k in equi])
-            bkeys = jnp.where(bvalid, bkeys.reshape(-1), _I64_MAX)
+            bnull = None
+            for d in rpairs:
+                if d.null is not None:
+                    m = _broadcast_to_mask(d.null, ro.valid).reshape(-1)
+                    bnull = m if bnull is None else (bnull | m)
+            bkeys = _combine_keys(rpairs)
+            bkeys = jnp.where(bvalid if bnull is None else
+                              (bvalid & ~bnull), bkeys.reshape(-1), _I64_MAX)
             order = jnp.argsort(bkeys)
             skeys = bkeys[order]
-            pkeys = _combine_keys([lo.cols[k] for k, _ in equi])
+            pkeys = _combine_keys(lpairs)
+            pnull = None
+            for d in lpairs:
+                if d.null is not None:
+                    m = _broadcast_to_mask(d.null, lo.valid)
+                    pnull = m if pnull is None else (pnull | m)
+            if pnull is not None:
+                pkeys = jnp.where(pnull, jnp.int64(_I64_MAX - 7), pkeys)
             pos = jnp.searchsorted(skeys, pkeys)
             posc = jnp.clip(pos, 0, skeys.shape[0] - 1)
             found = (skeys[posc] == pkeys) & lo.valid
@@ -1119,6 +1149,8 @@ class Executor:
     def _execute_core(self, node: ast.Plan, params: Tuple) -> Result:
         if isinstance(node, ast.Values):
             return hosteval.eval_values(node, params)
+        if isinstance(node, ast.WindowProject):
+            return hosteval.eval_window(node, params, self)
         if isinstance(node, ast.Union):
             left = self.execute(node.left, params)
             right = self.execute(node.right, params)
@@ -1267,7 +1299,7 @@ class Executor:
 def _is_result_level(child: ast.Plan) -> bool:
     """True when `child` produces a (small) materialized result whose
     parent ops should run on host: anything above an Aggregate."""
-    if isinstance(child, ast.Aggregate):
+    if isinstance(child, (ast.Aggregate, ast.WindowProject)):
         return True
     if isinstance(child, (ast.Sort, ast.Limit, ast.Distinct)):
         return True

@@ -390,6 +390,241 @@ def eval_values(node: ast.Values, params) -> Result:
 
 
 # --------------------------------------------------------------------------
+# Window functions (host-evaluated; device windows are a later round)
+# --------------------------------------------------------------------------
+
+def eval_window(plan, params, executor) -> Result:
+    """WindowProject: materialize the child, then evaluate each select
+    expression; WindowFunc nodes compute per-partition with pandas.
+    Default frames: whole partition without ORDER BY; running frame
+    (unbounded preceding → current row) with it."""
+    import pandas as pd
+
+    cols, nulls, names, dtypes, n = _eval_rel(plan.child, params, executor)
+
+    def eval_any(e, depth=0):
+        """Returns (values, nullmask); recurses through WindowFunc."""
+        if isinstance(e, ast.Alias):
+            return eval_any(e.child)
+        if isinstance(e, ast.WindowFunc):
+            return _window_values(e, cols, nulls, params, n)
+        # ordinary expression, but it may CONTAIN window funcs: substitute
+        # their computed values as pseudo-columns
+        subs = {}
+
+        def find(node):
+            if isinstance(node, ast.WindowFunc):
+                subs[id(node)] = node
+            for c in node.children():
+                find(c)
+
+        find(e)
+        if not subs:
+            return eval_expr(e, cols, nulls, params, n)
+        ext_cols = list(cols)
+        ext_nulls = list(nulls)
+
+        def replace(node):
+            if isinstance(node, ast.WindowFunc):
+                v, nl = _window_values(node, cols, nulls, params, n)
+                idx = len(ext_cols)
+                ext_cols.append(v)
+                ext_nulls.append(nl)
+                return ast.Col(f"__w{idx}", None, idx,
+                               expr_type(node))
+            return node.map_children(replace)
+
+        return eval_expr(replace(e), ext_cols, ext_nulls, params, n)
+
+    out_c, out_n, out_names, out_t = [], [], [], []
+    for e in plan.exprs:
+        v, nl = eval_any(e)
+        v = np.broadcast_to(v, (n,))
+        dt = expr_type(e)
+        # pandas paths float-promote ints (NaN machinery): restore the
+        # declared integer dtype so values and Result.dtypes agree
+        if T.is_integral(dt) and v.dtype.kind == "f":
+            filler = np.where(np.isnan(v), 0, v) if v.dtype.kind == "f" \
+                else v
+            v = filler.astype(dt.np_dtype)
+        out_c.append(v)
+        out_n.append(np.broadcast_to(nl, (n,)) if nl is not None else None)
+        out_names.append(_expr_name(e))
+        out_t.append(dt)
+    return Result(out_names, list(out_c), list(out_n), out_t)
+
+
+def _window_values(w, cols, nulls, params, n):
+    import pandas as pd
+
+    # partition keys
+    if w.partition_by:
+        keys = []
+        for p in w.partition_by:
+            v, _ = eval_expr(p, cols, nulls, params, n)
+            keys.append(np.broadcast_to(v, (n,)))
+        part_df = pd.DataFrame({f"k{i}": k for i, k in enumerate(keys)})
+        group_ids = part_df.groupby(list(part_df.columns), sort=False
+                                    ).ngroup().to_numpy()
+    else:
+        group_ids = np.zeros(n, dtype=np.int64)
+    # intra-partition order
+    if w.order_by:
+        order_keys = []
+        for e, asc in reversed(list(w.order_by)):
+            v, _ = eval_expr(e, cols, nulls, params, n)
+            v = np.broadcast_to(v, (n,))
+            if v.dtype == object:
+                v = np.array([str(x) if x is not None else "" for x in v])
+            order_keys.append(v if asc else _desc_key(v))
+        order_keys.append(group_ids)
+        sorted_idx = np.lexsort(order_keys)
+    else:
+        sorted_idx = np.argsort(group_ids, kind="stable")
+
+    g_sorted = group_ids[sorted_idx]
+    s = pd.Series(np.arange(n)[sorted_idx])
+    grp = s.groupby(g_sorted)
+
+    name = w.name
+    if name == "row_number":
+        out_sorted = grp.cumcount().to_numpy() + 1
+        return _unsort(out_sorted, sorted_idx, np.int64), None
+    if name in ("rank", "dense_rank"):
+        # tie groups: consecutive sorted rows equal on ALL order keys
+        ok_sorted = []
+        for e, _asc in w.order_by:
+            v, _ = eval_expr(e, cols, nulls, params, n)
+            v = np.broadcast_to(v, (n,))
+            if v.dtype == object:
+                v = np.array([str(x) if x is not None else "" for x in v])
+            ok_sorted.append(v[sorted_idx])
+        same = np.ones(n, dtype=bool)
+        if n:
+            same[0] = False
+        same[1:] &= g_sorted[1:] == g_sorted[:-1]
+        for k in ok_sorted:
+            same[1:] &= k[1:] == k[:-1]
+        pos_in_part = grp.cumcount().to_numpy()
+        start = pd.Series(np.where(same, np.nan, pos_in_part)).ffill()
+        if name == "rank":
+            out_sorted = start.to_numpy().astype(np.int64) + 1
+        else:
+            out_sorted = pd.Series(
+                (~same).astype(np.int64)).groupby(g_sorted).cumsum() \
+                .to_numpy()
+        return _unsort(out_sorted, sorted_idx, np.int64), None
+    if name == "ntile":
+        k = int(params[w.args[0].pos]
+                if isinstance(w.args[0], ast.ParamLiteral)
+                else w.args[0].value)
+        pos = grp.cumcount().to_numpy()
+        size = s.groupby(g_sorted).transform("size").to_numpy()
+        out_sorted = (pos * k // size) + 1
+        return _unsort(out_sorted, sorted_idx, np.int64), None
+    if name in ("lag", "lead"):
+        v, nl = eval_expr(w.args[0], cols, nulls, params, n)
+        v = np.broadcast_to(v, (n,))
+        offset = 1
+        if len(w.args) > 1 and isinstance(w.args[1],
+                                          (ast.Lit, ast.ParamLiteral)):
+            offset = int(params[w.args[1].pos]
+                         if isinstance(w.args[1], ast.ParamLiteral)
+                         else w.args[1].value)
+        shift = offset if name == "lag" else -offset
+        ser = pd.Series(v[sorted_idx])
+        shifted = ser.groupby(g_sorted).shift(shift)
+        out_nulls_sorted = shifted.isna().to_numpy()
+        filled = shifted.fillna(0 if v.dtype != object else "").to_numpy()
+        out = _unsort(filled, sorted_idx, None)
+        out_nl = _unsort(out_nulls_sorted, sorted_idx, np.bool_)
+        return out, (out_nl if out_nl.any() else None)
+    if name in ("sum", "avg", "min", "max", "count", "first_value",
+                "last_value"):
+        if w.args:
+            v, nl = eval_expr(w.args[0], cols, nulls, params, n)
+            v = np.broadcast_to(v, (n,))
+            isnull = np.broadcast_to(nl, (n,)).copy() if nl is not None \
+                else np.zeros(n, dtype=bool)
+            if v.dtype == object:
+                isnull = isnull | np.array([x is None for x in v])
+            # NULLs → NaN so pandas skips them (SQL aggregate semantics)
+            vf = v.astype(np.float64) if v.dtype != object else v
+            if isnull.any() and v.dtype != object:
+                vf = vf.copy()
+                vf[isnull] = np.nan
+        else:
+            vf = np.ones(n)
+            isnull = np.zeros(n, dtype=bool)
+        ser = pd.Series(vf[sorted_idx])
+        if isnull.any() and vf.dtype == object:
+            ser = ser.where(~pd.Series(isnull[sorted_idx]), np.nan)
+        g = ser.groupby(g_sorted)
+        if w.order_by:
+            # SQL default frame with ORDER BY is RANGE → peers (tied
+            # order keys) share the frame: compute running values, then
+            # take the LAST value of each tie group
+            ok_sorted = []
+            for e, _asc in w.order_by:
+                vv, _ = eval_expr(e, cols, nulls, params, n)
+                vv = np.broadcast_to(vv, (n,))
+                if vv.dtype == object:
+                    vv = np.array([str(x) if x is not None else ""
+                                   for x in vv])
+                ok_sorted.append(vv[sorted_idx])
+            same = np.ones(n, dtype=bool)
+            if n:
+                same[0] = False
+            same[1:] &= g_sorted[1:] == g_sorted[:-1]
+            for k in ok_sorted:
+                same[1:] &= k[1:] == k[:-1]
+            tie_gid = np.cumsum(~same)
+            if name == "avg":
+                run = (g.cumsum() /
+                       ser.notna().groupby(g_sorted).cumsum()).to_numpy()
+            elif name == "count":
+                run = ser.notna().groupby(g_sorted).cumsum().to_numpy()
+            elif name == "first_value":
+                run = g.transform("first").to_numpy()
+            elif name == "last_value":
+                run = ser.to_numpy()
+            else:
+                run = getattr(g, {"sum": "cumsum", "min": "cummin",
+                                  "max": "cummax"}[name])().to_numpy()
+            out_sorted = pd.Series(run).groupby(tie_gid).transform(
+                "last").to_numpy()
+        else:  # whole partition
+            agg = {"sum": "sum", "avg": "mean", "min": "min", "max": "max",
+                   "count": "count", "first_value": "first",
+                   "last_value": "last"}[name]
+            out_sorted = g.transform(agg).to_numpy()
+        out = _unsort(out_sorted, sorted_idx, None)
+        if name == "count":
+            return out.astype(np.int64), None
+        out_null = pd.isna(out)
+        if out_null.any():
+            return np.where(out_null, 0, out), np.asarray(out_null)
+        return out, None
+    raise HostEvalError(f"window function {name}")
+
+
+def _desc_key(v: np.ndarray):
+    if v.dtype.kind in "OUS":
+        order_idx = np.argsort(v, kind="stable")
+        rank = np.empty(len(v), dtype=np.int64)
+        rank[order_idx] = np.arange(len(v))
+        return -rank
+    return -v
+
+
+def _unsort(sorted_vals, sorted_idx, dtype):
+    out = np.empty(len(sorted_vals),
+                   dtype=sorted_vals.dtype if dtype is None else dtype)
+    out[sorted_idx] = sorted_vals
+    return out
+
+
+# --------------------------------------------------------------------------
 # Full-plan host fallback (pandas-based relational interpreter)
 # --------------------------------------------------------------------------
 
@@ -407,24 +642,41 @@ def _eval_rel(plan: ast.Plan, params, executor):
         if isinstance(info.data, RowTableData):
             arrays, cnt = info.data.to_arrays()
             cols = [np.asarray(a) for a in arrays]
+            col_nulls: List[Optional[np.ndarray]] = [
+                np.array([v is None for v in c]) if c.dtype == object
+                else None for c in cols]
         else:
             m = info.data.snapshot()
             chunks: List[List[np.ndarray]] = [[] for _ in info.schema.fields]
+            nchunks: List[List[np.ndarray]] = [[] for _ in info.schema.fields]
             for view in m.views:
                 live = view.live_mask()
                 lazy = info.data._decode_all(view)
                 for i, f in enumerate(info.schema.fields):
                     chunks[i].append(lazy[f.name][live])
+                    nm = view.null_mask(i)
+                    nchunks[i].append(
+                        nm[live] if nm is not None
+                        else np.zeros(int(live.sum()), dtype=np.bool_))
             if m.row_count:
                 for i, f in enumerate(info.schema.fields):
                     chunks[i].append(np.asarray(m.row_arrays[i]))
+                    rn = m.row_nulls[i] if m.row_nulls and \
+                        m.row_nulls[i] is not None else \
+                        np.zeros(m.row_count, dtype=np.bool_)
+                    nchunks[i].append(rn)
             cols = [np.concatenate(ch) if ch else
                     np.empty(0, dtype=f.dtype.np_dtype)
                     for ch, f in zip(chunks, info.schema.fields)]
+            col_nulls = []
+            for i, nc in enumerate(nchunks):
+                merged = np.concatenate(nc) if nc else \
+                    np.empty(0, dtype=np.bool_)
+                col_nulls.append(merged if merged.any() else None)
         n = int(cols[0].shape[0]) if cols else 0
         names = info.schema.names()
         dtypes = [f.dtype for f in info.schema.fields]
-        return cols, [None] * len(cols), names, dtypes, n
+        return cols, col_nulls, names, dtypes, n
 
     if isinstance(plan, ast.SubqueryAlias):
         return _eval_rel(plan.child, params, executor)
@@ -458,7 +710,7 @@ def _eval_rel(plan: ast.Plan, params, executor):
         return _eval_aggregate(plan, params, executor)
 
     if isinstance(plan, (ast.Sort, ast.Limit, ast.Distinct, ast.Union,
-                         ast.Values)):
+                         ast.Values, ast.WindowProject)):
         r = executor.execute(plan, params)
         return r.columns, r.nulls, r.names, r.dtypes, r.num_rows
 

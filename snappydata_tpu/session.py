@@ -279,6 +279,7 @@ class SnappySession:
     def _run_query(self, plan: ast.Plan, user_params=()) -> Result:
         if getattr(self.catalog, "_sample_maintainers", None):
             self._refresh_samples()
+        plan = self._decorrelate(plan)
         plan = self._rewrite_subqueries(plan, user_params)
         from snappydata_tpu.sql.optimizer import optimize
 
@@ -442,6 +443,143 @@ class SnappySession:
 
     # (row-level policy injection lives in the analyzer's relation
     # resolution so views and every other path are covered)
+
+    def _decorrelate(self, plan: ast.Plan) -> ast.Plan:
+        """Rewrite correlated [NOT] EXISTS filters into semi/anti joins —
+        the classic decorrelation for the TPC-H Q4/Q21/Q22 pattern
+        (ref: Catalyst RewritePredicateSubquery does the same):
+
+          Filter(child, EXISTS(SELECT ... FROM inner WHERE inner.a =
+          outer.b AND <inner-only preds>))
+            → Join(child, Filter(inner, preds), 'semi', a = b)
+
+        Only the single-block shape with conjunctive predicates is
+        handled; anything else keeps its (clear) unsupported error."""
+
+        def split_correlation(subplan, outer_names):
+            """If subplan is SELECT ... FROM <rel chain> WHERE <conj>,
+            split conjuncts into correlation equalities (inner_col =
+            outer_col) and inner-only predicates."""
+            node = subplan
+            # strip projection-only tops (SELECT 1 / SELECT cols)
+            while isinstance(node, (ast.Project, ast.SubqueryAlias,
+                                    ast.Distinct)):
+                node = node.children()[0]
+            if not isinstance(node, ast.Filter):
+                return None
+            inner_rel = node.child
+            conjuncts: List[ast.Expr] = []
+
+            def flat(e):
+                if isinstance(e, ast.BinOp) and e.op == "and":
+                    flat(e.left)
+                    flat(e.right)
+                else:
+                    conjuncts.append(e)
+
+            flat(node.condition)
+
+            inner_cols = _relation_columns(inner_rel, self.catalog)
+
+            def col_side(c):
+                """'outer' if the Col can only resolve in the outer scope,
+                'inner' if in the subquery's own relations."""
+                if c.qualifier:
+                    # a qualifier names its scope unambiguously (covers
+                    # self-join correlation t2.a = t.a on the same table)
+                    return "inner" if c.qualifier.lower() in inner_cols[1] \
+                        else "outer"
+                return "inner" if c.name.lower() in inner_cols[0] \
+                    else "outer"
+
+            corr = []
+            inner_only = []
+            for c in conjuncts:
+                if isinstance(c, ast.BinOp) and c.op == "=" \
+                        and isinstance(c.left, ast.Col) \
+                        and isinstance(c.right, ast.Col):
+                    sides = (col_side(c.left), col_side(c.right))
+                    if sides == ("inner", "outer"):
+                        corr.append((c.right, c.left))
+                        continue
+                    if sides == ("outer", "inner"):
+                        corr.append((c.left, c.right))
+                        continue
+                has_outer = any(
+                    isinstance(x, ast.Col) and col_side(x) == "outer"
+                    for x in ast.walk(c))
+                if has_outer:
+                    return None  # non-equi correlation: unsupported
+                inner_only.append(c)
+            if not corr:
+                return None
+            return inner_rel, corr, inner_only
+
+        def rewrite_filter(p: ast.Plan) -> ast.Plan:
+            if not isinstance(p, ast.Filter):
+                return p
+            conjuncts: List[ast.Expr] = []
+
+            def flat(e):
+                if isinstance(e, ast.BinOp) and e.op == "and":
+                    flat(e.left)
+                    flat(e.right)
+                else:
+                    conjuncts.append(e)
+
+            flat(p.condition)
+            child = p.child
+            rest: List[ast.Expr] = []
+            changed = False
+            for c in conjuncts:
+                negated = False
+                e = c
+                if isinstance(e, ast.UnaryOp) and e.op == "not" \
+                        and isinstance(e.child, ast.ExistsSubquery):
+                    negated, e = True, e.child
+                if isinstance(e, ast.ExistsSubquery):
+                    got = split_correlation(e.plan, None)
+                    if got is not None:
+                        inner_rel, corr, inner_only = got
+                        if inner_only:
+                            cond = inner_only[0]
+                            for x in inner_only[1:]:
+                                cond = ast.BinOp("and", cond, x)
+                            inner_rel = ast.Filter(inner_rel, cond)
+                        join_cond = None
+                        for outer_c, inner_c in corr:
+                            eq = ast.BinOp("=", outer_c, inner_c)
+                            join_cond = eq if join_cond is None else \
+                                ast.BinOp("and", join_cond, eq)
+                        child = ast.Join(child, inner_rel,
+                                         "anti" if negated else "semi",
+                                         join_cond)
+                        changed = True
+                        continue
+                rest.append(c)
+            if not changed:
+                return p
+            if rest:
+                cond = rest[0]
+                for x in rest[1:]:
+                    cond = ast.BinOp("and", cond, x)
+                return ast.Filter(child, cond)
+            return child
+
+        def walk_plans(p: ast.Plan) -> ast.Plan:
+            import dataclasses as _dc
+
+            if isinstance(p, ast.Filter):
+                p = rewrite_filter(p)
+            kids = p.children()
+            if not kids:
+                return p
+            if isinstance(p, (ast.Join, ast.Union)):
+                return _dc.replace(p, left=walk_plans(p.left),
+                                   right=walk_plans(p.right))
+            return _dc.replace(p, child=walk_plans(kids[0]))
+
+        return walk_plans(plan)
 
     def _rewrite_subqueries(self, plan: ast.Plan, user_params) -> ast.Plan:
         """Pre-evaluate UNCORRELATED subqueries and substitute literals
@@ -829,6 +967,27 @@ def _coerce(col: np.ndarray, nmask, dtype: T.DataType):
 
 def _s(v):
     return None if v is None else str(v)
+
+
+def _relation_columns(plan: ast.Plan, catalog):
+    """(set of column names, set of aliases) reachable in a FROM subtree."""
+    cols: set = set()
+    aliases: set = set()
+
+    def rec(p):
+        if isinstance(p, ast.UnresolvedRelation):
+            info = catalog.lookup_table(p.name)
+            if info is not None:
+                cols.update(n.lower() for n in info.schema.names())
+            aliases.add((p.alias or p.name.split(".")[-1]).lower())
+            return
+        if isinstance(p, ast.SubqueryAlias):
+            aliases.add(p.alias.lower())
+        for k in p.children():
+            rec(k)
+
+    rec(plan)
+    return cols, aliases
 
 
 def _contains_subquery(plan: ast.Plan) -> bool:

@@ -61,6 +61,8 @@ def _expr_name(e: ast.Expr) -> str:
     if isinstance(e, ast.Func):
         return f"{e.name}({', '.join(_expr_name(a) for a in e.args)})" \
             if e.args else f"{e.name}()"
+    if isinstance(e, ast.WindowFunc):
+        return f"{e.name}() OVER"
     if isinstance(e, ast.Cast):
         return _expr_name(e.child)
     if isinstance(e, (ast.Lit, ast.ParamLiteral)):
@@ -104,6 +106,14 @@ def expr_type(e: ast.Expr) -> T.DataType:
         if e.op == "/":
             return T.DOUBLE if lt.name not in ("decimal",) else lt
         return T.common_type(lt, rt)
+    if isinstance(e, ast.WindowFunc):
+        if e.name in ("row_number", "rank", "dense_rank", "ntile", "count"):
+            return T.LONG
+        if e.name == "avg":
+            return T.DOUBLE
+        if e.args:
+            return expr_type(e.args[0])
+        return T.DOUBLE
     if isinstance(e, ast.Func):
         low = e.name
         if low in ("count", "count_distinct", "approx_count_distinct"):
@@ -237,6 +247,9 @@ class Analyzer:
             exprs = self._resolve_select_list(plan.exprs, scope)
             out_scope = Scope([ScopeEntry(None, _expr_name(e), expr_type(e))
                                for e in exprs])
+            if any(any(isinstance(x, ast.WindowFunc) for x in ast.walk(e))
+                   for e in exprs):
+                return ast.WindowProject(child, tuple(exprs)), out_scope
             return ast.Project(child, tuple(exprs)), out_scope
 
         if isinstance(plan, ast.Aggregate):
@@ -278,7 +291,8 @@ class Analyzer:
                 except AnalysisError:
                     # ORDER BY an input column absent from the select list:
                     # append a hidden projection, sort, then trim
-                    if not isinstance(child, ast.Project):
+                    if not isinstance(child, (ast.Project,
+                                              ast.WindowProject)):
                         raise
                     in_scope = Scope(self._scope_of(child.child))
                     resolved = fold_constants(self.resolve_expr(e, in_scope))
@@ -288,7 +302,8 @@ class Analyzer:
                         len(child.exprs) + len(hidden) - 1,
                         expr_type(resolved)), asc))
             if hidden:
-                widened = ast.Project(
+                widened_cls = type(child)
+                widened = widened_cls(
                     child.child, child.exprs + tuple(
                         ast.Alias(h, f"__sort{j}")
                         for j, h in enumerate(hidden)))
@@ -456,7 +471,7 @@ class Analyzer:
         if isinstance(plan, ast.SubqueryAlias):
             return [dataclasses.replace(e, qualifier=plan.alias)
                     for e in self._scope_of(plan.child)]
-        if isinstance(plan, ast.Project):
+        if isinstance(plan, (ast.Project, ast.WindowProject)):
             return [ScopeEntry(None, _expr_name(e), expr_type(e))
                     for e in plan.exprs]
         if isinstance(plan, ast.Aggregate):
@@ -501,6 +516,9 @@ def tokenize_plan(plan: ast.Plan) -> Tuple[ast.Plan, Tuple[Any, ...]]:
     def tok(p: ast.Plan) -> ast.Plan:
         if isinstance(p, ast.Filter):
             return ast.Filter(tok(p.child), tok_expr(p.condition))
+        if isinstance(p, ast.WindowProject):
+            return ast.WindowProject(tok(p.child),
+                                     tuple(tok_expr(e) for e in p.exprs))
         if isinstance(p, ast.Project):
             return ast.Project(tok(p.child), tuple(tok_expr(e) for e in p.exprs))
         if isinstance(p, ast.Aggregate):
@@ -559,6 +577,9 @@ def assign_param_positions(plan: ast.Plan, offset: int) -> ast.Plan:
     def fix(p: ast.Plan) -> ast.Plan:
         if isinstance(p, ast.Filter):
             return ast.Filter(fix(p.child), fix_expr(p.condition))
+        if isinstance(p, ast.WindowProject):
+            return ast.WindowProject(fix(p.child),
+                                     tuple(fix_expr(e) for e in p.exprs))
         if isinstance(p, ast.Project):
             return ast.Project(fix(p.child),
                                tuple(fix_expr(e) for e in p.exprs))

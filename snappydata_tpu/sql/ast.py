@@ -232,6 +232,32 @@ class Func(Expr):
         return dataclasses.replace(self, args=tuple(fn(a) for a in self.args))
 
 
+@dataclasses.dataclass(frozen=True)
+class WindowFunc(Expr):
+    """fn(...) OVER (PARTITION BY ... ORDER BY ...). Default frame: whole
+    partition without ORDER BY, running frame with it (SQL default)."""
+
+    name: str = ""
+    args: Tuple[Expr, ...] = ()
+    partition_by: Tuple[Expr, ...] = ()
+    order_by: Tuple[Tuple[Expr, bool], ...] = ()
+    dtype: Optional["T.DataType"] = None
+
+    def children(self):
+        return tuple(self.args) + tuple(self.partition_by) + tuple(
+            e for e, _ in self.order_by)
+
+    def map_children(self, fn):
+        return dataclasses.replace(
+            self, args=tuple(fn(a) for a in self.args),
+            partition_by=tuple(fn(p) for p in self.partition_by),
+            order_by=tuple((fn(e), a) for e, a in self.order_by))
+
+
+WINDOW_FUNCS = {"row_number", "rank", "dense_rank", "lag", "lead",
+                "ntile", "sum", "avg", "count", "min", "max",
+                "first_value", "last_value"}
+
 AGG_FUNCS = {"sum", "avg", "count", "min", "max", "first", "last",
              "stddev", "variance", "count_distinct", "approx_count_distinct"}
 
@@ -366,6 +392,18 @@ class Union(Plan):
 @dataclasses.dataclass(frozen=True)
 class Values(Plan):
     rows: Tuple[Tuple[Expr, ...], ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowProject(Plan):
+    """Projection containing window functions — evaluated host-side over
+    the materialized child (device path is a later round)."""
+
+    child: Plan
+    exprs: Tuple[Expr, ...] = ()
+
+    def children(self):
+        return (self.child,)
 
 
 # --------------------------------------------------------------------------

@@ -38,6 +38,8 @@ def optimize(plan: ast.Plan, catalog) -> ast.Plan:
                              plan.group_exprs, plan.agg_exprs)
     if isinstance(plan, ast.Project):
         return ast.Project(optimize(plan.child, catalog), plan.exprs)
+    if isinstance(plan, ast.WindowProject):
+        return ast.WindowProject(optimize(plan.child, catalog), plan.exprs)
     if isinstance(plan, ast.Filter):
         return _optimize_filter(plan, catalog)
     if isinstance(plan, ast.Join):

@@ -511,6 +511,8 @@ class Parser:
         if self.at_op("*"):
             self.next()
             self.expect_op(")")
+            if self.at_kw("over"):
+                return self._window_clause("count", ())
             return ast.Func("count", ())  # count(*)
         distinct = self.accept_kw("distinct")
         args: List[ast.Expr] = []
@@ -520,9 +522,37 @@ class Parser:
                 args.append(self.expr())
         self.expect_op(")")
         low = name.lower()
+        if self.at_kw("over"):
+            if distinct:
+                raise SQLSyntaxError(
+                    "DISTINCT is not supported in window functions")
+            return self._window_clause(low, tuple(args))
         if distinct and low == "count":
             return ast.Func("count_distinct", tuple(args))
         return ast.Func(low, tuple(args), distinct=distinct)
+
+    def _window_clause(self, fname: str, args) -> ast.Expr:
+        self.expect_kw("over")
+        self.expect_op("(")
+        partition: List[ast.Expr] = []
+        orders: List = []
+        t = self.peek()
+        if t.kind in ("IDENT", "KW") and t.value.lower() == "partition":
+            self.next()
+            self.expect_kw("by")
+            partition.append(self.expr())
+            while self.accept_op(","):
+                partition.append(self.expr())
+        if self.at_kw("order"):
+            self.next()
+            self.expect_kw("by")
+            orders.append(self.sort_item())
+            while self.accept_op(","):
+                orders.append(self.sort_item())
+        self.expect_op(")")
+        if fname not in ast.WINDOW_FUNCS:
+            raise SQLSyntaxError(f"unsupported window function {fname}")
+        return ast.WindowFunc(fname, args, tuple(partition), tuple(orders))
 
     def interval_literal(self) -> ast.Expr:
         """INTERVAL '90' DAY → Lit(days) tagged DATE-delta (int)."""

@@ -439,12 +439,21 @@ class DiskStore:
             except Exception:
                 pass  # view over a dropped table: skip, like a stale view
         catalog._view_ddl = dict(meta.get("views") or {})
-        # policies/indexes: re-execute their DDL
+        # policies/indexes: re-execute their DDL. A failing POLICY is a
+        # security regression (the table would come up unfiltered) — fail
+        # recovery loudly; a failing index only loses a fast path: warn.
         for name, ddl in (meta.get("aux_ddl") or {}).items():
             try:
                 session.sql(ddl)
-            except Exception:
-                pass
+            except Exception as e:
+                if name.startswith("policy:"):
+                    raise RuntimeError(
+                        f"recovery could not restore row-level policy "
+                        f"{name!r} ({e}); refusing to come up without it")
+                import sys
+
+                print(f"warning: recovery skipped {name!r}: {e}",
+                      file=sys.stderr)
         catalog._aux_ddl = dict(meta.get("aux_ddl") or {})
         # AQP re-registration (review finding: maintainers/TopKs froze
         # silently after restart)

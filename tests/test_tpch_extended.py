@@ -50,6 +50,26 @@ def _days(iso):
     return (datetime.date.fromisoformat(iso) - datetime.date(1970, 1, 1)).days
 
 
+def test_q4_correlated_exists(s, dfs):
+    out = s.sql(tpch.Q4).rows()
+    li, orders = dfs["lineitem"], dfs["orders"]
+    good = set(li[li.l_commitdate < li.l_receiptdate].l_orderkey)
+    sel = orders[(orders.o_orderdate >= _days("1993-07-01"))
+                 & (orders.o_orderdate < _days("1993-10-01"))
+                 & orders.o_orderkey.isin(good)]
+    exp = sel.groupby("o_orderpriority").size().sort_index()
+    assert [(r[0], r[1]) for r in out] == list(exp.items())
+
+
+def test_not_exists_correlated(s, dfs):
+    q = """SELECT count(*) FROM customer WHERE NOT EXISTS (
+        SELECT 1 FROM orders WHERE o_custkey = c_custkey)"""
+    out = s.sql(q).rows()[0][0]
+    cust, orders = dfs["customer"], dfs["orders"]
+    exp = (~cust.c_custkey.isin(set(orders.o_custkey))).sum()
+    assert out == exp
+
+
 def test_q5(s, dfs):
     out = s.sql(tpch.Q5).rows()
     j = (dfs["lineitem"]

@@ -1,0 +1,146 @@
+"""Window function tests vs pandas oracle."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+
+
+@pytest.fixture(scope="module")
+def s():
+    sess = SnappySession(catalog=Catalog())
+    sess.sql("CREATE TABLE sal (dept STRING, emp INT, pay DOUBLE) "
+             "USING column")
+    rng = np.random.default_rng(5)
+    n = 500
+    sess.insert_arrays("sal", [
+        np.array(["a", "b", "c"], dtype=object)[rng.integers(0, 3, n)],
+        np.arange(n, dtype=np.int32),
+        np.round(rng.uniform(1000, 9000, n), 2)])
+    yield sess
+    sess.stop()
+
+
+@pytest.fixture(scope="module")
+def df(s):
+    r = s.sql("SELECT * FROM sal")
+    return pd.DataFrame({n: c for n, c in zip(r.names, r.columns)})
+
+
+def test_row_number(s, df):
+    r = s.sql("SELECT emp, row_number() OVER "
+              "(PARTITION BY dept ORDER BY pay DESC) AS rn FROM sal")
+    got = {row[0]: row[1] for row in r.rows()}
+    exp = df.sort_values("pay", ascending=False).groupby("dept").cumcount() + 1
+    for emp, rn in zip(df.emp, exp.reindex(df.index)):
+        assert got[emp] == rn
+
+
+def test_rank_and_dense_rank(s):
+    s.sql("CREATE TABLE t (g STRING, v INT) USING column")
+    s.sql("INSERT INTO t VALUES ('x', 10), ('x', 10), ('x', 20), "
+          "('y', 5), ('y', 7), ('y', 7)")
+    r = s.sql("SELECT g, v, rank() OVER (PARTITION BY g ORDER BY v) AS r, "
+              "dense_rank() OVER (PARTITION BY g ORDER BY v) AS dr "
+              "FROM t ORDER BY g, v")
+    rows = r.rows()
+    assert [(x[2], x[3]) for x in rows] == \
+        [(1, 1), (1, 1), (3, 2), (1, 1), (2, 2), (2, 2)]
+
+
+def test_partition_aggregate_whole_frame(s, df):
+    r = s.sql("SELECT emp, pay, sum(pay) OVER (PARTITION BY dept) AS total, "
+              "avg(pay) OVER (PARTITION BY dept) AS ap FROM sal")
+    totals = df.groupby("dept").pay.sum()
+    means = df.groupby("dept").pay.mean()
+    dept_of = dict(zip(df.emp, df.dept))
+    for emp, pay, total, ap in r.rows():
+        assert total == pytest.approx(totals[dept_of[emp]])
+        assert ap == pytest.approx(means[dept_of[emp]])
+
+
+def test_running_sum(s):
+    s.sql("CREATE TABLE rs (g STRING, ord INT, v INT) USING column")
+    s.sql("INSERT INTO rs VALUES ('a', 1, 10), ('a', 2, 20), ('a', 3, 30), "
+          "('b', 1, 5), ('b', 2, 5)")
+    r = s.sql("SELECT g, ord, sum(v) OVER (PARTITION BY g ORDER BY ord) "
+              "AS running FROM rs ORDER BY g, ord")
+    assert [x[2] for x in r.rows()] == [10, 30, 60, 5, 10]
+
+
+def test_lag_lead(s):
+    s.sql("CREATE TABLE ll (ord INT, v INT) USING column")
+    s.sql("INSERT INTO ll VALUES (1, 100), (2, 200), (3, 300)")
+    r = s.sql("SELECT ord, lag(v) OVER (ORDER BY ord) AS prev, "
+              "lead(v) OVER (ORDER BY ord) AS nxt FROM ll ORDER BY ord")
+    assert r.rows() == [(1, None, 200), (2, 100, 300), (3, 200, None)]
+
+
+def test_window_in_expression(s):
+    s.sql("CREATE TABLE we (g STRING, v DOUBLE) USING column")
+    s.sql("INSERT INTO we VALUES ('a', 10.0), ('a', 30.0), ('b', 50.0)")
+    r = s.sql("SELECT g, v, v / sum(v) OVER (PARTITION BY g) AS share "
+              "FROM we ORDER BY g, v")
+    assert [x[2] for x in r.rows()] == [pytest.approx(0.25),
+                                        pytest.approx(0.75),
+                                        pytest.approx(1.0)]
+
+
+def test_window_with_prepared_params(s):
+    s.sql("CREATE TABLE wp (id INT, age INT) USING column")
+    s.sql("INSERT INTO wp VALUES (1, 30), (2, 60), (3, 40)")
+    r = s.sql("SELECT id, row_number() OVER (ORDER BY id) FROM wp "
+              "WHERE age > ? AND id < ?", params=(35, 3))
+    assert r.rows() == [(2, 1)]
+
+
+def test_window_aggregates_skip_nulls(s):
+    s.sql("CREATE TABLE wn (b INT) USING column")
+    s.sql("INSERT INTO wn VALUES (NULL), (2), (4)")
+    r = s.sql("SELECT count(b) OVER () AS c, avg(b) OVER () AS a, "
+              "min(b) OVER () AS m FROM wn LIMIT 1")
+    assert r.rows() == [(2, 3.0, 2)]
+
+
+def test_running_frame_range_semantics_on_ties(s):
+    s.sql("CREATE TABLE wt (k INT, v INT) USING column")
+    s.sql("INSERT INTO wt VALUES (1, 10), (1, 20), (2, 5)")
+    r = s.sql("SELECT k, sum(v) OVER (ORDER BY k) AS rs FROM wt "
+              "ORDER BY k, v")
+    assert [x[1] for x in r.rows()] == [30, 30, 35]  # peers share the frame
+
+
+def test_null_join_keys_never_match(s):
+    s.sql("CREATE TABLE njc (ck INT) USING column")
+    s.sql("CREATE TABLE njo (ok INT) USING column")
+    s.sql("INSERT INTO njc VALUES (1), (NULL)")
+    s.sql("INSERT INTO njo VALUES (NULL), (2)")
+    r = s.sql("SELECT count(*) FROM njc WHERE NOT EXISTS "
+              "(SELECT 1 FROM njo WHERE ok = ck)")
+    assert r.rows()[0][0] == 2
+    r = s.sql("SELECT count(*) FROM njc JOIN njo ON ck = ok")
+    assert r.rows()[0][0] == 0
+
+
+def test_mixed_dtype_join_keys(s):
+    s.sql("CREATE TABLE mji (k INT) USING column")
+    s.sql("CREATE TABLE mjd (k2 DOUBLE) USING column")
+    s.sql("INSERT INTO mji VALUES (3), (4)")
+    s.sql("INSERT INTO mjd VALUES (3.0), (5.0)")
+    assert s.sql("SELECT count(*) FROM mji JOIN mjd ON k = k2"
+                 ).rows()[0][0] == 1
+
+
+def test_distinct_in_window_rejected(s):
+    with pytest.raises(Exception, match="DISTINCT"):
+        s.sql("SELECT count(DISTINCT dept) OVER () FROM sal")
+
+
+def test_count_star_window(s):
+    s.sql("CREATE TABLE cw (g STRING) USING column")
+    s.sql("INSERT INTO cw VALUES ('a'), ('a'), ('b')")
+    r = s.sql("SELECT g, count(*) OVER (PARTITION BY g) AS c FROM cw "
+              "ORDER BY g")
+    assert [x[1] for x in r.rows()] == [2, 2, 1]
