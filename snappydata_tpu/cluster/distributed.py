@@ -1,0 +1,474 @@
+"""Distributed scatter-gather execution over data-server shards.
+
+The multi-host data plane (SURVEY.md §2.5 partitioned regions): each data
+server owns a disjoint shard of every partitioned table (rows routed by
+the Spark-compatible murmur3 bucket of the partition key), replicated
+tables live on every server, and the lead plans queries as
+scatter + merge:
+
+  - DDL fans out to every server (and the lead's planning catalog).
+  - INSERTs route per-row to the owning server (replicated → all).
+  - Aggregate queries decompose: per-server PARTIAL SQL (sum/count
+    primitives — avg becomes sum+count, stddev adds sum of squares),
+    then a local MERGE SQL re-aggregates the gathered partials — exactly
+    the reference's partial aggregation + CollectAggregateExec driver
+    merge (SnappyStrategies.scala:464, ExistingPlans.scala:106), with
+    Arrow Flight as the exchange instead of GemFire messaging.
+  - Scan/filter/project queries scatter verbatim and concatenate.
+  - Joins scatter only when every joined table is collocated (same
+    partition key ⇒ matching rows share a bucket ⇒ local joins are
+    complete — CollapseCollocatedPlans' invariant) or replicated;
+    otherwise a clear error (shuffle exchange is a later round).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from snappydata_tpu import types as T
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.parallel.hashing import bucket_of_np
+from snappydata_tpu.sql import ast
+from snappydata_tpu.sql.parser import parse
+from snappydata_tpu.sql.render import RenderError, render_expr, render_plan
+
+
+class DistributedError(Exception):
+    pass
+
+
+class DistributedSession:
+    """Lead-side façade: same .sql() surface, data lives sharded across
+    server members discovered via the locator (or given addresses)."""
+
+    def __init__(self, server_addresses: Optional[Sequence[str]] = None,
+                 locator: Optional[str] = None, num_buckets: int = 128):
+        from snappydata_tpu.cluster.client import SnappyClient
+        from snappydata_tpu.session import SnappySession
+
+        if server_addresses is None:
+            from snappydata_tpu.cluster.locator import LocatorClient
+
+            lc = LocatorClient(locator, "dist-session", "client")
+            try:
+                members = lc.members()
+            finally:
+                lc.close()
+            server_addresses = [f"{m.host}:{m.port}" for m in members
+                                if m.role == "server" and m.port]
+        if not server_addresses:
+            raise DistributedError("no data servers found")
+        self.servers = [SnappyClient(address=a) for a in server_addresses]
+        self.num_buckets = num_buckets
+        # planning catalog: schemas only (no data) on the lead
+        self.planner = SnappySession(catalog=Catalog())
+
+    # ------------------------------------------------------------------
+
+    def sql(self, sql_text: str):
+        stmt = parse(sql_text)
+        if isinstance(stmt, (ast.CreateTable, ast.DropTable,
+                             ast.TruncateTable)):
+            self.planner.execute_statement(stmt)
+            for srv in self.servers:
+                srv.execute(sql_text)
+            from snappydata_tpu.engine.result import empty_result
+
+            return empty_result(["status"], [T.STRING])
+        if isinstance(stmt, ast.InsertInto) and isinstance(stmt.source,
+                                                           ast.Values):
+            return self._insert_values(stmt)
+        if isinstance(stmt, (ast.UpdateStmt, ast.DeleteStmt)):
+            # predicate applies shard-locally on every server; replicated
+            # tables touch every copy, so report ONE copy's count
+            info = self.planner.catalog.lookup_table(stmt.table)
+            replicated = info is not None and not info.partition_by
+            counts = []
+            for srv in self.servers:
+                out = srv.execute(sql_text)
+                counts.append(int(out["rows"][0][0])
+                              if out.get("rows") else 0)
+            total = max(counts) if replicated else sum(counts)
+            from snappydata_tpu.engine.result import Result
+
+            return Result(["count"], [np.array([total])], [None], [T.LONG])
+        if isinstance(stmt, ast.Query):
+            return self._query(stmt.plan)
+        raise DistributedError(
+            f"statement not supported distributed: {type(stmt).__name__}")
+
+    def insert_arrays(self, table: str, arrays: Sequence[np.ndarray],
+                      nulls: Optional[Sequence] = None) -> int:
+        """Route rows to their owning server by partition-key bucket.
+        `nulls[i]` marks SQL NULLs (rides the Arrow null buffers so
+        servers store real NULLs, not fillers)."""
+        import pyarrow as pa
+
+        info = self.planner.catalog.describe(table)
+        arrays = [np.asarray(a) for a in arrays]
+        n = int(arrays[0].shape[0])
+        names = info.schema.names()
+
+        def to_arrow(sel=None):
+            cols = {}
+            for i, (nm, a) in enumerate(zip(names, arrays)):
+                vals = a if sel is None else a[sel]
+                mask = None
+                if nulls is not None and nulls[i] is not None:
+                    mask = np.asarray(nulls[i]) if sel is None \
+                        else np.asarray(nulls[i])[sel]
+                if vals.dtype == object:
+                    cols[nm] = pa.array(
+                        [None if (mask is not None and mask[j])
+                         or v is None else str(v)
+                         for j, v in enumerate(vals)], type=pa.string())
+                else:
+                    cols[nm] = pa.array(vals, mask=mask)
+            return pa.table(cols)
+
+        def send(srv, table_arrow):
+            import pyarrow.flight as flight
+
+            descriptor = flight.FlightDescriptor.for_path(table)
+            writer, _ = srv._client().do_put(descriptor, table_arrow.schema)
+            writer.write_table(table_arrow)
+            writer.close()
+
+        if not info.partition_by:
+            arrow = to_arrow()
+            for srv in self.servers:
+                send(srv, arrow)
+            return n
+        key_ci = info.schema.index(info.partition_by[0])
+        buckets = bucket_of_np(arrays[key_ci], self.num_buckets)
+        owner = buckets % len(self.servers)
+        for si, srv in enumerate(self.servers):
+            mask = owner == si
+            if mask.any():
+                send(srv, to_arrow(mask))
+        return n
+
+    def _insert_values(self, stmt: ast.InsertInto):
+        from snappydata_tpu.engine import hosteval
+        from snappydata_tpu.engine.result import Result
+
+        resolved, _ = self.planner.analyzer.analyze_plan(stmt.source)
+        src = hosteval.eval_values(resolved, ())
+        info = self.planner.catalog.describe(stmt.table)
+        names = stmt.columns or tuple(info.schema.names())
+        arrays, masks = [], []
+        for f in info.schema.fields:
+            i = [c.lower() for c in names].index(f.name.lower())
+            col = src.columns[i]
+            masks.append(src.nulls[i])
+            if f.dtype.name == "string":
+                arrays.append(np.asarray(col, dtype=object))
+            else:
+                arrays.append(np.asarray(col).astype(f.dtype.np_dtype))
+        n = self.insert_arrays(stmt.table, arrays, nulls=masks)
+        return Result(["count"], [np.array([n])], [None], [T.LONG])
+
+    # ------------------------------------------------------------------
+
+    def _query(self, plan: ast.Plan):
+        self._check_scatterable(plan)
+        # peel ORDER BY / LIMIT: they apply after the merge
+        outer: List = []
+        node = plan
+        while isinstance(node, (ast.Sort, ast.Limit)):
+            outer.append(node)
+            node = node.children()[0]
+        having = None
+        if isinstance(node, ast.Filter) and isinstance(node.child,
+                                                       ast.Aggregate):
+            having = node.condition
+            node = node.child
+        if isinstance(node, ast.Aggregate):
+            result = self._scatter_aggregate(node, having, plan, outer)
+        else:
+            result = self._scatter_concat(node, outer)
+        return result
+
+    def _check_scatterable(self, plan: ast.Plan) -> None:
+        """Local execution is complete iff all joined tables are mutually
+        collocated or replicated (CollapseCollocatedPlans invariant)."""
+        tables = []
+
+        def rec(p):
+            if isinstance(p, ast.UnresolvedRelation):
+                info = self.planner.catalog.lookup_table(p.name)
+                if info is not None:
+                    tables.append(info)
+            for k in p.children():
+                rec(k)
+
+        rec(plan)
+        partitioned = [t for t in tables if t.partition_by]
+        if len(partitioned) > 1:
+            groups = set()
+            for t in partitioned:
+                root = t.colocate_with or t.name
+                # follow one level of colocation chain
+                base = self.planner.catalog.lookup_table(root)
+                if base is not None and base.colocate_with:
+                    root = base.colocate_with
+                groups.add((root, t.partition_by))
+            roots = {r for r, _ in groups}
+            if len(roots) > 1:
+                raise DistributedError(
+                    "join of non-collocated partitioned tables needs a "
+                    "shuffle exchange (later round); COLOCATE_WITH them "
+                    "or replicate one side")
+            # collocation only makes local joins complete when the join is
+            # keyed ON the partition key — verify an equality between the
+            # partition-key columns of every partitioned table pair exists
+            eq_pairs = []
+
+            def collect_eqs(p):
+                conds = []
+                if isinstance(p, ast.Join) and p.condition is not None:
+                    conds.append(p.condition)
+                if isinstance(p, ast.Filter):
+                    conds.append(p.condition)
+                for cond in conds:
+                    def flat(e):
+                        if isinstance(e, ast.BinOp) and e.op == "and":
+                            flat(e.left)
+                            flat(e.right)
+                        elif isinstance(e, ast.BinOp) and e.op == "=" \
+                                and isinstance(e.left, ast.Col) \
+                                and isinstance(e.right, ast.Col):
+                            eq_pairs.append((e.left.name.lower(),
+                                             e.right.name.lower()))
+                    flat(cond)
+                for k in p.children():
+                    collect_eqs(k)
+
+            collect_eqs(plan)
+            key_names = [t.partition_by[0] for t in partitioned]
+            for i in range(len(partitioned) - 1):
+                a, b = key_names[i], key_names[i + 1]
+                linked = any({x, y} == {a, b} or (a == b and x == y == a)
+                             for x, y in eq_pairs)
+                if not linked:
+                    raise DistributedError(
+                        f"collocated tables must join ON their partition "
+                        f"keys ({a} = {b}) for shard-local joins to be "
+                        f"complete; rewrite the join or replicate one side")
+
+    def _scatter_concat(self, node: ast.Plan, outer: List):
+        partial_sql = render_plan(node)
+        import pyarrow as pa
+
+        pieces = [srv.sql(partial_sql) for srv in self.servers]
+        merged = pa.concat_tables(pieces)
+        result = _arrow_to_result(merged, self.planner)
+        return _apply_outer(result, outer, self.planner)
+
+    def _scatter_aggregate(self, agg: ast.Aggregate, having, full_plan,
+                           outer: List):
+        """Decompose → scatter partial SQL → gather → local merge SQL."""
+        groups = list(agg.group_exprs)
+        partial_items: List[ast.Expr] = []
+        for gi, g in enumerate(groups):
+            partial_items.append(ast.Alias(g, f"__g{gi}"))
+        slots: List[Tuple[str, Optional[ast.Expr]]] = []
+
+        def slot_of(kind, arg) -> int:
+            for i, (k, a) in enumerate(slots):
+                if k == kind and a == arg:
+                    return i
+            slots.append((kind, arg))
+            return len(slots) - 1
+
+        def decompose(e: ast.Expr) -> ast.Expr:
+            if isinstance(e, ast.Func) and e.name in ast.AGG_FUNCS:
+                arg = e.args[0] if e.args else None
+                if e.name == "count" and arg is None:
+                    return _merge_ref(slot_of("count_star", None), "sum")
+                if e.name == "count":
+                    return _merge_ref(slot_of("count", arg), "sum")
+                if e.name == "sum":
+                    return _merge_ref(slot_of("sum", arg), "sum")
+                if e.name == "min":
+                    return _merge_ref(slot_of("min", arg), "min")
+                if e.name == "max":
+                    return _merge_ref(slot_of("max", arg), "max")
+                if e.name == "avg":
+                    s = _merge_ref(slot_of("sum", arg), "sum")
+                    c = _merge_ref(slot_of("count", arg), "sum")
+                    return ast.BinOp("/", s, c)
+                if e.name in ("stddev", "variance"):
+                    s = _merge_ref(slot_of("sum", arg), "sum")
+                    s2 = _merge_ref(slot_of("sumsq", arg), "sum")
+                    c = _merge_ref(slot_of("count", arg), "sum")
+                    mean = ast.BinOp("/", s, c)
+                    var = ast.BinOp("-", ast.BinOp("/", s2, c),
+                                    ast.BinOp("*", mean, mean))
+                    return var if e.name == "variance" else \
+                        ast.Func("sqrt", (var,))
+                raise DistributedError(
+                    f"aggregate {e.name} not distributable")
+            for gi, g in enumerate(groups):
+                if e == g:
+                    return ast.Col(f"__g{gi}")
+            return e.map_children(decompose)
+
+        merged_select: List[ast.Expr] = []
+        for e in agg.agg_exprs:
+            name = e.name if isinstance(e, ast.Alias) else None
+            base = e.child if isinstance(e, ast.Alias) else e
+            rewritten = decompose(base)
+            merged_select.append(ast.Alias(rewritten, name)
+                                 if name else rewritten)
+
+        for si, (kind, arg) in enumerate(slots):
+            if kind == "count_star":
+                partial_items.append(ast.Alias(ast.Func("count", ()),
+                                               f"__p{si}"))
+            elif kind == "sumsq":
+                partial_items.append(ast.Alias(
+                    ast.Func("sum", (ast.BinOp("*", arg, arg),)),
+                    f"__p{si}"))
+            else:
+                partial_items.append(ast.Alias(ast.Func(kind, (arg,)),
+                                               f"__p{si}"))
+
+        partial_plan = ast.Aggregate(agg.child, tuple(groups),
+                                     tuple(partial_items))
+        partial_sql = render_plan(partial_plan)
+
+        import pyarrow as pa
+
+        pieces = [srv.sql(partial_sql) for srv in self.servers]
+        merged = pa.concat_tables(pieces)
+
+        # load partials into a scratch table on the planner and merge
+        scratch = "__dist_partials"
+        self.planner.sql(f"DROP TABLE IF EXISTS {scratch}")
+        fields = []
+        for gi, g in enumerate(groups):
+            fields.append(f"__g{gi} {_sql_type(merged.schema[gi])}")
+        for si in range(len(slots)):
+            fields.append(
+                f"__p{si} {_sql_type(merged.schema[len(groups) + si])}")
+        self.planner.sql(
+            f"CREATE TABLE {scratch} ({', '.join(fields)}) USING column")
+        from snappydata_tpu.cluster.flight_server import arrow_to_arrays
+
+        arrays, nulls = arrow_to_arrays(merged)
+        if merged.num_rows:
+            self.planner.catalog.describe(scratch).data.insert_arrays(
+                arrays, nulls=nulls if any(m is not None for m in nulls)
+                else None)
+        merge_items = ", ".join(render_expr(e) for e in merged_select)
+        group_cols = ", ".join(f"__g{gi}" for gi in range(len(groups)))
+        merge_sql = f"SELECT {merge_items} FROM {scratch}"
+        if groups:
+            merge_sql += f" GROUP BY {group_cols}"
+        if having is not None:
+            merge_sql += f" HAVING {render_expr(_having_rewrite(having, groups))}"
+        result = self.planner.sql(merge_sql)
+        return _apply_outer(result, outer, self.planner,
+                            names=[_out_name(e) for e in agg.agg_exprs])
+
+    def close(self) -> None:
+        for srv in self.servers:
+            srv.close()
+
+
+def _merge_ref(slot: int, merge_fn: str) -> ast.Expr:
+    return ast.Func(merge_fn, (ast.Col(f"__p{slot}"),))
+
+
+def _having_rewrite(having: ast.Expr, groups=()) -> ast.Expr:
+    """HAVING over merged output: group expressions become __gN columns of
+    the scratch table; aggregate calls not in the select list are
+    rejected with a clear error."""
+    def rec(e):
+        for gi, g in enumerate(groups):
+            if e == g:
+                return ast.Col(f"__g{gi}")
+        if isinstance(e, ast.Func) and e.name in ast.AGG_FUNCS:
+            raise DistributedError(
+                "HAVING with aggregates not in the select list is not "
+                "supported distributed yet")
+        return e.map_children(rec)
+
+    return rec(having)
+
+
+def _out_name(e: ast.Expr) -> str:
+    from snappydata_tpu.sql.analyzer import _expr_name
+
+    return _expr_name(e)
+
+
+def _apply_outer(result, outer: List, planner, names=None):
+    from snappydata_tpu.engine import hosteval
+
+    if names and len(names) == len(result.names):
+        result.names = list(names)
+    for op in reversed(outer):
+        if isinstance(op, ast.Limit):
+            result = hosteval.limit(result, op.n)
+        elif isinstance(op, ast.Sort):
+            # resolve order refs against the result by name/position
+            orders = []
+            lower = [n.lower() for n in result.names]
+            for e, asc in op.orders:
+                target = e.child if isinstance(e, ast.Alias) else e
+                if isinstance(target, ast.Col) and \
+                        target.name.lower() in lower:
+                    idx = lower.index(target.name.lower())
+                    orders.append((ast.Col(target.name, None, idx,
+                                           result.dtypes[idx]), asc))
+                elif isinstance(target, ast.Lit) and \
+                        isinstance(target.value, int):
+                    idx = target.value - 1
+                    orders.append((ast.Col(result.names[idx], None, idx,
+                                           result.dtypes[idx]), asc))
+                else:
+                    raise DistributedError(
+                        "distributed ORDER BY must reference output "
+                        "columns by name or position")
+            result = hosteval.sort(result, orders, ())
+    return result
+
+
+def _sql_type(field) -> str:
+    import pyarrow as pa
+
+    t = field.type
+    if pa.types.is_string(t) or pa.types.is_large_string(t):
+        return "STRING"
+    if pa.types.is_integer(t):
+        return "BIGINT"
+    if pa.types.is_floating(t):
+        return "DOUBLE"
+    if pa.types.is_boolean(t):
+        return "BOOLEAN"
+    return "DOUBLE"
+
+
+def _arrow_to_result(table, planner):
+    from snappydata_tpu.cluster.flight_server import arrow_to_arrays
+    from snappydata_tpu.engine.result import Result
+
+    arrays, nulls = arrow_to_arrays(table)
+    dtypes = []
+    import pyarrow as pa
+
+    for f in table.schema:
+        if pa.types.is_string(f.type) or pa.types.is_large_string(f.type):
+            dtypes.append(T.STRING)
+        elif pa.types.is_integer(f.type):
+            dtypes.append(T.LONG)
+        elif pa.types.is_boolean(f.type):
+            dtypes.append(T.BOOLEAN)
+        else:
+            dtypes.append(T.DOUBLE)
+    return Result(list(table.column_names), arrays, nulls, dtypes)

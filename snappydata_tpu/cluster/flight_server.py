@@ -112,7 +112,9 @@ class SnappyFlightServer(flight.FlightServerBase):
         from snappydata_tpu.storage.table_store import RowTableData
 
         if isinstance(info.data, RowTableData):
-            info.data.insert_arrays(arrays)
+            from snappydata_tpu.session import _restore_none_arrays
+
+            info.data.insert_arrays(_restore_none_arrays(arrays, nulls))
         else:
             info.data.insert_arrays(
                 arrays, nulls=nulls if any(m is not None for m in nulls)

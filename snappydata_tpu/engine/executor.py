@@ -124,7 +124,7 @@ def _row_table_device(info, used):
             return jnp.asarray(host_array)
         return jax.device_put(host_array, ctx.replicated)
 
-    arrays, n = info.data.to_arrays()
+    arrays, row_masks, n = info.data.to_arrays_with_nulls()
     cap = max(1, n)
     cols = {}
     dicts = {}
@@ -139,13 +139,11 @@ def _row_table_device(info, used):
             vals = np.fromiter(
                 (lookup.get(v if v is not None else "", 0)
                  for v in arrays[ci]), dtype=np.int32, count=n)
-            row_nulls = np.fromiter((v is None for v in arrays[ci]),
-                                    dtype=np.bool_, count=n)
-            if row_nulls.any():
-                nmask = np.zeros((1, cap), dtype=np.bool_)
-                nmask[0, :n] = row_nulls
         else:
             vals = np.asarray(arrays[ci]).astype(f.dtype.device_dtype())
+        if row_masks[ci] is not None:
+            nmask = np.zeros((1, cap), dtype=np.bool_)
+            nmask[0, :n] = row_masks[ci]
         padded = np.zeros(cap, dtype=vals.dtype)
         padded[:n] = vals
         cols[ci] = _place(padded[None, :])
@@ -439,6 +437,34 @@ class Compiler:
         if not equi:
             raise CompileError("non-equi join not supported on device")
 
+        # string join keys: each table has its OWN dictionary, so codes are
+        # not comparable across tables — build a bind-time translation LUT
+        # mapping left codes into the right table's code space (unmatched
+        # values → -1, which equals no real code)
+        str_trans: Dict[int, int] = {}
+        for pi, (li, ri) in enumerate(equi):
+            lprov = lscope[li].dict_provider
+            rprov = rscope[ri - nleft].dict_provider
+            if lprov is None or rprov is None:
+                continue
+
+            def build_trans(params, _lp=lprov, _rp=rprov):
+                ld = _lp()
+                rd = _rp()
+                lookup = {v: i for i, v in enumerate(rd.tolist())}
+                trans = np.fromiter(
+                    (lookup.get(v, -1) for v in ld.tolist()),
+                    dtype=np.int32, count=len(ld))
+                size = max(1, 1 << (max(1, len(trans)) - 1).bit_length())
+                if size > len(trans):
+                    trans = np.concatenate(
+                        [trans, np.full(size - len(trans), -1,
+                                        dtype=np.int32)])
+                return trans
+
+            self.aux_builders.append(build_trans)
+            str_trans[pi] = len(self.aux_builders) - 1
+
         joint_scope = lscope + rscope if how not in ("semi", "anti") else lscope
         out_scope = [_ScopeCol(s.name, s.dtype, s.dict_provider,
                                True if how == "left" else s.nullable)
@@ -461,6 +487,12 @@ class Compiler:
                             DVal(b.value.astype(jnp.float64), b.null, b.dtype))
                 return a, b
 
+            # translate left string codes into right code space first
+            for pi, aux_i in str_trans.items():
+                trans = ctx.aux[aux_i]
+                lv = lpairs[pi]
+                codes = jnp.clip(lv.value, 0, trans.shape[0] - 1)
+                lpairs[pi] = DVal(trans[codes], lv.null, lv.dtype)
             coerced = [coerce_pair(a, b) for a, b in zip(lpairs, rpairs)]
             lpairs = [a for a, _ in coerced]
             rpairs = [b for _, b in coerced]

@@ -533,6 +533,10 @@ def _window_values(w, cols, nulls, params, n):
                          else w.args[1].value)
         shift = offset if name == "lag" else -offset
         ser = pd.Series(v[sorted_idx])
+        # a NULL input must shift in as NULL, not as its filler value
+        if nl is not None:
+            in_null = np.broadcast_to(nl, (n,))[sorted_idx]
+            ser = ser.where(~pd.Series(in_null), np.nan)
         shifted = ser.groupby(g_sorted).shift(shift)
         out_nulls_sorted = shifted.isna().to_numpy()
         filled = shifted.fillna(0 if v.dtype != object else "").to_numpy()
@@ -640,11 +644,8 @@ def _eval_rel(plan: ast.Plan, params, executor):
         from snappydata_tpu.storage.table_store import RowTableData
 
         if isinstance(info.data, RowTableData):
-            arrays, cnt = info.data.to_arrays()
+            arrays, col_nulls, cnt = info.data.to_arrays_with_nulls()
             cols = [np.asarray(a) for a in arrays]
-            col_nulls: List[Optional[np.ndarray]] = [
-                np.array([v is None for v in c]) if c.dtype == object
-                else None for c in cols]
         else:
             m = info.data.snapshot()
             chunks: List[List[np.ndarray]] = [[] for _ in info.schema.fields]
@@ -726,6 +727,34 @@ def _eval_join(plan: ast.Join, params, executor):
     rdf = pd.DataFrame({f"r{i}": c for i, c in enumerate(rc)})
     nleft = len(lc)
 
+    def _null_mask_of(df, name, arr, mask):
+        isnull = np.zeros(len(df), dtype=bool)
+        if mask is not None:
+            isnull |= np.asarray(mask)
+        isnull |= df[name].isna().to_numpy()
+        if hasattr(arr, "dtype") and arr.dtype == object:
+            isnull |= np.array([v is None for v in arr])
+        return isnull
+
+    sentineled: List[str] = []
+
+    def _null_proof_pair(li, rj):
+        """SQL: NULL join keys never match — but pandas merge matches
+        NaN==NaN. Replace null-key entries with side-unique sentinels
+        (and move both sides to object dtype so the merge still works)."""
+        lname, rname = f"l{li}", f"r{rj}"
+        lmask = _null_mask_of(ldf, lname, lc[li], ln[li])
+        rmask = _null_mask_of(rdf, rname, rc[rj], rn[rj])
+        if not lmask.any() and not rmask.any():
+            return
+        lobj = ldf[lname].astype(object).copy()
+        lobj[lmask] = [f"__Lnull{i}" for i in np.flatnonzero(lmask)]
+        ldf[lname] = lobj
+        robj = rdf[rname].astype(object).copy()
+        robj[rmask] = [f"__Rnull{i}" for i in np.flatnonzero(rmask)]
+        rdf[rname] = robj
+        sentineled.extend([lname, rname])
+
     equi = []
     residual = None
 
@@ -749,6 +778,8 @@ def _eval_join(plan: ast.Join, params, executor):
         residual = e if residual is None else ast.BinOp("and", residual, e)
 
     flatten(plan.condition)
+    for li, rj in equi:
+        _null_proof_pair(li, rj)
     how = {"inner": "inner", "left": "left", "right": "right",
            "full": "outer", "cross": "cross"}.get(plan.how)
     if how is None:  # semi/anti
@@ -767,6 +798,15 @@ def _eval_join(plan: ast.Join, params, executor):
     else:
         merged = ldf.merge(rdf, left_on=[f"l{i}" for i, _ in equi],
                            right_on=[f"r{j}" for _, j in equi], how=how)
+    # restore NULLs where sentinels rode through (outer joins keep them)
+    for name in set(sentineled):
+        if name in merged.columns:
+            col = merged[name]
+            hit = col.apply(lambda v: isinstance(v, str)
+                            and (v.startswith("__Lnull")
+                                 or v.startswith("__Rnull")))
+            if hit.any():
+                merged[name] = col.where(~hit, np.nan)
     n = len(merged)
     cols, nulls = [], []
     for i, dt in enumerate(lt):

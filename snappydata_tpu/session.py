@@ -324,8 +324,9 @@ class SnappySession:
         info = self.catalog.describe(table)
         arrays, nulls = _rows_to_arrays(info.schema, rows)
         if isinstance(info.data, RowTableData):
-            return self._journal_then(info, "insert", arrays, None,
-                                      lambda: info.data.insert_arrays(arrays))
+            raw = _restore_none_arrays(arrays, nulls)
+            return self._journal_then(info, "insert", raw, None,
+                                      lambda: info.data.insert_arrays(raw))
         return self._journal_then(
             info, "insert", arrays, nulls,
             lambda: info.data.insert_arrays(arrays, nulls=nulls))
@@ -785,10 +786,12 @@ class SnappySession:
             info.data.truncate()
         if stmt.put:
             if isinstance(info.data, RowTableData):
-                return info.data.put_arrays(arrays)
+                return info.data.put_arrays(
+                    _restore_none_arrays(arrays, null_masks))
             return self._column_put(info, arrays)
         if isinstance(info.data, RowTableData):
-            return info.data.insert_arrays(arrays)
+            return info.data.insert_arrays(
+                _restore_none_arrays(arrays, null_masks))
         return info.data.insert_arrays(arrays, nulls=null_masks)
 
     def _column_put(self, info, arrays) -> int:
@@ -903,6 +906,20 @@ class _ColsByIndex:
 class _NoneSeq:
     def __getitem__(self, i):
         return None
+
+
+def _restore_none_arrays(arrays, nulls):
+    """Row tables store python values: rebuild object arrays with None
+    where the null mask is set (numeric NULL fidelity)."""
+    out = []
+    for a, m in zip(arrays, nulls or [None] * len(arrays)):
+        if m is not None and np.asarray(m).any():
+            obj = np.asarray(a, dtype=object).copy()
+            obj[np.asarray(m)] = None
+            out.append(obj)
+        else:
+            out.append(a)
+    return out
 
 
 def _status() -> Result:

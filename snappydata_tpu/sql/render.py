@@ -1,0 +1,175 @@
+"""Render (unresolved) expression/plan ASTs back to SQL text.
+
+Used by the distributed scatter-gather router (cluster/distributed.py):
+the lead decomposes an aggregate query into per-server partial SQL and a
+local merge SQL — both rendered from rewritten ASTs. Covers the
+single-block SELECT shape (FROM/JOIN/WHERE/GROUP BY) plus the full
+expression grammar.
+"""
+
+from __future__ import annotations
+
+import datetime
+from typing import List, Optional
+
+from snappydata_tpu import types as T
+from snappydata_tpu.sql import ast
+
+_EPOCH = datetime.date(1970, 1, 1)
+
+
+class RenderError(Exception):
+    pass
+
+
+def render_expr(e: ast.Expr) -> str:
+    if isinstance(e, ast.Alias):
+        return f"{render_expr(e.child)} AS {e.name}"
+    if isinstance(e, ast.Col):
+        return f"{e.qualifier}.{e.name}" if e.qualifier else e.name
+    if isinstance(e, ast.Star):
+        return f"{e.qualifier}.*" if e.qualifier else "*"
+    if isinstance(e, ast.Lit):
+        return _render_lit(e)
+    if isinstance(e, ast.ParamLiteral):
+        raise RenderError("tokenized literal in render (render pre-token)")
+    if isinstance(e, ast.Param):
+        return "?"
+    if isinstance(e, ast.BinOp):
+        op = {"and": "AND", "or": "OR"}.get(e.op, e.op)
+        return f"({render_expr(e.left)} {op} {render_expr(e.right)})"
+    if isinstance(e, ast.UnaryOp):
+        if e.op == "not":
+            return f"(NOT {render_expr(e.child)})"
+        return f"(-{render_expr(e.child)})"
+    if isinstance(e, ast.IsNull):
+        return f"({render_expr(e.child)} IS " \
+               f"{'NOT ' if e.negated else ''}NULL)"
+    if isinstance(e, ast.InList):
+        vals = ", ".join(render_expr(v) for v in e.values)
+        neg = "NOT " if e.negated else ""
+        return f"({render_expr(e.child)} {neg}IN ({vals}))"
+    if isinstance(e, ast.Between):
+        neg = "NOT " if e.negated else ""
+        return (f"({render_expr(e.child)} {neg}BETWEEN "
+                f"{render_expr(e.lo)} AND {render_expr(e.hi)})")
+    if isinstance(e, ast.Like):
+        neg = "NOT " if e.negated else ""
+        pat = e.pattern.replace("'", "''")
+        return f"({render_expr(e.child)} {neg}LIKE '{pat}')"
+    if isinstance(e, ast.Case):
+        parts = ["CASE"]
+        for c, v in e.whens:
+            parts.append(f"WHEN {render_expr(c)} THEN {render_expr(v)}")
+        if e.otherwise is not None:
+            parts.append(f"ELSE {render_expr(e.otherwise)}")
+        parts.append("END")
+        return " ".join(parts)
+    if isinstance(e, ast.Cast):
+        return f"CAST({render_expr(e.child)} AS {e.to.name})"
+    if isinstance(e, ast.Func):
+        if e.name == "count" and not e.args:
+            return "count(*)"
+        if e.name == "count_distinct":
+            return f"count(DISTINCT {render_expr(e.args[0])})"
+        args = ", ".join(render_expr(a) for a in e.args)
+        return f"{e.name}({args})"
+    raise RenderError(f"cannot render {type(e).__name__}")
+
+
+def _render_lit(e: ast.Lit) -> str:
+    v = e.value
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if e.dtype is not None and e.dtype.name == "date":
+        return f"DATE '{(_EPOCH + datetime.timedelta(days=int(v))).isoformat()}'"
+    if isinstance(v, (int, float)):
+        return repr(v)
+    escaped = str(v).replace("'", "''")
+    return f"'{escaped}'"
+
+
+def render_plan(p: ast.Plan) -> str:
+    """Render a single-block SELECT tree (Project|Aggregate over
+    FROM-chain with optional Filter)."""
+    select_list: Optional[List[ast.Expr]] = None
+    group_by: List[ast.Expr] = []
+    where: Optional[ast.Expr] = None
+    having: Optional[ast.Expr] = None
+    orders = []
+    limit = None
+
+    node = p
+    while True:
+        if isinstance(node, ast.Limit):
+            limit = node.n
+            node = node.child
+        elif isinstance(node, ast.Sort):
+            orders = list(node.orders)
+            node = node.child
+        else:
+            break
+    if isinstance(node, ast.Filter) and isinstance(node.child, ast.Aggregate):
+        having = node.condition
+        node = node.child
+    if isinstance(node, ast.Aggregate):
+        select_list = list(node.agg_exprs)
+        group_by = list(node.group_exprs)
+        node = node.child
+    elif isinstance(node, ast.Project):
+        select_list = list(node.exprs)
+        node = node.child
+    if isinstance(node, ast.Filter):
+        where = node.condition
+        node = node.child
+    from_sql = _render_from(node)
+    if select_list is None:
+        select_list = [ast.Star()]
+    parts = ["SELECT " + ", ".join(render_expr(e) for e in select_list),
+             "FROM " + from_sql]
+    if where is not None:
+        parts.append("WHERE " + render_expr(where))
+    if group_by:
+        parts.append("GROUP BY " + ", ".join(render_expr(g)
+                                             for g in group_by))
+    if having is not None:
+        parts.append("HAVING " + render_expr(having))
+    if orders:
+        parts.append("ORDER BY " + ", ".join(
+            render_expr(e) + ("" if asc else " DESC") for e, asc in orders))
+    if limit is not None:
+        parts.append(f"LIMIT {limit}")
+    return " ".join(parts)
+
+
+def _render_from(node: ast.Plan) -> str:
+    if isinstance(node, ast.UnresolvedRelation):
+        return f"{node.name} {node.alias}" if node.alias else node.name
+    if isinstance(node, ast.SubqueryAlias):
+        return f"({render_plan(node.child)}) {node.alias}"
+    if isinstance(node, ast.Filter):
+        # filtered factor (from pushdown): render as subquery
+        inner = _render_from(node.child)
+        base = node.child
+        alias = base.alias if isinstance(base, ast.UnresolvedRelation) \
+            and base.alias else None
+        sub = (f"(SELECT * FROM {inner.split(' ')[0]} WHERE "
+               f"{render_expr(node.condition)})")
+        return f"{sub} {alias}" if alias else \
+            f"{sub} {inner.split(' ')[0].split('.')[-1]}"
+    if isinstance(node, ast.Join):
+        left = _render_from(node.left)
+        right = _render_from(node.right)
+        if node.how == "cross" and node.condition is None:
+            return f"{left}, {right}"
+        how = {"inner": "JOIN", "left": "LEFT JOIN",
+               "right": "RIGHT JOIN", "full": "FULL JOIN",
+               "semi": "SEMI JOIN", "anti": "ANTI JOIN"}.get(node.how)
+        if how is None or node.how in ("semi", "anti"):
+            raise RenderError(f"cannot render join {node.how}")
+        cond = f" ON {render_expr(node.condition)}" \
+            if node.condition is not None else ""
+        return f"{left} {how} {right}{cond}"
+    raise RenderError(f"cannot render FROM {type(node).__name__}")

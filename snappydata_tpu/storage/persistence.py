@@ -246,10 +246,12 @@ class DiskStore:
         tdir = os.path.join(self.path, "tables", info.name)
         os.makedirs(tdir, exist_ok=True)
         if isinstance(info.data, RowTableData):
-            arrays, n = info.data.to_arrays()
+            arrays, masks, n = info.data.to_arrays_with_nulls()
             with open(os.path.join(tdir, "rows.tmp"), "wb") as fh:
                 write_record(fh, {"kind": "rowtable", "n": n,
-                                  "wal_seq": wal_seq}, list(arrays))
+                                  "ncols": len(arrays),
+                                  "wal_seq": wal_seq},
+                             list(arrays) + list(masks))
             os.replace(os.path.join(tdir, "rows.tmp"),
                        os.path.join(tdir, "rows.dat"))
             return
@@ -476,7 +478,14 @@ class DiskStore:
                     for header, arrays in read_records(fh):
                         seq = header.get("wal_seq", 0)
                         if header["n"]:
-                            info.data.insert_arrays(arrays)
+                            ncols = header.get("ncols", len(arrays))
+                            cols, masks = arrays[:ncols], arrays[ncols:]
+                            if masks:
+                                from snappydata_tpu.session import \
+                                    _restore_none_arrays
+
+                                cols = _restore_none_arrays(cols, masks)
+                            info.data.insert_arrays(cols)
             return seq
         mpath = os.path.join(tdir, "manifest.json")
         if not os.path.exists(mpath):

@@ -571,13 +571,30 @@ class RowTableData:
         return tuple(c[ordinal] for c in self._cols)
 
     def to_arrays(self) -> Tuple[List[np.ndarray], int]:
+        arrays, _nulls, n = self.to_arrays_with_nulls()
+        return arrays, n
+
+    def to_arrays_with_nulls(self):
+        """(arrays, null masks, count): rows store python values incl.
+        None; numeric Nones fill as 0 with the mask set."""
         with self._lock:
             live = np.array(self._live, dtype=np.bool_)
-            out = []
+            out: List[np.ndarray] = []
+            masks: List[Optional[np.ndarray]] = []
             for f, c in zip(self.schema.fields, self._cols):
-                arr = np.array(c, dtype=f.dtype.np_dtype)
-                out.append(arr[live] if len(live) else arr)
-            return out, int(live.sum()) if len(live) else 0
+                nm = np.array([v is None for v in c], dtype=np.bool_)
+                if f.dtype.name == "string":
+                    arr = np.array(c, dtype=object)
+                else:
+                    arr = np.array([0 if v is None else v for v in c],
+                                   dtype=f.dtype.np_dtype)
+                if len(live):
+                    arr = arr[live]
+                    nm = nm[live]
+                out.append(arr)
+                masks.append(nm if nm.any() else None)
+            n = int(live.sum()) if len(live) else 0
+            return out, masks, n
 
     def update(self, predicate, assignments) -> int:
         with self._lock:

@@ -48,6 +48,28 @@ def test_update_to_null_and_back(s):
     assert rows[2] == "restored"
 
 
+def test_row_table_numeric_nulls(s):
+    """Row tables must preserve numeric NULLs end-to-end (they were stored
+    as 0), including through host semi/anti joins where NULL keys never
+    match."""
+    s.sql("CREATE TABLE rc (ck INT) USING row")
+    s.sql("CREATE TABLE ro (ok INT) USING row")
+    s.sql("INSERT INTO rc VALUES (1), (NULL)")
+    s.sql("INSERT INTO ro VALUES (NULL), (2)")
+    assert s.sql("SELECT count(*) FROM rc WHERE ck IS NULL").rows()[0][0] == 1
+    assert s.sql("SELECT sum(ck), count(ck) FROM rc").rows()[0] == (1, 1)
+    r = s.sql("SELECT count(*) FROM rc WHERE NOT EXISTS "
+              "(SELECT 1 FROM ro WHERE ok = ck)")
+    assert r.rows()[0][0] == 2
+
+
+def test_lag_null_input_shifts_as_null(s):
+    s.sql("CREATE TABLE lgr (ord INT, v INT) USING column")
+    s.sql("INSERT INTO lgr VALUES (1, 100), (2, NULL), (3, 300)")
+    r = s.sql("SELECT ord, lag(v) OVER (ORDER BY ord) FROM lgr ORDER BY ord")
+    assert r.rows() == [(1, None), (2, 100), (3, None)]
+
+
 def test_prepared_statement_params(s):
     s.sql("CREATE TABLE t (a INT, b INT) USING column")
     s.sql("INSERT INTO t VALUES (1, 10), (2, 20), (3, 30)")

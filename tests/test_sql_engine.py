@@ -132,6 +132,22 @@ def test_join_left(s):
     assert out.rows() == [(1, None), (2, "two"), (3, "three")]
 
 
+def test_string_key_join_across_dictionaries(s):
+    """Regression: each table has its own dictionary — string-key joins
+    must translate codes, not compare them raw (insertion order differs)."""
+    s.sql("CREATE TABLE l (code STRING, v INT) USING column")
+    s.sql("CREATE TABLE r (code STRING, label STRING) USING column")
+    # deliberately different insertion orders → different code assignments
+    s.sql("INSERT INTO l VALUES ('b', 1), ('a', 2), ('c', 3), ('zz', 4)")
+    s.sql("INSERT INTO r VALUES ('c', 'C!'), ('b', 'B!'), ('a', 'A!')")
+    out = s.sql("SELECT l.code, r.label, l.v FROM l JOIN r "
+                "ON l.code = r.code ORDER BY l.code")
+    assert out.rows() == [("a", "A!", 2), ("b", "B!", 1), ("c", "C!", 3)]
+    out = s.sql("SELECT count(*) FROM l LEFT JOIN r ON l.code = r.code "
+                "WHERE r.label IS NULL")
+    assert out.rows()[0][0] == 1  # 'zz' matches nothing
+
+
 def test_join_then_aggregate(s):
     s.sql("CREATE TABLE dept (did INT, dname STRING) USING column")
     s.sql("CREATE TABLE emp (eid INT, did INT, sal DOUBLE) USING column")
