@@ -1,0 +1,50 @@
+"""Approximate query processing over a stratified sample (ref example:
+examples/.../SynopsisDataExample.scala; docs/aqp.md).
+
+Run: PYTHONPATH=. python examples/approx_query.py
+"""
+
+import time
+
+import numpy as np
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+
+
+def main():
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE taxi (borough STRING, fare DOUBLE) USING column")
+    rng = np.random.default_rng(7)
+    n = 2_000_000
+    boroughs = np.array(["manhattan", "brooklyn", "queens", "bronx",
+                         "staten"], dtype=object)
+    probs = np.array([0.6, 0.2, 0.15, 0.045, 0.005])
+    s.insert_arrays("taxi", [
+        boroughs[rng.choice(5, n, p=probs)],
+        np.round(rng.gamma(2.0, 8.0, n), 2)])
+
+    # stratified sample keyed on the query column set
+    s.sql("CREATE SAMPLE TABLE taxi_sample ON taxi "
+          "OPTIONS (qcs 'borough', reservoir_size '500')")
+
+    t0 = time.time()
+    exact = s.sql("SELECT borough, count(*), avg(fare) FROM taxi "
+                  "GROUP BY borough ORDER BY borough")
+    t_exact = time.time() - t0
+    t0 = time.time()
+    approx = s.approx_sql("SELECT borough, count(*), avg(fare) FROM taxi "
+                          "GROUP BY borough ORDER BY borough")
+    t_approx = time.time() - t0
+
+    print(f"exact   ({t_exact * 1000:.0f}ms):")
+    print(exact.to_pandas())
+    print(f"approx  ({t_approx * 1000:.0f}ms):")
+    print(approx.to_pandas())
+
+    s.create_topk("hot_boroughs", "taxi", "borough", k=3)
+    print("TopK:", s.query_topk("hot_boroughs").rows())
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,41 @@
+"""Collocated join: two tables partitioned on the join key share bucket
+placement, so joins need no exchange (ref example:
+examples/.../CollocatedJoinExample.scala).
+
+Run: PYTHONPATH=. python examples/collocated_join.py
+"""
+
+import numpy as np
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+
+
+def main():
+    s = SnappySession(catalog=Catalog())
+    s.sql("""CREATE TABLE orders (o_orderkey BIGINT, o_custkey BIGINT,
+        o_total DOUBLE) USING column OPTIONS (partition_by 'o_orderkey')""")
+    s.sql("""CREATE TABLE lineitems (l_orderkey BIGINT, l_qty INT,
+        l_price DOUBLE) USING column
+        OPTIONS (partition_by 'l_orderkey', colocate_with 'orders')""")
+
+    n_o, n_l = 10_000, 40_000
+    rng = np.random.default_rng(1)
+    s.insert_arrays("orders", [
+        np.arange(n_o, dtype=np.int64),
+        rng.integers(0, 1000, n_o).astype(np.int64),
+        np.round(rng.uniform(10, 1000, n_o), 2)])
+    s.insert_arrays("lineitems", [
+        rng.integers(0, n_o, n_l).astype(np.int64),
+        rng.integers(1, 10, n_l).astype(np.int32),
+        np.round(rng.uniform(1, 100, n_l), 2)])
+
+    out = s.sql("""
+        SELECT o.o_custkey, count(*) AS items, sum(l.l_price * l.l_qty)
+        FROM lineitems l JOIN orders o ON l.l_orderkey = o.o_orderkey
+        GROUP BY o.o_custkey ORDER BY 3 DESC LIMIT 5""")
+    print(out.to_pandas())
+
+
+if __name__ == "__main__":
+    main()

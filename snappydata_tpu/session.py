@@ -33,10 +33,14 @@ class SnappySession:
     _default_lock = threading.Lock()
 
     def __init__(self, catalog: Optional[Catalog] = None, conf=None,
-                 data_dir: Optional[str] = None, recover: bool = True):
+                 data_dir: Optional[str] = None, recover: bool = True,
+                 user: str = "admin"):
         """`data_dir` attaches a DiskStore (ref: sys-disk-dir): DML becomes
         WAL-durable, `checkpoint()` persists batches/manifests, and when
-        `recover` the catalog+data are rebuilt from disk at startup."""
+        `recover` the catalog+data are rebuilt from disk at startup.
+        `user` is the session principal for GRANT/REVOKE checks (ref:
+        LDAP-auth'd connections; "admin" is the superuser)."""
+        self.user = user.lower()
         self.disk_store = None
         if data_dir is not None:
             from snappydata_tpu.storage.persistence import DiskStore
@@ -67,6 +71,9 @@ class SnappySession:
 
     def sql(self, sql_text: str, params: Sequence[Any] = ()) -> Result:
         stmt = parse(sql_text)
+        # authorize BEFORE journaling: a denied statement must never reach
+        # the WAL (replay runs as admin and would apply it — review finding)
+        self._authorize(stmt)
         ds = self.disk_store
         if ds is not None and isinstance(
                 stmt, (ast.InsertInto, ast.UpdateStmt, ast.DeleteStmt,
@@ -124,11 +131,35 @@ class SnappySession:
                 getattr(self.catalog, "_aux_ddl", {}).pop(
                     f"{kind}:{stmt.name.lower()}", None)
                 ds.save_catalog(self.catalog)
+            elif isinstance(stmt, (ast.GrantStmt, ast.RevokeStmt)):
+                ds.save_catalog(self.catalog)  # grants persist like DDL
         return result
 
     def execute_statement(self, stmt: ast.Statement, user_params=()) -> Result:
+        self._authorize(stmt)
         if isinstance(stmt, ast.Query):
             return self._run_query(stmt.plan, user_params)
+        if isinstance(stmt, ast.GrantStmt):
+            if self.user != "admin":
+                raise PermissionError("only admin may GRANT")
+            if self.catalog.lookup_table(stmt.table) is None and \
+                    self.catalog.lookup_view(stmt.table) is None:
+                raise ValueError(f"table or view not found: {stmt.table}")
+            grants = self._grants()
+            key = (stmt.grantee.lower(), _table_key(self.catalog, stmt.table))
+            privs = grants.setdefault(key, set())
+            privs.update(_expand_privs(stmt.privileges))
+            return _status()
+        if isinstance(stmt, ast.RevokeStmt):
+            if self.user != "admin":
+                raise PermissionError("only admin may REVOKE")
+            grants = self._grants()
+            key = (stmt.grantee.lower(), _table_key(self.catalog, stmt.table))
+            if key in grants:
+                grants[key] -= _expand_privs(stmt.privileges)
+                if not grants[key]:
+                    del grants[key]
+            return _status()
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt)
         if isinstance(stmt, ast.DropTable):
@@ -321,6 +352,7 @@ class SnappySession:
             return apply_fn()
 
     def insert(self, table: str, *rows) -> int:
+        self._require(table, "insert")
         info = self.catalog.describe(table)
         arrays, nulls = _rows_to_arrays(info.schema, rows)
         if isinstance(info.data, RowTableData):
@@ -332,17 +364,22 @@ class SnappySession:
             lambda: info.data.insert_arrays(arrays, nulls=nulls))
 
     def insert_arrays(self, table: str, arrays: Sequence[np.ndarray]) -> int:
+        self._require(table, "insert")
         info = self.catalog.describe(table)
         arrays = [np.asarray(a) for a in arrays]
         return self._journal_then(info, "insert", arrays, None,
                                   lambda: info.data.insert_arrays(arrays))
 
     def put(self, table: str, *rows) -> int:
+        self._require(table, "insert")
+        self._require(table, "update")
         info = self.catalog.describe(table)
         arrays, _ = _rows_to_arrays(info.schema, rows)
         return self.put_arrays(table, arrays)
 
     def put_arrays(self, table: str, arrays: Sequence[np.ndarray]) -> int:
+        self._require(table, "insert")
+        self._require(table, "update")
         info = self.catalog.describe(table)
         arrays = [np.asarray(a) for a in arrays]
 
@@ -357,6 +394,7 @@ class SnappySession:
                     key_arrays: Sequence[np.ndarray]) -> int:
         """Delete rows whose key tuple appears in `key_arrays` (CDC delete
         path; WAL kind 'delete_keys')."""
+        self._require(table, "delete")
         info = self.catalog.describe(table)
         key_arrays = [np.asarray(a) for a in key_arrays]
         keys = {tuple(c[i] for c in key_arrays)
@@ -400,6 +438,7 @@ class SnappySession:
     def get(self, table: str, key: tuple):
         """Point lookup on a row table's primary key — never enters the
         query engine (ref: ExecutionEngineArbiter fast path)."""
+        self._require(table, "select")
         info = self.catalog.describe(table)
         if not isinstance(info.data, RowTableData):
             raise ValueError("get() requires a row table with a primary key")
@@ -441,6 +480,66 @@ class SnappySession:
                                   stmt.options, stmt.if_not_exists,
                                   key_columns=keys)
         return _status()
+
+    # ------------------------------------------------------------------
+    # authorization (GRANT/REVOKE; ref grantRevokeExternal + LDAP auth —
+    # session-user based here, "admin" is superuser)
+    # ------------------------------------------------------------------
+
+    def _grants(self) -> Dict:
+        if not hasattr(self.catalog, "_grants"):
+            self.catalog._grants = {}
+        return self.catalog._grants
+
+    def _has_priv(self, table: str, priv: str) -> bool:
+        if self.user == "admin":
+            return True
+        key = (self.user, _table_key(self.catalog, table))
+        return priv in self._grants().get(key, set())
+
+    def _require(self, table: str, priv: str) -> None:
+        if not self._has_priv(table, priv):
+            raise PermissionError(
+                f"user {self.user!r} lacks {priv.upper()} on {table}")
+
+    def _authorize(self, stmt: ast.Statement) -> None:
+        if self.user == "admin":
+            return
+        if isinstance(stmt, ast.Query):
+            for t in _referenced_tables(stmt.plan):
+                self._require(t, "select")
+            return
+        if isinstance(stmt, ast.InsertInto):
+            self._require(stmt.table, "insert")
+            if stmt.put:
+                self._require(stmt.table, "update")  # upsert updates rows
+            if stmt.overwrite:
+                self._require(stmt.table, "delete")  # overwrite truncates
+            for t in _referenced_tables(stmt.source):
+                self._require(t, "select")
+            return
+        if isinstance(stmt, ast.UpdateStmt):
+            self._require(stmt.table, "update")
+            for e in [stmt.where] + [x for _, x in stmt.assignments]:
+                if e is not None:
+                    for t in _expr_subquery_tables(e):
+                        self._require(t, "select")
+            return
+        if isinstance(stmt, ast.DeleteStmt):
+            self._require(stmt.table, "delete")
+            if stmt.where is not None:
+                for t in _expr_subquery_tables(stmt.where):
+                    self._require(t, "select")
+            return
+        if isinstance(stmt, (ast.CreateTable, ast.DropTable,
+                             ast.TruncateTable, ast.CreatePolicy,
+                             ast.DropPolicy, ast.CreateIndex,
+                             ast.DropIndex, ast.ExecCode, ast.SetConf,
+                             ast.CreateView, ast.DropView)):
+            raise PermissionError(
+                f"user {self.user!r} may not run "
+                f"{type(stmt).__name__} (DDL is admin-only)")
+        # SHOW/DESCRIBE stay open (metadata reads)
 
     # (row-level policy injection lives in the analyzer's relation
     # resolution so views and every other path are covered)
@@ -906,6 +1005,64 @@ class _ColsByIndex:
 class _NoneSeq:
     def __getitem__(self, i):
         return None
+
+
+def _expand_privs(privs) -> set:
+    out = set()
+    for p in privs:
+        if p == "all":
+            out.update({"select", "insert", "update", "delete"})
+        else:
+            out.add(p)
+    return out
+
+
+def _table_key(catalog, table: str) -> str:
+    from snappydata_tpu.catalog.catalog import _norm
+
+    return _norm(table)
+
+
+def _expr_subquery_tables(e: ast.Expr):
+    out = []
+    for node in ast.walk(e):
+        if isinstance(node, (ast.ScalarSubquery, ast.InSubquery,
+                             ast.ExistsSubquery)):
+            out.extend(_referenced_tables(node.plan))
+    return out
+
+
+def _referenced_tables(plan: ast.Plan):
+    out = []
+
+    def rec(p):
+        if isinstance(p, ast.UnresolvedRelation):
+            out.append(p.name)
+        for e in _plan_exprs(p):
+            for node in ast.walk(e):
+                if isinstance(node, (ast.ScalarSubquery, ast.InSubquery,
+                                     ast.ExistsSubquery)):
+                    rec(node.plan)
+        for k in p.children():
+            rec(k)
+
+    def _plan_exprs(p):
+        if isinstance(p, ast.Filter):
+            return [p.condition]
+        if isinstance(p, (ast.Project, ast.WindowProject)):
+            return list(p.exprs)
+        if isinstance(p, ast.Aggregate):
+            return list(p.group_exprs) + list(p.agg_exprs)
+        if isinstance(p, ast.Join) and p.condition is not None:
+            return [p.condition]
+        if isinstance(p, ast.Values):
+            return [e for row in p.rows for e in row]
+        if isinstance(p, ast.Sort):
+            return [e for e, _ in p.orders]
+        return []
+
+    rec(plan)
+    return out
 
 
 def _restore_none_arrays(arrays, nulls):

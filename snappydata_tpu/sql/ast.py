@@ -571,6 +571,24 @@ class DropIndex(Statement):
 
 
 @dataclasses.dataclass(frozen=True)
+class GrantStmt(Statement):
+    """GRANT priv[, ...] ON table TO user (ref: grantRevokeExternal,
+    SnappyDDLParser.scala:837; LDAP-backed in the reference, session-user
+    based here)."""
+
+    privileges: tuple = ()
+    table: str = ""
+    grantee: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class RevokeStmt(Statement):
+    privileges: tuple = ()
+    table: str = ""
+    grantee: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
 class ExecCode(Statement):
     """EXEC PYTHON '<code>' — per-session remote interpreter (ref: EXEC
     SCALA, cluster/.../remote/interpreter/SnappyInterpreterExecute)."""

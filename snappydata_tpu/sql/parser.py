@@ -124,6 +124,8 @@ class Parser:
             return self._finishing(ast.DescribeTable(self.qualified_name()))
         if low == "set":
             return self._finishing(self.set_stmt())
+        if low in ("grant", "revoke"):
+            return self._finishing(self.grant_revoke_stmt(low))
         if low == "exec":
             self.next()
             lang = self.peek()
@@ -811,6 +813,28 @@ class Parser:
         if self.accept_kw("where"):
             where = self.expr()
         return ast.DeleteStmt(table, where)
+
+    def grant_revoke_stmt(self, kind: str) -> ast.Statement:
+        self.next()
+        privs = [self.ident().lower()]
+        while self.accept_op(","):
+            privs.append(self.ident().lower())
+        valid = {"select", "insert", "update", "delete", "all"}
+        for p in privs:
+            if p not in valid:
+                raise SQLSyntaxError(f"unknown privilege {p!r}")
+        self.expect_kw("on")
+        self.accept_kw("table")
+        table = self.qualified_name()
+        if kind == "grant":
+            self.expect_kw("to")
+        else:
+            if not (self.accept_kw("from") or self.accept_kw("to")):
+                raise SQLSyntaxError("REVOKE expects FROM <user>")
+        grantee = self.ident()
+        if kind == "grant":
+            return ast.GrantStmt(tuple(privs), table, grantee)
+        return ast.RevokeStmt(tuple(privs), table, grantee)
 
     def set_stmt(self) -> ast.Statement:
         self.expect_kw("set")

@@ -234,10 +234,13 @@ class DiskStore:
         views = dict(getattr(catalog, "_view_ddl", {}))
         topks = dict(getattr(catalog, "_topk_defs", {}))
         aux = dict(getattr(catalog, "_aux_ddl", {}))  # policies/indexes
+        grants = [[user, table, sorted(privs)] for (user, table), privs
+                  in getattr(catalog, "_grants", {}).items()]
         tmp = os.path.join(self.path, "catalog.json.tmp")
         with open(tmp, "w") as fh:
             json.dump({"version": 1, "tables": tables, "views": views,
-                       "topks": topks, "aux_ddl": aux}, fh, indent=1)
+                       "topks": topks, "aux_ddl": aux,
+                       "grants": grants}, fh, indent=1)
         os.replace(tmp, os.path.join(self.path, "catalog.json"))
 
     # -- checkpoint ------------------------------------------------------
@@ -457,6 +460,8 @@ class DiskStore:
                 print(f"warning: recovery skipped {name!r}: {e}",
                       file=sys.stderr)
         catalog._aux_ddl = dict(meta.get("aux_ddl") or {})
+        catalog._grants = {(u, t): set(p)
+                           for u, t, p in (meta.get("grants") or [])}
         # AQP re-registration (review finding: maintainers/TopKs froze
         # silently after restart)
         for info in sample_tables:

@@ -1,0 +1,125 @@
+"""GRANT/REVOKE + session-user authorization (ref: grantRevokeExternal
+SnappyDDLParser.scala:837, LDAP auth hooks — session-principal model)."""
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+
+
+@pytest.fixture()
+def env():
+    catalog = Catalog()
+    admin = SnappySession(catalog=catalog)  # default user: admin
+    admin.sql("CREATE TABLE t (a INT) USING column")
+    admin.sql("INSERT INTO t VALUES (1), (2)")
+    alice = SnappySession(catalog=catalog, user="alice")
+    yield admin, alice
+
+
+def test_unprivileged_user_denied(env):
+    admin, alice = env
+    with pytest.raises(PermissionError, match="SELECT"):
+        alice.sql("SELECT * FROM t")
+    with pytest.raises(PermissionError, match="INSERT"):
+        alice.sql("INSERT INTO t VALUES (3)")
+    with pytest.raises(PermissionError, match="admin-only"):
+        alice.sql("DROP TABLE t")
+
+
+def test_grant_then_revoke(env):
+    admin, alice = env
+    admin.sql("GRANT SELECT, INSERT ON t TO alice")
+    assert alice.sql("SELECT count(*) FROM t").rows()[0][0] == 2
+    alice.sql("INSERT INTO t VALUES (3)")
+    with pytest.raises(PermissionError, match="UPDATE"):
+        alice.sql("UPDATE t SET a = 0 WHERE a = 1")
+    admin.sql("REVOKE INSERT ON t FROM alice")
+    with pytest.raises(PermissionError, match="INSERT"):
+        alice.sql("INSERT INTO t VALUES (4)")
+    assert alice.sql("SELECT count(*) FROM t").rows()[0][0] == 3
+
+
+def test_grant_all_and_subquery_tables_checked(env):
+    admin, alice = env
+    admin.sql("CREATE TABLE u (b INT) USING column")
+    admin.sql("INSERT INTO u VALUES (1)")
+    admin.sql("GRANT ALL ON t TO alice")
+    alice.sql("UPDATE t SET a = 9 WHERE a = 1")
+    # subquery touches u, which alice cannot read
+    with pytest.raises(PermissionError, match="lacks SELECT on u"):
+        alice.sql("SELECT * FROM t WHERE a IN (SELECT b FROM u)")
+
+
+def test_only_admin_grants(env):
+    admin, alice = env
+    with pytest.raises(PermissionError, match="only admin"):
+        alice.sql("GRANT SELECT ON t TO bob")
+
+
+def test_denied_dml_never_reaches_wal(tmp_path):
+    """A rejected statement must not be journaled — replay runs as admin
+    and would apply it."""
+    catalog = Catalog()
+    admin = SnappySession(catalog=catalog, data_dir=str(tmp_path),
+                          recover=False)
+    alice = SnappySession(catalog=catalog, user="alice")
+    alice.disk_store = admin.disk_store
+    admin.sql("CREATE TABLE secret (k INT) USING column")
+    admin.sql("INSERT INTO secret VALUES (42)")
+    with pytest.raises(PermissionError):
+        alice.sql("DELETE FROM secret WHERE k = 42")
+    admin.disk_store.close()
+    s2 = SnappySession(data_dir=str(tmp_path))
+    assert s2.sql("SELECT count(*) FROM secret").rows()[0][0] == 1
+
+
+def test_grants_survive_restart(tmp_path):
+    catalog = Catalog()
+    admin = SnappySession(catalog=catalog, data_dir=str(tmp_path),
+                          recover=False)
+    admin.sql("CREATE TABLE t (a INT) USING column")
+    admin.sql("INSERT INTO t VALUES (1)")
+    admin.sql("GRANT SELECT ON t TO alice")
+    admin.disk_store.close()
+    s2 = SnappySession(data_dir=str(tmp_path))
+    alice = SnappySession(catalog=s2.catalog, user="alice")
+    assert alice.sql("SELECT count(*) FROM t").rows()[0][0] == 1
+
+
+def test_subquery_exfiltration_denied(env):
+    admin, alice = env
+    admin.sql("CREATE TABLE secret (k INT) USING column")
+    admin.sql("INSERT INTO secret VALUES (42)")
+    admin.sql("GRANT ALL ON t TO alice")
+    with pytest.raises(PermissionError, match="secret"):
+        alice.sql("UPDATE t SET a = (SELECT max(k) FROM secret)")
+    with pytest.raises(PermissionError, match="secret"):
+        alice.sql("DELETE FROM t WHERE a IN (SELECT k FROM secret)")
+    with pytest.raises(PermissionError, match="secret"):
+        alice.sql("INSERT INTO t VALUES ((SELECT max(k) FROM secret))")
+
+
+def test_put_requires_update_priv(env):
+    admin, alice = env
+    admin.sql("CREATE TABLE kv (k INT PRIMARY KEY, v INT) USING row")
+    admin.sql("GRANT INSERT ON kv TO alice")
+    with pytest.raises(PermissionError, match="UPDATE"):
+        alice.sql("PUT INTO kv VALUES (1, 2)")
+    admin.sql("GRANT UPDATE ON kv TO alice")
+    alice.sql("PUT INTO kv VALUES (1, 2)")
+
+
+def test_grant_on_view(env):
+    admin, alice = env
+    admin.sql("CREATE VIEW tv AS SELECT a FROM t")
+    admin.sql("GRANT SELECT ON tv TO alice")
+    assert alice.sql("SELECT count(*) FROM tv").rows()[0][0] == 2
+
+
+def test_policy_composes_with_grants(env):
+    admin, alice = env
+    admin.sql("GRANT SELECT ON t TO alice")
+    admin.sql("CREATE POLICY p ON t USING a > 1")
+    assert alice.sql("SELECT count(*) FROM t").rows()[0][0] == 1
