@@ -48,6 +48,11 @@ class Properties:
     max_groups: int = 1 << 16                 # static upper bound for generic group-by output
     batches_pow2_bucketing: bool = True       # pad #batches to pow2 → fewer recompiles
 
+    # Memory (ref: SnappyUnifiedMemoryManager eviction-heap-percentage —
+    # here the budget caps cached DEVICE arrays; eviction drops them back
+    # to host, from which they rebuild on next access)
+    device_cache_bytes: int = 0               # 0 = unlimited
+
     # Cluster
     num_buckets: int = 128                    # default buckets per partitioned table (ref DDL BUCKETS)
     redundancy: int = 0

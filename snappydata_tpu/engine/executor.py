@@ -576,6 +576,8 @@ class Compiler:
                 arg = e.args[0] if e.args else None
                 if e.name == "count":
                     return _SlotRef(slot_of("count", arg), T.LONG)
+                if e.name in ("count_distinct", "approx_count_distinct"):
+                    return _SlotRef(slot_of("count_distinct", arg), T.LONG)
                 if e.name == "sum":
                     return _SlotRef(slot_of("sum", arg), expr_type(e))
                 if e.name in ("min", "max", "first", "last"):
@@ -718,6 +720,21 @@ class Compiler:
                     w = w & ~_broadcast_to_mask(dv.null, out.valid).reshape(-1)
                 if kind == "count":
                     slot_arrays.append(seg("count", w))
+                elif kind == "count_distinct":
+                    # exact: sort (group, value-bits) pairs, count group
+                    # boundaries where the value changes (sort-based
+                    # distinct — no hash table needed on TPU)
+                    vb = _key_bits(v)
+                    gw = jnp.where(w, gidx, num_groups)
+                    order = jnp.lexsort((vb, gw))
+                    g_s = gw[order]
+                    v_s = vb[order]
+                    new = jnp.ones_like(g_s, dtype=bool)
+                    new = new.at[1:].set((g_s[1:] != g_s[:-1])
+                                         | (v_s[1:] != v_s[:-1]))
+                    slot_arrays.append(jax.ops.segment_sum(
+                        new.astype(jnp.int64), g_s,
+                        num_segments=num_groups + 1))
                 elif kind == "sum":
                     acc = v.astype(_acc_dtype(dv.dtype))
                     slot_arrays.append(seg("sum", jnp.where(w, acc, 0)))

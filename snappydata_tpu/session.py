@@ -71,13 +71,14 @@ class SnappySession:
 
     def sql(self, sql_text: str, params: Sequence[Any] = ()) -> Result:
         stmt = parse(sql_text)
-        # authorize BEFORE journaling: a denied statement must never reach
-        # the WAL (replay runs as admin and would apply it — review finding)
-        self._authorize(stmt)
         ds = self.disk_store
         if ds is not None and isinstance(
                 stmt, (ast.InsertInto, ast.UpdateStmt, ast.DeleteStmt,
                        ast.TruncateTable)):
+            # authorize BEFORE journaling: a denied statement must never
+            # reach the WAL (replay runs as admin and would apply it);
+            # non-journaled paths authorize once in execute_statement
+            self._authorize(stmt)
             # journal BEFORE applying, under the mutation lock shared with
             # checkpoints (WAL invariant: on-disk log ≥ in-memory state)
             table = getattr(stmt, "table", None) or stmt.name
@@ -180,6 +181,10 @@ class SnappySession:
                     idxs.pop(iname)
                     getattr(self.catalog, "_aux_ddl", {}).pop(
                         f"index:{iname}", None)
+                # grants must not survive onto a recreated namesake table
+                grants = getattr(self.catalog, "_grants", {})
+                for gk in [k for k in grants if k[1] == tname]:
+                    grants.pop(gk)
             return _status()
         if isinstance(stmt, ast.TruncateTable):
             self.catalog.describe(stmt.name).data.truncate()
@@ -802,6 +807,7 @@ class SnappySession:
         stmt = parse(sql_text)
         if not isinstance(stmt, ast.Query):
             raise ValueError("approx_sql expects a query")
+        self._authorize(stmt)  # same privileges as the exact query
         rewritten = approx_rewrite(stmt.plan, self.catalog)
         if rewritten is None:
             return self._run_query(stmt.plan, tuple(params))
@@ -814,6 +820,7 @@ class SnappySession:
         SnappyContextFunctions.createTopK :42)."""
         from snappydata_tpu.aqp.sketches import TopKSummary
 
+        self._require(base_table, "select")
         base = self.catalog.describe(base_table)
         ci = base.schema.index(key_column)
         topk = TopKSummary(k=k)
@@ -841,6 +848,9 @@ class SnappySession:
         topk = getattr(self.catalog, "_topks", {}).get(name.lower())
         if topk is None:
             raise ValueError(f"no such TopK: {name}")
+        defs = getattr(self.catalog, "_topk_defs", {}).get(name.lower())
+        if defs is not None:
+            self._require(defs["base_table"], "select")
         items = topk.top(n)
         return Result(
             ["key", "estimated_count"],

@@ -116,6 +116,10 @@ def expr_type(e: ast.Expr) -> T.DataType:
         return T.DOUBLE
     if isinstance(e, ast.Func):
         low = e.name
+        if low in ("count_distinct", "approx_count_distinct") \
+                and len(e.args) > 1:
+            raise AnalysisError(
+                "multi-column COUNT(DISTINCT a, b) is not supported yet")
         if low in ("count", "count_distinct", "approx_count_distinct"):
             return T.LONG
         if low in ("avg", "stddev", "variance"):

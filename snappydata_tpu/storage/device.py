@@ -169,6 +169,9 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                           _place(null_mask) if any_null else None)
         columns[ci], stats_min[ci], stats_max[ci], nulls[ci] = cache[key]
 
+    if _cache_budget.enabled():
+        _cache_budget.touch(data._device_cache, cache_key,
+                            _entry_bytes(cache))
     return DeviceTable(schema, b, cap, cache["valid"], columns, dicts,
                        stats_min, stats_max, manifest.total_rows(), nulls)
 
@@ -177,3 +180,64 @@ def data_pow2() -> bool:
     from snappydata_tpu import config
 
     return config.global_properties().batches_pow2_bucketing
+
+
+class _DeviceCacheBudget:
+    """Process-wide accounting of cached device arrays with LRU eviction
+    (ref: SnappyUnifiedMemoryManager evicting regions to disk under
+    memory pressure — here eviction drops device copies back to host,
+    from which they rebuild transparently on next bind)."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        # (id(table_cache_dict), cache_key) -> (bytes, tick, cache_ref)
+        self._entries: Dict = {}
+        self._tick = 0
+
+    def _budget(self) -> int:
+        from snappydata_tpu import config
+
+        return config.global_properties().device_cache_bytes
+
+    def enabled(self) -> bool:
+        return self._budget() > 0
+
+    def touch(self, table_cache: Dict, cache_key, nbytes: int) -> None:
+        budget = self._budget()
+        if budget <= 0:
+            return
+        with self._lock:
+            self._tick += 1
+            # strong ref to the owning cache dict: it lives with its table
+            # anyway, and eviction empties it (bounded residue)
+            self._entries[(id(table_cache), repr(cache_key))] = (
+                nbytes, self._tick, table_cache, cache_key)
+            total = sum(e[0] for e in self._entries.values())
+            if total <= budget:
+                return
+            from snappydata_tpu.observability.metrics import global_registry
+
+            for key, (b, _, owner, ck) in sorted(
+                    self._entries.items(), key=lambda kv: kv[1][1]):
+                if total <= budget:
+                    break
+                owner.pop(ck, None)  # device arrays released
+                self._entries.pop(key, None)
+                total -= b
+                global_registry().inc("device_cache_evictions")
+
+
+_cache_budget = _DeviceCacheBudget()
+
+
+def _entry_bytes(dt_cols: Dict) -> int:
+    total = 0
+    for v in dt_cols.values():
+        if isinstance(v, tuple):
+            arrs = [x for x in v if hasattr(x, "nbytes")]
+        else:
+            arrs = [v] if hasattr(v, "nbytes") else []
+        total += sum(int(a.nbytes) for a in arrs)
+    return total

@@ -258,6 +258,44 @@ def test_date_functions_and_literals(s):
     assert out.rows()[0][0] == 1  # only 2020-03-15 precedes 2020-12-02
 
 
+def test_count_distinct_on_device(s):
+    from snappydata_tpu.observability.metrics import global_registry
+
+    s.sql("CREATE TABLE cd (g STRING, v INT) USING column")
+    rng = np.random.default_rng(3)
+    s.insert_arrays("cd", [
+        np.array(["x", "y"], dtype=object)[rng.integers(0, 2, 20000)],
+        rng.integers(0, 250, 20000).astype(np.int32)])
+    before = global_registry().counter("host_fallbacks")
+    out = s.sql("SELECT g, count(DISTINCT v) FROM cd GROUP BY g ORDER BY g")
+    assert [r[1] for r in out.rows()] == [250, 250]
+    assert global_registry().counter("host_fallbacks") == before
+    assert s.sql("SELECT count(DISTINCT g) FROM cd").rows()[0][0] == 2
+
+
+def test_device_cache_eviction_budget(s):
+    from snappydata_tpu import config
+    from snappydata_tpu.observability.metrics import global_registry
+
+    config.global_properties().device_cache_bytes = 1_000_000
+    try:
+        for i in range(4):
+            s.sql(f"CREATE TABLE ev{i} (a BIGINT) USING column")
+            s.insert_arrays(f"ev{i}",
+                            [np.arange(60_000, dtype=np.int64)])
+        before = global_registry().counter("device_cache_evictions")
+        for i in range(4):
+            assert s.sql(f"SELECT sum(a) FROM ev{i}").rows()[0][0] == \
+                sum(range(60_000))
+        assert global_registry().counter("device_cache_evictions") > before
+        # evicted caches rebuild transparently and stay correct
+        for i in range(4):
+            assert s.sql(f"SELECT count(*) FROM ev{i}").rows()[0][0] == \
+                60_000
+    finally:
+        config.global_properties().device_cache_bytes = 0
+
+
 def test_batch_skipping_stats(s):
     """Stats-based batch pruning (ref columnBatchesSkipped) must not
     change results and must actually skip."""
