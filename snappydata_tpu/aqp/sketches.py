@@ -63,6 +63,64 @@ class CountMinSketch:
         return out
 
 
+class TimeDecayedTopK:
+    """Time-axis TopK (ref: the reference pairs TopK with Hokusai for its
+    time dimension; TopK trait core/.../execution/TopK.scala:23 exposes
+    start/end-time queries). One CMS + space-saving summary per time
+    bucket; memory is bounded by evicting the oldest buckets past
+    `max_buckets`. (Hokusai's width-halving ladder — degrading old
+    buckets instead of dropping them — is a later refinement.)"""
+
+    def __init__(self, k: int = 50, bucket_seconds: int = 60,
+                 max_buckets: int = 64, cms_width: int = 2048):
+        self.k = k
+        self.bucket_seconds = bucket_seconds
+        self.max_buckets = max_buckets
+        self.cms_width = cms_width
+        self._buckets: Dict[int, TopKSummary] = {}
+        self._lock = threading.Lock()
+
+    def _bucket_of(self, ts: float) -> int:
+        return int(ts // self.bucket_seconds)
+
+    def observe(self, keys: Sequence, timestamps: Sequence,
+                counts: Optional[Sequence] = None) -> None:
+        keys = np.asarray(keys)
+        ts = np.asarray(timestamps, dtype=np.float64)
+        cnt = np.ones(len(keys), dtype=np.int64) if counts is None \
+            else np.asarray(counts, dtype=np.int64)
+        buckets = (ts // self.bucket_seconds).astype(np.int64)
+        with self._lock:
+            for b in np.unique(buckets):
+                mask = buckets == b
+                summ = self._buckets.get(int(b))
+                if summ is None:
+                    summ = TopKSummary(k=self.k, cms_width=self.cms_width)
+                    self._buckets[int(b)] = summ
+                summ.observe(keys[mask], cnt[mask])
+            # bound memory: drop buckets beyond max_buckets (oldest first)
+            if len(self._buckets) > self.max_buckets:
+                for b in sorted(self._buckets)[:-self.max_buckets]:
+                    del self._buckets[b]
+
+    def top(self, n: Optional[int] = None, start_time: Optional[float] = None,
+            end_time: Optional[float] = None) -> List[Tuple[object, int]]:
+        """TopK over a time range (ref queryTopK(name, start, end))."""
+        n = n or self.k
+        lo = self._bucket_of(start_time) if start_time is not None else None
+        hi = self._bucket_of(end_time) if end_time is not None else None
+        merged: Dict = {}
+        with self._lock:
+            for b, summ in self._buckets.items():
+                if lo is not None and b < lo:
+                    continue
+                if hi is not None and b > hi:
+                    continue
+                for key, c in summ.top(summ.k * 4):
+                    merged[key] = merged.get(key, 0) + c
+        return sorted(merged.items(), key=lambda kv: -kv[1])[:n]
+
+
 class TopKSummary:
     """Space-saving top-K over a key column, CMS-backed counts for keys
     evicted from the monitored set (the reference pairs StreamSummary with
@@ -70,6 +128,7 @@ class TopKSummary:
 
     def __init__(self, k: int = 50, cms_depth: int = 5, cms_width: int = 2048):
         self.k = k
+        self.cms_width = cms_width
         self.cms = CountMinSketch(cms_depth, cms_width)
         self._counts: Dict = {}
         self._lock = threading.Lock()

@@ -95,6 +95,13 @@ class SnappySession:
             if isinstance(stmt, ast.CreateTable):
                 if not hasattr(self.catalog, "_view_ddl"):
                     self.catalog._view_ddl = {}
+                if stmt.stream:
+                    # stream feeds re-register on recovery via DDL replay
+                    # (review finding: tables silently stopped being fed)
+                    if not hasattr(self.catalog, "_aux_ddl"):
+                        self.catalog._aux_ddl = {}
+                    self.catalog._aux_ddl[
+                        f"stream:{stmt.name.lower()}"] = sql_text
                 ds.save_catalog(self.catalog)
                 if stmt.as_select is not None:
                     # CTAS rows exist only in memory: checkpoint the new
@@ -105,6 +112,8 @@ class SnappySession:
                             ds.checkpoint_table(info, ds.current_wal_seq())
             elif isinstance(stmt, ast.DropTable):
                 ds.drop_table_dir(_norm(stmt.name))
+                getattr(self.catalog, "_aux_ddl", {}).pop(
+                    f"stream:{_norm(stmt.name)}", None)
                 ds.save_catalog(self.catalog)
             elif isinstance(stmt, ast.CreateView):
                 if not hasattr(self.catalog, "_view_ddl"):
@@ -185,6 +194,23 @@ class SnappySession:
                 grants = getattr(self.catalog, "_grants", {})
                 for gk in [k for k in grants if k[1] == tname]:
                     grants.pop(gk)
+                # stream tables: stop the feeding query
+                stream = getattr(self.catalog, "_streams", {}).pop(tname,
+                                                                   None)
+                if stream is not None:
+                    stream.stop()
+                # TopKs over the dropped table: deregister (a persisted
+                # stale def would crash recovery — review finding)
+                defs = getattr(self.catalog, "_topk_defs", {})
+                for nm in [n for n, d in defs.items()
+                           if d["base_table"] == tname]:
+                    defs.pop(nm)
+                    getattr(self.catalog, "_topks", {}).pop(nm, None)
+                # sample maintainers of/over the dropped table
+                maints = getattr(self.catalog, "_sample_maintainers", {})
+                for nm in [n for n, m in maints.items()
+                           if n == tname or m.base_info.name == tname]:
+                    maints.pop(nm)
             return _status()
         if isinstance(stmt, ast.TruncateTable):
             self.catalog.describe(stmt.name).data.truncate()
@@ -231,6 +257,8 @@ class SnappySession:
             return _status()
         if isinstance(stmt, ast.ExecCode):
             return self._exec_code(stmt.code)
+        if isinstance(stmt, ast.ExplainStmt):
+            return self._explain(stmt.query)
         if isinstance(stmt, ast.CreatePolicy):
             info = self.catalog.describe(stmt.table)
             for node in ast.walk(stmt.using):
@@ -279,6 +307,59 @@ class SnappySession:
             self.catalog.describe(entry[0]).data.drop_index(stmt.name)
             return _status()
         raise ValueError(f"unsupported statement {type(stmt).__name__}")
+
+    def _explain(self, plan: ast.Plan) -> Result:
+        """EXPLAIN: optimized + resolved plan tree, one node per line
+        (ref: the plan info SnappySQLListener feeds the SQL UI)."""
+        from snappydata_tpu.sql.optimizer import optimize
+        from snappydata_tpu.sql.analyzer import _expr_name
+
+        plan = self._decorrelate(plan)
+        optimized = optimize(plan, self.catalog)
+        resolved, _ = self.analyzer.analyze_plan(optimized)
+        lines: List[str] = []
+
+        def describe(p: ast.Plan) -> str:
+            if isinstance(p, ast.Relation):
+                info = self.catalog.lookup_table(p.name)
+                extra = ""
+                if info is not None and info.partition_by:
+                    extra = f" partition_by={','.join(info.partition_by)}"
+                return f"Scan {p.name}{extra}"
+            if isinstance(p, ast.Filter):
+                return "Filter"
+            if isinstance(p, ast.Project):
+                return ("Project [" +
+                        ", ".join(_expr_name(e) for e in p.exprs) + "]")
+            if isinstance(p, ast.WindowProject):
+                return "WindowProject (host)"
+            if isinstance(p, ast.Aggregate):
+                keys = ", ".join(_expr_name(g) for g in p.group_exprs)
+                return f"HashAggregate keys=[{keys}]"
+            if isinstance(p, ast.Join):
+                return f"Join {p.how} (sort+searchsorted)"
+            if isinstance(p, ast.Sort):
+                return "Sort (host)"
+            if isinstance(p, ast.Limit):
+                return f"Limit {p.n}"
+            if isinstance(p, ast.Distinct):
+                return "Distinct (host)"
+            if isinstance(p, ast.Union):
+                return "Union"
+            if isinstance(p, ast.SubqueryAlias):
+                return f"SubqueryAlias {p.alias}"
+            if isinstance(p, ast.Values):
+                return f"Values ({len(p.rows)} rows)"
+            return type(p).__name__
+
+        def walk_plan(p: ast.Plan, depth: int) -> None:
+            lines.append("  " * depth + describe(p))
+            for k in p.children():
+                walk_plan(k, depth + 1)
+
+        walk_plan(resolved, 0)
+        return Result(["plan"], [np.array(lines, dtype=object)],
+                      [None], [T.STRING])
 
     def _exec_code(self, code: str) -> Result:
         """EXEC PYTHON: per-session interpreter namespace persisting across
@@ -462,6 +543,8 @@ class SnappySession:
     def _create_table(self, stmt: ast.CreateTable) -> Result:
         if stmt.provider == "sample":
             return self._create_sample_table(stmt)
+        if stmt.stream:
+            return self._create_stream_table(stmt)
         if stmt.as_select is not None:
             if stmt.if_not_exists and \
                     self.catalog.lookup_table(stmt.name) is not None:
@@ -512,6 +595,10 @@ class SnappySession:
             return
         if isinstance(stmt, ast.Query):
             for t in _referenced_tables(stmt.plan):
+                self._require(t, "select")
+            return
+        if isinstance(stmt, ast.ExplainStmt):
+            for t in _referenced_tables(stmt.query):
                 self._require(t, "select")
             return
         if isinstance(stmt, ast.InsertInto):
@@ -768,6 +855,62 @@ class SnappySession:
         self.register_sample(info)
         return _status()
 
+    def _create_stream_table(self, stmt: ast.CreateTable) -> Result:
+        """CREATE STREAM TABLE name (schema) USING file_stream|memory_stream
+        OPTIONS (directory '...', interval '...', conflation 'true',
+        key_columns '...') — a queryable table continuously fed by a
+        micro-batch source (ref: stream DDL SnappyDDLParser.scala:716 and
+        the stream sources in core/.../sql/streaming; exactly-once via the
+        sink state table)."""
+        from snappydata_tpu.streaming import FileSource, MemorySource
+        from snappydata_tpu.streaming.query import StreamingQuery
+
+        opts = {k.lower(): str(v) for k, v in stmt.options.items()}
+        schema = T.Schema([T.Field(c.name, c.dtype, c.nullable)
+                           for c in stmt.columns])
+        keys = tuple(c.name for c in stmt.columns if c.primary_key)
+        provider = stmt.provider if stmt.provider in ("file_stream",
+                                                      "memory_stream") \
+            else opts.get("provider", "memory_stream")
+        if not hasattr(self.catalog, "_streams"):
+            self.catalog._streams = {}
+        tname = stmt.name.lower()
+        if tname in self.catalog._streams:
+            if stmt.if_not_exists:
+                return _status()  # keep the running query; don't leak one
+            raise ValueError(f"stream table already exists: {stmt.name}")
+        # validate options BEFORE creating storage (a failed CREATE must
+        # not leave an orphan table — review finding)
+        interval = float(opts.get("interval", "0.1"))
+        if provider == "file_stream":
+            directory = opts.get("directory")
+            if not directory:
+                raise ValueError(
+                    "file_stream requires OPTIONS (directory '...')")
+            source = FileSource(directory, schema.names())
+        else:
+            source = MemorySource()
+        # backing storage: a normal column table holding the stream's
+        # materialized contents (queryable like any table); if_not_exists
+        # also covers recovery, where the table was already restored
+        self.catalog.create_table(stmt.name, schema, "column", stmt.options,
+                                  if_not_exists=True, key_columns=keys)
+        query = StreamingQuery(
+            self, f"stream_{tname}", source, stmt.name,
+            conflation=opts.get("conflation", "false").lower() == "true",
+            interval_s=interval)
+        self.catalog._streams[tname] = query
+        query.start()
+        return _status()
+
+    def stream_source(self, table: str):
+        """The MemorySource feeding a memory_stream table (programmatic
+        batch injection)."""
+        q = getattr(self.catalog, "_streams", {}).get(table.lower())
+        if q is None:
+            raise ValueError(f"not a stream table: {table}")
+        return q.source
+
     def register_sample(self, info) -> None:
         """(Re)wire a sample table's reservoir + base-table feed — also
         called on recovery (review finding: samples froze after restart)."""
@@ -815,26 +958,37 @@ class SnappySession:
         return self._run_query(rewritten, tuple(params))
 
     def create_topk(self, name: str, base_table: str, key_column: str,
-                    k: int = 50) -> None:
+                    k: int = 50, time_column: Optional[str] = None,
+                    bucket_seconds: int = 60) -> None:
         """Register a TopK structure fed by base-table inserts (ref:
-        SnappyContextFunctions.createTopK :42)."""
-        from snappydata_tpu.aqp.sketches import TopKSummary
+        SnappyContextFunctions.createTopK :42). With `time_column`, a
+        Hokusai-style time-bucketed TopK supporting start/end-time
+        queries (ref TopK trait time axis, TopK.scala:23)."""
+        from snappydata_tpu.aqp.sketches import TimeDecayedTopK, TopKSummary
 
         self._require(base_table, "select")
         base = self.catalog.describe(base_table)
         ci = base.schema.index(key_column)
-        topk = TopKSummary(k=k)
+        ti = base.schema.index(time_column) if time_column else None
+        topk = TimeDecayedTopK(k=k, bucket_seconds=bucket_seconds) \
+            if time_column else TopKSummary(k=k)
         if not hasattr(self.catalog, "_topks"):
             self.catalog._topks = {}
             self.catalog._topk_defs = {}
         self.catalog._topks[name.lower()] = topk
         self.catalog._topk_defs[name.lower()] = {
-            "base_table": base.name, "key_column": key_column.lower(), "k": k}
+            "base_table": base.name, "key_column": key_column.lower(),
+            "k": k, "time_column": time_column.lower() if time_column
+            else None, "bucket_seconds": bucket_seconds}
         if self.disk_store is not None:
             self.disk_store.save_catalog(self.catalog)
 
-        def feed(arrays, nulls=None, _ci=ci, _t=topk):
-            _t.observe(np.asarray(arrays[_ci]))
+        def feed(arrays, nulls=None, _ci=ci, _ti=ti, _t=topk):
+            if _ti is None:
+                _t.observe(np.asarray(arrays[_ci]))
+            else:
+                _t.observe(np.asarray(arrays[_ci]),
+                           np.asarray(arrays[_ti], dtype=np.float64))
 
         base.data.on_insert.append(feed)
         from snappydata_tpu.engine.hosteval import _eval_rel
@@ -842,16 +996,27 @@ class SnappySession:
         cols, _, _, _, n = _eval_rel(
             ast.Relation(base.name, base.schema), (), self.executor)
         if n:
-            topk.observe(cols[ci])
+            if ti is None:
+                topk.observe(cols[ci])
+            else:
+                topk.observe(cols[ci],
+                             np.asarray(cols[ti], dtype=np.float64))
 
-    def query_topk(self, name: str, n: Optional[int] = None) -> Result:
+    def query_topk(self, name: str, n: Optional[int] = None,
+                   start_time: Optional[float] = None,
+                   end_time: Optional[float] = None) -> Result:
         topk = getattr(self.catalog, "_topks", {}).get(name.lower())
         if topk is None:
             raise ValueError(f"no such TopK: {name}")
         defs = getattr(self.catalog, "_topk_defs", {}).get(name.lower())
         if defs is not None:
             self._require(defs["base_table"], "select")
-        items = topk.top(n)
+        from snappydata_tpu.aqp.sketches import TimeDecayedTopK
+
+        if isinstance(topk, TimeDecayedTopK):
+            items = topk.top(n, start_time=start_time, end_time=end_time)
+        else:
+            items = topk.top(n)
         return Result(
             ["key", "estimated_count"],
             [np.array([k for k, _ in items], dtype=object),

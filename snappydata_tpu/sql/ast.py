@@ -472,6 +472,7 @@ class CreateTable(Statement):
     as_select: Optional[Plan] = None
     if_not_exists: bool = False
     temporary: bool = False
+    stream: bool = False  # CREATE STREAM TABLE (ref SnappyDDLParser:716)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -568,6 +569,14 @@ class CreateIndex(Statement):
 class DropIndex(Statement):
     name: str
     if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class ExplainStmt(Statement):
+    """EXPLAIN <query> — resolved/optimized plan tree (ref: plan info the
+    SnappySQLListener surfaces to the UI)."""
+
+    query: object = None  # ast.Plan
 
 
 @dataclasses.dataclass(frozen=True)

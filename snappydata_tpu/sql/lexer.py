@@ -30,7 +30,7 @@ KEYWORDS = {
     "describe", "interval", "date", "timestamp", "true", "false",
     "primary", "key", "options", "external", "sample", "stream", "policy",
     "index", "alter", "add", "column", "deploy", "undeploy", "grant",
-    "revoke", "with", "to", "exec", "scala", "over",
+    "revoke", "with", "to", "exec", "scala", "over", "explain",
 }
 
 _TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "||"}

@@ -126,6 +126,10 @@ class Parser:
             return self._finishing(self.set_stmt())
         if low in ("grant", "revoke"):
             return self._finishing(self.grant_revoke_stmt(low))
+        if low == "explain":
+            self.next()
+            plan = self.query_expr()
+            return self._finishing(ast.ExplainStmt(plan))
         if low == "exec":
             self.next()
             lang = self.peek()
@@ -649,6 +653,7 @@ class Parser:
             return ast.CreateIndex(name, table, tuple(cols), if_not_exists)
         self.accept_kw("external")
         sample = self.accept_kw("sample")
+        stream = self.accept_kw("stream")
         self.expect_kw("table")
         if_not_exists = False
         if self.accept_kw("if"):
@@ -676,7 +681,8 @@ class Parser:
         if self.accept_kw("as"):
             as_select = self.query_expr()
         return ast.CreateTable(name, tuple(columns), provider, options,
-                               as_select, if_not_exists, temporary)
+                               as_select, if_not_exists, temporary,
+                               stream=stream)
 
     def column_defs(self) -> List[ast.ColumnDef]:
         self.expect_op("(")

@@ -70,6 +70,7 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
               if k != cache_key and not (k[1] == cache_key[1]
                                          and k[0] >= manifest.version - 1)]:
         data._device_cache.pop(k, None)
+        _cache_budget.forget(data._device_cache, k)
 
     schema = data.schema
     cap = data.capacity
@@ -203,6 +204,13 @@ class _DeviceCacheBudget:
 
     def enabled(self) -> bool:
         return self._budget() > 0
+
+    def forget(self, table_cache: Dict, cache_key) -> None:
+        """Version pruning dropped this entry: stop counting its bytes
+        (otherwise every rebuild inflated the budget and evicted
+        innocents)."""
+        with self._lock:
+            self._entries.pop((id(table_cache), repr(cache_key)), None)
 
     def touch(self, table_cache: Dict, cache_key, nbytes: int) -> None:
         budget = self._budget()

@@ -468,7 +468,9 @@ class DiskStore:
             session.register_sample(info)
         for name, d in (meta.get("topks") or {}).items():
             session.create_topk(name, d["base_table"], d["key_column"],
-                                k=d.get("k", 50))
+                                k=d.get("k", 50),
+                                time_column=d.get("time_column"),
+                                bucket_seconds=d.get("bucket_seconds", 60))
         return catalog
 
     def _load_table_data(self, info) -> int:

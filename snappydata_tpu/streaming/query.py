@@ -61,16 +61,33 @@ class FileSource(Source):
             return None
         path = os.path.join(self.directory, files[offset])
         rows = []
+        skipped = 0
         with open(path) as fh:
             for line in fh:
                 line = line.strip()
-                if line:
+                if not line:
+                    continue
+                try:
                     rows.append(json.loads(line))
+                except ValueError:
+                    # poison line: skip rather than wedging the stream on
+                    # the same offset forever (log-tailing semantics)
+                    skipped += 1
+        if skipped:
+            import sys
+
+            print(f"warning: {path}: skipped {skipped} malformed line(s)",
+                  file=sys.stderr)
         cols = {n: np.array([r.get(n) for r in rows]) for n in self.names}
         for extra in ("_eventType",):
             if rows and extra in rows[0]:
                 cols[extra] = np.array([r[extra] for r in rows])
         return cols, offset + 1
+
+
+def _batch_empty(columns) -> bool:
+    return not columns or all(len(np.asarray(v)) == 0
+                              for v in columns.values())
 
 
 class StreamingQuery:
@@ -112,6 +129,9 @@ class StreamingQuery:
             columns, new_offset = got
             if self.transform is not None:
                 columns = self.transform(columns)
+            if _batch_empty(columns):
+                offset = new_offset  # nothing to apply; just advance
+                continue
             try:
                 self.sink.process_batch(offset, columns)
                 self.batches_processed += 1
@@ -132,7 +152,8 @@ class StreamingQuery:
             columns, new_offset = got
             if self.transform is not None:
                 columns = self.transform(columns)
-            if self.sink.process_batch(offset, columns):
+            if not _batch_empty(columns) and \
+                    self.sink.process_batch(offset, columns):
                 applied += 1
             self.batches_processed += 1
             offset = new_offset
