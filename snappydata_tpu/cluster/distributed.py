@@ -174,6 +174,7 @@ class DistributedSession:
     # ------------------------------------------------------------------
 
     def _query(self, plan: ast.Plan):
+        plan = self._broadcast_exchange(plan)
         self._check_scatterable(plan)
         # peel ORDER BY / LIMIT: they apply after the merge
         outer: List = []
@@ -191,6 +192,121 @@ class DistributedSession:
         else:
             result = self._scatter_concat(node, outer)
         return result
+
+    def _broadcast_exchange(self, plan: ast.Plan) -> ast.Plan:
+        """Joins of non-collocated partitioned tables: ship the SMALLER
+        side to every server as a temporary replicated table, making the
+        join shard-local — the reference's broadcast/replicated hash-join
+        exchange (HashJoinStrategies size threshold; here bounded by
+        `broadcast_rows`). Leaves the plan unchanged when tables are
+        already collocated/replicated or both sides are too big."""
+        broadcast_rows = 500_000
+        tables: Dict[str, object] = {}
+
+        def rec(p):
+            if isinstance(p, ast.UnresolvedRelation):
+                info = self.planner.catalog.lookup_table(p.name)
+                if info is not None:
+                    tables[info.name] = info
+            for k in p.children():
+                rec(k)
+
+        rec(plan)
+        partitioned = [t for t in tables.values() if t.partition_by]
+        if len(partitioned) <= 1:
+            return plan
+        try:
+            self._check_scatterable(plan)
+            return plan  # already collocated: no exchange needed
+        except DistributedError:
+            pass
+        # outer joins: a broadcast PRESERVED side would null-extend on
+        # every server (duplicated rows) — keep the clear error instead
+        def has_outer(p):
+            if isinstance(p, ast.Join) and p.how in ("left", "right",
+                                                     "full"):
+                return True
+            return any(has_outer(k) for k in p.children())
+
+        if has_outer(plan):
+            return plan
+        sizes = {}
+        for t in partitioned:
+            total = 0
+            for srv in self.servers:
+                r = srv.execute(f"SELECT count(*) FROM {t.name}")
+                total += int(r["rows"][0][0]) if r.get("rows") else 0
+            sizes[t.name] = total
+        # pick the smallest table whose REMOVAL leaves the remaining
+        # partitioned tables mutually collocated (review finding: the
+        # globally-smallest choice could leave the conflict in place)
+        name = None
+        for cand, size in sorted(sizes.items(), key=lambda kv: kv[1]):
+            if size > broadcast_rows:
+                break
+            remaining = [t for t in partitioned if t.name != cand]
+            if self._mutually_collocated(remaining):
+                name = cand
+                break
+        if name is None:
+            return plan  # no single broadcast resolves it → clear error
+        size = sizes[name]
+        # materialize the small table on the lead and replicate it;
+        # cached by (table, global row count) so repeat queries over an
+        # unchanged table reuse the existing replica (review finding)
+        tmp = f"__bcast_{name}"
+        if not hasattr(self, "_bcast_cache"):
+            self._bcast_cache = {}
+        if self._bcast_cache.get(name) != size:
+            import pyarrow as pa
+
+            pieces = [srv.sql(f"SELECT * FROM {name}")
+                      for srv in self.servers]
+            merged = pa.concat_tables(pieces)
+            info = self.planner.catalog.describe(name)
+            ddl_cols = ", ".join(
+                f"{f.name} {_ddl_type(f.dtype)}"
+                for f in info.schema.fields)
+            self.sql(f"DROP TABLE IF EXISTS {tmp}")
+            self.sql(f"CREATE TABLE {tmp} ({ddl_cols}) USING column")
+            from snappydata_tpu.cluster.flight_server import arrow_to_arrays
+
+            arrays, nulls = arrow_to_arrays(merged)
+            if merged.num_rows:
+                self.insert_arrays(tmp, arrays, nulls=nulls)
+            self._bcast_cache[name] = size
+
+        def rename(p):
+            import dataclasses as _dc
+
+            if isinstance(p, ast.UnresolvedRelation):
+                from snappydata_tpu.catalog.catalog import _norm
+
+                if _norm(p.name) == name:
+                    return ast.UnresolvedRelation(
+                        tmp, alias=p.alias or p.name.split(".")[-1])
+                return p
+            kids = p.children()
+            if not kids:
+                return p
+            if isinstance(p, (ast.Join, ast.Union)):
+                return _dc.replace(p, left=rename(p.left),
+                                   right=rename(p.right))
+            return _dc.replace(p, child=rename(kids[0]))
+
+        return rename(plan)
+
+    def _mutually_collocated(self, partitioned) -> bool:
+        if len(partitioned) <= 1:
+            return True
+        roots = set()
+        for t in partitioned:
+            root = t.colocate_with or t.name
+            base = self.planner.catalog.lookup_table(root)
+            if base is not None and base.colocate_with:
+                root = base.colocate_with
+            roots.add(root)
+        return len(roots) == 1
 
     def _check_scatterable(self, plan: ast.Plan) -> None:
         """Local execution is complete iff all joined tables are mutually
@@ -376,6 +492,11 @@ class DistributedSession:
                             names=[_out_name(e) for e in agg.agg_exprs])
 
     def close(self) -> None:
+        for name in list(getattr(self, "_bcast_cache", {})):
+            try:
+                self.sql(f"DROP TABLE IF EXISTS __bcast_{name}")
+            except Exception:
+                pass
         for srv in self.servers:
             srv.close()
 
@@ -437,6 +558,13 @@ def _apply_outer(result, outer: List, planner, names=None):
                         "columns by name or position")
             result = hosteval.sort(result, orders, ())
     return result
+
+
+def _ddl_type(dt) -> str:
+    return {"string": "STRING", "int": "INT", "long": "BIGINT",
+            "double": "DOUBLE", "float": "REAL", "boolean": "BOOLEAN",
+            "date": "DATE", "timestamp": "TIMESTAMP", "short": "SMALLINT",
+            "byte": "TINYINT", "decimal": "DOUBLE"}.get(dt.name, "DOUBLE")
 
 
 def _sql_type(field) -> str:

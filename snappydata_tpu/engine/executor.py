@@ -356,6 +356,10 @@ class Compiler:
             self._prune_cursor += 1
             used = sorted(pruned) if pruned is not None \
                 else list(range(len(info.schema)))
+            for uci in used:
+                if info.schema.fields[uci].dtype.name == "array":
+                    raise CompileError(
+                        "ARRAY columns evaluate on the host path")
             rel_idx = len(self.relations)
             self.relations.append(_RelationInput(info, used))
             scope = [

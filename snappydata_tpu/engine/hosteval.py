@@ -232,6 +232,49 @@ def _eval_func(e: ast.Func, cols, nulls, params, n):
         v, nl = args[0]
         return np.array([len(str(x)) if x is not None else 0 for x in v],
                         dtype=np.int32), nl
+    if name == "array":
+        vs = [np.broadcast_to(a[0], (n,)) for a in args]
+        nls = [a[1] for a in args]
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            out[i] = [None if (nls[j] is not None
+                               and np.broadcast_to(nls[j], (n,))[i])
+                      else _plain(vs[j][i]) for j in range(len(vs))]
+        return out, None
+    if name == "size":
+        v, nl = args[0]
+        out = np.array([len(x) if isinstance(x, (list, tuple)) else -1
+                        for x in np.broadcast_to(v, (n,))], dtype=np.int32)
+        return out, nl
+    if name == "array_contains":
+        v, nl = args[0]
+        needle = np.broadcast_to(args[1][0], (n,))
+        needle_null = args[1][1]
+        out = np.array(
+            [isinstance(x, (list, tuple)) and _plain(needle[i]) in x
+             for i, x in enumerate(np.broadcast_to(v, (n,)))])
+        combined = nl
+        if needle_null is not None:
+            nn = np.broadcast_to(needle_null, (n,))
+            combined = nn if combined is None else (combined | nn)
+        return out, combined
+    if name == "element_at":
+        v, nl = args[0]
+        idx = np.broadcast_to(args[1][0], (n,))
+        vals = []
+        nulls_out = np.zeros(n, dtype=bool)
+        for i, x in enumerate(np.broadcast_to(v, (n,))):
+            k = int(idx[i]) - 1  # element_at is 1-based
+            if isinstance(x, (list, tuple)) and 0 <= k < len(x):
+                vals.append(x[k])
+                nulls_out[i] = x[k] is None
+            else:
+                vals.append(None)
+                nulls_out[i] = True
+        out = np.array(vals, dtype=object)
+        if nl is not None:
+            nulls_out |= np.broadcast_to(nl, (n,))
+        return out, (nulls_out if nulls_out.any() else None)
     if name == "concat":
         vs = [np.broadcast_to(a[0], (n,)) for a in args]
         nl = None
@@ -244,6 +287,10 @@ def _eval_func(e: ast.Func, cols, nulls, params, n):
 
 def _to_str(x):
     return None if x is None else str(x)
+
+
+def _plain(x):
+    return x.item() if hasattr(x, "item") else x
 
 
 def _or_null(a, b):
@@ -268,12 +315,17 @@ def limit(result: Result, k: int) -> Result:
                   result.dtypes)
 
 
+def _hashable(row):
+    return tuple(tuple(v) if isinstance(v, list) else v for v in row)
+
+
 def distinct(result: Result) -> Result:
     seen = set()
     keep = []
     for i, row in enumerate(result.rows()):
-        if row not in seen:
-            seen.add(row)
+        key = _hashable(row)
+        if key not in seen:
+            seen.add(key)
             keep.append(i)
     idx = np.array(keep, dtype=np.int64)
     return _take(result, idx)
@@ -378,8 +430,12 @@ def eval_values(node: ast.Values, params) -> Result:
                 vals.append(None)
             else:
                 vals.append(v)
-        if dt.name == "string":
-            arr = np.array(vals, dtype=object)
+        if dt.name in ("string", "array") or dt.np_dtype == object:
+            # element-wise: np.array() would turn equal-length lists
+            # into a 2-D array and strip their list-ness
+            arr = np.empty(len(vals), dtype=object)
+            for j, v in enumerate(vals):
+                arr[j] = v
         else:
             arr = np.array([0 if v is None else v for v in vals],
                            dtype=dt.np_dtype)
@@ -855,10 +911,16 @@ def _eval_aggregate(plan: ast.Aggregate, params, executor):
     for g in groups:
         v, nl = eval_expr(g, cols, nulls, params, n)
         v = np.broadcast_to(v, (n,))
-        gvals.append(np.array([None if (nl is not None and nl[i]) else
-                               (v[i] if v.dtype != object else v[i])
-                               for i in range(n)], dtype=object)
-                     if nl is not None else v)
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            if nl is not None and np.broadcast_to(nl, (n,))[i]:
+                out[i] = None
+            else:
+                x = v[i]
+                # lists are unhashable: group by their tuple form (output
+                # converts back)
+                out[i] = tuple(x) if isinstance(x, list) else x
+        gvals.append(out)
 
     if groups:
         df = pd.DataFrame({f"g{i}": g for i, g in enumerate(gvals)})
@@ -881,11 +943,15 @@ def _eval_aggregate(plan: ast.Aggregate, params, executor):
         vals, nmask = [], []
         for key, idx in zip(group_keys, group_indices):
             v = _agg_one(e, key, groups, idx, cols, nulls, params, n)
+            if isinstance(v, tuple):  # array group key: back to list form
+                v = list(v)
             nmask.append(v is None)
             vals.append(v)
         dt = out_types[-1]
-        if dt.name == "string":
-            arr = np.array(vals, dtype=object)
+        if dt.name in ("string", "array"):
+            arr = np.empty(len(vals), dtype=object)
+            for j, v in enumerate(vals):
+                arr[j] = v
         else:
             arr = np.array([0 if v is None else v for v in vals],
                            dtype=dt.np_dtype if dt.name != "decimal"

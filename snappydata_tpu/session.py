@@ -1276,8 +1276,11 @@ def _rows_to_arrays(schema: T.Schema, rows):
     for i, f in enumerate(schema.fields):
         vals = [r[i] for r in rows]
         nmask = np.array([v is None for v in vals])
-        if f.dtype.name == "string":
-            arrays.append(np.array(vals, dtype=object))
+        if f.dtype.name in ("string", "array"):
+            arr = np.empty(len(vals), dtype=object)
+            for j, v in enumerate(vals):
+                arr[j] = v
+            arrays.append(arr)
         else:
             arrays.append(np.array(
                 [0 if v is None else v for v in vals], dtype=f.dtype.np_dtype))
@@ -1297,6 +1300,14 @@ def _result_to_arrays(result: Result, schema: T.Schema):
 def _coerce(col: np.ndarray, nmask, dtype: T.DataType):
     """→ (storage array, null mask | None): NULLs become fillers + mask
     instead of being silently written as 0 (review finding)."""
+    if dtype.name == "array":
+        out = np.empty(len(col), dtype=object)
+        for i, v in enumerate(col):
+            out[i] = list(v) if isinstance(v, (list, tuple, np.ndarray)) \
+                else v
+        if nmask is not None:
+            out[np.asarray(nmask)] = None
+        return out, (np.asarray(nmask) if nmask is not None else None)
     if dtype.name == "string":
         out = np.array([_s(v) for v in col], dtype=object)
         if nmask is not None:

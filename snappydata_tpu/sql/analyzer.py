@@ -127,8 +127,18 @@ def expr_type(e: ast.Expr) -> T.DataType:
             return at if at.name == "decimal" else T.DOUBLE
         if low in ("sum", "min", "max", "first", "last", "abs", "coalesce"):
             return expr_type(e.args[0])
-        if low in ("year", "month", "day", "length", "instr"):
+        if low in ("year", "month", "day", "length", "instr", "size"):
             return T.INT
+        if low == "array":
+            elem = expr_type(e.args[0]) if e.args else T.DOUBLE
+            return T.ArrayType("array", elem)
+        if low == "array_contains":
+            return T.BOOLEAN
+        if low == "element_at":
+            at = expr_type(e.args[0])
+            if isinstance(at, T.ArrayType):
+                return at.element
+            return T.STRING
         if low in ("substr", "substring", "upper", "lower", "trim", "concat",
                    "ltrim", "rtrim"):
             return T.STRING

@@ -504,13 +504,24 @@ class Parser:
         # identifier: column ref or function call
         if t.kind in ("IDENT", "KW"):
             name = self.ident()
-            if self.at_op("(") :
-                return self.func_call(name)
+            if self.at_op("("):
+                return self._maybe_subscript(self.func_call(name))
             if self.accept_op("."):
                 col = self.ident()
-                return ast.Col(col, qualifier=name)
-            return ast.Col(name)
+                return self._maybe_subscript(ast.Col(col, qualifier=name))
+            return self._maybe_subscript(ast.Col(name))
         raise SQLSyntaxError(f"unexpected token {t.value!r} at {t.pos}")
+
+    def _maybe_subscript(self, base: ast.Expr) -> ast.Expr:
+        """a[i] → element_at(a, i+1) (SQL element_at is 1-based)."""
+        while self.accept_op("["):
+            idx = self.expr()
+            self.expect_op("]")
+            # [] uses 0-based indexing like Spark's a[i]; element_at is
+            # 1-based — normalize to element_at(a, idx + 1)
+            idx1 = ast.BinOp("+", idx, ast.Lit(1, T.INT))
+            base = ast.Func("element_at", (base, idx1))
+        return base
 
     def func_call(self, name: str) -> ast.Expr:
         self.expect_op("(")
@@ -600,6 +611,10 @@ class Parser:
 
     def type_name(self) -> T.DataType:
         name = self.ident()
+        if name.lower() == "array" and self.accept_op("<"):
+            elem = self.type_name()
+            self.expect_op(">")
+            return T.parse_type("array", element=elem)
         args = []
         if self.accept_op("("):
             while not self.at_op(")"):

@@ -32,6 +32,7 @@ class Encoding(enum.IntEnum):
     DICTIONARY = 1
     RUN_LENGTH = 2
     BOOLEAN_BITSET = 3
+    OBJECT = 4  # raw python objects (ARRAY columns; host-evaluated)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -118,6 +119,22 @@ def encode_column(values: np.ndarray, dtype: T.DataType,
     compare, and that we need globally for device-side group-by on codes.
     """
     n = int(values.shape[0])
+    if dtype.name == "array":
+        # raw object storage; queries over array columns run host-side
+        obj = np.asarray(values, dtype=object)
+        nulls_mask = np.fromiter((v is None for v in obj), dtype=np.bool_,
+                                 count=n)
+        packed = None
+        if validity is not None:
+            nulls_mask |= ~np.asarray(validity)
+        if nulls_mask.any():
+            from snappydata_tpu.storage import bitmask
+
+            packed = bitmask.pack(~nulls_mask)
+        return EncodedColumn(Encoding.OBJECT, dtype, n, obj,
+                             validity=packed,
+                             stats=ColumnStats(None, None,
+                                               int(nulls_mask.sum()), n))
     if dtype.name == "string" and validity is None:
         # derive validity from SQL NULL (None) values (vectorized)
         nulls = np.asarray(values) == None  # noqa: E711 elementwise
@@ -216,6 +233,8 @@ def decode_to_numpy(col: EncodedColumn, capacity: Optional[int] = None,
         out = col.dictionary[col.data] if strings else col.data
     elif col.encoding == Encoding.RUN_LENGTH:
         out = np.repeat(col.data, col.runs)
+    elif col.encoding == Encoding.OBJECT:
+        out = col.data
     elif col.encoding == Encoding.BOOLEAN_BITSET:
         from snappydata_tpu.storage import bitmask
 
@@ -223,7 +242,10 @@ def decode_to_numpy(col: EncodedColumn, capacity: Optional[int] = None,
     else:  # pragma: no cover
         raise ValueError(f"unknown encoding {col.encoding}")
     if cap > n:
-        pad = np.zeros(cap - n, dtype=out.dtype)
+        if out.dtype == object:
+            pad = np.full(cap - n, None, dtype=object)
+        else:
+            pad = np.zeros(cap - n, dtype=out.dtype)
         out = np.concatenate([out, pad])
     return out
 

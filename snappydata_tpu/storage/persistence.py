@@ -44,6 +44,19 @@ from snappydata_tpu.storage.table_store import (BatchView, ColumnTableData,
 _MAGIC = b"SNTP"
 
 
+def _np_json(v):
+    """json serializer for numpy scalars/arrays inside ARRAY cells."""
+    if isinstance(v, np.ndarray):
+        return v.tolist()
+    if isinstance(v, (np.integer,)):
+        return int(v)
+    if isinstance(v, (np.floating,)):
+        return float(v)
+    if isinstance(v, np.bool_):
+        return bool(v)
+    raise TypeError(f"not JSON serializable: {type(v)}")
+
+
 # --------------------------------------------------------------------------
 # array (de)serialization — no pickle, self-describing
 # --------------------------------------------------------------------------
@@ -51,6 +64,12 @@ _MAGIC = b"SNTP"
 def _arr_to_parts(arr: Optional[np.ndarray]) -> Tuple[dict, List[bytes]]:
     if arr is None:
         return {"kind": "none"}, []
+    if arr.dtype == object and any(
+            isinstance(v, (list, tuple, dict, np.ndarray))
+            for v in arr.tolist()):
+        payload = json.dumps(arr.tolist(),
+                             default=_np_json).encode("utf-8")
+        return {"kind": "json", "n": len(arr)}, [payload]
     if arr.dtype == object:  # string values → utf8 blob + offsets
         blobs = [(v if v is not None else "").encode("utf-8")
                  for v in arr.tolist()]
@@ -67,6 +86,11 @@ def _arr_to_parts(arr: Optional[np.ndarray]) -> Tuple[dict, List[bytes]]:
 def _arr_from_parts(meta: dict, parts: List[bytes]) -> Optional[np.ndarray]:
     if meta["kind"] == "none":
         return None
+    if meta["kind"] == "json":
+        out = np.empty(meta["n"], dtype=object)
+        for i, v in enumerate(json.loads(parts[0].decode("utf-8"))):
+            out[i] = v
+        return out
     if meta["kind"] == "utf8":
         n = meta["n"]
         offsets = np.frombuffer(parts[0], dtype=np.int64)

@@ -25,6 +25,8 @@ class DataType:
 
     @property
     def np_dtype(self) -> np.dtype:
+        if self.name == "array":
+            return np.dtype(object)
         return _NP[self.name]
 
     def device_dtype(self) -> np.dtype:
@@ -38,6 +40,17 @@ class DataType:
         if self.name in ("double", "float") and not config.use_float64():
             return np.dtype(np.float32)
         return self.np_dtype
+
+
+@dataclasses.dataclass(frozen=True)
+class ArrayType(DataType):
+    """ARRAY<T>: stored as python lists (host); queries referencing array
+    columns evaluate on the host path (device arrays are a later round)."""
+
+    element: "DataType" = None
+
+    def __str__(self):
+        return f"array<{self.element}>"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -90,7 +103,10 @@ _BY_NAME = {
 }
 
 
-def parse_type(name: str, args: Optional[list] = None) -> DataType:
+def parse_type(name: str, args: Optional[list] = None,
+               element: Optional[DataType] = None) -> DataType:
+    if name.lower() == "array":
+        return ArrayType("array", element or DOUBLE)
     base = _BY_NAME.get(name.lower())
     if base is None:
         raise ValueError(f"unknown data type: {name}")

@@ -127,8 +127,24 @@ def test_collocated_join_allowed_non_collocated_rejected(loaded):
     r = ds.sql("SELECT count(*), sum(i.price) FROM orders2 o "
                "JOIN items2 i ON o.ok = i.ok").rows()[0]
     assert r[0] == 50 and r[1] == pytest.approx(100.0)
-    # non-collocated partitioned join → clear error
-    ds.sql("CREATE TABLE other (x BIGINT) USING column "
+    # non-collocated partitioned join: small side broadcasts automatically
+    ds.sql("CREATE TABLE other (x BIGINT, tag STRING) USING column "
            "OPTIONS (partition_by 'x')")
-    with pytest.raises(DistributedError, match="collocat"):
-        ds.sql("SELECT count(*) FROM orders2 o JOIN other t ON o.ok = t.x")
+    ds.insert_arrays("other", [np.arange(0, 50, 2, dtype=np.int64),
+                               np.array(["t"] * 25, dtype=object)])
+    r = ds.sql("SELECT count(*) FROM orders2 o JOIN other t ON o.ok = t.x")
+    assert r.rows()[0][0] == 25  # broadcast exchange made it complete
+
+
+def test_broadcast_exchange_group_by(loaded):
+    ds, _, df = loaded
+    # tx is partitioned by k; make a small partitioned dim on another key
+    ds.sql("CREATE TABLE kdim (kk BIGINT, bucket_name STRING) USING column "
+           "OPTIONS (partition_by 'kk')")
+    kk = np.arange(0, 5000, dtype=np.int64)
+    ds.insert_arrays("kdim", [kk, np.array(
+        [f"b{k % 3}" for k in kk], dtype=object)])
+    r = ds.sql("SELECT d.bucket_name, count(*) FROM tx t JOIN kdim d "
+               "ON t.k = d.kk GROUP BY d.bucket_name ORDER BY d.bucket_name")
+    exp = df.assign(b=[f"b{k % 3}" for k in df.k]).groupby("b").size()
+    assert [(x[0], x[1]) for x in r.rows()] == list(exp.items())
