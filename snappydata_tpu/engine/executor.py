@@ -357,9 +357,9 @@ class Compiler:
             used = sorted(pruned) if pruned is not None \
                 else list(range(len(info.schema)))
             for uci in used:
-                if info.schema.fields[uci].dtype.name == "array":
+                if info.schema.fields[uci].dtype.name in ("array", "map"):
                     raise CompileError(
-                        "ARRAY columns evaluate on the host path")
+                        "complex-typed columns evaluate on the host path")
             rel_idx = len(self.relations)
             self.relations.append(_RelationInput(info, used))
             scope = [
@@ -676,12 +676,22 @@ class Compiler:
                     else:
                         fast = False
                         cards.append(None)
-                if fast and int(np.prod(cards)) <= max_groups:
-                    num_groups = int(np.prod(cards))
+                # NULL group keys form their own group (SQL semantics):
+                # a nullable key gets one extra code slot = card, claimed
+                # by rows whose key is NULL
+                eff_cards = [c + 1 if c is not None and kd.null is not None
+                             else c for c, kd in zip(cards, kdvals)]
+                if fast and int(np.prod(eff_cards)) <= max_groups:
+                    num_groups = int(np.prod(eff_cards))
                     gidx = jnp.zeros(n, dtype=jnp.int64)
-                    for kd, card in zip(kdvals, cards):
-                        kv = _broadcast_to_mask(kd.value, out.valid)
-                        gidx = gidx * card + kv.reshape(-1).astype(jnp.int64)
+                    for kd, card, ecard in zip(kdvals, cards, eff_cards):
+                        kv = _broadcast_to_mask(kd.value, out.valid) \
+                            .reshape(-1).astype(jnp.int64)
+                        if kd.null is not None:
+                            nb = _broadcast_to_mask(kd.null, out.valid) \
+                                .reshape(-1)
+                            kv = jnp.where(nb, card, kv)
+                        gidx = gidx * ecard + kv
                     key_vals = kdvals
                 else:
                     fast = False
@@ -690,7 +700,10 @@ class Compiler:
                     num_groups = min(max_groups, n)
                     combined = _combine_keys(
                         [DVal(_broadcast_to_mask(k.value, out.valid)
-                              .reshape(-1), None, k.dtype) for k in kdvals])
+                              .reshape(-1),
+                              _broadcast_to_mask(k.null, out.valid)
+                              .reshape(-1) if k.null is not None else None,
+                              k.dtype) for k in kdvals])
                     combined = jnp.where(valid, combined, _I64_MAX)
                     uniq = jnp.unique(combined, size=num_groups + 1,
                                       fill_value=_I64_MAX)
@@ -702,10 +715,6 @@ class Compiler:
                         overflow = uniq[-1] != _I64_MAX
                     gidx = jnp.searchsorted(uniq, combined)
                     key_vals = kdvals
-                # rows with any NULL group key: SQL groups them together —
-                # codes carry no null distinction here; nulls in keys are
-                # rare, keep rows (documented deviation until null-key
-                # segregation lands)
             gidx = jnp.where(valid, gidx, num_groups)
 
             seg = functools.partial(_seg_reduce, gidx=gidx,
@@ -762,22 +771,28 @@ class Compiler:
                 # empty input (count()=0, sum()=0-as-proxy-for-null)
                 gvalid = jnp.ones(1, dtype=bool)
 
-            # --- group key values per segment ---
+            # --- group key values per segment (+ per-group key null masks:
+            # the extra code slot / null-segregated hash group) ---
             key_arrays = []
+            key_nulls: List[Optional[jnp.ndarray]] = []
             if groups:
                 if fast:
                     # decode mixed-radix group index back to key codes
                     ar = jnp.arange(num_groups, dtype=jnp.int64)
                     strides = []
                     acc = 1
-                    for card in reversed([c if c else 1 for c in
-                                          _cards_of(key_infos, ctx)]):
+                    for ecard in reversed([c if c else 1 for c in eff_cards]):
                         strides.append(acc)
-                        acc *= card
+                        acc *= ecard
                     strides = list(reversed(strides))
-                    for (card, stride, kd) in zip(
-                            _cards_of(key_infos, ctx), strides, key_vals):
-                        kv = ((ar // stride) % card)
+                    for (card, ecard, stride, kd) in zip(
+                            cards, eff_cards, strides, key_vals):
+                        kv = ((ar // stride) % ecard)
+                        if ecard > card:  # nullable key: code==card → NULL
+                            key_nulls.append(kv == card)
+                            kv = jnp.minimum(kv, card - 1)
+                        else:
+                            key_nulls.append(None)
                         key_arrays.append(kv.astype(
                             kd.dtype.device_dtype() if kd.dtype else jnp.int64))
                 else:
@@ -787,13 +802,23 @@ class Compiler:
                         key_arrays.append(jax.ops.segment_max(
                             jnp.where(valid, kv, filler), gidx,
                             num_segments=num_groups + 1)[:num_groups])
+                        if kd.null is not None:
+                            nb = _broadcast_to_mask(kd.null, out.valid) \
+                                .reshape(-1)
+                            key_nulls.append(jax.ops.segment_max(
+                                (nb & valid).astype(jnp.int32), gidx,
+                                num_segments=num_groups + 1)[:num_groups]
+                                .astype(bool))
+                        else:
+                            key_nulls.append(None)
                 key_arrays = [k[:num_groups] if k.shape[0] > num_groups else k
                               for k in key_arrays]
 
             # --- evaluate select expressions over [G] arrays ---
             post_cols: Dict[int, DVal] = {}
             for gi, karr in enumerate(key_arrays):
-                post_cols[gi] = DVal(karr, None, post_scope_types[gi])
+                post_cols[gi] = DVal(karr, key_nulls[gi],
+                                     post_scope_types[gi])
             slot_cols: Dict[int, DVal] = {}
             for si, arr in enumerate(slot_arrays):
                 slot_cols[len(groups) + si] = DVal(
@@ -990,11 +1015,18 @@ def _key_bits(v):
 
 
 def _combine_keys(dvals: List[DVal]):
-    """Combine N key DVals into one int64 key. Single key: exact. Multiple:
-    mixed via a 64-bit hash (documented collision risk ~ n²/2⁻⁶⁴; exact
-    multi-key via packing/sort lands with the generic hash table)."""
+    """Combine N key DVals into one int64 key. Single key: exact (NULL maps
+    to a reserved sentinel — collision odds with a real value hitting that
+    exact bit pattern are ~2⁻⁶⁴). Multiple: mixed via a 64-bit hash with
+    the null flag folded in exactly (documented collision risk ~ n²·2⁻⁶⁴;
+    exact multi-key via packing/sort lands with the generic hash table).
+    NULL keys hash to their own group per SQL GROUP BY semantics."""
     if len(dvals) == 1:
-        return _key_bits(dvals[0].value)
+        d = dvals[0]
+        bits = _key_bits(d.value)
+        if d.null is not None:
+            bits = jnp.where(d.null, _I64_MAX - 1, bits)
+        return bits
     acc = jnp.zeros(jnp.shape(dvals[0].value), dtype=jnp.uint64)
     for d in dvals:
         k = _key_bits(d.value).astype(jnp.uint64)
@@ -1002,6 +1034,9 @@ def _combine_keys(dvals: List[DVal]):
         k = (k ^ (k >> 27)) * jnp.uint64(0x94d049bb133111eb)
         k = k ^ (k >> 31)
         acc = acc * jnp.uint64(0x100000001b3) + k
+        if d.null is not None:
+            # exact: a NULL key differs from every value in its own bit
+            acc = acc * jnp.uint64(2) + d.null.astype(jnp.uint64)
     return acc.astype(jnp.int64)
 
 

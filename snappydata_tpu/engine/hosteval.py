@@ -241,10 +241,28 @@ def _eval_func(e: ast.Func, cols, nulls, params, n):
                                and np.broadcast_to(nls[j], (n,))[i])
                       else _plain(vs[j][i]) for j in range(len(vs))]
         return out, None
+    if name == "map":
+        vs = [np.broadcast_to(a[0], (n,)) for a in args]
+        out = np.empty(n, dtype=object)
+        for i in range(n):
+            out[i] = {_plain(vs[j][i]): _plain(vs[j + 1][i])
+                      for j in range(0, len(vs), 2)}
+        return out, None
+    if name in ("map_keys", "map_values"):
+        v, nl = args[0]
+        out = np.empty(n, dtype=object)
+        for i, x in enumerate(np.broadcast_to(v, (n,))):
+            if isinstance(x, dict):
+                out[i] = list(x.keys()) if name == "map_keys" \
+                    else list(x.values())
+            else:
+                out[i] = None
+        return out, nl
     if name == "size":
         v, nl = args[0]
-        out = np.array([len(x) if isinstance(x, (list, tuple)) else -1
-                        for x in np.broadcast_to(v, (n,))], dtype=np.int32)
+        out = np.array(
+            [len(x) if isinstance(x, (list, tuple, dict)) else -1
+             for x in np.broadcast_to(v, (n,))], dtype=np.int32)
         return out, nl
     if name == "array_contains":
         v, nl = args[0]
@@ -264,8 +282,17 @@ def _eval_func(e: ast.Func, cols, nulls, params, n):
         vals = []
         nulls_out = np.zeros(n, dtype=bool)
         for i, x in enumerate(np.broadcast_to(v, (n,))):
-            k = int(idx[i]) - 1  # element_at is 1-based
-            if isinstance(x, (list, tuple)) and 0 <= k < len(x):
+            if isinstance(x, dict):  # map lookup by key, not position
+                got = x.get(_plain(idx[i]))
+                vals.append(got)
+                nulls_out[i] = got is None
+                continue
+            if not isinstance(x, (list, tuple)):  # NULL map/array row
+                vals.append(None)
+                nulls_out[i] = True
+                continue
+            k = int(idx[i]) - 1  # element_at on arrays is 1-based
+            if 0 <= k < len(x):
                 vals.append(x[k])
                 nulls_out[i] = x[k] is None
             else:
@@ -430,7 +457,7 @@ def eval_values(node: ast.Values, params) -> Result:
                 vals.append(None)
             else:
                 vals.append(v)
-        if dt.name in ("string", "array") or dt.np_dtype == object:
+        if dt.name in ("string", "array", "map") or dt.np_dtype == object:
             # element-wise: np.array() would turn equal-length lists
             # into a 2-D array and strip their list-ness
             arr = np.empty(len(vals), dtype=object)
@@ -948,7 +975,7 @@ def _eval_aggregate(plan: ast.Aggregate, params, executor):
             nmask.append(v is None)
             vals.append(v)
         dt = out_types[-1]
-        if dt.name in ("string", "array"):
+        if dt.name in ("string", "array", "map"):
             arr = np.empty(len(vals), dtype=object)
             for j, v in enumerate(vals):
                 arr[j] = v

@@ -74,7 +74,7 @@ class SnappySession:
         ds = self.disk_store
         if ds is not None and isinstance(
                 stmt, (ast.InsertInto, ast.UpdateStmt, ast.DeleteStmt,
-                       ast.TruncateTable)):
+                       ast.TruncateTable, ast.AlterTable)):
             # authorize BEFORE journaling: a denied statement must never
             # reach the WAL (replay runs as admin and would apply it);
             # non-journaled paths authorize once in execute_statement
@@ -215,6 +215,8 @@ class SnappySession:
         if isinstance(stmt, ast.TruncateTable):
             self.catalog.describe(stmt.name).data.truncate()
             return _status()
+        if isinstance(stmt, ast.AlterTable):
+            return self._alter_table(stmt)
         if isinstance(stmt, ast.CreateView):
             if _contains_subquery(stmt.query):
                 raise AnalysisError(
@@ -540,6 +542,40 @@ class SnappySession:
     # DML internals
     # ------------------------------------------------------------------
 
+    def _alter_table(self, stmt: ast.AlterTable) -> Result:
+        """ALTER TABLE ADD/DROP COLUMN (ref SnappySession.alterTable:1628,
+        SnappyDDLParser.scala:697-713). Supported for both row and column
+        tables; existing rows read the added column as NULL."""
+        info = self.catalog.describe(stmt.table)
+        if info.provider == "sample":
+            raise ValueError("ALTER TABLE is not supported on sample tables")
+        if stmt.add:
+            cd = stmt.column
+            if any(f.name.lower() == cd.name.lower()
+                   for f in info.schema.fields):
+                raise ValueError(f"column already exists: {cd.name}")
+            info.data.add_column(T.Field(cd.name, cd.dtype, cd.nullable))
+        else:
+            cname = stmt.name
+            info.schema.index(cname)  # validates existence
+            low = cname.lower()
+            if low in info.partition_by:
+                raise ValueError(
+                    f"cannot drop partitioning column {cname}")
+            if low in info.key_columns:
+                raise ValueError(f"cannot drop primary key column {cname}")
+            for iname, (t, icols) in getattr(self.catalog, "_indexes",
+                                             {}).items():
+                if t == info.name and low in icols:
+                    raise ValueError(
+                        f"column {cname} is referenced by index {iname}")
+            info.data.drop_column(cname)
+        info.schema = info.data.schema
+        self.catalog.generation += 1
+        if self.disk_store is not None:
+            self.disk_store.save_catalog(self.catalog)
+        return _status()
+
     def _create_table(self, stmt: ast.CreateTable) -> Result:
         if stmt.provider == "sample":
             return self._create_sample_table(stmt)
@@ -624,7 +660,8 @@ class SnappySession:
                     self._require(t, "select")
             return
         if isinstance(stmt, (ast.CreateTable, ast.DropTable,
-                             ast.TruncateTable, ast.CreatePolicy,
+                             ast.TruncateTable, ast.AlterTable,
+                             ast.CreatePolicy,
                              ast.DropPolicy, ast.CreateIndex,
                              ast.DropIndex, ast.ExecCode, ast.SetConf,
                              ast.CreateView, ast.DropView)):
@@ -1276,7 +1313,7 @@ def _rows_to_arrays(schema: T.Schema, rows):
     for i, f in enumerate(schema.fields):
         vals = [r[i] for r in rows]
         nmask = np.array([v is None for v in vals])
-        if f.dtype.name in ("string", "array"):
+        if f.dtype.name in ("string", "array", "map"):
             arr = np.empty(len(vals), dtype=object)
             for j, v in enumerate(vals):
                 arr[j] = v
@@ -1300,11 +1337,13 @@ def _result_to_arrays(result: Result, schema: T.Schema):
 def _coerce(col: np.ndarray, nmask, dtype: T.DataType):
     """→ (storage array, null mask | None): NULLs become fillers + mask
     instead of being silently written as 0 (review finding)."""
-    if dtype.name == "array":
+    if dtype.name in ("array", "map"):
         out = np.empty(len(col), dtype=object)
         for i, v in enumerate(col):
-            out[i] = list(v) if isinstance(v, (list, tuple, np.ndarray)) \
-                else v
+            if isinstance(v, (list, tuple, np.ndarray)):
+                out[i] = list(v)
+            else:
+                out[i] = v  # dicts/None pass through
         if nmask is not None:
             out[np.asarray(nmask)] = None
         return out, (np.asarray(nmask) if nmask is not None else None)

@@ -132,12 +132,24 @@ def expr_type(e: ast.Expr) -> T.DataType:
         if low == "array":
             elem = expr_type(e.args[0]) if e.args else T.DOUBLE
             return T.ArrayType("array", elem)
+        if low == "map":
+            k = expr_type(e.args[0]) if e.args else T.STRING
+            v = expr_type(e.args[1]) if len(e.args) > 1 else T.DOUBLE
+            return T.MapType("map", k, v)
+        if low in ("map_keys", "map_values"):
+            at = expr_type(e.args[0])
+            if isinstance(at, T.MapType):
+                return T.ArrayType(
+                    "array", at.key if low == "map_keys" else at.value)
+            return T.ArrayType("array", T.STRING)
         if low == "array_contains":
             return T.BOOLEAN
         if low == "element_at":
             at = expr_type(e.args[0])
             if isinstance(at, T.ArrayType):
                 return at.element
+            if isinstance(at, T.MapType):
+                return at.value
             return T.STRING
         if low in ("substr", "substring", "upper", "lower", "trim", "concat",
                    "ltrim", "rtrim"):

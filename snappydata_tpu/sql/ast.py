@@ -482,6 +482,17 @@ class DropTable(Statement):
 
 
 @dataclasses.dataclass(frozen=True)
+class AlterTable(Statement):
+    """ALTER TABLE t ADD [COLUMN] c type | DROP [COLUMN] c
+    (ref SnappyDDLParser.scala:697-713, AlterTableAddColumnCommand)."""
+
+    table: str
+    add: bool
+    column: Optional["ColumnDef"] = None   # ADD
+    name: Optional[str] = None             # DROP
+
+
+@dataclasses.dataclass(frozen=True)
 class TruncateTable(Statement):
     name: str
 

@@ -109,6 +109,8 @@ class Parser:
             self.next()
             self.expect_kw("table")
             return self._finishing(ast.TruncateTable(self.qualified_name()))
+        if low == "alter":
+            return self._finishing(self.alter_stmt())
         if low in ("insert", "put"):
             return self._finishing(self.insert_stmt())
         if low == "update":
@@ -615,6 +617,12 @@ class Parser:
             elem = self.type_name()
             self.expect_op(">")
             return T.parse_type("array", element=elem)
+        if name.lower() == "map" and self.accept_op("<"):
+            key = self.type_name()
+            self.expect_op(",")
+            val = self.type_name()
+            self.expect_op(">")
+            return T.parse_type("map", element=val, key=key)
         args = []
         if self.accept_op("("):
             while not self.at_op(")"):
@@ -698,6 +706,26 @@ class Parser:
         return ast.CreateTable(name, tuple(columns), provider, options,
                                as_select, if_not_exists, temporary,
                                stream=stream)
+
+    def alter_stmt(self) -> ast.Statement:
+        """ALTER TABLE t ADD [COLUMN] c type [NOT NULL] | DROP [COLUMN] c
+        (ref SnappyDDLParser.scala:697-713)."""
+        self.expect_kw("alter")
+        self.expect_kw("table")
+        table = self.qualified_name()
+        if self.accept_kw("add"):
+            self.accept_kw("column")
+            cname = self.ident()
+            dt = self.type_name()
+            nullable = True
+            if self.accept_kw("not"):
+                self.expect_kw("null")
+                nullable = False
+            return ast.AlterTable(table, True,
+                                  column=ast.ColumnDef(cname, dt, nullable))
+        self.expect_kw("drop")
+        self.accept_kw("column")
+        return ast.AlterTable(table, False, name=self.ident())
 
     def column_defs(self) -> List[ast.ColumnDef]:
         self.expect_op("(")

@@ -119,8 +119,8 @@ def encode_column(values: np.ndarray, dtype: T.DataType,
     compare, and that we need globally for device-side group-by on codes.
     """
     n = int(values.shape[0])
-    if dtype.name == "array":
-        # raw object storage; queries over array columns run host-side
+    if dtype.name in ("array", "map"):
+        # raw object storage; queries over complex columns run host-side
         obj = np.asarray(values, dtype=object)
         nulls_mask = np.fromiter((v is None for v in obj), dtype=np.bool_,
                                  count=n)

@@ -277,6 +277,8 @@ class DiskStore:
             with open(os.path.join(tdir, "rows.tmp"), "wb") as fh:
                 write_record(fh, {"kind": "rowtable", "n": n,
                                   "ncols": len(arrays),
+                                  "columns": [f.name.lower() for f in
+                                              info.schema.fields],
                                   "wal_seq": wal_seq},
                              list(arrays) + list(masks))
             os.replace(os.path.join(tdir, "rows.tmp"),
@@ -305,6 +307,10 @@ class DiskStore:
             "version": m.version,
             "batches": batch_entries,
             "row_count": m.row_count,
+            # schema as of this checkpoint: ALTER TABLE between checkpoints
+            # makes load align columns by NAME (missing → NULL, extra →
+            # dropped), then the fenced WAL replays the ALTER itself
+            "columns": [f.name.lower() for f in info.schema.fields],
             "wal_seq": wal_seq,   # replay fence: records ≤ this are folded
         }
         with open(os.path.join(tdir, "rowbuf.tmp"), "wb") as fh:
@@ -516,6 +522,9 @@ class DiskStore:
                                     _restore_none_arrays
 
                                 cols = _restore_none_arrays(cols, masks)
+                            cols = _align_by_name(
+                                cols, header.get("columns"),
+                                info.schema, header["n"])
                             info.data.insert_arrays(cols)
             return seq
         mpath = os.path.join(tdir, "manifest.json")
@@ -524,6 +533,12 @@ class DiskStore:
         with open(mpath) as fh:
             manifest = json.load(fh)
         data: ColumnTableData = info.data
+        cur_names = [f.name.lower() for f in info.schema.fields]
+        saved_names = manifest.get("columns", cur_names)
+        remap = None          # saved col idx -> current col idx (or None)
+        if saved_names != cur_names:
+            remap = [cur_names.index(nm) if nm in cur_names else None
+                     for nm in saved_names]
         views = []
         for entry in manifest["batches"]:
             batch = self._read_batch(os.path.join(tdir, entry["file"]),
@@ -534,6 +549,18 @@ class DiskStore:
                  _unb64_any(d["values"]),
                  _unb64(d["nulls"], np.bool_) if d.get("nulls") else None)
                 for d in entry.get("deltas", ()))
+            if remap is not None:
+                by_name = dict(zip(saved_names, batch.columns))
+                import dataclasses as _dc
+
+                batch = _dc.replace(batch, columns=tuple(
+                    by_name[nm] if nm in by_name
+                    else data._all_null_column(ci, f.dtype, batch.num_rows)
+                    for ci, (nm, f) in enumerate(
+                        zip(cur_names, info.schema.fields))))
+                deltas = tuple((remap[ci], hit, vals, vn)
+                               for ci, hit, vals, vn in deltas
+                               if remap[ci] is not None)
             views.append(BatchView(batch, delete_mask, deltas))
         with data._lock:
             # re-intern dictionaries so table-level codes match batch codes
@@ -547,16 +574,21 @@ class DiskStore:
             if os.path.exists(rb):
                 with open(rb, "rb") as fh:
                     for header, arrays in read_records(fh):
-                        n_cols = len(info.schema.fields)
+                        n_cols = len(saved_names)
                         if header["n"]:
+                            cols = list(arrays[:n_cols])
+                            nls = list(arrays[n_cols:]) or [None] * n_cols
+                            if remap is not None:
+                                cols, nls = _align_rowbuf(
+                                    cols, nls, saved_names, info.schema,
+                                    header["n"])
                             # row-buffer strings must re-enter the shared
                             # dictionary (batches carry their own dict;
                             # buffer rows don't)
                             for ci in data._dicts:
                                 data._intern_strings(
-                                    ci, np.asarray(arrays[ci], dtype=object))
-                            data._row_buffer.append(
-                                arrays[:n_cols], arrays[n_cols:])
+                                    ci, np.asarray(cols[ci], dtype=object))
+                            data._row_buffer.append(cols, nls)
             # advance batch id counter past recovered ids
             import itertools
 
@@ -687,3 +719,38 @@ def _unb64_any(d: dict) -> np.ndarray:
 
     return np.frombuffer(base64.b64decode(d["b64"]),
                          dtype=np.dtype(d["dtype"])).reshape(d["shape"]).copy()
+
+def _align_by_name(cols, saved_names, schema, n):
+    """Row-table checkpoint → current schema: match columns by name; a
+    column added since the checkpoint reads NULL, a dropped one is skipped
+    (the fenced WAL then replays the ALTER itself, which no-ops)."""
+    cur = [f.name.lower() for f in schema.fields]
+    if saved_names is None or list(saved_names) == cur:
+        return cols
+    by_name = dict(zip(saved_names, cols))
+    out = []
+    for nm in cur:
+        if nm in by_name:
+            out.append(by_name[nm])
+        else:
+            out.append(np.full(n, None, dtype=object))
+    return out
+
+
+def _align_rowbuf(cols, nls, saved_names, schema, n):
+    """Column-table row-buffer checkpoint → current schema (see
+    _align_by_name); missing columns read NULL via an all-set mask."""
+    cur_fields = [(f.name.lower(), f) for f in schema.fields]
+    by_name = dict(zip(saved_names, zip(cols, nls)))
+    out_c, out_n = [], []
+    for nm, f in cur_fields:
+        if nm in by_name:
+            c, m = by_name[nm]
+            out_c.append(c)
+            out_n.append(m)
+        else:
+            npd = f.dtype.np_dtype
+            out_c.append(np.full(n, None, dtype=object) if npd == object
+                         else np.zeros(n, dtype=npd))
+            out_n.append(np.ones(n, dtype=np.bool_))
+    return out_c, out_n

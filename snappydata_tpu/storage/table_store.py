@@ -151,6 +151,25 @@ class RowBuffer:
         self.count = 0
         self._nulls = [None] * len(self.schema.fields)
 
+    def add_field(self, field: T.Field) -> None:
+        """Schema evolution: existing buffered rows read NULL."""
+        self.schema = T.Schema(tuple(self.schema.fields) + (field,))
+        npd = field.dtype.np_dtype
+        self._cols.append(np.empty(self.capacity, dtype=npd)
+                          if npd == object
+                          else np.zeros(self.capacity, dtype=npd))
+        nm = None
+        if self.count:
+            nm = np.zeros(self.capacity, dtype=np.bool_)
+            nm[:self.count] = True
+        self._nulls.append(nm)
+
+    def drop_field(self, idx: int) -> None:
+        self.schema = T.Schema(tuple(
+            f for i, f in enumerate(self.schema.fields) if i != idx))
+        del self._cols[idx]
+        del self._nulls[idx]
+
 
 class ColumnTableData:
     """Storage for one COLUMN table: immutable batches + row delta buffer +
@@ -324,6 +343,86 @@ class ColumnTableData:
                 [m[sl] if m is not None else None for m in nulls]))
             pos += take
         return out
+
+    # --- schema evolution (ref: AlterTableAddColumnCommand /
+    # AlterTableDropColumnCommand, SnappySession.alterTable:1628; we extend
+    # it to column tables — existing rows read the new column as NULL) ---
+
+    def _all_null_column(self, col_idx: int, dtype: T.DataType,
+                         n: int):
+        from snappydata_tpu.storage import bitmask
+        from snappydata_tpu.storage.encoding import (ColumnStats,
+                                                     EncodedColumn, Encoding)
+
+        validity = bitmask.pack(np.zeros(n, dtype=np.bool_))
+        stats = ColumnStats(None, None, n, n)
+        if dtype.name == "string":
+            return EncodedColumn(
+                Encoding.DICTIONARY, dtype, n, np.zeros(n, dtype=np.int32),
+                dictionary=np.array(self._dicts[col_idx], dtype=object),
+                validity=validity, stats=stats)
+        if dtype.name in ("array", "map"):
+            return EncodedColumn(Encoding.OBJECT, dtype, n,
+                                 np.full(n, None, dtype=object),
+                                 validity=validity, stats=stats)
+        if dtype.name == "boolean":
+            return EncodedColumn(Encoding.BOOLEAN_BITSET, dtype, n,
+                                 bitmask.pack(np.zeros(n, dtype=np.bool_)),
+                                 validity=validity, stats=stats)
+        # run-length [0]*n: one cell regardless of batch size
+        return EncodedColumn(Encoding.RUN_LENGTH, dtype, n,
+                             np.zeros(1, dtype=dtype.device_dtype()),
+                             runs=np.array([n], dtype=np.int32),
+                             validity=validity, stats=stats)
+
+    def add_column(self, field: T.Field) -> None:
+        """ALTER TABLE ADD COLUMN: existing rows read NULL. Existing
+        batches get a constant-size all-null encoded column; the manifest
+        version bump invalidates device caches and compiled plans."""
+        with self._lock:
+            idx = len(self.schema.fields)
+            self.schema = T.Schema(tuple(self.schema.fields) + (field,))
+            if field.dtype.name == "string":
+                # non-empty shared dictionary so device LUTs over it are
+                # never zero-sized (codes are masked null anyway)
+                self._dicts[idx] = [""]
+                self._dict_lookup[idx] = {"": 0}
+            self._row_buffer.add_field(field)
+            views = []
+            for v in self._manifest.views:
+                b = v.batch
+                nb = dataclasses.replace(
+                    b, columns=b.columns + (self._all_null_column(
+                        idx, field.dtype, b.num_rows),))
+                views.append(dataclasses.replace(v, batch=nb))
+            self._publish(tuple(views))
+
+    def drop_column(self, name: str) -> None:
+        with self._lock:
+            idx = self.schema.index(name)
+            if len(self.schema.fields) == 1:
+                raise ValueError("cannot drop the only column")
+            self.schema = T.Schema(tuple(
+                f for i, f in enumerate(self.schema.fields) if i != idx))
+
+            def remap(i):
+                return i - 1 if i > idx else i
+
+            self._dicts = {remap(i): d for i, d in self._dicts.items()
+                           if i != idx}
+            self._dict_lookup = {remap(i): d
+                                 for i, d in self._dict_lookup.items()
+                                 if i != idx}
+            self._row_buffer.drop_field(idx)
+            views = []
+            for v in self._manifest.views:
+                b = v.batch
+                nb = dataclasses.replace(b, columns=tuple(
+                    c for i, c in enumerate(b.columns) if i != idx))
+                deltas = tuple((remap(ci), hit, vals, vn)
+                               for ci, hit, vals, vn in v.deltas if ci != idx)
+                views.append(dataclasses.replace(v, batch=nb, deltas=deltas))
+            self._publish(tuple(views))
 
     def force_rollover(self) -> None:
         with self._lock:
@@ -655,6 +754,32 @@ class RowTableData:
 
     def count(self) -> int:
         return int(sum(self._live))
+
+    def add_column(self, field: T.Field) -> None:
+        """ALTER TABLE ADD COLUMN (ref SnappySession.alterTable:1628):
+        existing rows read NULL for the new column."""
+        with self._lock:
+            n = len(self._live)
+            self.schema = T.Schema(tuple(self.schema.fields) + (field,))
+            self._cols.append([None] * n)
+            self._version += 1
+
+    def drop_column(self, name: str) -> None:
+        with self._lock:
+            idx = self.schema.index(name)
+            if len(self.schema.fields) == 1:
+                raise ValueError("cannot drop the only column")
+            if idx in self._key_idx:
+                raise ValueError(f"cannot drop primary key column {name}")
+            for iname, icols in getattr(self, "_indexes", {}).items():
+                if name.lower() in icols:
+                    raise ValueError(
+                        f"column {name} is referenced by index {iname}")
+            self.schema = T.Schema(tuple(
+                f for i, f in enumerate(self.schema.fields) if i != idx))
+            del self._cols[idx]
+            self._key_idx = [i - 1 if i > idx else i for i in self._key_idx]
+            self._version += 1
 
     def create_index(self, name: str, columns: Sequence[str]) -> None:
         """Secondary index (ref: row-store indexes, CreateIndexTest).

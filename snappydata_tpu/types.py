@@ -25,7 +25,7 @@ class DataType:
 
     @property
     def np_dtype(self) -> np.dtype:
-        if self.name == "array":
+        if self.name in ("array", "map"):
             return np.dtype(object)
         return _NP[self.name]
 
@@ -51,6 +51,17 @@ class ArrayType(DataType):
 
     def __str__(self):
         return f"array<{self.element}>"
+
+
+@dataclasses.dataclass(frozen=True)
+class MapType(DataType):
+    """MAP<K,V>: python dicts, host-evaluated like ARRAY."""
+
+    key: "DataType" = None
+    value: "DataType" = None
+
+    def __str__(self):
+        return f"map<{self.key},{self.value}>"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,9 +115,12 @@ _BY_NAME = {
 
 
 def parse_type(name: str, args: Optional[list] = None,
-               element: Optional[DataType] = None) -> DataType:
+               element: Optional[DataType] = None,
+               key: Optional[DataType] = None) -> DataType:
     if name.lower() == "array":
         return ArrayType("array", element or DOUBLE)
+    if name.lower() == "map":
+        return MapType("map", key or STRING, element or DOUBLE)
     base = _BY_NAME.get(name.lower())
     if base is None:
         raise ValueError(f"unknown data type: {name}")

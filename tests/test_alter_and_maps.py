@@ -1,0 +1,189 @@
+"""ALTER TABLE ADD/DROP COLUMN (ref SnappyDDLParser.scala:697-713,
+SnappySession.alterTable:1628), MAP<K,V> columns, and NULL group-key
+segregation (SQL GROUP BY puts NULL keys in their own group)."""
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+
+
+@pytest.fixture()
+def s():
+    sess = SnappySession(catalog=Catalog())
+    yield sess
+    sess.stop()
+
+
+# --- ALTER TABLE ---------------------------------------------------------
+
+def test_alter_add_column_column_table(s):
+    s.sql("CREATE TABLE c (id INT, x DOUBLE) USING column "
+          "OPTIONS (column_max_delta_rows '3')")
+    for i in range(7):  # forces batches to exist before the ALTER
+        s.sql(f"INSERT INTO c VALUES ({i}, {i * 1.5})")
+    s.sql("ALTER TABLE c ADD COLUMN tag STRING")
+    assert s.sql("SELECT count(*) FROM c WHERE tag IS NULL").rows() == [(7,)]
+    s.sql("INSERT INTO c VALUES (7, 10.5, 'new')")
+    assert s.sql("SELECT id, tag FROM c WHERE id >= 6 ORDER BY id").rows() \
+        == [(6, None), (7, 'new')]
+    # the added column is updatable
+    s.sql("ALTER TABLE c ADD COLUMN w DOUBLE")
+    s.sql("UPDATE c SET w = x * 2 WHERE id = 1")
+    assert s.sql("SELECT w FROM c WHERE id = 1").rows() == [(3.0,)]
+    assert s.sql("SELECT w FROM c WHERE id = 2").rows()[0][0] is None
+
+
+def test_alter_drop_column(s):
+    s.sql("CREATE TABLE c (id INT, x DOUBLE, y INT) USING column")
+    s.sql("INSERT INTO c VALUES (1, 1.5, 10), (2, 2.5, 20)")
+    s.sql("ALTER TABLE c DROP COLUMN x")
+    assert s.sql("DESCRIBE c").rows() == [
+        ("id", "int", True), ("y", "int", True)]
+    assert s.sql("SELECT * FROM c ORDER BY id").rows() == [(1, 10), (2, 20)]
+
+
+def test_alter_row_table_and_guards(s):
+    s.sql("CREATE TABLE r (k INT PRIMARY KEY, v STRING) USING row")
+    s.sql("INSERT INTO r VALUES (1, 'a')")
+    s.sql("ALTER TABLE r ADD COLUMN extra INT")
+    s.sql("INSERT INTO r VALUES (2, 'b', 42)")
+    assert s.sql("SELECT k, extra FROM r ORDER BY k").rows() == \
+        [(1, None), (2, 42)]
+    with pytest.raises(Exception, match="primary key"):
+        s.sql("ALTER TABLE r DROP COLUMN k")
+    with pytest.raises(Exception, match="already exists"):
+        s.sql("ALTER TABLE r ADD COLUMN extra INT")
+
+
+def test_alter_is_admin_only(s):
+    s.sql("CREATE TABLE t (id INT) USING column")
+    user = SnappySession(catalog=s.catalog, user="bob")
+    with pytest.raises(PermissionError):
+        user.sql("ALTER TABLE t ADD COLUMN z INT")
+
+
+def test_alter_persistence(tmp_path):
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE t (id INT) USING column")
+    s.sql("INSERT INTO t VALUES (1)")
+    s.checkpoint()
+    s.sql("ALTER TABLE t ADD COLUMN v DOUBLE")  # WAL tail
+    s.sql("INSERT INTO t VALUES (2, 9.5)")
+    s.disk_store.close()
+    s2 = SnappySession(data_dir=str(tmp_path))
+    assert s2.sql("SELECT id, v FROM t ORDER BY id").rows() == \
+        [(1, None), (2, 9.5)]
+
+
+def test_alter_checkpoint_then_drop_in_wal_tail(tmp_path):
+    # checkpoint carries 3 cols; the WAL tail drops one — load aligns the
+    # checkpointed batches by NAME, then replay applies the DROP
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE t (id INT, x DOUBLE) USING column "
+          "OPTIONS (column_max_delta_rows '2')")
+    for i in range(5):
+        s.sql(f"INSERT INTO t VALUES ({i}, {i * 1.0})")
+    s.sql("ALTER TABLE t ADD COLUMN tag STRING")
+    s.sql("INSERT INTO t VALUES (5, 5.0, 'z')")
+    s.checkpoint()
+    s.sql("ALTER TABLE t DROP COLUMN x")
+    s.sql("INSERT INTO t VALUES (6, 'w')")
+    s.disk_store.close()
+    s2 = SnappySession(data_dir=str(tmp_path))
+    assert s2.sql("DESCRIBE t").rows() == [
+        ("id", "int", True), ("tag", "string", True)]
+    rows = s2.sql("SELECT id, tag FROM t ORDER BY id").rows()
+    assert rows[5:] == [(5, "z"), (6, "w")]
+    assert all(tag is None for _, tag in rows[:5])
+
+
+def test_alter_row_table_recovery(tmp_path):
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE r (k INT PRIMARY KEY, v STRING) USING row")
+    s.sql("INSERT INTO r VALUES (1, 'a'), (2, 'b')")
+    s.checkpoint()
+    s.sql("ALTER TABLE r ADD COLUMN w DOUBLE")
+    s.sql("INSERT INTO r VALUES (3, 'c', 1.5)")
+    s.disk_store.close()
+    s2 = SnappySession(data_dir=str(tmp_path))
+    assert s2.sql("SELECT k, w FROM r ORDER BY k").rows() == \
+        [(1, None), (2, None), (3, 1.5)]
+    assert s2.sql("SELECT v FROM r WHERE k = 3").rows() == [("c",)]
+
+
+# --- MAP<K,V> ------------------------------------------------------------
+
+def test_map_create_insert_select(s):
+    s.sql("CREATE TABLE t (id INT, m MAP<STRING, INT>) USING column")
+    s.sql("INSERT INTO t VALUES (1, map('a', 1, 'b', 2)), "
+          "(2, map('c', 3)), (3, NULL)")
+    rows = s.sql("SELECT id, m FROM t ORDER BY id").rows()
+    assert rows[0] == (1, {"a": 1, "b": 2})
+    assert rows[2][1] is None
+    assert s.sql("SELECT id, element_at(m, 'a') FROM t ORDER BY id").rows() \
+        == [(1, 1), (2, None), (3, None)]
+    assert s.sql("SELECT size(m) FROM t WHERE id = 1").rows() == [(2,)]
+    assert s.sql("SELECT map_keys(m) FROM t WHERE id = 1").rows() == \
+        [(["a", "b"],)]
+    assert s.sql("SELECT map_values(m) FROM t WHERE id = 2").rows() == [([3],)]
+    assert s.sql("SELECT id FROM t WHERE element_at(m, 'b') = 2").rows() == \
+        [(1,)]
+
+
+def test_map_persistence(tmp_path):
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE t (id INT, m MAP<STRING, INT>) USING column "
+          "OPTIONS (column_max_delta_rows '2')")
+    for i in range(5):  # rolls over into batches
+        s.sql(f"INSERT INTO t VALUES ({i}, map('k', {i * 10}))")
+    s.checkpoint()
+    s.sql("INSERT INTO t VALUES (5, NULL)")
+    s.disk_store.close()
+    s2 = SnappySession(data_dir=str(tmp_path))
+    assert s2.sql("SELECT id, element_at(m, 'k') FROM t ORDER BY id").rows() \
+        == [(0, 0), (1, 10), (2, 20), (3, 30), (4, 40), (5, None)]
+
+
+def test_map_queries_leave_plain_columns_on_device(s):
+    from snappydata_tpu.observability.metrics import global_registry
+
+    s.sql("CREATE TABLE t (k INT, m MAP<STRING, INT>) USING column")
+    s.sql("INSERT INTO t VALUES (1, map('a', 1)), (2, map('b', 2))")
+    before = global_registry().counter("host_fallbacks")
+    assert s.sql("SELECT sum(k) FROM t").rows() == [(3,)]
+    assert global_registry().counter("host_fallbacks") == before
+
+
+# --- NULL group keys -----------------------------------------------------
+
+def test_null_group_keys_string(s):
+    s.sql("CREATE TABLE t (id INT, tag STRING) USING column")
+    s.sql("INSERT INTO t VALUES (1, 'a'), (2, NULL), (3, NULL), (4, 'b')")
+    assert s.sql("SELECT tag, count(*) FROM t GROUP BY tag ORDER BY tag"
+                 ).rows() == [(None, 2), ("a", 1), ("b", 1)]
+
+
+def test_null_group_keys_numeric_and_bool(s):
+    s.sql("CREATE TABLE n (id INT, v INT) USING column")
+    s.sql("INSERT INTO n VALUES (1, 5), (2, NULL), (3, NULL), (4, 7)")
+    assert s.sql("SELECT v, count(*) FROM n GROUP BY v ORDER BY v").rows() \
+        == [(None, 2), (5, 1), (7, 1)]
+    s.sql("CREATE TABLE b (f BOOLEAN, x INT)")
+    s.sql("INSERT INTO b VALUES (true, 1), (NULL, 2), (false, 3), (NULL, 4)")
+    assert s.sql("SELECT f, count(*) FROM b GROUP BY f ORDER BY f").rows() \
+        == [(None, 2), (False, 1), (True, 1)]
+
+
+def test_null_group_keys_multi_and_agg(s):
+    s.sql("CREATE TABLE m (g STRING, x DOUBLE)")
+    s.sql("INSERT INTO m VALUES ('a', 1.0), (NULL, 2.0), (NULL, 4.0)")
+    assert s.sql("SELECT g, avg(x) FROM m GROUP BY g ORDER BY g").rows() == \
+        [(None, 3.0), ("a", 1.0)]
+    assert s.sql("SELECT g, count(*) c FROM m GROUP BY g "
+                 "HAVING count(*) > 1").rows() == [(None, 2)]

@@ -55,6 +55,38 @@ def test_array_rollover_and_nonarray_queries_stay_on_device(s):
     assert global_registry().counter("host_fallbacks") == before
 
 
+def test_array_contains_null_needle(s):
+    # a NULL needle yields NULL (filtered out), not a match (review fix)
+    s.sql("CREATE TABLE t (id INT, v ARRAY<INT>, nn INT) USING column")
+    s.sql("INSERT INTO t VALUES (1, array(1, 2), 1), (2, array(3), NULL)")
+    assert s.sql("SELECT id FROM t WHERE array_contains(v, nn)").rows() == \
+        [(1,)]
+
+
+def test_group_by_and_distinct_on_arrays(s):
+    # unhashable list cells must not crash GROUP BY/DISTINCT (review fix)
+    s.sql("CREATE TABLE t (id INT, v ARRAY<INT>) USING column")
+    s.sql("INSERT INTO t VALUES (1, array(1, 2)), (2, array(1, 2)), "
+          "(3, array(9))")
+    assert s.sql("SELECT v, count(*) FROM t GROUP BY v ORDER BY 2 DESC"
+                 ).rows() == [([1, 2], 2), ([9], 1)]
+    assert len(s.sql("SELECT DISTINCT v FROM t").rows()) == 2
+
+
+def test_numpy_array_cells_persist(tmp_path):
+    # numpy values inside array cells serialize to the WAL (review fix)
+    import numpy as np
+
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE t (id INT, v ARRAY<INT>) USING column")
+    s.insert("t", (1, np.array([1, 2])), (2, np.array([3, 4])))
+    s.disk_store.close()
+    s2 = SnappySession(data_dir=str(tmp_path))
+    assert s2.sql("SELECT id, v FROM t ORDER BY id").rows() == \
+        [(1, [1, 2]), (2, [3, 4])]
+
+
 def test_array_persistence(tmp_path):
     s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
                       recover=False)
