@@ -19,18 +19,71 @@ vs_baseline 15.4.
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
 
 
+def _probe_backend(timeout_s: float, attempts: int):
+    """Verify the accelerator backend ONCE, up front, in a SUBPROCESS —
+    never lazily mid-ingest (round-1 failure mode: the axon TPU relay went
+    'Unavailable' ~2min into the load and a per-query backend probe crashed
+    the run; a sick relay can also HANG backend init >300s while holding
+    jax's global backend lock, which would poison this process too).
+    Returns the platform name, or None if the accelerator is unreachable."""
+    import subprocess
+
+    code = ("import jax, json, jax.numpy as jnp; d = jax.devices(); "
+            "jax.device_get(jnp.arange(4) + 1); "
+            "print(json.dumps({'platform': d[0].platform, 'n': len(d)}))")
+    for attempt in range(1, attempts + 1):
+        try:
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, timeout=timeout_s,
+                                  text=True)
+        except subprocess.TimeoutExpired:
+            print(f"bench: backend probe attempt {attempt}/{attempts} hung "
+                  f">{timeout_s}s (accelerator relay down?)",
+                  file=sys.stderr, flush=True)
+            continue
+        if proc.returncode == 0 and proc.stdout.strip():
+            info = json.loads(proc.stdout.strip().splitlines()[-1])
+            print(f"bench: backend ready — {info['n']}x {info['platform']}",
+                  file=sys.stderr, flush=True)
+            return info["platform"]
+        print(f"bench: backend probe attempt {attempt}/{attempts} failed: "
+              f"{(proc.stderr or '').strip()[-400:]}",
+              file=sys.stderr, flush=True)
+        time.sleep(min(10.0, 2.0 * attempt))
+    return None
+
+
 def main() -> None:
-    sf = float(os.environ.get("SNAPPY_BENCH_SF", "16.0"))
     repeats = int(os.environ.get("SNAPPY_BENCH_REPEATS", "5"))
 
-    from snappydata_tpu import SnappySession
+    platform = _probe_backend(
+        timeout_s=float(os.environ.get("SNAPPY_BENCH_INIT_TIMEOUT", "120")),
+        attempts=int(os.environ.get("SNAPPY_BENCH_INIT_ATTEMPTS", "3")))
+    tpu_unreachable = platform is None
+    if tpu_unreachable:
+        # The record must still be green and honest: run on CPU, say so.
+        print("bench: WARNING — accelerator unreachable; falling back to "
+              "CPU (result will carry tpu_unreachable=true)",
+              file=sys.stderr, flush=True)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        platform = "cpu"
+    sf_default = "4.0" if platform == "cpu" else "16.0"
+    sf = float(os.environ.get("SNAPPY_BENCH_SF", sf_default))
+
+    from snappydata_tpu import SnappySession, config
     from snappydata_tpu.catalog import Catalog
     from snappydata_tpu.utils import tpch
+
+    # pin the dtype policy NOW so nothing re-queries backend state mid-run
+    config.global_properties().decimal_as_float64 = platform == "cpu"
 
     s = SnappySession(catalog=Catalog())
     t0 = time.time()
@@ -58,6 +111,8 @@ def main() -> None:
         "unit": "rows/s",
         "vs_baseline": round(geomean / baseline, 3),
         "detail": {
+            "platform": platform,
+            "tpu_unreachable": tpu_unreachable,
             "sf": sf,
             "rows": n_rows,
             "load_s": round(load_s, 2),

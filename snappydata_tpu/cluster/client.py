@@ -14,9 +14,13 @@ import pyarrow.flight as flight
 
 class SnappyClient:
     def __init__(self, address: Optional[str] = None,
-                 locator: Optional[str] = None):
+                 locator: Optional[str] = None,
+                 token: Optional[str] = None):
         """Connect directly (`address`='host:port') or discover query
-        servers through a locator ('host:port' of the locator service)."""
+        servers through a locator ('host:port' of the locator service).
+        `token` authenticates every request when the server has
+        auth_tokens configured."""
+        self._token = token
         self._addresses: List[str] = []
         if address:
             self._addresses.append(address)
@@ -66,7 +70,8 @@ class SnappyClient:
     def sql(self, sql: str, params: Sequence = ()) -> pa.Table:
         """Query → Arrow table (record-batch paged by Flight)."""
         ticket = flight.Ticket(json.dumps(
-            {"sql": sql, "params": list(params)}).encode("utf-8"))
+            self._with_token({"sql": sql, "params": list(params)})
+        ).encode("utf-8"))
         try:
             return self._client().do_get(ticket).read_all()
         except (flight.FlightUnavailableError, ConnectionError):
@@ -75,7 +80,8 @@ class SnappyClient:
 
     def execute(self, sql: str, params: Sequence = ()) -> dict:
         """DDL/DML via action (no result paging needed)."""
-        body = json.dumps({"sql": sql, "params": list(params)}).encode()
+        body = json.dumps(self._with_token(
+            {"sql": sql, "params": list(params)})).encode()
         try:
             results = list(self._client().do_action(
                 flight.Action("sql", body)))
@@ -88,13 +94,24 @@ class SnappyClient:
     def insert(self, table: str, columns: dict) -> None:
         """Bulk columnar ingest via do_put."""
         arrow = pa.table(columns)
-        descriptor = flight.FlightDescriptor.for_path(table)
+        if self._token is not None:
+            descriptor = flight.FlightDescriptor.for_command(json.dumps(
+                {"table": table, "token": self._token}).encode("utf-8"))
+        else:
+            descriptor = flight.FlightDescriptor.for_path(table)
         writer, _ = self._client().do_put(descriptor, arrow.schema)
         writer.write_table(arrow)
         writer.close()
 
+    def _with_token(self, body: dict) -> dict:
+        if self._token is not None:
+            body["token"] = self._token
+        return body
+
     def stats(self) -> dict:
-        results = list(self._client().do_action(flight.Action("stats", b"")))
+        body = json.dumps(self._with_token({})).encode("utf-8")
+        results = list(self._client().do_action(
+            flight.Action("stats", body)))
         return json.loads(results[0].body.to_pybytes().decode("utf-8"))
 
     def close(self) -> None:

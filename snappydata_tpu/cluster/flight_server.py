@@ -70,10 +70,18 @@ def arrow_to_arrays(table: pa.Table):
 
 
 class SnappyFlightServer(flight.FlightServerBase):
-    def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
+                 auth_tokens: Optional[dict] = None):
+        """`auth_tokens`: token → user map. When configured, EVERY request
+        must carry a valid `token` field and runs as that principal (so
+        GRANT/REVOKE applies); when absent, requests run as an
+        UNAUTHENTICATED remote session — EXEC PYTHON is refused either way
+        unless the principal is an authenticated admin (advisor finding:
+        the network surface used to run as the admin superuser)."""
         location = f"grpc://{host}:{port}"
         super().__init__(location)
         self.session = session
+        self.auth_tokens = auth_tokens or {}
         self.host = host
         self._location = location
 
@@ -81,20 +89,31 @@ class SnappyFlightServer(flight.FlightServerBase):
     def actual_port(self) -> int:
         return self.port
 
+    def _session_for(self, body: Optional[dict]):
+        """Per-request principal session (ref: SnappySessionPerConnection,
+        SparkSQLExecuteImpl.scala:99)."""
+        if self.auth_tokens:
+            user = self.auth_tokens.get((body or {}).get("token"))
+            if user is None:
+                raise flight.FlightUnauthenticatedError(
+                    "missing or invalid token")
+            return self.session.for_user(user, authenticated=True)
+        return self.session.for_user(self.session.user, authenticated=False)
+
     # -- queries ----------------------------------------------------------
 
     def do_get(self, context, ticket: flight.Ticket):
         req = json.loads(ticket.ticket.decode("utf-8"))
-        result = self.session.sql(req["sql"],
-                                  params=tuple(req.get("params", ())))
+        result = self._session_for(req).sql(
+            req["sql"], params=tuple(req.get("params", ())))
         return flight.RecordBatchStream(result_to_arrow(result))
 
     def get_flight_info(self, context, descriptor):
         req = json.loads(descriptor.command.decode("utf-8"))
         # execute eagerly to learn the schema (plan-cache makes re-exec in
         # do_get cheap); proper lazy schema derivation is a later round
-        result = self.session.sql(req["sql"],
-                                  params=tuple(req.get("params", ())))
+        result = self._session_for(req).sql(
+            req["sql"], params=tuple(req.get("params", ())))
         table = result_to_arrow(result)
         endpoint = flight.FlightEndpoint(
             descriptor.command, [flight.Location(self._location)])
@@ -104,24 +123,34 @@ class SnappyFlightServer(flight.FlightServerBase):
     # -- bulk ingest ------------------------------------------------------
 
     def do_put(self, context, descriptor, reader, writer):
-        target = descriptor.path[0].decode("utf-8") if descriptor.path \
-            else json.loads(descriptor.command.decode("utf-8"))["table"]
+        if descriptor.path:
+            target, body = descriptor.path[0].decode("utf-8"), None
+        else:
+            body = json.loads(descriptor.command.decode("utf-8"))
+            target = body["table"]
+        sess = self._session_for(body)   # raises if auth on and no token
+        sess._require(target, "insert")
         table = reader.read_all()
         arrays, nulls = arrow_to_arrays(table)
         info = self.session.catalog.describe(target)
         from snappydata_tpu.storage.table_store import RowTableData
 
+        # WAL-then-apply under the store's mutation lock (same invariant as
+        # session mutations: journal first so a concurrent checkpoint can't
+        # fold un-journaled rows, and carry null masks so recovery doesn't
+        # turn bulk-ingested NULLs into zeros).
         if isinstance(info.data, RowTableData):
             from snappydata_tpu.session import _restore_none_arrays
 
-            info.data.insert_arrays(_restore_none_arrays(arrays, nulls))
+            raw = _restore_none_arrays(arrays, nulls)
+            self.session._journal_then(
+                info, "insert", raw, None,
+                lambda: info.data.insert_arrays(raw))
         else:
-            info.data.insert_arrays(
-                arrays, nulls=nulls if any(m is not None for m in nulls)
-                else None)
-        if self.session.disk_store is not None:
-            self.session.disk_store.wal_append(target.lower(), "insert",
-                                               arrays=arrays)
+            nmask = nulls if any(m is not None for m in nulls) else None
+            self.session._journal_then(
+                info, "insert", arrays, nmask,
+                lambda: info.data.insert_arrays(arrays, nulls=nmask))
 
     # -- ops --------------------------------------------------------------
 
@@ -130,16 +159,20 @@ class SnappyFlightServer(flight.FlightServerBase):
         body = json.loads(action.body.to_pybytes().decode("utf-8")) \
             if action.body else {}
         if name == "sql":
-            result = self.session.sql(body["sql"],
-                                      params=tuple(body.get("params", ())))
+            result = self._session_for(body).sql(
+                body["sql"], params=tuple(body.get("params", ())))
             payload = {"names": result.names,
                        "rows": [[_json_val(v) for v in r]
                                 for r in result.rows()[:1000]]}
             yield flight.Result(json.dumps(payload).encode("utf-8"))
         elif name == "checkpoint":
+            sess = self._session_for(body)
+            if self.auth_tokens and sess.user != "admin":
+                raise flight.FlightServerError("checkpoint requires admin")
             self.session.checkpoint()
             yield flight.Result(b"{}")
         elif name == "stats":
+            self._session_for(body)  # catalog metadata: token when auth on
             from snappydata_tpu.observability import TableStatsService
 
             stats = TableStatsService(self.session.catalog).collect_once()

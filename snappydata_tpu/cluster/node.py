@@ -58,8 +58,10 @@ class _MemberNode:
     def _start_flight(self) -> int:
         from snappydata_tpu.cluster.flight_server import SnappyFlightServer
 
+        tokens = self.session.conf.get("auth_tokens") or None
         self.flight = SnappyFlightServer(self.session, self.host,
-                                         self._flight_port)
+                                         self._flight_port,
+                                         auth_tokens=tokens)
         self._flight_thread = threading.Thread(target=self.flight.serve,
                                                daemon=True)
         self._flight_thread.start()
@@ -151,7 +153,9 @@ class LeadNode(_MemberNode):
         self.stats_service = TableStatsService(self.session.catalog).start()
         self.rest = RestService(self.session, self.stats_service,
                                 membership=self.membership,
-                                host=self.host, port=self.rest_port).start()
+                                host=self.host, port=self.rest_port,
+                                auth_tokens=self.session.conf.get(
+                                    "auth_tokens") or None).start()
         self.is_primary = True
 
     def _step_down(self) -> None:

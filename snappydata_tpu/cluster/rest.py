@@ -25,14 +25,15 @@ class JobRegistry:
         self._jobs: Dict[str, dict] = {}
         self._lock = threading.Lock()
 
-    def submit_sql(self, sql: str, params=()) -> str:
+    def submit_sql(self, sql: str, params=(), session=None) -> str:
         job_id = uuid.uuid4().hex[:12]
+        sess = session or self.session
         with self._lock:
             self._jobs[job_id] = {"status": "RUNNING", "sql": sql}
 
         def run():
             try:
-                result = self.session.sql(sql, params=params)
+                result = sess.sql(sql, params=params)
                 with self._lock:
                     self._jobs[job_id].update(
                         status="FINISHED",
@@ -101,10 +102,17 @@ text-align:left}}h2{{margin-top:1.5em}}</style></head><body>
 
 class RestService:
     def __init__(self, session, stats_service, membership=None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 auth_tokens=None):
+        """`auth_tokens`: token → user map. When configured, job submission
+        requires `Authorization: Bearer <token>` (or `X-Snappy-Token`) and
+        runs as that principal; when absent, jobs run as an unauthenticated
+        remote session (EXEC PYTHON refused — advisor finding: the job
+        endpoint used to execute arbitrary SQL as the admin superuser)."""
         self.session = session
         self.stats_service = stats_service
         self.membership = membership
+        self.auth_tokens = auth_tokens or {}
         self.jobs = JobRegistry(session)
         svc = self
 
@@ -144,22 +152,47 @@ class RestService:
                     self._send(_render_dashboard(svc).encode(),
                                content_type="text/html")
                 elif path.startswith("/jobs/"):
+                    # job results carry query rows: same auth as submission
+                    if self._principal_session() is None:
+                        return
                     st = svc.jobs.status(path.split("/")[-1])
                     self._send(st if st else {"error": "no such job"},
                                200 if st else 404)
                 elif path == "/jobs":
+                    if self._principal_session() is None:
+                        return
                     self._send(svc.jobs.list())
                 else:
                     self._send({"error": "not found"}, 404)
+
+            def _principal_session(self):
+                """Resolve the request principal; None → 401 already sent."""
+                token = self.headers.get("X-Snappy-Token")
+                if token is None:
+                    auth = self.headers.get("Authorization", "")
+                    if auth.startswith("Bearer "):
+                        token = auth[len("Bearer "):]
+                if svc.auth_tokens:
+                    user = svc.auth_tokens.get(token)
+                    if user is None:
+                        self._send({"error": "missing or invalid token"},
+                                   401)
+                        return None
+                    return svc.session.for_user(user, authenticated=True)
+                return svc.session.for_user(svc.session.user,
+                                            authenticated=False)
 
             def do_POST(self):
                 path = self.path.rstrip("/")
                 length = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(length) or b"{}")
                 if path == "/jobs":
-                    job_id = svc.jobs.submit_sql(body["sql"],
-                                                 tuple(body.get("params",
-                                                                ())))
+                    sess = self._principal_session()
+                    if sess is None:
+                        return
+                    job_id = svc.jobs.submit_sql(
+                        body["sql"], tuple(body.get("params", ())),
+                        session=sess)
                     self._send({"jobId": job_id, "status": "STARTED"})
                 else:
                     self._send({"error": "not found"}, 404)

@@ -96,13 +96,23 @@ def global_properties() -> Properties:
     return _global
 
 
+_use_float64_cached: Optional[bool] = None
+
+
 def use_float64() -> bool:
     """Decimal/compute dtype policy: float64 on CPU (exact test oracle),
     float32 on TPU (no fast f64 there). Integer width is NOT policy —
     LONG/TIMESTAMP are always int64, which is why the package force-enables
-    jax x64 at import (int64 silently wraps to int32 otherwise)."""
+    jax x64 at import (int64 silently wraps to int32 otherwise).
+
+    The backend query happens at most ONCE per process and the answer is
+    cached — a flaky accelerator backend must never be re-consulted
+    mid-query/mid-ingest (round-1 bench crashed exactly there)."""
+    global _use_float64_cached
     if _global.decimal_as_float64 is not None:
         return _global.decimal_as_float64
-    import jax
+    if _use_float64_cached is None:
+        import jax
 
-    return jax.default_backend() == "cpu"
+        _use_float64_cached = jax.default_backend() == "cpu"
+    return _use_float64_cached

@@ -58,6 +58,24 @@ class SnappySession:
         self.analyzer = Analyzer(catalog)
         self.executor = Executor(catalog, self.conf)
 
+    def for_user(self, user: str, remote: bool = True,
+                 authenticated: bool = False) -> "SnappySession":
+        """A session for `user` sharing this session's catalog, conf and
+        disk store — the per-request principal on network surfaces (ref:
+        SnappySessionPerConnection, SparkSQLExecuteImpl.scala:99). `remote`
+        marks it network-derived (gates EXEC PYTHON); `authenticated` means
+        the principal was established by a verified credential."""
+        s = SnappySession(catalog=self.catalog, conf=self.conf, user=user)
+        s.disk_store = self.disk_store
+        # plan cache + analyzer state are user-independent (RLS predicates
+        # are injected per-plan at resolution) — share them so per-request
+        # sessions keep the compiled-plan cache warm
+        s.analyzer = self.analyzer
+        s.executor = self.executor
+        s.remote = remote
+        s.authenticated = authenticated
+        return s
+
     def checkpoint(self) -> None:
         """Persist all tables + catalog to the attached disk store and fold
         the WAL (ref: disk-store flush / backup base image)."""
@@ -258,6 +276,17 @@ class SnappySession:
             self.conf.set(stmt.key, stmt.value)
             return _status()
         if isinstance(stmt, ast.ExecCode):
+            # EXEC PYTHON is arbitrary code execution: on network-derived
+            # sessions it requires an AUTHENTICATED admin principal — an
+            # unauthenticated network caller must never reach it (advisor
+            # finding: REST/Flight ran as the admin superuser, an RCE).
+            if getattr(self, "remote", False) and not (
+                    getattr(self, "authenticated", False)
+                    and self.user == "admin"):
+                raise PermissionError(
+                    "EXEC PYTHON is refused on network surfaces unless an "
+                    "authenticated admin principal is established "
+                    "(configure auth_tokens and pass the admin token)")
             return self._exec_code(stmt.code)
         if isinstance(stmt, ast.ExplainStmt):
             return self._explain(stmt.query)

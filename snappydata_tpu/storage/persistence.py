@@ -44,6 +44,21 @@ from snappydata_tpu.storage.table_store import (BatchView, ColumnTableData,
 _MAGIC = b"SNTP"
 
 
+import contextlib
+
+
+@contextlib.contextmanager
+def _no_journal(session):
+    """Detach the session's disk store so statements executed during
+    recovery are not re-journaled (they came FROM the journal/catalog)."""
+    saved = session.disk_store
+    session.disk_store = None
+    try:
+        yield
+    finally:
+        session.disk_store = saved
+
+
 def _np_json(v):
     """json serializer for numpy scalars/arrays inside ARRAY cells."""
     if isinstance(v, np.ndarray):
@@ -229,6 +244,22 @@ class DiskStore:
     def _wal_path(self) -> str:
         return os.path.join(self.path, "wal.log")
 
+    @staticmethod
+    def _durable_replace(tmp: str, dst: str) -> None:
+        """fsync(tmp) → rename → fsync(dir): a checkpoint artifact must be
+        on stable storage BEFORE anything (like WAL rotation) assumes it is
+        — the reference's oplog stores fsync before truncating. A power
+        loss right after os.replace without these leaves an empty/partial
+        file whose covering WAL records were already discarded."""
+        with open(tmp, "rb") as fh:
+            os.fsync(fh.fileno())
+        os.replace(tmp, dst)
+        dfd = os.open(os.path.dirname(dst) or ".", os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
+
     def _scan_last_seq(self) -> int:
         last = 0
         if os.path.exists(self._wal_path()):
@@ -265,7 +296,7 @@ class DiskStore:
             json.dump({"version": 1, "tables": tables, "views": views,
                        "topks": topks, "aux_ddl": aux,
                        "grants": grants}, fh, indent=1)
-        os.replace(tmp, os.path.join(self.path, "catalog.json"))
+        self._durable_replace(tmp, os.path.join(self.path, "catalog.json"))
 
     # -- checkpoint ------------------------------------------------------
 
@@ -281,8 +312,8 @@ class DiskStore:
                                               info.schema.fields],
                                   "wal_seq": wal_seq},
                              list(arrays) + list(masks))
-            os.replace(os.path.join(tdir, "rows.tmp"),
-                       os.path.join(tdir, "rows.dat"))
+            self._durable_replace(os.path.join(tdir, "rows.tmp"),
+                                  os.path.join(tdir, "rows.dat"))
             return
         data: ColumnTableData = info.data
         m = data.snapshot()
@@ -318,12 +349,12 @@ class DiskStore:
                          list(m.row_arrays) + [
                              nm for nm in (m.row_nulls or
                                            [None] * len(m.row_arrays))])
-        os.replace(os.path.join(tdir, "rowbuf.tmp"),
-                   os.path.join(tdir, "rowbuf.dat"))
+        self._durable_replace(os.path.join(tdir, "rowbuf.tmp"),
+                              os.path.join(tdir, "rowbuf.dat"))
         tmp = os.path.join(tdir, "manifest.json.tmp")
         with open(tmp, "w") as fh:
             json.dump(manifest, fh)
-        os.replace(tmp, os.path.join(tdir, "manifest.json"))
+        self._durable_replace(tmp, os.path.join(tdir, "manifest.json"))
         # GC batches dropped from the manifest (deletes/truncate)
         live = {e["file"] for e in batch_entries}
         for f in os.listdir(tdir):
@@ -359,7 +390,7 @@ class DiskStore:
                 write_record(fh, header,
                              [col.data, col.dictionary, col.runs,
                               col.validity])
-        os.replace(fpath + ".tmp", fpath)
+        self._durable_replace(fpath + ".tmp", fpath)
 
     # -- WAL -------------------------------------------------------------
 
@@ -416,7 +447,7 @@ class DiskStore:
             if self._wal_fh is not None:
                 self._wal_fh.close()
                 self._wal_fh = None
-            os.replace(tmp, self._wal_path())
+            self._durable_replace(tmp, self._wal_path())
 
     def drop_table_dir(self, table: str) -> None:
         """DROP TABLE: journal a drop marker, remove the on-disk dir (a
@@ -466,13 +497,25 @@ class DiskStore:
             session = SnappySession(catalog=catalog)
         else:
             session.catalog = catalog
+        # Views must exist BEFORE WAL replay: a journaled statement may read
+        # one (INSERT INTO t SELECT ... FROM some_view) and replay swallows
+        # statement errors, silently dropping committed rows otherwise. A
+        # view over a table only created later in the WAL can't restore yet
+        # — retry those after replay.
+        pending_views = {}
+        with _no_journal(session):  # recovery DDL must not re-journal
+            for name, ddl in (meta.get("views") or {}).items():
+                try:
+                    session.sql(ddl)
+                except Exception:
+                    pending_views[name] = ddl
         self._replay_wal(catalog, session, folded)
-        # views: re-execute their DDL (needs tables present)
-        for name, ddl in (meta.get("views") or {}).items():
-            try:
-                session.sql(ddl)
-            except Exception:
-                pass  # view over a dropped table: skip, like a stale view
+        with _no_journal(session):
+            for name, ddl in pending_views.items():
+                try:
+                    session.sql(ddl)
+                except Exception:
+                    pass  # view over a dropped table: skip, like stale view
         catalog._view_ddl = dict(meta.get("views") or {})
         # policies/indexes: re-execute their DDL. A failing POLICY is a
         # security regression (the table would come up unfiltered) — fail
@@ -619,14 +662,9 @@ class DiskStore:
         wal = self._wal_path()
         if not os.path.exists(wal):
             return
-        # replay must not re-journal: detach the session's store for the
-        # duration (records already ARE the journal)
-        saved_store = session.disk_store
-        session.disk_store = None
-        try:
+        # replay must not re-journal (records already ARE the journal)
+        with _no_journal(session):
             self._replay_wal_inner(catalog, session, folded, wal)
-        finally:
-            session.disk_store = saved_store
 
     def _replay_wal_inner(self, catalog, session, folded: Dict[str, int],
                           wal: str) -> None:
