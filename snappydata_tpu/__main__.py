@@ -1,3 +1,17 @@
+import os
+
+# Honor JAX_PLATFORMS for CLI-launched processes even when a site
+# bootstrap (e.g. an accelerator plugin's sitecustomize) force-set the
+# platform list at interpreter start: cluster members must be able to run
+# CPU-only (several per host, none monopolizing the accelerator).
+if os.environ.get("JAX_PLATFORMS"):
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except Exception:
+        pass  # backend already initialized; leave it be
+
 from snappydata_tpu.cli import main
 
 raise SystemExit(main())

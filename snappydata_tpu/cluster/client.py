@@ -92,8 +92,10 @@ class SnappyClient:
         return json.loads(results[0].body.to_pybytes().decode("utf-8"))
 
     def insert(self, table: str, columns: dict) -> None:
-        """Bulk columnar ingest via do_put."""
-        arrow = pa.table(columns)
+        """Bulk columnar ingest via do_put. `columns` is a name → array
+        dict or a ready pyarrow Table."""
+        arrow = columns if isinstance(columns, pa.Table) else \
+            pa.table(columns)
         if self._token is not None:
             descriptor = flight.FlightDescriptor.for_command(json.dumps(
                 {"table": table, "token": self._token}).encode("utf-8"))
@@ -102,6 +104,15 @@ class SnappyClient:
         writer, _ = self._client().do_put(descriptor, arrow.schema)
         writer.write_table(arrow)
         writer.close()
+
+    def repartition(self, body: dict) -> dict:
+        """Ask this server to hash-repartition its shard of body['table']
+        by body['key'] into body['dest'] across body['servers'] (the
+        shuffle-exchange fan-out)."""
+        raw = json.dumps(self._with_token(dict(body))).encode("utf-8")
+        results = list(self._client().do_action(
+            flight.Action("repartition", raw)))
+        return json.loads(results[0].body.to_pybytes().decode("utf-8"))
 
     def _with_token(self, body: dict) -> dict:
         if self._token is not None:

@@ -61,6 +61,7 @@ class DistributedSession:
                                 if m.role == "server" and m.port]
         if not server_addresses:
             raise DistributedError("no data servers found")
+        self.server_addresses = list(server_addresses)
         self.servers = [SnappyClient(address=a) for a in server_addresses]
         self.num_buckets = num_buckets
         # planning catalog: schemas only (no data) on the lead
@@ -75,6 +76,14 @@ class DistributedSession:
             self.planner.execute_statement(stmt)
             for srv in self.servers:
                 srv.execute(sql_text)
+            # a recreated/truncated table must never reuse exchange temps
+            from snappydata_tpu.catalog.catalog import _norm
+
+            nm = _norm(stmt.name)
+            getattr(self, "_bcast_cache", {}).pop(nm, None)
+            for k in [k for k in getattr(self, "_shuf_cache", {})
+                      if k.startswith(f"__shuf_{nm}_")]:
+                self._shuf_cache.pop(k, None)
             from snappydata_tpu.engine.result import empty_result
 
             return empty_result(["status"], [T.STRING])
@@ -174,7 +183,7 @@ class DistributedSession:
     # ------------------------------------------------------------------
 
     def _query(self, plan: ast.Plan):
-        plan = self._broadcast_exchange(plan)
+        plan = self._plan_exchanges(plan)
         self._check_scatterable(plan)
         # peel ORDER BY / LIMIT: they apply after the merge
         outer: List = []
@@ -193,26 +202,43 @@ class DistributedSession:
             result = self._scatter_concat(node, outer)
         return result
 
-    def _broadcast_exchange(self, plan: ast.Plan) -> ast.Plan:
-        """Joins of non-collocated partitioned tables: ship the SMALLER
-        side to every server as a temporary replicated table, making the
-        join shard-local — the reference's broadcast/replicated hash-join
-        exchange (HashJoinStrategies size threshold; here bounded by
-        `broadcast_rows`). Leaves the plan unchanged when tables are
-        already collocated/replicated or both sides are too big."""
-        broadcast_rows = 500_000
-        tables: Dict[str, object] = {}
+    # ------------------------------------------------------------------
+    # exchange planning: broadcast + hash-repartition (shuffle)
+    # ------------------------------------------------------------------
+
+    def _plan_exchanges(self, plan: ast.Plan) -> ast.Plan:
+        """Make every join shard-local. Non-collocated partitioned tables
+        are fixed by, in order of preference per join edge:
+
+        1. keep the bigger side in place when it is already partitioned on
+           its join column and HASH-REPARTITION the other side onto its
+           join column, colocated with it — each server re-buckets its
+           shard by murmur3 of the new key and streams the pieces
+           peer-to-peer over Flight (ref: Spark exchange fallback in
+           SnappyStrategies.scala:80-128, re-shaped as server-to-server
+           Arrow streams instead of a driver-mediated shuffle);
+        2. BROADCAST the smaller side to every server when it fits the
+           hash_join_size byte budget (inner joins only — a broadcast
+           PRESERVED side of an outer join would null-extend per server
+           and duplicate rows);
+        3. repartition BOTH sides onto the join keys into a fresh
+           colocation group.
+
+        Exchanges materialize as temp tables cached by the source table's
+        mutation VERSION (not row count — updates that keep the count
+        constant still invalidate)."""
+        infos: Dict[str, object] = {}
 
         def rec(p):
             if isinstance(p, ast.UnresolvedRelation):
                 info = self.planner.catalog.lookup_table(p.name)
                 if info is not None:
-                    tables[info.name] = info
+                    infos.setdefault(info.name, info)
             for k in p.children():
                 rec(k)
 
         rec(plan)
-        partitioned = [t for t in tables.values() if t.partition_by]
+        partitioned = [t for t in infos.values() if t.partition_by]
         if len(partitioned) <= 1:
             return plan
         try:
@@ -220,44 +246,191 @@ class DistributedSession:
             return plan  # already collocated: no exchange needed
         except DistributedError:
             pass
-        # outer joins: a broadcast PRESERVED side would null-extend on
-        # every server (duplicated rows) — keep the clear error instead
-        def has_outer(p):
-            if isinstance(p, ast.Join) and p.how in ("left", "right",
-                                                     "full"):
-                return True
-            return any(has_outer(k) for k in p.children())
 
-        if has_outer(plan):
-            return plan
-        sizes = {}
-        for t in partitioned:
-            total = 0
-            for srv in self.servers:
-                r = srv.execute(f"SELECT count(*) FROM {t.name}")
-                total += int(r["rows"][0][0]) if r.get("rows") else 0
-            sizes[t.name] = total
-        # pick the smallest table whose REMOVAL leaves the remaining
-        # partitioned tables mutually collocated (review finding: the
-        # globally-smallest choice could leave the conflict in place)
-        name = None
-        for cand, size in sorted(sizes.items(), key=lambda kv: kv[1]):
-            if size > broadcast_rows:
-                break
-            remaining = [t for t in partitioned if t.name != cand]
-            if self._mutually_collocated(remaining):
-                name = cand
-                break
-        if name is None:
-            return plan  # no single broadcast resolves it → clear error
-        size = sizes[name]
-        # materialize the small table on the lead and replicate it;
-        # cached by (table, global row count) so repeat queries over an
-        # unchanged table reuse the existing replica (review finding)
+        stats = self._global_table_stats([t.name for t in partitioned])
+        edges = self._join_edges(plan, list(infos.values()))
+        has_outer = self._has_outer(plan)
+        bcast_limit = self.planner.conf.hash_join_size
+
+        assigned = {t.name: t.partition_by[0].lower() for t in partitioned}
+        root = {t.name: self._colo_root(t) for t in partitioned}
+        pinned: set = set()
+        moved: Dict[str, Tuple[str, Optional[str]]] = {}  # name→(key,anchor)
+        bcast: set = set()
+
+        def size_b(nm):
+            return stats[nm]["bytes"]
+
+        part_names = set(assigned)
+        edges = [(a, ca, b, cb) for a, ca, b, cb in edges
+                 if a in part_names and b in part_names and a != b]
+        edges.sort(key=lambda e: -min(size_b(e[0]), size_b(e[2])))
+        pair_edges: Dict[frozenset, List[Tuple[str, str, str, str]]] = {}
+        for e in edges:
+            pair_edges.setdefault(frozenset((e[0], e[2])), []).append(e)
+
+        def pair_resolved(a: str, b: str) -> bool:
+            """A composite-key join is shard-local as soon as the pair
+            shares a colocation root via ANY of its equi columns — the
+            remaining equalities are residual filters."""
+            if root[a] != root[b]:
+                return False
+            for x, cx, y, cy in pair_edges[frozenset((a, b))]:
+                if x == a and assigned[a] == cx and assigned[b] == cy:
+                    return True
+                if x == b and assigned[b] == cx and assigned[a] == cy:
+                    return True
+            return False
+
+        for a, ca, b, cb in edges:
+            if a in bcast or b in bcast:
+                continue  # edge resolved by replication
+            if pair_resolved(a, b):
+                pinned.update((a, b))
+                continue
+            if size_b(a) >= size_b(b):
+                big, bc_col, small, sm_col = a, ca, b, cb
+            else:
+                big, bc_col, small, sm_col = b, cb, a, ca
+            if assigned[big] == bc_col and small not in pinned:
+                moved[small] = (sm_col, big)
+                assigned[small], root[small] = sm_col, root[big]
+                pinned.update((big, small))
+                continue
+            if assigned[small] == sm_col and big not in pinned:
+                moved[big] = (bc_col, small)
+                assigned[big], root[big] = bc_col, root[small]
+                pinned.update((big, small))
+                continue
+            if not has_outer and size_b(small) <= bcast_limit and \
+                    small not in pinned:
+                bcast.add(small)
+                continue
+            if big not in pinned and small not in pinned:
+                # fresh colocation group keyed on this edge
+                moved[big] = (bc_col, None)
+                assigned[big], root[big] = bc_col, f"__grp_{big}"
+                moved[small] = (sm_col, big)
+                assigned[small], root[small] = sm_col, root[big]
+                pinned.update((big, small))
+                continue
+            raise DistributedError(
+                f"cannot make join of {a} and {b} shard-local: both sides "
+                f"are pinned to conflicting partition keys and "
+                f"{'outer join forbids broadcast' if has_outer else 'neither fits the broadcast budget'}")
+
+        if not moved and not bcast:
+            return plan  # unresolvable here → _check_scatterable errors
+
+        final = {t.name: t.name for t in partitioned}
+        for nm in bcast:
+            final[nm] = self._materialize_broadcast(nm, stats[nm])
+        # anchors (fresh-group heads, anchor=None) first so dependents can
+        # COLOCATE_WITH their temp table
+        for nm, (key, anchor) in sorted(
+                moved.items(), key=lambda kv: kv[1][1] is not None):
+            anchor_final = final.get(anchor, anchor) if anchor else None
+            final[nm] = self._materialize_shuffle(nm, key, anchor_final,
+                                                  stats[nm])
+        mapping = {orig: f for orig, f in final.items() if f != orig}
+        return _rename_tables(plan, mapping)
+
+    def _has_outer(self, plan: ast.Plan) -> bool:
+        if isinstance(plan, ast.Join) and plan.how in ("left", "right",
+                                                       "full"):
+            return True
+        return any(self._has_outer(k) for k in plan.children())
+
+    def _global_table_stats(self, names) -> Dict[str, dict]:
+        """One stats() round-trip per server → global rows/bytes and a
+        version token (tuple of per-server mutation versions)."""
+        per_server = [srv.stats() for srv in self.servers]
+        out = {}
+        for nm in names:
+            rows = bytes_ = 0
+            versions = []
+            for st in per_server:
+                t = st.get(nm) or {}
+                rows += t.get("row_count", 0)
+                bytes_ += t.get("in_memory_bytes", 0)
+                versions.append((t.get("data_id", -1),
+                                 t.get("version", -1)))
+            # row-buffer rows aren't in batch bytes yet: floor the estimate
+            out[nm] = {"rows": rows, "bytes": max(bytes_, rows * 32),
+                       "version_token": tuple(versions)}
+        return out
+
+    def _join_edges(self, plan: ast.Plan, infos) -> List[Tuple[str, str,
+                                                               str, str]]:
+        """Equality join edges with columns resolved to their tables:
+        (table_a, col_a, table_b, col_b). Qualified columns resolve via
+        the alias; bare columns by unique schema membership."""
+        alias_map: Dict[str, str] = {}
+
+        def walk(p):
+            if isinstance(p, ast.UnresolvedRelation):
+                info = self.planner.catalog.lookup_table(p.name)
+                if info is not None:
+                    alias = (p.alias or p.name.split(".")[-1]).lower()
+                    alias_map[alias] = info.name
+                    alias_map.setdefault(info.name.lower(), info.name)
+            for k in p.children():
+                walk(k)
+
+        walk(plan)
+        by_col: Dict[str, List[str]] = {}
+        for info in infos:
+            for f in info.schema.fields:
+                by_col.setdefault(f.name.lower(), []).append(info.name)
+
+        def resolve(col: ast.Col) -> Optional[Tuple[str, str]]:
+            nm = col.name.lower()
+            if col.qualifier:
+                t = alias_map.get(col.qualifier.lower())
+                return (t, nm) if t else None
+            owners = by_col.get(nm, [])
+            return (owners[0], nm) if len(owners) == 1 else None
+
+        edges: List[Tuple[str, str, str, str]] = []
+
+        def collect(p):
+            conds = []
+            if isinstance(p, ast.Join) and p.condition is not None:
+                conds.append(p.condition)
+            if isinstance(p, ast.Filter):
+                conds.append(p.condition)
+            for cond in conds:
+                def flat(e):
+                    if isinstance(e, ast.BinOp) and e.op == "and":
+                        flat(e.left)
+                        flat(e.right)
+                    elif isinstance(e, ast.BinOp) and e.op == "=" and \
+                            isinstance(e.left, ast.Col) and \
+                            isinstance(e.right, ast.Col):
+                        ra, rb = resolve(e.left), resolve(e.right)
+                        if ra and rb and ra[0] != rb[0]:
+                            edges.append((ra[0], ra[1], rb[0], rb[1]))
+                flat(cond)
+            for k in p.children():
+                collect(k)
+
+        collect(plan)
+        return edges
+
+    def _colo_root(self, t) -> str:
+        root = t.colocate_with or t.name
+        base = self.planner.catalog.lookup_table(root)
+        if base is not None and base.colocate_with:
+            root = base.colocate_with
+        return root
+
+    def _materialize_broadcast(self, name: str, stat: dict) -> str:
+        """Replicate `name` to every server as a temp table (version-cached
+        — the reference's replicated-table hash join build side)."""
         tmp = f"__bcast_{name}"
         if not hasattr(self, "_bcast_cache"):
             self._bcast_cache = {}
-        if self._bcast_cache.get(name) != size:
+        if self._bcast_cache.get(name) != stat["version_token"]:
             import pyarrow as pa
 
             pieces = [srv.sql(f"SELECT * FROM {name}")
@@ -274,27 +447,41 @@ class DistributedSession:
             arrays, nulls = arrow_to_arrays(merged)
             if merged.num_rows:
                 self.insert_arrays(tmp, arrays, nulls=nulls)
-            self._bcast_cache[name] = size
+            self._bcast_cache[name] = stat["version_token"]
+        return tmp
 
-        def rename(p):
-            import dataclasses as _dc
-
-            if isinstance(p, ast.UnresolvedRelation):
-                from snappydata_tpu.catalog.catalog import _norm
-
-                if _norm(p.name) == name:
-                    return ast.UnresolvedRelation(
-                        tmp, alias=p.alias or p.name.split(".")[-1])
-                return p
-            kids = p.children()
-            if not kids:
-                return p
-            if isinstance(p, (ast.Join, ast.Union)):
-                return _dc.replace(p, left=rename(p.left),
-                                   right=rename(p.right))
-            return _dc.replace(p, child=rename(kids[0]))
-
-        return rename(plan)
+    def _materialize_shuffle(self, name: str, key: str,
+                             anchor_final: Optional[str],
+                             stat: dict) -> str:
+        """Hash-repartition `name` onto `key` across the servers into a
+        temp table (optionally colocated with `anchor_final`). Every
+        server re-buckets its own shard and pushes sub-shards directly to
+        their owners — the lead only coordinates."""
+        # the anchor is part of the temp's identity: the same table shuffled
+        # on the same key but colocated with a DIFFERENT anchor is a
+        # different placement contract (review finding)
+        tmp = f"__shuf_{name}_{key}" + \
+            (f"__w_{anchor_final}" if anchor_final else "")
+        if not hasattr(self, "_shuf_cache"):
+            self._shuf_cache = {}
+        if self._shuf_cache.get(tmp) == stat["version_token"]:
+            return tmp
+        info = self.planner.catalog.describe(name)
+        ddl_cols = ", ".join(f"{f.name} {_ddl_type(f.dtype)}"
+                             for f in info.schema.fields)
+        opts = f"partition_by '{key}'"
+        if anchor_final:
+            opts += f", colocate_with '{anchor_final}'"
+        self.sql(f"DROP TABLE IF EXISTS {tmp}")
+        self.sql(f"CREATE TABLE {tmp} ({ddl_cols}) USING column "
+                 f"OPTIONS ({opts})")
+        addrs = list(self.server_addresses)
+        body = {"table": name, "key": key, "dest": tmp, "servers": addrs,
+                "num_buckets": self.num_buckets}
+        for srv in self.servers:
+            srv.repartition(body)
+        self._shuf_cache[tmp] = stat["version_token"]
+        return tmp
 
     def _mutually_collocated(self, partitioned) -> bool:
         if len(partitioned) <= 1:
@@ -335,9 +522,10 @@ class DistributedSession:
             roots = {r for r, _ in groups}
             if len(roots) > 1:
                 raise DistributedError(
-                    "join of non-collocated partitioned tables needs a "
-                    "shuffle exchange (later round); COLOCATE_WITH them "
-                    "or replicate one side")
+                    "could not plan an exchange for this join of "
+                    "non-collocated partitioned tables (no usable "
+                    "equality join keys); join ON the partition keys, "
+                    "COLOCATE_WITH the tables, or replicate one side")
             # collocation only makes local joins complete when the join is
             # keyed ON the partition key — verify an equality between the
             # partition-key columns of every partitioned table pair exists
@@ -497,8 +685,36 @@ class DistributedSession:
                 self.sql(f"DROP TABLE IF EXISTS __bcast_{name}")
             except Exception:
                 pass
+        for tmp in list(getattr(self, "_shuf_cache", {})):
+            try:
+                self.sql(f"DROP TABLE IF EXISTS {tmp}")
+            except Exception:
+                pass
         for srv in self.servers:
             srv.close()
+
+
+def _rename_tables(plan: ast.Plan, mapping: Dict[str, str]) -> ast.Plan:
+    """Swap relations for their exchange temp tables, keeping the original
+    alias so the rest of the plan resolves unchanged."""
+    from snappydata_tpu.catalog.catalog import _norm
+
+    def rename(p):
+        if isinstance(p, ast.UnresolvedRelation):
+            target = mapping.get(_norm(p.name))
+            if target is not None:
+                return ast.UnresolvedRelation(
+                    target, alias=p.alias or p.name.split(".")[-1])
+            return p
+        kids = p.children()
+        if not kids:
+            return p
+        if isinstance(p, (ast.Join, ast.Union)):
+            return dataclasses.replace(p, left=rename(p.left),
+                                       right=rename(p.right))
+        return dataclasses.replace(p, child=rename(kids[0]))
+
+    return rename(plan)
 
 
 def _merge_ref(slot: int, merge_fn: str) -> ast.Expr:

@@ -28,13 +28,17 @@ import pyarrow.flight as flight
 from snappydata_tpu import types as T
 
 
-def result_to_arrow(result) -> pa.Table:
+def result_to_arrow(result, sel: Optional[np.ndarray] = None) -> pa.Table:
+    """Result → Arrow table; `sel` optionally selects a row subset (used by
+    the repartition exchange to ship one peer's shard)."""
     arrays = []
     names = []
     for name, col, nmask, dtype in zip(result.names, result.columns,
                                        result.nulls, result.dtypes):
         names.append(name)
-        mask = pa.array(nmask) if nmask is not None else None
+        if sel is not None:
+            col = np.asarray(col)[sel]
+            nmask = np.asarray(nmask)[sel] if nmask is not None else None
         if dtype.name == "string" or col.dtype == object:
             arrays.append(pa.array(
                 [None if (nmask is not None and nmask[i]) or v is None
@@ -177,10 +181,55 @@ class SnappyFlightServer(flight.FlightServerBase):
 
             stats = TableStatsService(self.session.catalog).collect_once()
             yield flight.Result(json.dumps(stats).encode("utf-8"))
+        elif name == "repartition":
+            # Peer-to-peer hash-repartition (shuffle) exchange: THIS server
+            # re-buckets its local shard of `table` by `key` and streams
+            # each peer's sub-shard straight to that peer's `dest` table
+            # over do_put — no lead-side materialization (ref: Spark
+            # exchange fallback, SnappyStrategies.scala:80-128, re-shaped
+            # as server-to-server Arrow Flight streams).
+            sess = self._session_for(body)
+            sess._require(body["table"], "select")
+            n = self._repartition_shard(
+                sess, body["table"], body["key"], body["dest"],
+                body["servers"], int(body["num_buckets"]),
+                body.get("token"))
+            yield flight.Result(json.dumps({"rows": n}).encode("utf-8"))
         elif name == "ping":
             yield flight.Result(b'{"ok": true}')
         else:
             raise flight.FlightServerError(f"unknown action {name}")
+
+    def _repartition_shard(self, sess, table: str, key: str, dest: str,
+                           servers, num_buckets: int,
+                           token: Optional[str]) -> int:
+        """Scan the local shard, bucket rows by murmur3(key) (the SAME
+        placement formula the lead's insert routing uses, so re-bucketed
+        rows land exactly where a direct insert would), push each peer its
+        sub-shard."""
+        from snappydata_tpu.cluster.client import SnappyClient
+        from snappydata_tpu.parallel.hashing import bucket_of_np
+
+        result = sess.sql(f"SELECT * FROM {table}")
+        n = int(result.columns[0].shape[0]) if result.columns else 0
+        if n == 0:
+            return 0
+        ki = [c.lower() for c in result.names].index(key.lower())
+        buckets = bucket_of_np(np.asarray(result.columns[ki]), num_buckets)
+        owner = buckets % len(servers)
+        sent = 0
+        for si, addr in enumerate(servers):
+            mask = owner == si
+            if not mask.any():
+                continue
+            piece = result_to_arrow(result, sel=mask)
+            client = SnappyClient(address=addr, token=token)
+            try:
+                client.insert(dest, piece)
+            finally:
+                client.close()
+            sent += int(mask.sum())
+        return sent
 
     def list_actions(self, context):
         return [("sql", "execute a statement"),

@@ -162,19 +162,25 @@ class CompiledPlan:
                  static_providers: List[Callable[[], int]],
                  traced: Callable,
                  out_scope: List["_ScopeCol"],
-                 is_aggregate: bool):
+                 is_aggregate: bool,
+                 bind_checks: Optional[List[Callable]] = None):
         self.relations = relations
         self.aux_builders = aux_builders
         self.static_providers = static_providers
         self.traced = traced
         self.out_scope = out_scope  # dict_provider read at assemble time
         self.is_aggregate = is_aggregate
+        self.bind_checks = bind_checks or []
         self._jitted: Dict[tuple, Callable] = {}
 
     def execute(self, params: Tuple) -> Result:
         from snappydata_tpu.observability.metrics import global_registry
 
         reg = global_registry()
+        # data-dependent validity (e.g. join build-key uniqueness): raises
+        # CompileError -> executor reroutes to the host path
+        for check in self.bind_checks:
+            check()
         tables = [r.bind() for r in self.relations]
         arrays: List = []
         for r, dt in zip(self.relations, tables):
@@ -254,6 +260,75 @@ def data_needs_mask(v, mask) -> bool:
     return int(np.prod(np.shape(v))) == mask.shape[0]
 
 
+_uniq_cache: Dict[Tuple[int, int, Tuple[int, ...]], tuple] = {}
+
+
+def _require_unique_build(info, ordinals: Tuple[int, ...]) -> None:
+    """Raise CompileError unless `info`'s columns `ordinals` are jointly
+    unique in the CURRENT snapshot (cached per mutation version). Runs at
+    bind time, so data changes re-validate; a failure reroutes the query
+    to the exact host join."""
+    import weakref
+
+    from snappydata_tpu.storage.table_store import RowTableData
+
+    data = info.data
+    ver = data.version if isinstance(data, RowTableData) \
+        else data.snapshot().version
+    key = (id(data), ver, ordinals)
+    ok = None
+    entry = _uniq_cache.get(key)
+    if entry is not None:
+        ref, cached_ok = entry
+        # id() values are reused after GC: the weakref proves the cached
+        # verdict belongs to THIS data object, not a dead table's
+        if ref() is data:
+            ok = cached_ok
+    if ok is None:
+        cols = _host_key_columns(info, ordinals)
+        n = int(cols[0].shape[0]) if cols else 0
+        if n == 0:
+            ok = True
+        elif len(cols) == 1:
+            import pandas as pd
+
+            ok = len(pd.unique(cols[0])) == n
+        else:
+            import pandas as pd
+
+            ok = not pd.DataFrame(
+                {i: c for i, c in enumerate(cols)}).duplicated().any()
+        if len(_uniq_cache) > 4096:
+            _uniq_cache.clear()
+        _uniq_cache[key] = (weakref.ref(data), ok)
+    if not ok:
+        raise CompileError(
+            f"join build side {info.name} has duplicate keys on columns "
+            f"{ordinals}; host path")
+
+
+def _host_key_columns(info, ordinals: Tuple[int, ...]) -> List[np.ndarray]:
+    from snappydata_tpu.storage.table_store import RowTableData
+
+    data = info.data
+    if isinstance(data, RowTableData):
+        arrays, _, n = data.to_arrays_with_nulls()
+        return [np.asarray(arrays[i])[:n] for i in ordinals]
+    m = data.snapshot()
+    out = []
+    for i in ordinals:
+        name = info.schema.fields[i].name
+        parts = []
+        for view in m.views:
+            live = view.live_mask()
+            parts.append(np.asarray(data._decode_all(view)[name])[live])
+        if m.row_count:
+            parts.append(np.asarray(m.row_arrays[i])[:m.row_count])
+        out.append(np.concatenate(parts) if parts
+                   else np.empty(0, dtype=object))
+    return out
+
+
 def _param_scalar(v):
     if isinstance(v, bool):
         return np.asarray(v)
@@ -280,6 +355,7 @@ class Compiler:
         self.relations: List[_RelationInput] = []
         self.aux_builders: List[Callable] = []
         self.static_providers: List[Callable] = []
+        self.bind_checks: List[Callable] = []
 
     # -- static/aux plumbing ----------------------------------------------
 
@@ -324,7 +400,8 @@ class Compiler:
                      else _ScopeCol(oc.name, oc.dtype, oc.dict_provider)
                      for oc in out_cols]
         return CompiledPlan(self.relations, self.aux_builders,
-                            self.static_providers, traced, out_scope, is_agg)
+                            self.static_providers, traced, out_scope, is_agg,
+                            self.bind_checks)
 
     # -- node emitters -----------------------------------------------------
 
@@ -441,6 +518,27 @@ class Compiler:
         if not equi:
             raise CompileError("non-equi join not supported on device")
 
+        # The device join is sort+searchsorted: ONE build-side match per
+        # probe row. That is exact only when the build (right) side is
+        # UNIQUE on the join keys (the overwhelmingly common dim/PK build
+        # side). Anything else (N:M, 1:N on the build side) must take the
+        # host path or rows are silently dropped. Semi/anti only need
+        # membership, so they are exempt.
+        if how not in ("semi", "anti"):
+            sources = [self._resolve_build_source(plan.right, ri - nleft)
+                       for _, ri in equi]
+            if any(s is None for s in sources):
+                raise CompileError(
+                    "join build side uniqueness unprovable on device "
+                    "(derived build columns); host path")
+            info_r = sources[0][0]
+            if any(s[0] is not info_r for s in sources):
+                raise CompileError(
+                    "join build keys span multiple base tables; host path")
+            ords = tuple(sorted({s[1] for s in sources}))
+            self.bind_checks.append(
+                lambda _i=info_r, _o=ords: _require_unique_build(_i, _o))
+
         # string join keys: each table has its OWN dictionary, so codes are
         # not comparable across tables — build a bind-time translation LUT
         # mapping left codes into the right table's code space (unmatched
@@ -553,6 +651,27 @@ class Compiler:
             return out
 
         return run_join, out_scope
+
+    def _resolve_build_source(self, plan: ast.Plan, ordinal: int
+                              ) -> Optional[Tuple[object, int]]:
+        """Map a build-side scope ordinal to its base (TableInfo, schema
+        ordinal), following filters/aliases/plain-column projections.
+        Filters only REMOVE rows, so uniqueness of the base column implies
+        uniqueness of the filtered build side (conservative the safe way
+        round). None = unprovable."""
+        if isinstance(plan, (ast.SubqueryAlias, ast.Filter)):
+            return self._resolve_build_source(plan.child, ordinal)
+        if isinstance(plan, ast.Relation):
+            info = self.catalog.lookup_table(plan.name)
+            return None if info is None else (info, ordinal)
+        if isinstance(plan, ast.Project):
+            e = plan.exprs[ordinal]
+            if isinstance(e, ast.Alias):
+                e = e.child
+            if isinstance(e, ast.Col) and e.index is not None:
+                return self._resolve_build_source(plan.child, e.index)
+            return None
+        return None
 
     # -- aggregate ---------------------------------------------------------
 
@@ -1270,6 +1389,7 @@ class Executor:
         try:
             return compiled.execute(params)
         except CompileError:
+            reg.inc("host_fallbacks")
             return self._host_fallback(node, params)
 
     def _try_point_lookup(self, node: ast.Plan, params: Tuple

@@ -37,11 +37,13 @@ class TableStatsService:
                 rows = info.data.count()
                 batches = 0
                 in_memory_bytes = 0
+                version = info.data.version
             else:
                 m = info.data.snapshot()
                 rows = m.total_rows()
                 batches = len(m.views)
                 in_memory_bytes = sum(v.batch.nbytes for v in m.views)
+                version = m.version
             stats[info.name] = {
                 "provider": info.provider,
                 "row_count": rows,
@@ -49,6 +51,14 @@ class TableStatsService:
                 "in_memory_bytes": in_memory_bytes,
                 "buckets": info.buckets,
                 "redundancy": info.redundancy,
+                # mutation version: exchange caches key on this, NOT on row
+                # count (updates that keep the count constant must still
+                # invalidate — review finding). data_id distinguishes table
+                # INCARNATIONS: a DROP/CREATE resets the version counter on
+                # a fresh object, and (data_id, version) must not collide
+                # with the old incarnation's token.
+                "version": version,
+                "data_id": id(info.data),
             }
         with self._lock:
             self._stats = stats

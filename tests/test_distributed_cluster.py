@@ -148,3 +148,208 @@ def test_broadcast_exchange_group_by(loaded):
                "ON t.k = d.kk GROUP BY d.bucket_name ORDER BY d.bucket_name")
     exp = df.assign(b=[f"b{k % 3}" for k in df.k]).groupby("b").size()
     assert [(x[0], x[1]) for x in r.rows()] == list(exp.items())
+
+
+# --------------------------------------------------------------------------
+# hash-repartition (shuffle) exchange: both-sides-large, non-collocated
+# --------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def tpch_nc(dist):
+    """TPC-H-shaped schema deliberately NON-collocated: orders partitioned
+    by customer key, lineitem by order key, customer by nation key — every
+    join needs an exchange (ref: Spark falls back to a shuffle exchange,
+    SnappyStrategies.scala:80-128)."""
+    ds, servers = dist
+    ds.sql("CREATE TABLE nc_customer (c_custkey BIGINT, c_mktsegment STRING, "
+           "c_nationkey BIGINT) USING column OPTIONS (partition_by 'c_nationkey')")
+    ds.sql("CREATE TABLE nc_orders (o_orderkey BIGINT, o_custkey BIGINT, "
+           "o_orderdate BIGINT, o_shippriority BIGINT) USING column "
+           "OPTIONS (partition_by 'o_custkey')")
+    ds.sql("CREATE TABLE nc_lineitem (l_orderkey BIGINT, l_extendedprice DOUBLE, "
+           "l_discount DOUBLE, l_shipdate BIGINT, l_suppkey BIGINT) "
+           "USING column OPTIONS (partition_by 'l_orderkey')")
+    ds.sql("CREATE TABLE nc_supplier (s_suppkey BIGINT, s_nationkey BIGINT) "
+           "USING column OPTIONS (partition_by 's_suppkey')")
+    rng = np.random.default_rng(7)
+    n_cust, n_ord, n_li, n_supp = 400, 3000, 12000, 50
+    cust = pd.DataFrame({
+        "c_custkey": np.arange(n_cust, dtype=np.int64),
+        "c_mktsegment": np.array(["BUILDING", "AUTO", "STEEL"],
+                                 dtype=object)[rng.integers(0, 3, n_cust)],
+        "c_nationkey": rng.integers(0, 25, n_cust).astype(np.int64)})
+    orders = pd.DataFrame({
+        "o_orderkey": np.arange(n_ord, dtype=np.int64),
+        "o_custkey": rng.integers(0, n_cust, n_ord).astype(np.int64),
+        "o_orderdate": rng.integers(0, 1000, n_ord).astype(np.int64),
+        "o_shippriority": rng.integers(0, 2, n_ord).astype(np.int64)})
+    li = pd.DataFrame({
+        "l_orderkey": rng.integers(0, n_ord, n_li).astype(np.int64),
+        "l_extendedprice": np.round(rng.random(n_li) * 1000, 2),
+        "l_discount": np.round(rng.random(n_li) * 0.1, 2),
+        "l_shipdate": rng.integers(0, 1000, n_li).astype(np.int64),
+        "l_suppkey": rng.integers(0, n_supp, n_li).astype(np.int64)})
+    supp = pd.DataFrame({
+        "s_suppkey": np.arange(n_supp, dtype=np.int64),
+        "s_nationkey": rng.integers(0, 25, n_supp).astype(np.int64)})
+    for name, df in (("nc_customer", cust), ("nc_orders", orders),
+                     ("nc_lineitem", li), ("nc_supplier", supp)):
+        ds.insert_arrays(name, [df[c].to_numpy() for c in df.columns])
+    return ds, cust, orders, li, supp
+
+
+def test_shuffle_exchange_q3(tpch_nc):
+    """Q3 shape: big-big join (lineitem x orders) repartitions orders onto
+    the order key; customer broadcasts."""
+    ds, cust, orders, li, _ = tpch_nc
+    r = ds.sql(
+        "SELECT l_orderkey, sum(l_extendedprice * (1 - l_discount)) AS rev,"
+        " o_orderdate, o_shippriority "
+        "FROM nc_customer, nc_orders, nc_lineitem "
+        "WHERE c_mktsegment = 'BUILDING' AND c_custkey = o_custkey "
+        "AND l_orderkey = o_orderkey AND o_orderdate < 500 "
+        "AND l_shipdate > 500 "
+        "GROUP BY l_orderkey, o_orderdate, o_shippriority "
+        "ORDER BY rev DESC, l_orderkey LIMIT 10")
+    m = li.merge(orders, left_on="l_orderkey", right_on="o_orderkey")
+    m = m.merge(cust, left_on="o_custkey", right_on="c_custkey")
+    m = m[(m.c_mktsegment == "BUILDING") & (m.o_orderdate < 500)
+          & (m.l_shipdate > 500)]
+    m["rev"] = m.l_extendedprice * (1 - m.l_discount)
+    exp = (m.groupby(["l_orderkey", "o_orderdate", "o_shippriority"],
+                     as_index=False).rev.sum()
+           .sort_values(["rev", "l_orderkey"],
+                        ascending=[False, True]).head(10))
+    got = r.rows()
+    assert len(got) == len(exp)
+    for row, (_, e) in zip(got, exp.iterrows()):
+        assert row[0] == e.l_orderkey
+        assert row[1] == pytest.approx(e.rev)
+        assert row[2] == e.o_orderdate and row[3] == e.o_shippriority
+
+
+def test_shuffle_exchange_q10_shape(tpch_nc):
+    """Q10 shape: customer revenue over returned-ish items."""
+    ds, cust, orders, li, _ = tpch_nc
+    r = ds.sql(
+        "SELECT c_custkey, sum(l_extendedprice * (1 - l_discount)) AS rev "
+        "FROM nc_customer, nc_orders, nc_lineitem "
+        "WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey "
+        "AND o_orderdate >= 300 AND o_orderdate < 700 "
+        "GROUP BY c_custkey ORDER BY rev DESC, c_custkey LIMIT 20")
+    m = li.merge(orders, left_on="l_orderkey", right_on="o_orderkey")
+    m = m.merge(cust, left_on="o_custkey", right_on="c_custkey")
+    m = m[(m.o_orderdate >= 300) & (m.o_orderdate < 700)]
+    m["rev"] = m.l_extendedprice * (1 - m.l_discount)
+    exp = (m.groupby("c_custkey", as_index=False).rev.sum()
+           .sort_values(["rev", "c_custkey"],
+                        ascending=[False, True]).head(20))
+    got = r.rows()
+    assert len(got) == len(exp)
+    for row, (_, e) in zip(got, exp.iterrows()):
+        assert row[0] == e.c_custkey
+        assert row[1] == pytest.approx(e.rev)
+
+
+def test_shuffle_exchange_q5_shape(tpch_nc):
+    """Q5 shape: four tables, two exchanges (orders→orderkey shuffle,
+    supplier+customer broadcast)."""
+    ds, cust, orders, li, supp = tpch_nc
+    r = ds.sql(
+        "SELECT s_nationkey, sum(l_extendedprice * (1 - l_discount)) AS rev "
+        "FROM nc_customer, nc_orders, nc_lineitem, nc_supplier "
+        "WHERE c_custkey = o_custkey AND l_orderkey = o_orderkey "
+        "AND l_suppkey = s_suppkey AND c_nationkey = s_nationkey "
+        "GROUP BY s_nationkey ORDER BY rev DESC, s_nationkey")
+    m = li.merge(orders, left_on="l_orderkey", right_on="o_orderkey")
+    m = m.merge(cust, left_on="o_custkey", right_on="c_custkey")
+    m = m.merge(supp, left_on="l_suppkey", right_on="s_suppkey")
+    m = m[m.c_nationkey == m.s_nationkey]
+    m["rev"] = m.l_extendedprice * (1 - m.l_discount)
+    exp = (m.groupby("s_nationkey", as_index=False).rev.sum()
+           .sort_values(["rev", "s_nationkey"], ascending=[False, True]))
+    got = r.rows()
+    assert len(got) == len(exp)
+    for row, (_, e) in zip(got, exp.iterrows()):
+        assert row[0] == e.s_nationkey
+        assert row[1] == pytest.approx(e.rev)
+
+
+def test_shuffle_exchange_invalidates_on_update(tpch_nc):
+    """Exchange temp tables are cached by mutation VERSION: an UPDATE that
+    keeps row counts constant must still invalidate them."""
+    ds, cust, orders, li, _ = tpch_nc
+    q = ("SELECT count(*), sum(l_extendedprice) FROM nc_orders, nc_lineitem "
+         "WHERE l_orderkey = o_orderkey AND o_shippriority = 1")
+    before = ds.sql(q).rows()[0]
+    ds.sql("UPDATE nc_lineitem SET l_extendedprice = l_extendedprice + 1")
+    after = ds.sql(q).rows()[0]
+    assert after[0] == before[0]
+    assert after[1] == pytest.approx(before[1] + before[0])
+
+
+def test_outer_join_via_repartition(tpch_nc):
+    """Outer joins of non-collocated tables work through repartition
+    (broadcast is correctly refused for them)."""
+    ds, cust, orders, li, _ = tpch_nc
+    r = ds.sql(
+        "SELECT count(*) FROM nc_orders o LEFT JOIN nc_lineitem l "
+        "ON o.o_orderkey = l.l_orderkey")
+    m = orders.merge(li, left_on="o_orderkey", right_on="l_orderkey",
+                     how="left")
+    assert r.rows()[0][0] == len(m)
+
+
+def test_composite_key_shuffle_join(dist):
+    """A composite-key join (x AND y) between two large non-collocated
+    tables resolves by repartitioning on ONE key; the second equality is a
+    residual filter (review finding: it used to raise)."""
+    ds, _ = dist
+    ds.sql("CREATE TABLE ck_a (x BIGINT, y BIGINT, v DOUBLE) USING column "
+           "OPTIONS (partition_by 'v')")
+    ds.sql("CREATE TABLE ck_b (x BIGINT, y BIGINT, w DOUBLE) USING column "
+           "OPTIONS (partition_by 'w')")
+    rng = np.random.default_rng(5)
+    n = 4000
+    ax = np.arange(n, dtype=np.int64)   # unique build keys
+    ay = ax % 7
+    ds.insert_arrays("ck_a", [ax, ay, rng.random(n)])
+    bx = rng.integers(0, n, 9000).astype(np.int64)
+    by = rng.integers(0, 7, 9000).astype(np.int64)
+    ds.insert_arrays("ck_b", [bx, by, rng.random(9000)])
+    # force both over the broadcast budget so repartition is the only plan
+    old = ds.planner.conf.hash_join_size
+    ds.planner.conf.hash_join_size = 1
+    try:
+        r = ds.sql("SELECT count(*) FROM ck_a, ck_b WHERE ck_a.x = ck_b.x "
+                   "AND ck_a.y = ck_b.y").rows()[0][0]
+    finally:
+        ds.planner.conf.hash_join_size = old
+    da = pd.DataFrame({"x": ax, "y": ay})
+    db = pd.DataFrame({"x": bx, "y": by})
+    assert r == len(da.merge(db, on=["x", "y"]))
+
+
+def test_exchange_cache_invalidated_by_recreate(dist):
+    """DROP + CREATE resets server-side version counters; the exchange
+    cache must not serve the dead incarnation's temp (review finding)."""
+    ds, _ = dist
+    for _ in range(2):
+        ds.sql("DROP TABLE IF EXISTS rc_f")
+        ds.sql("DROP TABLE IF EXISTS rc_d")
+    ds.sql("CREATE TABLE rc_f (k BIGINT, v DOUBLE) USING column "
+           "OPTIONS (partition_by 'v')")
+    ds.sql("CREATE TABLE rc_d (k BIGINT, t BIGINT) USING column "
+           "OPTIONS (partition_by 'k')")
+    ds.insert_arrays("rc_f", [np.arange(100, dtype=np.int64),
+                              np.arange(100).astype(np.float64)])
+    ds.insert_arrays("rc_d", [np.arange(100, dtype=np.int64),
+                              np.ones(100, dtype=np.int64)])
+    q = "SELECT count(*) FROM rc_f, rc_d WHERE rc_f.k = rc_d.k"
+    assert ds.sql(q).rows()[0][0] == 100
+    ds.sql("DROP TABLE rc_f")
+    ds.sql("CREATE TABLE rc_f (k BIGINT, v DOUBLE) USING column "
+           "OPTIONS (partition_by 'v')")
+    ds.insert_arrays("rc_f", [np.arange(40, dtype=np.int64),
+                              np.arange(40).astype(np.float64)])
+    assert ds.sql(q).rows()[0][0] == 40

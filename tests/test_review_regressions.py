@@ -104,3 +104,35 @@ def test_ctas_if_not_exists_idempotent(s):
     s.sql("CREATE TABLE IF NOT EXISTS dst USING column AS SELECT a FROM src")
     s.sql("CREATE TABLE IF NOT EXISTS dst USING column AS SELECT a FROM src")
     assert s.sql("SELECT count(*) FROM dst").rows()[0][0] == 2
+
+
+def test_join_duplicate_build_keys_expand():
+    """The device join is searchsorted (one build match per probe row) and
+    must reroute to the host path when the build side has duplicate join
+    keys — N:M and 1:N-on-build joins used to silently drop matches."""
+    import pandas as pd
+    from snappydata_tpu import SnappySession
+    from snappydata_tpu.catalog import Catalog
+
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE jl (k BIGINT, a BIGINT) USING column")
+    s.sql("CREATE TABLE jr (k BIGINT, b BIGINT) USING column")
+    rng = np.random.default_rng(3)
+    lk = rng.integers(0, 20, 100).astype(np.int64)
+    rk = rng.integers(0, 20, 80).astype(np.int64)
+    s.insert_arrays("jl", [lk, np.arange(100, dtype=np.int64)])
+    s.insert_arrays("jr", [rk, np.arange(80, dtype=np.int64)])
+    dl = pd.DataFrame({"k": lk}); dr = pd.DataFrame({"k": rk})
+    exp_inner = len(dl.merge(dr, on="k"))
+    exp_left = len(dl.merge(dr, on="k", how="left"))
+    got_inner = s.sql(
+        "SELECT count(*) FROM jl JOIN jr ON jl.k = jr.k").rows()[0][0]
+    got_left = s.sql(
+        "SELECT count(*) FROM jl LEFT JOIN jr ON jl.k = jr.k").rows()[0][0]
+    assert got_inner == exp_inner
+    assert got_left == exp_left
+    # sums must match too (not just counts)
+    exp_sum = int(dl.assign(i=np.arange(100)).merge(dr, on="k").i.sum())
+    got_sum = s.sql(
+        "SELECT sum(jl.a) FROM jl JOIN jr ON jl.k = jr.k").rows()[0][0]
+    assert got_sum == exp_sum
