@@ -110,19 +110,26 @@ class SnappyFlightServer(flight.FlightServerBase):
         req = json.loads(ticket.ticket.decode("utf-8"))
         result = self._session_for(req).sql(
             req["sql"], params=tuple(req.get("params", ())))
-        return flight.RecordBatchStream(result_to_arrow(result))
+        table = result_to_arrow(result)
+        # page as record batches (ref: CachedDataFrame paged collect /
+        # GfxdHeapDataOutputStream result pages) — clients start consuming
+        # before the last page is serialized
+        chunk = int(req.get("page_rows", 65536))
+        batches = table.to_batches(max_chunksize=max(1, chunk))
+        return flight.GeneratorStream(table.schema, iter(batches))
 
     def get_flight_info(self, context, descriptor):
         req = json.loads(descriptor.command.decode("utf-8"))
-        # execute eagerly to learn the schema (plan-cache makes re-exec in
-        # do_get cheap); proper lazy schema derivation is a later round
-        result = self._session_for(req).sql(
-            req["sql"], params=tuple(req.get("params", ())))
-        table = result_to_arrow(result)
+        # schema WITHOUT executing (ref: prepared-statement metadata phase,
+        # SparkSQLPrepareImpl) — clients can plan on dtypes cheaply
+        sess = self._session_for(req)
+        schema = sess.query_schema(req["sql"])
+        fields = [pa.field(f.name, _arrow_type(f.dtype), f.nullable)
+                  for f in schema.fields]
         endpoint = flight.FlightEndpoint(
             descriptor.command, [flight.Location(self._location)])
-        return flight.FlightInfo(table.schema, descriptor, [endpoint],
-                                 table.num_rows, -1)
+        return flight.FlightInfo(pa.schema(fields), descriptor, [endpoint],
+                                 -1, -1)
 
     # -- bulk ingest ------------------------------------------------------
 
@@ -241,3 +248,14 @@ def _json_val(v):
     if v is None or isinstance(v, (int, float, str, bool)):
         return v
     return str(v)
+
+
+def _arrow_type(dt) -> pa.DataType:
+    if dt.name == "string":
+        return pa.string()
+    if dt.name in ("array", "map", "struct"):
+        return pa.string()  # complex values ride JSON-encoded
+    try:
+        return pa.from_numpy_dtype(np.dtype(dt.np_dtype))
+    except (pa.ArrowNotImplementedError, TypeError):
+        return pa.string()

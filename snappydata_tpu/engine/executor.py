@@ -410,6 +410,8 @@ class Compiler:
         the region ROOT, delegating to _emit_rel for the relational body."""
         if isinstance(plan, ast.Aggregate):
             return self._emit_aggregate(plan)
+        if isinstance(plan, ast.WindowProject):
+            return self._emit_window(plan)
         rel_emit, scope = self._emit_rel(plan)
 
         def run_root(ctx) -> tuple:
@@ -423,6 +425,276 @@ class Compiler:
             return out.valid, tuple(pairs), jnp.asarray(False)
 
         return run_root, scope
+
+    # -- window ------------------------------------------------------------
+
+    _WINDOW_DEVICE_FUNCS = frozenset({
+        "row_number", "rank", "dense_rank", "sum", "count", "avg", "min",
+        "max", "lag", "lead"})
+
+    def _emit_window(self, plan: "ast.WindowProject"):
+        """Device OVER(): one lexsort per distinct (PARTITION BY, ORDER BY)
+        pair, then SEGMENTED SCANS in the sorted domain — cumulative
+        sums/mins via `lax.associative_scan` with a reset-flag monoid,
+        rank/row_number from segment- and tie-boundary positions computed
+        with `searchsorted` over the (sorted) segment ids — and an inverse
+        permutation back to table order. Everything is static-shaped and
+        branch-free, which is what the TPU wants (the reference runs
+        windows through its execution engine via the PushDownWindow rule,
+        SnappySessionState.scala:261; hosteval keeps the general
+        fallback)."""
+        child, scope = self._emit_rel(plan.child)
+        wfs: List[ast.WindowFunc] = []
+
+        def collect(e):
+            if isinstance(e, ast.WindowFunc):
+                if e not in wfs:
+                    wfs.append(e)
+                return
+            for c in e.children():
+                collect(c)
+
+        for e in plan.exprs:
+            collect(e)
+        if not wfs:
+            raise CompileError("window project without window functions")
+
+        builder = self._builder_for(scope)
+        groups: Dict[tuple, dict] = {}
+        specs = []
+        for wf in wfs:
+            if wf.name not in self._WINDOW_DEVICE_FUNCS:
+                raise CompileError(f"window {wf.name}: host path")
+            if wf.name in ("rank", "dense_rank") and not wf.order_by:
+                raise CompileError("rank without ORDER BY: host path")
+            for oe, _asc in wf.order_by:
+                odt = expr_type(oe)
+                if odt is None or odt.name in ("string", "array", "map"):
+                    raise CompileError("window ORDER BY on non-numeric "
+                                       "key: host path")
+            arg_run = None
+            arg_dtype = None
+            offset = 1
+            if wf.name in ("sum", "avg", "min", "max"):
+                arg_dtype = expr_type(wf.args[0])
+                if arg_dtype is None or not T.is_numeric(arg_dtype):
+                    raise CompileError("window aggregate over non-numeric "
+                                       "argument: host path")
+                arg_run = builder.emit(wf.args[0])
+            elif wf.name == "count" and wf.args:
+                arg_run = builder.emit(wf.args[0])
+            elif wf.name in ("lag", "lead"):
+                if not wf.order_by:
+                    raise CompileError("lag/lead without ORDER BY")
+                if len(wf.args) > 2:
+                    raise CompileError("lag/lead default value: host path")
+                arg_dtype = expr_type(wf.args[0])
+                if arg_dtype is not None and arg_dtype.name == "string":
+                    raise CompileError("lag/lead over strings: host path")
+                if len(wf.args) > 1:
+                    if not isinstance(wf.args[1], ast.Lit):
+                        raise CompileError("non-literal lag/lead offset")
+                    offset = int(wf.args[1].value)
+                arg_run = builder.emit(wf.args[0])
+            gk = (wf.partition_by, wf.order_by)
+            if gk not in groups:
+                groups[gk] = {
+                    "part": [builder.emit(p) for p in wf.partition_by],
+                    "order": [(builder.emit(oe), asc)
+                              for oe, asc in wf.order_by],
+                }
+            specs.append((wf, gk, arg_run, arg_dtype, offset))
+
+        # select list with window values as appended pseudo-columns
+        ext_scope = list(scope) + [
+            _ScopeCol(f"__w{i}", expr_type(wf) or T.DOUBLE, None, True)
+            for i, wf in enumerate(wfs)]
+
+        def rewrite(e):
+            if isinstance(e, ast.WindowFunc):
+                i = wfs.index(e)
+                return ast.Col(f"__w{i}", None, len(scope) + i,
+                               ext_scope[len(scope) + i].dtype)
+            return e.map_children(rewrite)
+
+        out_exprs = [rewrite(e) for e in plan.exprs]
+        ext_builder = self._builder_for(ext_scope)
+        out_runs = [ext_builder.emit(
+            e.child if isinstance(e, ast.Alias) else e) for e in out_exprs]
+        out_scope = [
+            _ScopeCol(_expr_name(orig), expr_type(orig) or T.DOUBLE,
+                      self._derived_dict_provider(
+                          e.child if isinstance(e, ast.Alias) else e,
+                          ext_scope), True)
+            for orig, e in zip(plan.exprs, out_exprs)]
+
+        fdt = jnp.float64 if config.use_float64() else jnp.float32
+
+        def run_window(ctx) -> tuple:
+            out = child(ctx)
+            valid2 = out.valid
+            flatmask = valid2.reshape(-1)
+            n = int(flatmask.shape[0])
+            idx = jnp.arange(n)
+            rt = Runtime(out.cols, ctx.params, ctx.aux_slice(builder))
+
+            def flat(dv: DVal):
+                v = _broadcast_to_mask(dv.value, valid2).reshape(-1)
+                nl = _broadcast_to_mask(dv.null, valid2).reshape(-1) \
+                    if dv.null is not None else None
+                return v, nl
+
+            gdata: Dict[tuple, dict] = {}
+            for gk, g in groups.items():
+                part_flat = []
+                for r in g["part"]:
+                    dv = r(rt)
+                    v, nl = flat(dv)
+                    part_flat.append(DVal(v, nl, dv.dtype, dv.dictionary))
+                pk = _combine_keys(part_flat) if part_flat \
+                    else jnp.zeros(n, dtype=jnp.int64)
+                pk = jnp.where(flatmask, pk, jnp.int64(_I64_MAX))
+                okeys = []
+                for r, asc in g["order"]:
+                    v, nl = flat(r(rt))
+                    if v.dtype == jnp.bool_:
+                        v = v.astype(jnp.int32)
+                    kv = v if asc else -v
+                    if nl is not None:  # NULLS LAST within the partition
+                        big = jnp.asarray(
+                            np.inf if jnp.issubdtype(kv.dtype, jnp.floating)
+                            else np.iinfo(np.dtype(kv.dtype.name)).max,
+                            dtype=kv.dtype)
+                        kv = jnp.where(nl, big, kv)
+                    okeys.append(kv)
+                perm = jnp.lexsort(tuple(reversed(okeys)) + (pk,))
+                inv = jnp.argsort(perm)
+                gs = pk[perm]
+                one = jnp.ones(1, dtype=bool)
+                new_seg = jnp.concatenate([one, gs[1:] != gs[:-1]])
+                seg_id = jnp.cumsum(new_seg) - 1
+                seg_first = jnp.searchsorted(seg_id, seg_id, side="left")
+                seg_last = jnp.searchsorted(seg_id, seg_id,
+                                            side="right") - 1
+                d = dict(perm=perm, inv=inv, new_seg=new_seg,
+                         seg_id=seg_id, seg_first=seg_first,
+                         seg_last=seg_last)
+                if okeys:
+                    tie_new = new_seg
+                    for kv in okeys:
+                        ks = kv[perm]
+                        tie_new = tie_new | jnp.concatenate(
+                            [one, ks[1:] != ks[:-1]])
+                    tie_id = jnp.cumsum(tie_new) - 1
+                    d["tie_id"] = tie_id
+                    d["tie_first"] = jnp.searchsorted(tie_id, tie_id,
+                                                      side="left")
+                    d["tie_last"] = jnp.searchsorted(tie_id, tie_id,
+                                                     side="right") - 1
+                gdata[gk] = d
+
+            def segscan(op, vals, new_seg):
+                """Inclusive segmented scan: reset at segment starts."""
+                def comb(a, b):
+                    af, av = a
+                    bf, bv = b
+                    return af | bf, jnp.where(bf, bv, op(av, bv))
+
+                _f, outv = jax.lax.associative_scan(
+                    comb, (new_seg, vals))
+                return outv
+
+            win_vals: List[DVal] = []
+            for wf, gk, arg_run, arg_dtype, offset in specs:
+                d = gdata[gk]
+                perm, inv = d["perm"], d["inv"]
+                frame_end = d["tie_last"] if wf.order_by else d["seg_last"]
+                if wf.name == "row_number":
+                    res = idx - d["seg_first"] + 1
+                    win_vals.append(DVal(res[inv], None, T.LONG))
+                    continue
+                if wf.name == "rank":
+                    res = d["tie_first"] - d["seg_first"] + 1
+                    win_vals.append(DVal(res[inv], None, T.LONG))
+                    continue
+                if wf.name == "dense_rank":
+                    res = d["tie_id"] - d["tie_id"][d["seg_first"]] + 1
+                    win_vals.append(DVal(res[inv], None, T.LONG))
+                    continue
+                if wf.name in ("lag", "lead"):
+                    dv = arg_run(rt)
+                    v, nl = flat(dv)
+                    vs = v[perm]
+                    nls = nl[perm] if nl is not None else None
+                    k = offset if wf.name == "lag" else -offset
+                    src = idx - k
+                    ok = (src >= d["seg_first"]) & (src <= d["seg_last"])
+                    srcc = jnp.clip(src, 0, n - 1)
+                    val_s = vs[srcc]
+                    null_s = ~ok
+                    if nls is not None:
+                        null_s = null_s | nls[srcc]
+                    win_vals.append(DVal(val_s[inv], null_s[inv],
+                                         arg_dtype or dv.dtype))
+                    continue
+                # aggregates: sum / count / avg / min / max
+                if arg_run is not None:
+                    dv = arg_run(rt)
+                    v, nl = flat(dv)
+                else:  # count(*)
+                    v = jnp.ones(n, dtype=jnp.int64)
+                    nl = None
+                vs = v[perm]
+                notnull = jnp.ones(n, dtype=bool) if nl is None \
+                    else ~nl[perm]
+                notnull = notnull & flatmask[perm]
+                cnt = segscan(jnp.add, notnull.astype(jnp.int64),
+                              d["new_seg"])[frame_end]
+                if wf.name == "count":
+                    win_vals.append(DVal(cnt[inv], None, T.LONG))
+                    continue
+                if wf.name in ("sum", "avg"):
+                    acc_dt = fdt if wf.name == "avg" or \
+                        jnp.issubdtype(vs.dtype, jnp.floating) else jnp.int64
+                    contrib = jnp.where(notnull, vs, 0).astype(acc_dt)
+                    ssum = segscan(jnp.add, contrib, d["new_seg"])[frame_end]
+                    if wf.name == "avg":
+                        res = ssum / jnp.maximum(cnt, 1).astype(fdt)
+                    else:
+                        res = ssum
+                    win_vals.append(DVal(res[inv], (cnt == 0)[inv],
+                                         expr_type(wf) or T.DOUBLE))
+                    continue
+                # min / max
+                if jnp.issubdtype(vs.dtype, jnp.floating):
+                    sent = jnp.asarray(np.inf if wf.name == "min"
+                                       else -np.inf, dtype=vs.dtype)
+                else:
+                    ii = np.iinfo(np.dtype(vs.dtype.name))
+                    sent = jnp.asarray(ii.max if wf.name == "min"
+                                       else ii.min, dtype=vs.dtype)
+                contrib = jnp.where(notnull, vs, sent)
+                op = jnp.minimum if wf.name == "min" else jnp.maximum
+                res = segscan(op, contrib, d["new_seg"])[frame_end]
+                win_vals.append(DVal(res[inv], (cnt == 0)[inv],
+                                     arg_dtype or T.DOUBLE))
+
+            ext_cols: Dict[int, DVal] = {}
+            for i, dv in out.cols.items():
+                v, nl = flat(dv)
+                ext_cols[i] = DVal(v, nl, dv.dtype, dv.dictionary)
+            for i, dv in enumerate(win_vals):
+                ext_cols[len(scope) + i] = dv
+            rt2 = Runtime(ext_cols, ctx.params,
+                          ctx.aux_slice(ext_builder))
+            pairs = []
+            for r in out_runs:
+                dv = r(rt2)
+                pairs.append((_broadcast_to_mask(dv.value, flatmask),
+                              dv.null))
+            return flatmask, tuple(pairs), jnp.asarray(False)
+
+        return run_window, out_scope
 
     def _emit_rel(self, plan: ast.Plan):
         """Relational body → (emitter(ctx)->RelOut, scope list[_ScopeCol])."""
@@ -517,6 +789,10 @@ class Compiler:
         equi, residual = _split_equi(plan.condition, nleft)
         if not equi:
             raise CompileError("non-equi join not supported on device")
+        if residual is not None and how in ("semi", "anti"):
+            # semi/anti drop the right columns before the residual could
+            # run; host path evaluates it per matched pair
+            raise CompileError("semi/anti join with residual: host path")
 
         # The device join is sort+searchsorted: ONE build-side match per
         # probe row. That is exact only when the build (right) side is
@@ -1218,6 +1494,8 @@ def _plan_width(plan: ast.Plan) -> int:
         if plan.how in ("semi", "anti"):
             return _plan_width(plan.left)
         return _plan_width(plan.left) + _plan_width(plan.right)
+    if isinstance(plan, ast.WindowProject):
+        return len(plan.exprs)
     raise CompileError(f"width of {type(plan).__name__}")
 
 
@@ -1260,6 +1538,12 @@ def _collect_used(plan: ast.Plan, needed: Optional[set], out: List[set]) -> None
         needed = set(needed) | _expr_cols(plan.condition)
         _collect_used(plan.left, {i for i in needed if i < wl}, out)
         _collect_used(plan.right, {i - wl for i in needed if i >= wl}, out)
+        return
+    if isinstance(plan, ast.WindowProject):
+        need = set()
+        for e in plan.exprs:
+            need |= _expr_cols(e)  # walk() covers args/partition/order keys
+        _collect_used(plan.child, need, out)
         return
     raise CompileError(f"prune: {type(plan).__name__}")
 
@@ -1345,6 +1629,15 @@ class Executor:
                 continue
             break
 
+        # executeTake early-stop (ref: CachedDataFrame.executeTake:766):
+        # a bare LIMIT over a scan chain decodes batches incrementally and
+        # stops as soon as enough rows survive — never materializing the
+        # full table
+        if len(host_ops) == 1 and isinstance(host_ops[0], ast.Limit):
+            taken = self._try_take(node, host_ops[0].n, params)
+            if taken is not None:
+                return taken
+
         result = self._execute_core(node, params)
 
         for op in reversed(host_ops):
@@ -1356,8 +1649,6 @@ class Executor:
     def _execute_core(self, node: ast.Plan, params: Tuple) -> Result:
         if isinstance(node, ast.Values):
             return hosteval.eval_values(node, params)
-        if isinstance(node, ast.WindowProject):
-            return hosteval.eval_window(node, params, self)
         if isinstance(node, ast.Union):
             left = self.execute(node.left, params)
             right = self.execute(node.right, params)
@@ -1391,6 +1682,128 @@ class Executor:
         except CompileError:
             reg.inc("host_fallbacks")
             return self._host_fallback(node, params)
+
+    def _try_take(self, node: ast.Plan, n: int, params: Tuple
+                  ) -> Optional[Result]:
+        """LIMIT-n over Project?/Filter?/Relation on a column table:
+        decode one batch at a time, keep qualifying rows, stop at n."""
+        from snappydata_tpu.storage.table_store import RowTableData
+
+        proj = filt = None
+        cur = node
+        if isinstance(cur, ast.Project):
+            proj, cur = cur, cur.child
+        while isinstance(cur, ast.SubqueryAlias):
+            cur = cur.child
+        if isinstance(cur, ast.Filter):
+            filt, cur = cur, cur.child
+        while isinstance(cur, ast.SubqueryAlias):
+            cur = cur.child
+        if not isinstance(cur, ast.Relation) or n <= 0:
+            return None
+        info = self.catalog.lookup_table(cur.name)
+        if info is None or isinstance(info.data, RowTableData):
+            return None  # row tables answer from indexes / are small
+        checked = ([e for e in proj.exprs] if proj else []) + \
+            ([filt.condition] if filt else [])
+        for e in checked:
+            for x in ast.walk(e):
+                if isinstance(x, (ast.WindowFunc, ast.ScalarSubquery,
+                                  ast.InSubquery, ast.ExistsSubquery)):
+                    return None
+                if isinstance(x, ast.Func) and x.name in ast.AGG_FUNCS:
+                    return None
+        data = info.data
+        m = data.snapshot()
+        schema = info.schema
+        if proj is not None:
+            names = [_expr_name(e) for e in proj.exprs]
+            dtypes = [expr_type(e) or T.STRING for e in proj.exprs]
+        else:
+            names = schema.names()
+            dtypes = [f.dtype for f in schema.fields]
+        out_cols: List[List[np.ndarray]] = [[] for _ in names]
+        out_nulls: List[List[Optional[np.ndarray]]] = [[] for _ in names]
+        have = 0
+        decoded = 0
+
+        def consume(cols, nulls, cnt) -> int:
+            nonlocal have
+            if cnt == 0:
+                return 0
+            if filt is not None:
+                v, nl = hosteval.eval_expr(filt.condition, cols, nulls,
+                                           params, cnt)
+                keep = np.broadcast_to(v, (cnt,)).astype(bool)
+                if nl is not None:
+                    keep = keep & ~np.broadcast_to(nl, (cnt,))
+                idx = np.flatnonzero(keep)
+                if idx.size == 0:
+                    return 0
+                cols = [c[idx] for c in cols]
+                nulls = [nm[idx] if nm is not None else None
+                         for nm in nulls]
+                cnt = idx.size
+            take = min(cnt, n - have)
+            if proj is not None:
+                for j, e in enumerate(proj.exprs):
+                    v, nl = hosteval.eval_expr(e, cols, nulls, params, cnt)
+                    v = np.broadcast_to(v, (cnt,))
+                    out_cols[j].append(v[:take])
+                    out_nulls[j].append(
+                        np.broadcast_to(nl, (cnt,))[:take]
+                        if nl is not None else None)
+            else:
+                for j in range(len(names)):
+                    out_cols[j].append(cols[j][:take])
+                    out_nulls[j].append(nulls[j][:take]
+                                        if nulls[j] is not None else None)
+            have += take
+            return take
+
+        for view in m.views:
+            if have >= n:
+                break
+            decoded += 1
+            live = view.live_mask()
+            lazy = data._decode_all(view)
+            cnt = int(live.sum())
+            cols = [np.asarray(lazy[f.name])[live] for f in schema.fields]
+            nulls = []
+            for i in range(len(schema.fields)):
+                nm = view.null_mask(i)
+                nulls.append(nm[live] if nm is not None else None)
+            consume(cols, nulls, cnt)
+        if have < n and m.row_count:
+            cols = [np.asarray(a)[:m.row_count] for a in m.row_arrays]
+            nulls = [nm[:m.row_count] if nm is not None else None
+                     for nm in (m.row_nulls or [None] * len(cols))]
+            consume(cols, nulls, m.row_count)
+
+        from snappydata_tpu.observability.metrics import global_registry
+
+        reg = global_registry()
+        if decoded < len(m.views):
+            reg.inc("take_early_stops")
+        reg.inc("take_batches_decoded", decoded)
+        final_cols, final_nulls = [], []
+        for j, dt in enumerate(dtypes):
+            if out_cols[j]:
+                vals = np.concatenate(out_cols[j])
+            else:
+                vals = np.empty(0, dtype=object if dt.name == "string"
+                                else dt.np_dtype)
+            parts = out_nulls[j]
+            if any(p is not None for p in parts):
+                nm = np.concatenate(
+                    [p if p is not None else
+                     np.zeros(len(c), dtype=bool)
+                     for p, c in zip(parts, out_cols[j])])
+            else:
+                nm = None
+            final_cols.append(vals)
+            final_nulls.append(nm)
+        return Result(names, final_cols, final_nulls, dtypes)
 
     def _try_point_lookup(self, node: ast.Plan, params: Tuple
                           ) -> Optional[Result]:
@@ -1486,6 +1899,8 @@ class Executor:
         """CodegenSparkFallback analogue (core/.../execution/
         CodegenSparkFallback.scala:33): when device lowering can't handle a
         construct, evaluate on host via numpy."""
+        if isinstance(node, ast.WindowProject):
+            return hosteval.eval_window(node, params, self)
         return hosteval.eval_plan(node, params, self)
 
     # -- host post-ops ----------------------------------------------------

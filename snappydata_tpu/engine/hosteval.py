@@ -868,11 +868,38 @@ def _eval_join(plan: ast.Join, params, executor):
     if how is None:  # semi/anti
         lk = [f"l{i}" for i, _ in equi]
         rk = [f"r{j}" for _, j in equi]
-        merged = ldf.merge(rdf[rk].drop_duplicates(), left_on=lk,
-                           right_on=rk, how="left", indicator=True)
-        hit = merged["_merge"] == "both"
-        keep = hit if plan.how == "semi" else ~hit
-        idx = np.nonzero(keep.to_numpy())[0]
+        if residual is None:
+            merged = ldf.merge(rdf[rk].drop_duplicates(), left_on=lk,
+                               right_on=rk, how="left", indicator=True)
+            hit_mask = (merged["_merge"] == "both").to_numpy()
+        else:
+            # EXISTS with extra non-equi correlation (TPC-H Q21's
+            # l2.suppkey <> l1.suppkey): pair up on the equi keys, apply
+            # the residual per pair, keep left rows with ≥1 surviving pair
+            ldf2 = ldf.copy()
+            ldf2["__rowid"] = np.arange(len(ldf2))
+            merged = ldf2.merge(rdf, left_on=lk, right_on=rk, how="inner")
+            mn = len(merged)
+            mcols, mnulls = [], []
+            for i, dt in enumerate(lt):
+                s = merged[f"l{i}"]
+                mcols.append(_from_pandas(s, dt))
+                mnulls.append(s.isna().to_numpy() if s.isna().any()
+                              else None)
+            for j, dt in enumerate(rt):
+                s = merged[f"r{j}"]
+                mcols.append(_from_pandas(s, dt))
+                mnulls.append(s.isna().to_numpy() if s.isna().any()
+                              else None)
+            v, nl2 = eval_expr(residual, mcols, mnulls, params, mn)
+            ok = np.broadcast_to(v, (mn,)).astype(bool)
+            if nl2 is not None:
+                ok = ok & ~nl2
+            hit_ids = merged["__rowid"].to_numpy()[ok]
+            hit_mask = np.zeros(len(ldf), dtype=bool)
+            hit_mask[hit_ids] = True
+        keep = hit_mask if plan.how == "semi" else ~hit_mask
+        idx = np.nonzero(keep)[0]
         return ([c[idx] for c in lc],
                 [nm[idx] if nm is not None else None for nm in ln],
                 lnames, lt, len(idx))

@@ -459,6 +459,30 @@ class SnappySession:
     def table_rows(self, name: str) -> Result:
         return self.sql(f"SELECT * FROM {name}")
 
+    def query_schema(self, sql_text: str) -> T.Schema:
+        """Output schema of a query WITHOUT executing it (ref:
+        CachedDataFrame exposes the analyzed schema; Flight
+        get_flight_info uses this instead of running the query)."""
+        stmt = parse(sql_text)
+        if not isinstance(stmt, ast.Query):
+            return T.Schema([T.Field("status", T.STRING)])
+        plan = self._decorrelate(stmt.plan)
+
+        def sub_placeholder(e: ast.Expr) -> ast.Expr:
+            # type-only placeholders: subqueries must not EXECUTE here
+            if isinstance(e, ast.ScalarSubquery):
+                sub_resolved, _ = self.analyzer.analyze_plan(
+                    self._decorrelate(e.plan))
+                dt = _output_schema(sub_resolved).fields[0].dtype
+                return ast.Lit(None, dt)
+            if isinstance(e, (ast.InSubquery, ast.ExistsSubquery)):
+                return ast.Lit(True, T.BOOLEAN)
+            return e
+
+        plan = ast.transform_plan_exprs(plan, sub_placeholder)
+        resolved, _ = self.analyzer.analyze_plan(plan)
+        return _output_schema(resolved)
+
     def _journal_then(self, info, kind: str, arrays, nulls, apply_fn):
         """WAL-then-apply under the mutation lock (no-op without a store)."""
         if self.disk_store is None:
@@ -714,14 +738,18 @@ class SnappySession:
         Only the single-block shape with conjunctive predicates is
         handled; anything else keeps its (clear) unsupported error."""
 
-        def split_correlation(subplan, outer_names):
+        def split_correlation(subplan, outer_names, want_select=False):
             """If subplan is SELECT ... FROM <rel chain> WHERE <conj>,
             split conjuncts into correlation equalities (inner_col =
-            outer_col) and inner-only predicates."""
+            outer_col) and inner-only predicates. With `want_select`, also
+            return the projected select expressions (for IN rewrites)."""
             node = subplan
+            select_exprs = None
             # strip projection-only tops (SELECT 1 / SELECT cols)
             while isinstance(node, (ast.Project, ast.SubqueryAlias,
                                     ast.Distinct)):
+                if isinstance(node, ast.Project) and select_exprs is None:
+                    select_exprs = node.exprs
                 node = node.children()[0]
             if not isinstance(node, ast.Filter):
                 return None
@@ -752,6 +780,7 @@ class SnappySession:
 
             corr = []
             inner_only = []
+            corr_residual = []
             for c in conjuncts:
                 if isinstance(c, ast.BinOp) and c.op == "=" \
                         and isinstance(c.left, ast.Col) \
@@ -767,11 +796,70 @@ class SnappySession:
                     isinstance(x, ast.Col) and col_side(x) == "outer"
                     for x in ast.walk(c))
                 if has_outer:
-                    return None  # non-equi correlation: unsupported
+                    # non-equi correlation (Q21's l2.suppkey <> l1.suppkey)
+                    # rides as a residual on the decorrelated join
+                    corr_residual.append(c)
+                    continue
                 inner_only.append(c)
             if not corr:
                 return None
-            return inner_rel, corr, inner_only
+            if want_select:
+                return inner_rel, corr, inner_only, select_exprs, \
+                    corr_residual
+            return inner_rel, corr, inner_only, corr_residual
+
+        def split_scalar_agg(subplan):
+            """Correlated scalar aggregate subquery → pieces for the
+            aggregate-then-join rewrite (TPC-H Q2/Q17/Q20 shape):
+
+              (SELECT <expr over AGG(inner cols)> FROM inner
+               WHERE inner.k = outer.k AND <inner preds>)
+
+            Returns (inner_rel, corr, inner_only, select_expr) or None."""
+            node = subplan
+            while isinstance(node, ast.SubqueryAlias):
+                node = node.child
+            if not isinstance(node, ast.Aggregate) or node.group_exprs \
+                    or len(node.agg_exprs) != 1:
+                return None
+            sel = node.agg_exprs[0]
+            if isinstance(sel, ast.Alias):
+                sel = sel.child
+            aggs = [x for x in ast.walk(sel)
+                    if isinstance(x, ast.Func) and x.name in ast.AGG_FUNCS]
+            # empty-group semantics: sum/avg/min/max yield NULL (the inner
+            # join's dropped row ≡ comparison-with-NULL = false); count
+            # would need 0 via a left join — host error stays for it
+            if not aggs or any(a.name not in ("sum", "avg", "min", "max")
+                               for a in aggs):
+                return None
+            inner = node.child
+            if not isinstance(inner, ast.Filter):
+                return None
+            got = split_correlation(inner, None)
+            if got is None or got[3]:
+                return None  # non-equi correlation: can't group-then-join
+            inner_rel, corr, inner_only, _res = got
+            # every column in the select must belong to the inner scope
+            inner_cols = _relation_columns(inner_rel, self.catalog)
+            for x in ast.walk(sel):
+                if isinstance(x, ast.Col):
+                    in_inner = (x.qualifier.lower() in inner_cols[1]
+                                if x.qualifier
+                                else x.name.lower() in inner_cols[0])
+                    if not in_inner:
+                        return None
+            return inner_rel, corr, inner_only, sel
+
+        import itertools as _it
+
+        sq_counter = _it.count()
+
+        def _and_all(exprs):
+            cond = exprs[0]
+            for x in exprs[1:]:
+                cond = ast.BinOp("and", cond, x)
+            return cond
 
         def rewrite_filter(p: ast.Plan) -> ast.Plan:
             if not isinstance(p, ast.Filter):
@@ -787,7 +875,9 @@ class SnappySession:
 
             flat(p.condition)
             child = p.child
-            rest: List[ast.Expr] = []
+            rest: List[ast.Expr] = []    # untouched conjuncts (stay BELOW)
+            post: List[ast.Expr] = []    # rewritten comparisons (go ABOVE)
+            join_specs: List[tuple] = []  # (inner_rel, how, cond)
             changed = False
             for c in conjuncts:
                 negated = False
@@ -798,31 +888,88 @@ class SnappySession:
                 if isinstance(e, ast.ExistsSubquery):
                     got = split_correlation(e.plan, None)
                     if got is not None:
-                        inner_rel, corr, inner_only = got
+                        inner_rel, corr, inner_only, corr_res = got
                         if inner_only:
-                            cond = inner_only[0]
-                            for x in inner_only[1:]:
-                                cond = ast.BinOp("and", cond, x)
-                            inner_rel = ast.Filter(inner_rel, cond)
-                        join_cond = None
-                        for outer_c, inner_c in corr:
-                            eq = ast.BinOp("=", outer_c, inner_c)
-                            join_cond = eq if join_cond is None else \
-                                ast.BinOp("and", join_cond, eq)
-                        child = ast.Join(child, inner_rel,
-                                         "anti" if negated else "semi",
-                                         join_cond)
+                            inner_rel = ast.Filter(inner_rel,
+                                                   _and_all(inner_only))
+                        join_cond = _and_all(
+                            [ast.BinOp("=", oc, ic) for oc, ic in corr]
+                            + corr_res)
+                        join_specs.append(
+                            (inner_rel, "anti" if negated else "semi",
+                             join_cond))
+                        changed = True
+                        continue
+                # correlated scalar aggregate in a comparison →
+                # aggregate-then-join (ref: Catalyst's scalar-subquery
+                # decorrelation; unlocks TPC-H Q2/Q17/Q20)
+                if isinstance(e, ast.BinOp) and e.op in (
+                        "<", "<=", ">", ">=", "=", "<>", "!="):
+                    done = False
+                    for side in ("left", "right"):
+                        sub = getattr(e, side)
+                        if not isinstance(sub, ast.ScalarSubquery):
+                            continue
+                        got = split_scalar_agg(sub.plan)
+                        if got is None:
+                            continue
+                        inner_rel, corr, inner_only, sel = got
+                        if inner_only:
+                            inner_rel = ast.Filter(inner_rel,
+                                                   _and_all(inner_only))
+                        alias = f"__sq{next(sq_counter)}"
+                        group = tuple(ic for _oc, ic in corr)
+                        aggs = tuple(
+                            ast.Alias(ic, f"__ck{j}")
+                            for j, (_oc, ic) in enumerate(corr)
+                        ) + (ast.Alias(sel, "__sv"),)
+                        sq = ast.SubqueryAlias(
+                            ast.Aggregate(inner_rel, group, aggs), alias)
+                        join_cond = _and_all([
+                            ast.BinOp("=", oc,
+                                      ast.Col(f"__ck{j}", alias))
+                            for j, (oc, _ic) in enumerate(corr)])
+                        join_specs.append((sq, "inner", join_cond))
+                        import dataclasses as _dc2
+
+                        post.append(_dc2.replace(
+                            e, **{side: ast.Col("__sv", alias)}))
+                        changed = done = True
+                        break
+                    if done:
+                        continue
+                # correlated IN → semi join on (value, correlation keys)
+                if isinstance(e, ast.InSubquery) and not e.negated:
+                    got = split_correlation(e.plan, None, want_select=True)
+                    if got is not None and got[3] and len(got[3]) == 1:
+                        inner_rel, corr, inner_only, sel_exprs, corr_res \
+                            = got
+                        sel = sel_exprs[0]
+                        if isinstance(sel, ast.Alias):
+                            sel = sel.child
+                        if inner_only:
+                            inner_rel = ast.Filter(inner_rel,
+                                                   _and_all(inner_only))
+                        join_cond = _and_all(
+                            [ast.BinOp("=", e.child, sel)] +
+                            [ast.BinOp("=", oc, ic) for oc, ic in corr]
+                            + corr_res)
+                        join_specs.append((inner_rel, "semi", join_cond))
                         changed = True
                         continue
                 rest.append(c)
             if not changed:
                 return p
-            if rest:
-                cond = rest[0]
-                for x in rest[1:]:
-                    cond = ast.BinOp("and", cond, x)
-                return ast.Filter(child, cond)
-            return child
+            # decorrelation joins stack ABOVE the remaining filter so the
+            # optimizer still sees the original Filter-over-FROM-chain and
+            # can order it by size (burying a comma-joined FROM under a
+            # semi join used to leave it an unordered cross product)
+            base = ast.Filter(child, _and_all(rest)) if rest else child
+            for inner_rel, how2, cond2 in join_specs:
+                base = ast.Join(base, inner_rel, how2, cond2)
+            if post:
+                base = ast.Filter(base, _and_all(post))
+            return base
 
         def walk_plans(p: ast.Plan) -> ast.Plan:
             import dataclasses as _dc
@@ -1271,6 +1418,37 @@ def _expr_subquery_tables(e: ast.Expr):
                              ast.ExistsSubquery)):
             out.extend(_referenced_tables(node.plan))
     return out
+
+
+def _output_schema(plan: ast.Plan) -> T.Schema:
+    """Output fields of a RESOLVED plan (schema without execution)."""
+    from snappydata_tpu.sql.analyzer import _expr_name as _en
+    from snappydata_tpu.sql.analyzer import expr_type as _et
+
+    if isinstance(plan, (ast.Project, ast.WindowProject)):
+        return T.Schema([T.Field(_en(e), _et(e) or T.STRING)
+                         for e in plan.exprs])
+    if isinstance(plan, ast.Aggregate):
+        return T.Schema([T.Field(_en(e), _et(e) or T.DOUBLE)
+                         for e in plan.agg_exprs])
+    if isinstance(plan, (ast.Sort, ast.Limit, ast.Distinct, ast.Filter,
+                         ast.SubqueryAlias)):
+        return _output_schema(plan.children()[0])
+    if isinstance(plan, ast.Relation):
+        return plan.schema
+    if isinstance(plan, ast.Join):
+        if plan.how in ("semi", "anti"):
+            return _output_schema(plan.left)
+        left = _output_schema(plan.left)
+        right = _output_schema(plan.right)
+        return T.Schema(list(left.fields) + list(right.fields))
+    if isinstance(plan, ast.Union):
+        return _output_schema(plan.left)
+    if isinstance(plan, ast.Values):
+        row = plan.rows[0]
+        return T.Schema([T.Field(f"c{i}", _et(e) or T.STRING)
+                         for i, e in enumerate(row)])
+    raise ValueError(f"no output schema for {type(plan).__name__}")
 
 
 def _referenced_tables(plan: ast.Plan):

@@ -146,6 +146,22 @@ def gen_part(num_rows: int, seed: int = 4):
     }
 
 
+def gen_partsupp(num_parts: int, num_supps: int, seed: int = 6):
+    """4 suppliers per part with DISTINCT supplier keys per part (the
+    (ps_partkey, ps_suppkey) pair is the TPC-H primary key)."""
+    rng = np.random.default_rng(seed)
+    pk = np.repeat(np.arange(1, num_parts + 1, dtype=np.int64), 4)
+    n = len(pk)
+    j = np.tile(np.arange(4, dtype=np.int64), num_parts)
+    sk = ((pk - 1 + j * max(1, num_supps // 4)) % num_supps) + 1
+    return {
+        "ps_partkey": pk,
+        "ps_suppkey": sk.astype(np.int64),
+        "ps_availqty": rng.integers(1, 10_000, n).astype(np.int32),
+        "ps_supplycost": np.round(rng.uniform(1.0, 1000.0, n), 2),
+    }
+
+
 def gen_nation():
     return {
         "n_nationkey": np.arange(25, dtype=np.int64),
@@ -168,6 +184,11 @@ SUPPLIER_DDL = """CREATE TABLE supplier (
 PART_DDL = """CREATE TABLE part (
     p_partkey BIGINT, p_brand STRING, p_type STRING, p_size INT,
     p_container STRING, p_retailprice DOUBLE
+) USING column"""
+
+PARTSUPP_DDL = """CREATE TABLE partsupp (
+    ps_partkey BIGINT, ps_suppkey BIGINT, ps_availqty INT,
+    ps_supplycost DOUBLE
 ) USING column"""
 
 NATION_DDL = """CREATE TABLE nation (
@@ -261,6 +282,9 @@ def load_tpch(session, sf: float = 0.001, seed: int = 0,
         session.insert_arrays("supplier",
                               list(gen_supplier(n_s, seed + 3).values()))
         session.insert_arrays("part", list(gen_part(n_p, seed + 4).values()))
+        session.sql(PARTSUPP_DDL)
+        session.insert_arrays(
+            "partsupp", list(gen_partsupp(n_p, n_s, seed + 6).values()))
         session.insert_arrays("nation", list(gen_nation().values()))
         session.insert_arrays("region", list(gen_region().values()))
 
@@ -326,3 +350,53 @@ WHERE o_orderkey IN (
   AND c_custkey = o_custkey AND o_orderkey = l_orderkey
 GROUP BY c_name, c_custkey, o_orderkey, o_orderdate, o_totalprice
 ORDER BY o_totalprice DESC, o_orderdate LIMIT 100"""
+Q2 = """SELECT s_acctbal, s_name, n_name, p_partkey, p_type
+FROM part, supplier, partsupp, nation, region
+WHERE p_partkey = ps_partkey AND s_suppkey = ps_suppkey
+  AND p_size = 15 AND s_nationkey = n_nationkey
+  AND n_regionkey = r_regionkey AND r_name = 'EUROPE'
+  AND ps_supplycost = (
+    SELECT min(ps_supplycost)
+    FROM partsupp, supplier, nation, region
+    WHERE p_partkey = ps_partkey AND s_suppkey = ps_suppkey
+      AND s_nationkey = n_nationkey AND n_regionkey = r_regionkey
+      AND r_name = 'EUROPE')
+ORDER BY s_acctbal DESC, n_name, s_name, p_partkey LIMIT 100"""
+
+Q17 = """SELECT sum(l_extendedprice) / 7.0 AS avg_yearly
+FROM lineitem, part
+WHERE p_partkey = l_partkey AND p_brand = 'Brand#23'
+  AND p_container = 'MED BOX'
+  AND l_quantity < (
+    SELECT 0.2 * avg(l_quantity) FROM lineitem
+    WHERE l_partkey = p_partkey)"""
+
+Q20 = """SELECT s_name FROM supplier, nation
+WHERE s_suppkey IN (
+    SELECT ps_suppkey FROM partsupp
+    WHERE ps_partkey IN (
+        SELECT p_partkey FROM part WHERE p_type LIKE 'STANDARD%')
+      AND ps_availqty > (
+        SELECT 0.5 * sum(l_quantity) FROM lineitem
+        WHERE l_partkey = ps_partkey AND l_suppkey = ps_suppkey
+          AND l_shipdate >= DATE '1994-01-01'
+          AND l_shipdate < DATE '1995-01-01'))
+  AND s_nationkey = n_nationkey AND n_name = 'CANADA'
+ORDER BY s_name"""
+
+Q21 = """SELECT s_name, count(*) AS numwait
+FROM supplier, lineitem l1, orders, nation
+WHERE s_suppkey = l1.l_suppkey AND o_orderkey = l1.l_orderkey
+  AND o_orderstatus = 'F' AND l1.l_receiptdate > l1.l_commitdate
+  AND EXISTS (
+    SELECT 1 FROM lineitem l2
+    WHERE l2.l_orderkey = l1.l_orderkey
+      AND l2.l_suppkey <> l1.l_suppkey)
+  AND NOT EXISTS (
+    SELECT 1 FROM lineitem l3
+    WHERE l3.l_orderkey = l1.l_orderkey
+      AND l3.l_suppkey <> l1.l_suppkey
+      AND l3.l_receiptdate > l3.l_commitdate)
+  AND s_nationkey = n_nationkey AND n_name = 'SAUDI ARABIA'
+GROUP BY s_name ORDER BY numwait DESC, s_name LIMIT 100"""
+

@@ -152,3 +152,34 @@ def test_rest_status_metrics_jobs(cluster):
         time.sleep(0.05)
     assert status["status"] == "FINISHED"
     assert status["rows"] == [[3]]
+
+
+def test_flight_schema_without_execution_and_paging(cluster):
+    """get_flight_info derives the schema from the analyzer (no query
+    execution); do_get pages results as record batches."""
+    import pyarrow as pa
+    import pyarrow.flight as pafl
+
+    locator, lead, server, catalog = cluster
+    client = SnappyClient(address=server.flight_address)
+    client.execute("CREATE TABLE fs (a BIGINT, s STRING, d DOUBLE) "
+                   "USING column")
+    client.insert("fs", {"a": np.arange(200_000, dtype=np.int64),
+                         "s": np.array(["x"] * 200_000, dtype=object),
+                         "d": np.ones(200_000)})
+    desc = pafl.FlightDescriptor.for_command(
+        json.dumps({"sql": "SELECT a, s, sum(d) AS t FROM fs "
+                           "GROUP BY a, s"}).encode())
+    info = client._client().get_flight_info(desc)
+    assert info.schema.field("a").type == pa.int64()
+    assert info.schema.field("s").type == pa.string()
+    assert info.schema.field("t").type in (pa.float64(), pa.float32())
+
+    reader = client._client().do_get(pafl.Ticket(
+        json.dumps({"sql": "SELECT a FROM fs", "page_rows": 4096}
+                   ).encode()))
+    batches = [b for b in reader]
+    assert len(batches) > 10  # paged, not one monolith
+    total = sum(len(b.data) for b in batches)
+    assert total == 200_000
+    client.close()

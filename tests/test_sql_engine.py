@@ -342,3 +342,41 @@ def test_mutation_then_query_sees_new_version(s):
     assert s.sql("SELECT sum(v) FROM t").rows()[0][0] == 40
     s.sql("DELETE FROM t WHERE k = 1")
     assert s.sql("SELECT sum(v) FROM t").rows()[0][0] == 30
+
+
+def test_execute_take_early_stop():
+    """LIMIT-only queries decode batches incrementally and stop early
+    (ref: CachedDataFrame.executeTake:766) — not the whole table."""
+    from snappydata_tpu import config
+    from snappydata_tpu.observability.metrics import global_registry
+
+    gp = config.global_properties()
+    old_rows = gp.column_batch_rows
+    gp.column_batch_rows = 1024  # table store reads the global properties
+    try:
+        s = SnappySession(catalog=Catalog())
+        s.sql("CREATE TABLE taketest (a BIGINT, b STRING) USING column")
+        n = 20_000
+        s.insert_arrays("taketest", [
+            np.arange(n, dtype=np.int64),
+            np.array([f"v{i % 97}" for i in range(n)], dtype=object)])
+    finally:
+        gp.column_batch_rows = old_rows
+    assert len(s.catalog.describe("taketest").data.snapshot().views) >= 5
+    reg = global_registry()
+    before_dec = reg.snapshot()["counters"].get("take_batches_decoded", 0)
+    before_stop = reg.snapshot()["counters"].get("take_early_stops", 0)
+
+    r = s.sql("SELECT a, b FROM taketest LIMIT 5")
+    assert r.num_rows == 5
+    assert [row[0] for row in r.rows()] == [0, 1, 2, 3, 4]
+
+    r2 = s.sql("SELECT a FROM taketest WHERE a >= 3000 LIMIT 7")
+    assert [row[0] for row in r2.rows()] == list(range(3000, 3007))
+
+    snap = reg.snapshot()["counters"]
+    stops = snap.get("take_early_stops", 0) - before_stop
+    decoded = snap.get("take_batches_decoded", 0) - before_dec
+    assert stops == 2
+    # ~20 batches exist; the two queries together must decode only a few
+    assert decoded <= 6, decoded

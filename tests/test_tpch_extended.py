@@ -39,6 +39,7 @@ def dfs():
         "customer": pd.DataFrame(tpch.gen_customer(n_c, 23)),
         "supplier": pd.DataFrame(tpch.gen_supplier(n_s, 24)),
         "part": pd.DataFrame(tpch.gen_part(n_p, 25)),
+        "partsupp": pd.DataFrame(tpch.gen_partsupp(n_p, n_s, 27)),
         "nation": pd.DataFrame(tpch.gen_nation()),
         "region": pd.DataFrame(tpch.gen_region()),
     }
@@ -152,3 +153,91 @@ def test_q18(s, dfs):
     for row, (_, e) in zip(out, g.iterrows()):
         assert row[2] == e.o_orderkey
         assert row[5] == pytest.approx(e.l_quantity)
+
+
+def test_q17_correlated_scalar(s, dfs):
+    """Correlated scalar aggregate → aggregate-then-join decorrelation."""
+    out = s.sql(tpch.Q17).rows()
+    li, part = dfs["lineitem"], dfs["part"]
+    p = part[(part.p_brand == "Brand#23") & (part.p_container == "MED BOX")]
+    m = li.merge(p[["p_partkey"]], left_on="l_partkey",
+                 right_on="p_partkey")
+    thresh = li.groupby("l_partkey").l_quantity.mean() * 0.2
+    m = m[m.l_quantity < m.l_partkey.map(thresh)]
+    exp = m.l_extendedprice.sum() / 7.0
+    got = out[0][0]
+    if len(m) == 0:
+        assert got is None or got == 0
+    else:
+        assert got == pytest.approx(exp, rel=1e-9)
+
+
+def test_q2_correlated_min(s, dfs):
+    out = s.sql(tpch.Q2).rows()
+    ps, su = dfs["partsupp"], dfs["supplier"]
+    na, re_, pa = dfs["nation"], dfs["region"], dfs["part"]
+    eu = na.merge(re_[re_.r_name == "EUROPE"], left_on="n_regionkey",
+                  right_on="r_regionkey")
+    inner = ps.merge(su, left_on="ps_suppkey", right_on="s_suppkey") \
+        .merge(eu, left_on="s_nationkey", right_on="n_nationkey")
+    mincost = inner.groupby("ps_partkey").ps_supplycost.min()
+    m = pa[pa.p_size == 15].merge(
+        inner, left_on="p_partkey", right_on="ps_partkey")
+    m = m[m.ps_supplycost == m.p_partkey.map(mincost)]
+    exp = m.sort_values(
+        ["s_acctbal", "n_name", "s_name", "p_partkey"],
+        ascending=[False, True, True, True]).head(100)
+    assert len(out) == len(exp)
+    for row, (_, e) in zip(out, exp.iterrows()):
+        assert row[0] == pytest.approx(e.s_acctbal)
+        assert row[1] == e.s_name and row[2] == e.n_name
+        assert row[3] == e.p_partkey
+
+
+def test_q20_nested_correlated(s, dfs):
+    out = [r[0] for r in s.sql(tpch.Q20).rows()]
+    li, ps = dfs["lineitem"], dfs["partsupp"]
+    su, na, pa = dfs["supplier"], dfs["nation"], dfs["part"]
+    d0, d1 = _days("1994-01-01"), _days("1995-01-01")
+    parts = set(pa[pa.p_type.str.startswith("STANDARD")].p_partkey)
+    lw = li[(li.l_shipdate >= d0) & (li.l_shipdate < d1)]
+    halfsum = lw.groupby(["l_partkey", "l_suppkey"]).l_quantity.sum() * 0.5
+    cand = ps[ps.ps_partkey.isin(parts)].copy()
+    key = list(zip(cand.ps_partkey, cand.ps_suppkey))
+    thr = [halfsum.get(k, None) for k in key]
+    keep = [t is not None and q > t for q, t in zip(cand.ps_availqty, thr)]
+    supps = set(cand[keep].ps_suppkey)
+    nk = na[na.n_name == "CANADA"].n_nationkey.iloc[0]
+    exp = sorted(su[(su.s_suppkey.isin(supps))
+                    & (su.s_nationkey == nk)].s_name)
+    assert out == exp
+
+
+def test_q21_exists_with_nonequi_correlation(s, dfs):
+    out = s.sql(tpch.Q21).rows()
+    li, su, od, na = (dfs["lineitem"], dfs["supplier"], dfs["orders"],
+                      dfs["nation"])
+    nk = na[na.n_name == "SAUDI ARABIA"].n_nationkey.iloc[0]
+    l1 = li[li.l_receiptdate > li.l_commitdate]
+    m = l1.merge(od[od.o_orderstatus == "F"], left_on="l_orderkey",
+                 right_on="o_orderkey")
+    m = m.merge(su[su.s_nationkey == nk], left_on="l_suppkey",
+                right_on="s_suppkey")
+    by_order = li.groupby("l_orderkey").l_suppkey.agg(set)
+    late = li[li.l_receiptdate > li.l_commitdate]
+    late_by_order = late.groupby("l_orderkey").l_suppkey.agg(set)
+
+    def keeps(r):
+        others = by_order.get(r.l_orderkey, set()) - {r.l_suppkey}
+        if not others:
+            return False
+        late_others = late_by_order.get(r.l_orderkey, set()) - {r.l_suppkey}
+        return not late_others
+
+    m = m[[keeps(r) for _, r in m.iterrows()]]
+    exp = m.groupby("s_name").size().reset_index(name="numwait") \
+        .sort_values(["numwait", "s_name"], ascending=[False, True]) \
+        .head(100)
+    assert len(out) == len(exp)
+    for row, (_, e) in zip(out, exp.iterrows()):
+        assert row[0] == e.s_name and row[1] == e.numwait
