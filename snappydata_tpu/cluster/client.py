@@ -114,6 +114,18 @@ class SnappyClient:
             flight.Action("repartition", raw)))
         return json.loads(results[0].body.to_pybytes().decode("utf-8"))
 
+    def ping(self) -> None:
+        """Liveness probe (raises if the member is unreachable)."""
+        list(self._client().do_action(flight.Action("ping", b"")))
+
+    def promote(self, body: dict) -> dict:
+        """Failover re-hosting: move this server's replica-shadow rows of
+        body['buckets'] into its primary table (body['table'])."""
+        raw = json.dumps(self._with_token(dict(body))).encode("utf-8")
+        results = list(self._client().do_action(
+            flight.Action("promote", raw)))
+        return json.loads(results[0].body.to_pybytes().decode("utf-8"))
+
     def _with_token(self, body: dict) -> dict:
         if self._token is not None:
             body["token"] = self._token

@@ -64,8 +64,182 @@ class DistributedSession:
         self.server_addresses = list(server_addresses)
         self.servers = [SnappyClient(address=a) for a in server_addresses]
         self.num_buckets = num_buckets
+        # EXPLICIT bucket → server-index map (ref: BucketRegion primary
+        # per bucket, StoreUtils.scala:179-215). Placement survives member
+        # death by REASSIGNING buckets, never by re-hashing — collocated
+        # tables stay collocated across failovers because every table
+        # follows the same map.
+        n = len(self.servers)
+        self.bucket_map: List[int] = [b % n for b in range(num_buckets)]
+        self.alive: List[bool] = [True] * n
         # planning catalog: schemas only (no data) on the lead
         self.planner = SnappySession(catalog=Catalog())
+
+    # -- membership / replica placement --------------------------------
+
+    def _alive(self):
+        return [(i, s) for i, s in enumerate(self.servers)
+                if self.alive[i]]
+
+    def _replica_index(self, bucket: int) -> Optional[int]:
+        """Fixed replica placement: the next ORIGINAL index after the
+        bucket's original primary (liveness-independent, so every row of
+        a bucket's replica lives on one known server)."""
+        n = len(self.servers)
+        if n < 2:
+            return None
+        return ((bucket % n) + 1) % n
+
+    def mark_server_failed(self, index: int) -> None:
+        """Member-departed: re-host the dead server's buckets onto their
+        replica holders (ref: membership-driven executor/bucket recovery,
+        ExecutorInitiator.scala:71-90). Promotion moves rows from each
+        survivor's <table>__replica shadow into its primary table, so
+        queries stay COMPLETE for redundancy ≥ 1 tables."""
+        if not self.alive[index]:
+            return
+        self.alive[index] = False
+        promoted: Dict[int, List[int]] = {}  # new primary -> buckets
+        for b in range(self.num_buckets):
+            if self.bucket_map[b] != index:
+                continue
+            r = self._replica_index(b)
+            if r is None or not self.alive[r] or r == index:
+                continue  # no surviving replica: bucket is lost (r=0)
+            self.bucket_map[b] = r
+            promoted.setdefault(r, []).append(b)
+        # exchange temps were built from pre-failure placement; clear
+        # FIRST so a promotion failure can't leave them stale
+        getattr(self, "_bcast_cache", {}).clear()
+        getattr(self, "_shuf_cache", {}).clear()
+        dead_targets = set()
+        for info in self.planner.catalog.list_tables():
+            if not info.partition_by or info.redundancy <= 0:
+                continue
+            for si, buckets in promoted.items():
+                if si in dead_targets:
+                    continue
+                try:
+                    self.servers[si].promote(
+                        {"table": info.name,
+                         "key": info.partition_by[0],
+                         "buckets": buckets,
+                         "num_buckets": self.num_buckets})
+                except Exception:
+                    dead_targets.add(si)
+        for si in dead_targets:  # the promotion target was dead too
+            self.mark_server_failed(si)
+
+    def replace_server(self, index: int, address: str) -> None:
+        """A restarted/replacement member rejoins at `index` EMPTY: its
+        buckets were re-hosted on failover, so any stale on-disk rows it
+        recovered must not double-count. It is truncated and starts
+        receiving new writes; bucket placement stays with the survivors
+        (rebalancing back is a manual op, like the reference's
+        rebalance)."""
+        from snappydata_tpu.cluster.client import SnappyClient
+
+        try:
+            self.servers[index].close()
+        except Exception:
+            pass
+        client = SnappyClient(address=address)
+        seed_from = next((s for i, s in self._alive() if i != index), None)
+        tables = [t for t in self.planner.catalog.list_tables()
+                  if not t.name.startswith("__")]  # skip lead-local
+        # colocation anchors before dependents
+        tables.sort(key=lambda t: t.colocate_with is not None)
+        for info in tables:
+            # a replacement process starts with an empty catalog: give it
+            # the schema, then make sure any recovered stale rows are gone
+            ddl_cols = ", ".join(
+                f"{f.name} {_ddl_type(f.dtype)}"
+                + (" PRIMARY KEY" if f.name in info.key_columns else "")
+                for f in info.schema.fields)
+            opts = []
+            if info.partition_by:
+                opts.append(f"partition_by '{info.partition_by[0]}'")
+            if info.colocate_with:
+                opts.append(f"colocate_with '{info.colocate_with}'")
+            if info.redundancy:
+                opts.append(f"redundancy '{info.redundancy}'")
+            ddl = (f"CREATE TABLE IF NOT EXISTS {info.name} ({ddl_cols}) "
+                   f"USING {info.provider}")
+            if opts:
+                ddl += f" OPTIONS ({', '.join(opts)})"
+            client.execute(ddl)
+            client.execute(f"TRUNCATE TABLE {info.name}")
+            if info.partition_by and info.redundancy > 0:
+                client.execute(
+                    f"CREATE TABLE IF NOT EXISTS {info.name}__replica "
+                    f"({ddl_cols.replace(' PRIMARY KEY', '')}) "
+                    f"USING column")
+                client.execute(f"TRUNCATE TABLE {info.name}__replica")
+            if not info.partition_by and seed_from is not None:
+                # replicated tables must rejoin with the FULL copy, not
+                # just post-rejoin rows — re-seed from a surviving member
+                piece = seed_from.sql(f"SELECT * FROM {info.name}")
+                if piece.num_rows:
+                    client.insert(info.name, piece)
+        self.servers[index] = client
+        self.server_addresses[index] = address
+        self.alive[index] = True
+        getattr(self, "_bcast_cache", {}).clear()
+        getattr(self, "_shuf_cache", {}).clear()
+
+    def _probe(self, index: int) -> bool:
+        """Distinguish 'member died' from 'statement failed': a failed
+        call against a server that still answers ping is an APPLICATION
+        error and must propagate, not trigger failover."""
+        try:
+            self.servers[index]._invalidate()
+            self.servers[index].ping()
+            return True
+        except Exception:
+            return False
+
+    def _fan(self, fn, retries: int = 1):
+        """Run fn(server) on every ALIVE server (read path — fn must be
+        idempotent); a member failure triggers failover (replica
+        promotion) and ONE full restart so results are complete, not
+        partial."""
+        for attempt in range(retries + 1):
+            out = []
+            failed = None
+            for si, srv in self._alive():
+                try:
+                    out.append(fn(srv))
+                except Exception:
+                    if self._probe(si):
+                        raise  # server alive: statement error, no failover
+                    failed = si
+                    break
+            if failed is None:
+                return out
+            self.mark_server_failed(failed)
+            if sum(self.alive) == 0:
+                raise DistributedError("all data servers failed")
+            if attempt == retries:
+                raise DistributedError(
+                    f"server {self.server_addresses[failed]} failed and "
+                    f"retries exhausted")
+
+    def _fan_mutation(self, fn):
+        """Run fn(server) ONCE per alive server (mutations are NOT
+        idempotent — never re-execute on a server that already applied).
+        A dead member is failed over and skipped: its shard's mutation
+        survives through the replica shadows the OTHER servers mirror."""
+        out = []
+        for si, srv in self._alive():
+            try:
+                out.append(fn(srv))
+            except Exception:
+                if self._probe(si):
+                    raise
+                self.mark_server_failed(si)
+        if sum(self.alive) == 0:
+            raise DistributedError("all data servers failed")
+        return out
 
     # ------------------------------------------------------------------
 
@@ -74,8 +248,31 @@ class DistributedSession:
         if isinstance(stmt, (ast.CreateTable, ast.DropTable,
                              ast.TruncateTable)):
             self.planner.execute_statement(stmt)
-            for srv in self.servers:
-                srv.execute(sql_text)
+            self._fan(lambda srv: srv.execute(sql_text))
+            info = self.planner.catalog.lookup_table(
+                getattr(stmt, "name", ""))
+            if isinstance(stmt, ast.CreateTable) and info is not None \
+                    and info.partition_by and info.redundancy > 0:
+                # replica shadow table per server (ref: redundant bucket
+                # copies) — invisible to queries, promoted on failover
+                ddl_cols = ", ".join(
+                    f"{f.name} {_ddl_type(f.dtype)}"
+                    for f in info.schema.fields)
+                rddl = (f"CREATE TABLE {info.name}__replica ({ddl_cols}) "
+                        f"USING column")
+                self._fan(lambda srv, _r=rddl: srv.execute(_r))
+            elif isinstance(stmt, (ast.DropTable, ast.TruncateTable)):
+                from snappydata_tpu.catalog.catalog import _norm as _n2
+
+                verb = "DROP TABLE IF EXISTS" \
+                    if isinstance(stmt, ast.DropTable) else "TRUNCATE TABLE"
+                rsql = f"{verb} {_n2(stmt.name)}__replica"
+                def _try_replica(srv, _r=rsql):
+                    try:
+                        srv.execute(_r)
+                    except Exception:
+                        pass  # no replica shadow for this table
+                self._fan(_try_replica)
             # a recreated/truncated table must never reuse exchange temps
             from snappydata_tpu.catalog.catalog import _norm
 
@@ -95,11 +292,24 @@ class DistributedSession:
             # tables touch every copy, so report ONE copy's count
             info = self.planner.catalog.lookup_table(stmt.table)
             replicated = info is not None and not info.partition_by
-            counts = []
-            for srv in self.servers:
+
+            rsql = None
+            if info is not None and info.partition_by and \
+                    info.redundancy > 0:
+                # replica shadows must see the same mutation or a later
+                # promotion would resurrect stale rows; the statement is
+                # re-RENDERED from the AST against the shadow table (a
+                # text substitution would miss qualifiers/subqueries)
+                rsql = _render_dml(stmt, f"{info.name}__replica")
+
+            def run_mut(srv):
                 out = srv.execute(sql_text)
-                counts.append(int(out["rows"][0][0])
-                              if out.get("rows") else 0)
+                if rsql is not None:
+                    srv.execute(rsql)  # failures must be LOUD: silent
+                    # shadow divergence corrupts the next failover
+                return int(out["rows"][0][0]) if out.get("rows") else 0
+
+            counts = self._fan_mutation(run_mut)
             total = max(counts) if replicated else sum(counts)
             from snappydata_tpu.engine.result import Result
 
@@ -138,26 +348,76 @@ class DistributedSession:
                     cols[nm] = pa.array(vals, mask=mask)
             return pa.table(cols)
 
-        def send(srv, table_arrow):
+        def send(srv, table_arrow, target=table):
             import pyarrow.flight as flight
 
-            descriptor = flight.FlightDescriptor.for_path(table)
+            descriptor = flight.FlightDescriptor.for_path(target)
             writer, _ = srv._client().do_put(descriptor, table_arrow.schema)
             writer.write_table(table_arrow)
             writer.close()
 
         if not info.partition_by:
             arrow = to_arrow()
-            for srv in self.servers:
-                send(srv, arrow)
+            self._fan(lambda srv: send(srv, arrow))
             return n
         key_ci = info.schema.index(info.partition_by[0])
         buckets = bucket_of_np(arrays[key_ci], self.num_buckets)
-        owner = buckets % len(self.servers)
-        for si, srv in enumerate(self.servers):
-            mask = owner == si
-            if mask.any():
-                send(srv, to_arrow(mask))
+        n0 = len(self.servers)
+        has_replicas = info.redundancy > 0 and n0 > 1
+        rep_target = ((buckets % n0) + 1) % n0 if has_replicas else None
+        done = np.zeros(n, dtype=bool)
+        done_rep = np.zeros(n, dtype=bool) if has_replicas \
+            else np.ones(n, dtype=bool)
+        for _attempt in range(4):  # survives members dying MID-LOAD
+            owner = np.asarray(self.bucket_map)[buckets]
+            if has_replicas:
+                # a row whose replica landed but whose primary write hit
+                # the dying server was ALREADY delivered by promotion (its
+                # new primary IS its replica holder) — resending would
+                # duplicate it
+                done[(~done) & done_rep & (rep_target == owner)] = True
+            failed = None
+            for si, srv in self._alive():
+                sel = np.flatnonzero((owner == si) & ~done)
+                if sel.size:
+                    try:
+                        send(srv, to_arrow(sel))
+                        done[sel] = True
+                    except Exception:
+                        failed = si
+                        break
+                # redundant copy to the bucket's FIXED replica holder
+                # (skipped when the holder is dead or is the primary:
+                # degraded redundancy, never duplicated data)
+                if not done_rep.all() and rep_target is not None:
+                    rsel = np.flatnonzero(
+                        (rep_target == si) & ~done_rep & (owner != si))
+                    if rsel.size:
+                        try:
+                            send(srv, to_arrow(rsel),
+                                 target=f"{table}__replica")
+                            done_rep[rsel] = True
+                        except Exception:
+                            failed = si
+                            break
+                    # replica collapses onto the primary → degraded, done
+                    done_rep[(rep_target == si) & (owner == si)] = True
+            if failed is None:
+                if rep_target is not None:
+                    # dead replica holders: degraded redundancy, not a loop
+                    alive_mask = np.asarray(self.alive)[rep_target]
+                    done_rep[~alive_mask] = True
+                if done_rep.all():
+                    break
+                continue
+            self.mark_server_failed(failed)
+            # primary writes the dead server acked WITHOUT a replica copy
+            # yet are gone with it — re-deliver them to the new owner
+            done[done & (owner == failed) & ~done_rep] = False
+            if sum(self.alive) == 0:
+                raise DistributedError("all data servers failed mid-load")
+        if not done.all():
+            raise DistributedError("insert incomplete after failovers")
         return n
 
     def _insert_values(self, stmt: ast.InsertInto):
@@ -344,7 +604,7 @@ class DistributedSession:
     def _global_table_stats(self, names) -> Dict[str, dict]:
         """One stats() round-trip per server → global rows/bytes and a
         version token (tuple of per-server mutation versions)."""
-        per_server = [srv.stats() for srv in self.servers]
+        per_server = self._fan(lambda srv: srv.stats())
         out = {}
         for nm in names:
             rows = bytes_ = 0
@@ -433,8 +693,8 @@ class DistributedSession:
         if self._bcast_cache.get(name) != stat["version_token"]:
             import pyarrow as pa
 
-            pieces = [srv.sql(f"SELECT * FROM {name}")
-                      for srv in self.servers]
+            pieces = self._fan(
+                lambda srv: srv.sql(f"SELECT * FROM {name}"))
             merged = pa.concat_tables(pieces)
             info = self.planner.catalog.describe(name)
             ddl_cols = ", ".join(
@@ -475,11 +735,22 @@ class DistributedSession:
         self.sql(f"DROP TABLE IF EXISTS {tmp}")
         self.sql(f"CREATE TABLE {tmp} ({ddl_cols}) USING column "
                  f"OPTIONS ({opts})")
-        addrs = list(self.server_addresses)
+        alive = self._alive()
+        addrs = [self.server_addresses[i] for i, _ in alive]
+        local_of = {i: li for li, (i, _) in enumerate(alive)}
+        lost = [b for b in range(self.num_buckets)
+                if self.bucket_map[b] not in local_of]
+        if lost:
+            raise DistributedError(
+                f"{len(lost)} buckets have no surviving copy (their "
+                f"primary AND replica members are gone); cannot shuffle "
+                f"{name} completely")
+        owners = [local_of[self.bucket_map[b]]
+                  for b in range(self.num_buckets)]
         body = {"table": name, "key": key, "dest": tmp, "servers": addrs,
-                "num_buckets": self.num_buckets}
-        for srv in self.servers:
-            srv.repartition(body)
+                "num_buckets": self.num_buckets,
+                "bucket_owners": owners}
+        self._fan(lambda srv: srv.repartition(body))
         self._shuf_cache[tmp] = stat["version_token"]
         return tmp
 
@@ -567,7 +838,7 @@ class DistributedSession:
         partial_sql = render_plan(node)
         import pyarrow as pa
 
-        pieces = [srv.sql(partial_sql) for srv in self.servers]
+        pieces = self._fan(lambda srv: srv.sql(partial_sql))
         merged = pa.concat_tables(pieces)
         result = _arrow_to_result(merged, self.planner)
         return _apply_outer(result, outer, self.planner)
@@ -647,7 +918,7 @@ class DistributedSession:
 
         import pyarrow as pa
 
-        pieces = [srv.sql(partial_sql) for srv in self.servers]
+        pieces = self._fan(lambda srv: srv.sql(partial_sql))
         merged = pa.concat_tables(pieces)
 
         # load partials into a scratch table on the planner and merge
@@ -691,7 +962,37 @@ class DistributedSession:
             except Exception:
                 pass
         for srv in self.servers:
-            srv.close()
+            try:
+                srv.close()
+            except Exception:
+                pass
+
+
+def _render_dml(stmt, target_table: str) -> str:
+    """Render an UPDATE/DELETE against a different table. Column
+    qualifiers naming the original table (or any alias) are stripped —
+    the statement is single-table, so bare names resolve. Subqueries in
+    the WHERE clause cannot be retargeted safely → error loudly."""
+    def strip_quals(e: ast.Expr) -> ast.Expr:
+        if isinstance(e, (ast.ScalarSubquery, ast.InSubquery,
+                          ast.ExistsSubquery)):
+            raise DistributedError(
+                "UPDATE/DELETE with subqueries is not supported on "
+                "redundant tables (replica mirror cannot be derived)")
+        if isinstance(e, ast.Col) and e.qualifier:
+            return ast.Col(e.name, None, e.index, e.dtype)
+        return e.map_children(strip_quals)
+
+    if isinstance(stmt, ast.UpdateStmt):
+        sets = ", ".join(
+            f"{c} = {render_expr(strip_quals(v))}"
+            for c, v in stmt.assignments)
+        sql = f"UPDATE {target_table} SET {sets}"
+    else:
+        sql = f"DELETE FROM {target_table}"
+    if stmt.where is not None:
+        sql += f" WHERE {render_expr(strip_quals(stmt.where))}"
+    return sql
 
 
 def _rename_tables(plan: ast.Plan, mapping: Dict[str, str]) -> ast.Plan:

@@ -200,8 +200,17 @@ class SnappyFlightServer(flight.FlightServerBase):
             n = self._repartition_shard(
                 sess, body["table"], body["key"], body["dest"],
                 body["servers"], int(body["num_buckets"]),
-                body.get("token"))
+                body.get("token"), body.get("bucket_owners"))
             yield flight.Result(json.dumps({"rows": n}).encode("utf-8"))
+        elif name == "promote":
+            # failover re-hosting: replica-shadow rows of the given
+            # buckets become primary rows on THIS server (ref: bucket
+            # redundancy re-hosting on member departure)
+            sess = self._session_for(body)
+            moved = self._promote_replica(
+                sess, body["table"], body["key"],
+                frozenset(body["buckets"]), int(body["num_buckets"]))
+            yield flight.Result(json.dumps({"rows": moved}).encode("utf-8"))
         elif name == "ping":
             yield flight.Result(b'{"ok": true}')
         else:
@@ -209,10 +218,12 @@ class SnappyFlightServer(flight.FlightServerBase):
 
     def _repartition_shard(self, sess, table: str, key: str, dest: str,
                            servers, num_buckets: int,
-                           token: Optional[str]) -> int:
+                           token: Optional[str],
+                           bucket_owners=None) -> int:
         """Scan the local shard, bucket rows by murmur3(key) (the SAME
-        placement formula the lead's insert routing uses, so re-bucketed
-        rows land exactly where a direct insert would), push each peer its
+        placement the lead's insert routing uses — an explicit bucket→
+        server map when given, so re-bucketed rows land exactly where a
+        direct insert would even after failovers), push each peer its
         sub-shard."""
         from snappydata_tpu.cluster.client import SnappyClient
         from snappydata_tpu.parallel.hashing import bucket_of_np
@@ -223,7 +234,10 @@ class SnappyFlightServer(flight.FlightServerBase):
             return 0
         ki = [c.lower() for c in result.names].index(key.lower())
         buckets = bucket_of_np(np.asarray(result.columns[ki]), num_buckets)
-        owner = buckets % len(servers)
+        if bucket_owners is not None:
+            owner = np.asarray(bucket_owners, dtype=np.int64)[buckets]
+        else:
+            owner = buckets % len(servers)
         sent = 0
         for si, addr in enumerate(servers):
             mask = owner == si
@@ -237,6 +251,54 @@ class SnappyFlightServer(flight.FlightServerBase):
                 client.close()
             sent += int(mask.sum())
         return sent
+
+    def _promote_replica(self, sess, table: str, key: str,
+                         buckets: frozenset, num_buckets: int) -> int:
+        """Move rows of `buckets` from <table>__replica into <table> and
+        drop them from the shadow (their old primary died)."""
+        from snappydata_tpu.parallel.hashing import bucket_of_np
+
+        replica = f"{table}__replica"
+        result = sess.sql(f"SELECT * FROM {replica}")
+        n = int(result.columns[0].shape[0]) if result.columns else 0
+        if n == 0:
+            return 0
+        ki = [c.lower() for c in result.names].index(key.lower())
+        kvals = np.asarray(result.columns[ki])
+        rb = bucket_of_np(kvals, num_buckets)
+        mask = np.isin(rb, np.fromiter(buckets, dtype=np.int64))
+        moved = int(mask.sum())
+        if moved == 0:
+            return 0
+        from snappydata_tpu.storage.table_store import RowTableData
+
+        info = self.session.catalog.describe(table)
+        arrays = [np.asarray(c)[mask] for c in result.columns]
+        nulls = [np.asarray(nm)[mask] if nm is not None else None
+                 for nm in result.nulls]
+        nmask = nulls if any(m is not None for m in nulls) else None
+        if isinstance(info.data, RowTableData):
+            from snappydata_tpu.session import _restore_none_arrays
+
+            raw = _restore_none_arrays(arrays, nulls)
+            self.session._journal_then(
+                info, "insert", raw, None,
+                lambda: info.data.insert_arrays(raw))
+        else:
+            self.session._journal_then(
+                info, "insert", arrays, nmask,
+                lambda: info.data.insert_arrays(arrays, nulls=nmask))
+        # remove promoted rows from the shadow so a LATER promotion of
+        # other buckets can't double-promote these
+        rinfo = self.session.catalog.describe(replica)
+
+        def pred(cols, _k=key.lower(), _bk=buckets, _nb=num_buckets):
+            vals = np.asarray(cols[_k])
+            return np.isin(bucket_of_np(vals, _nb),
+                           np.fromiter(_bk, dtype=np.int64))
+
+        rinfo.data.delete(pred)
+        return moved
 
     def list_actions(self, context):
         return [("sql", "execute a statement"),
