@@ -85,6 +85,24 @@ def main() -> None:
     # pin the dtype policy NOW so nothing re-queries backend state mid-run
     config.global_properties().decimal_as_float64 = platform == "cpu"
 
+    # TPU smoke: one small query compiled + executed + VALUE-ASSERTED on
+    # the real backend before the big load, so numeric regressions surface
+    # here with a clear message instead of as a wrong headline number
+    smoke = SnappySession(catalog=Catalog())
+    smoke.sql("CREATE TABLE smoke (g BIGINT, v DOUBLE) USING column")
+    smoke.insert_arrays("smoke", [
+        np.arange(1000, dtype=np.int64) % 4,
+        np.arange(1000, dtype=np.float64)])
+    row = smoke.sql("SELECT g, count(*), sum(v) FROM smoke GROUP BY g "
+                    "ORDER BY g").rows()
+    assert [r[0] for r in row] == [0, 1, 2, 3], row
+    assert all(r[1] == 250 for r in row), row
+    exp = [float(sum(range(g, 1000, 4))) for g in range(4)]
+    for r, e in zip(row, exp):
+        assert abs(r[2] - e) <= 1e-6 * e, (r, e)
+    print(f"bench: {platform} smoke OK (grouped agg value-asserted)",
+          file=sys.stderr, flush=True)
+
     s = SnappySession(catalog=Catalog())
     t0 = time.time()
     tpch.load_tpch(s, sf=sf, seed=17)

@@ -35,7 +35,16 @@ class Properties:
     # Storage (ref: Literals.scala:129 ColumnBatchSize ~24MB, :138 ColumnMaxDeltaRows 10000)
     column_batch_rows: int = 1 << 17          # rows per column batch (static XLA shape)
     column_max_delta_rows: int = 10000        # row-buffer rollover threshold
-    compression_codec: str = "none"           # "none" | "zlib" (lz4 absent in env)
+    # at-rest codec for checkpoints/WAL — ON by default like the
+    # reference's LZ4 (Constant.DEFAULT_CODEC, jdbc/.../Constant.scala:150);
+    # zstd level 1 is the env's LZ4-class codec
+    compression_codec: str = "zstd"           # "zstd" | "zlib" | "none"
+
+    # Host memory budget for resident column batches; above it the
+    # coldest batches spill to disk as memmaps (transparently reloaded
+    # through the OS page cache). 0 = unlimited. Ref:
+    # SnappyUnifiedMemoryManager eviction-heap-percentage.
+    host_store_bytes: int = 0
 
     # Planner (ref: Literals.scala:153 HashJoinSize 100MB, :161 HashAggregateSize)
     hash_join_size: int = 100 * 1024 * 1024   # max build-side bytes for broadcast join

@@ -265,12 +265,20 @@ def decode_validity(col: EncodedColumn, capacity: Optional[int] = None) -> Optio
 # --- at-rest compression (ref: CompressionUtils LZ4/Snappy; env has zlib) ---
 
 def compress_bytes(raw: bytes, codec: str) -> Tuple[str, bytes]:
+    if codec == "zstd":
+        import zstandard
+
+        return "zstd", zstandard.ZstdCompressor(level=1).compress(raw)
     if codec == "zlib":
         return "zlib", zlib.compress(raw, level=1)
     return "none", raw
 
 
 def decompress_bytes(codec: str, blob: bytes) -> bytes:
+    if codec == "zstd":
+        import zstandard
+
+        return zstandard.ZstdDecompressor().decompress(blob)
     if codec == "zlib":
         return zlib.decompress(blob)
     return blob

@@ -1,0 +1,141 @@
+"""Host memory eviction: spill cold column batches to disk as memmaps.
+
+The reference evicts region entries to disk when heap crosses
+eviction-heap-percentage (SnappyUnifiedMemoryManager.scala:379-401;
+SnappyStorageEvictor). TPU-first shape of the same idea: when a table's
+RESIDENT batch bytes exceed `host_store_bytes`, the OLDEST batches'
+numeric arrays are rewritten into a spill file and replaced by
+`np.memmap` views — semantically identical arrays whose residency the OS
+page cache manages, so reload is transparent (a later scan simply pages
+the bytes back in). Dictionaries and object-typed arrays stay resident
+(small / not memmappable).
+
+Spilling republishes the manifest, which (by design) invalidates the
+table's device caches for the spilled version — trading a device
+re-upload for host RAM, the same trade the reference makes on eviction.
+"""
+
+from __future__ import annotations
+
+import atexit
+import dataclasses
+import itertools
+import os
+import shutil
+import tempfile
+import weakref
+from typing import Optional, Tuple
+
+import numpy as np
+
+_spill_dir: Optional[str] = None
+_spill_ids = itertools.count()  # unique filenames (id() values recycle)
+
+
+def _dir() -> str:
+    global _spill_dir
+    if _spill_dir is None:
+        _spill_dir = tempfile.mkdtemp(prefix="snappy_hoststore_")
+        atexit.register(shutil.rmtree, _spill_dir, ignore_errors=True)
+    return _spill_dir
+
+
+def resident_bytes(arr: Optional[np.ndarray]) -> int:
+    """SPILLABLE bytes an array keeps in host RAM. memmaps count 0 (the
+    page cache owns them); object-dtype arrays count 0 too — they CANNOT
+    spill, and counting them would make the budget unreachable (the
+    spiller would rewrite the same batches on every insert forever)."""
+    if arr is None or isinstance(arr, np.memmap) or arr.dtype == object:
+        return 0
+    return arr.nbytes
+
+
+def batch_resident_bytes(batch) -> int:
+    total = 0
+    for col in batch.columns:
+        for a in (col.data, col.runs, col.validity):
+            total += resident_bytes(a)
+    return total
+
+
+def spill_batch(batch) -> Tuple[int, object]:
+    """Write one batch's numeric arrays to disk; returns (bytes_freed,
+    new ColumnBatch with memmap-backed columns). The spill file is
+    unlinked when the new batch object is garbage-collected (Linux keeps
+    the inode alive for any still-mapped views)."""
+    path = os.path.join(_dir(),
+                        f"batch_{next(_spill_ids)}_{batch.batch_id}.bin")
+    freed = 0
+    new_cols = []
+    # file must exist and carry all bytes BEFORE memmaps are constructed
+    with open(path, "wb") as fh:
+        staged = []
+        for col in batch.columns:
+            offs = {}
+            for name in ("data", "runs", "validity"):
+                a = getattr(col, name)
+                if a is None or isinstance(a, np.memmap) or \
+                        a.dtype == object:
+                    offs[name] = None
+                    continue
+                ac = np.ascontiguousarray(a)
+                offs[name] = (fh.tell(), ac.dtype, ac.shape)
+                fh.write(ac.tobytes())
+                freed += ac.nbytes
+            staged.append(offs)
+        fh.flush()
+        os.fsync(fh.fileno())
+    if freed == 0:
+        os.unlink(path)
+        return 0, batch
+    for col, offs in zip(batch.columns, staged):
+        repl = {}
+        for name, spec in offs.items():
+            if spec is not None:
+                off, dt, shape = spec
+                repl[name] = np.memmap(path, dtype=dt, mode="r",
+                                       offset=off, shape=shape)
+        new_cols.append(dataclasses.replace(col, **repl) if repl else col)
+    new_batch = dataclasses.replace(batch, columns=tuple(new_cols))
+    weakref.finalize(new_batch, _unlink_quiet, path)
+    return freed, new_batch
+
+
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+def spill_to_budget(data, budget: int) -> int:
+    """Spill `data`'s oldest resident batches until the table fits the
+    budget. Returns batches spilled."""
+    from snappydata_tpu.observability.metrics import global_registry
+
+    spilled = 0
+    with data._lock:
+        m = data._manifest
+        per_view = [batch_resident_bytes(v.batch) for v in m.views]
+        total = sum(per_view)
+        if total <= budget:
+            return 0
+        new_views = list(m.views)
+        freed_total = 0
+        for i, v in enumerate(new_views):  # oldest (lowest index) first
+            if total - freed_total <= budget:
+                break
+            if per_view[i] == 0:
+                continue
+            freed, nb = spill_batch(v.batch)
+            if freed == 0:
+                continue
+            freed_total += freed
+            new_views[i] = dataclasses.replace(v, batch=nb)
+            spilled += 1
+        if spilled:
+            data._publish(tuple(new_views))
+    if spilled:
+        reg = global_registry()
+        reg.inc("host_batches_spilled", spilled)
+    return spilled

@@ -121,15 +121,33 @@ def _arr_from_parts(meta: dict, parts: List[bytes]) -> Optional[np.ndarray]:
 
 
 def write_record(fh, header: dict, arrays: List[Optional[np.ndarray]]) -> None:
+    from snappydata_tpu import config
+    from snappydata_tpu.storage.encoding import compress_bytes
+
+    codec = config.global_properties().compression_codec
     metas = []
     parts: List[bytes] = []
+    codecs: List[str] = []
     for a in arrays:
         m, ps = _arr_to_parts(a)
         m["nparts"] = len(ps)
         metas.append(m)
-        parts.extend(ps)
-    head = json.dumps({"h": header, "arrays": metas,
-                       "sizes": [len(p) for p in parts]}).encode("utf-8")
+        for p in ps:
+            # at-rest compression ON by default (ref: LZ4'd oplogs);
+            # stored only when it actually shrinks the part
+            if codec != "none" and len(p) > 512:
+                used, blob = compress_bytes(p, codec)
+                if len(blob) < len(p):
+                    parts.append(blob)
+                    codecs.append(used)
+                    continue
+            parts.append(p)
+            codecs.append("none")
+    head_obj = {"h": header, "arrays": metas,
+                "sizes": [len(p) for p in parts]}
+    if any(c != "none" for c in codecs):
+        head_obj["codecs"] = codecs
+    head = json.dumps(head_obj).encode("utf-8")
     fh.write(_MAGIC)
     fh.write(struct.pack("<I", len(head)))
     fh.write(head)
@@ -157,11 +175,19 @@ def read_records(fh):
             return  # torn/garbled tail record (crash mid-write)
         parts = []
         ok = True
-        for size in head["sizes"]:
+        codecs = head.get("codecs")
+        for pi, size in enumerate(head["sizes"]):
             p = fh.read(size)
             if len(p) < size:  # torn tail write (crash mid-record)
                 ok = False
                 break
+            if codecs is not None and codecs[pi] != "none":
+                from snappydata_tpu.storage.encoding import decompress_bytes
+
+                try:
+                    p = decompress_bytes(codecs[pi], p)
+                except Exception:
+                    return  # garbled tail (crash mid-write): stop cleanly
             parts.append(p)
         if not ok:
             return

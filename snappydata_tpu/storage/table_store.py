@@ -289,9 +289,22 @@ class ColumnTableData:
             if self._row_buffer.count >= self.max_delta_rows:
                 views.extend(self._rollover_locked())
             self._publish(tuple(views))
+        self._maybe_spill()
         for cb in self.on_insert:
             cb(arrays, nulls)
         return n
+
+    def _maybe_spill(self) -> None:
+        """Evict the coldest batches to disk when the host budget is
+        exceeded (ref: SnappyStorageEvictor region eviction,
+        SnappyUnifiedMemoryManager.scala:379-401)."""
+        from snappydata_tpu import config
+
+        budget = config.global_properties().host_store_bytes
+        if budget:
+            from snappydata_tpu.storage import hoststore
+
+            hoststore.spill_to_budget(self, budget)
 
     def _cut_batch(self, arrays: List[np.ndarray],
                    nulls: Optional[List[Optional[np.ndarray]]] = None,
