@@ -204,7 +204,11 @@ class CompiledPlan:
                 col = dt.columns[ci]
                 nl = dt.nulls.get(ci)
                 if take_idx is not None:
-                    col = jnp.take(col, take_idx, axis=0)
+                    if isinstance(col, tuple):  # array column plates
+                        col = tuple(jnp.take(c, take_idx, axis=0)
+                                    for c in col)
+                    else:
+                        col = jnp.take(col, take_idx, axis=0)
                     nl = jnp.take(nl, take_idx, axis=0) \
                         if nl is not None else None
                 arrays.append((col, nl))
@@ -367,6 +371,7 @@ class Compiler:
 
     def compile(self, plan: ast.Plan) -> CompiledPlan:
         is_agg = isinstance(plan, ast.Aggregate)
+        _validate_array_usage(plan)
         # column pruning: per-relation needed ordinals, DFS leaf order
         # (HBM-bandwidth saver; ref analogue: Catalyst column pruning into
         # ColumnTableScan's per-column decoders)
@@ -419,6 +424,9 @@ class Compiler:
             pairs = []
             for i in range(len(scope)):
                 dv = out.cols[i]
+                if isinstance(dv.value, tuple):
+                    raise CompileError(
+                        "array-valued output column: host path")
                 v = _broadcast_to_mask(dv.value, out.valid)
                 nl = dv.null
                 pairs.append((v, nl))
@@ -706,7 +714,10 @@ class Compiler:
             used = sorted(pruned) if pruned is not None \
                 else list(range(len(info.schema)))
             for uci in used:
-                if info.schema.fields[uci].dtype.name in ("array", "map"):
+                fdt = info.schema.fields[uci].dtype
+                if fdt.name in ("map", "struct") or (
+                        fdt.name == "array"
+                        and not T.is_numeric(fdt.element)):
                     raise CompileError(
                         "complex-typed columns evaluate on the host path")
             rel_idx = len(self.relations)
@@ -1497,6 +1508,42 @@ def _plan_width(plan: ast.Plan) -> int:
     if isinstance(plan, ast.WindowProject):
         return len(plan.exprs)
     raise CompileError(f"width of {type(plan).__name__}")
+
+
+
+
+def _validate_array_usage(plan: ast.Plan) -> None:
+    """Array-typed columns may appear on device ONLY as the first argument
+    of size/element_at/array_contains (their plate layout is opaque to
+    every other operator) — anything else reroutes to the host path."""
+    def check_expr(e: ast.Expr, allowed: bool) -> None:
+        if isinstance(e, ast.Col) and isinstance(e.dtype, T.ArrayType) \
+                and not allowed:
+            raise CompileError(
+                "array column outside size/element_at/array_contains: "
+                "host path")
+        from snappydata_tpu.engine.exprs import ARRAY_DEVICE_FUNCS
+
+        for i, c in enumerate(e.children()):
+            ok = isinstance(e, ast.Func) and i == 0 and \
+                e.name in ARRAY_DEVICE_FUNCS
+            check_expr(c, ok)
+
+    def walk(p: ast.Plan) -> None:
+        if isinstance(p, ast.Filter):
+            check_expr(p.condition, False)
+        elif isinstance(p, (ast.Project, ast.WindowProject)):
+            for e in p.exprs:
+                check_expr(e, False)
+        elif isinstance(p, ast.Aggregate):
+            for e in list(p.group_exprs) + list(p.agg_exprs):
+                check_expr(e, False)
+        elif isinstance(p, ast.Join) and p.condition is not None:
+            check_expr(p.condition, False)
+        for k in p.children():
+            walk(k)
+
+    walk(plan)
 
 
 def _collect_used(plan: ast.Plan, needed: Optional[set], out: List[set]) -> None:

@@ -38,6 +38,12 @@ class CompileError(Exception):
     pass
 
 
+# the ONLY functions that may consume an array-typed column on device
+# (its (values, lengths, element_nulls) plate layout is opaque to every
+# other operator); executor._validate_array_usage enforces the same set
+ARRAY_DEVICE_FUNCS = ("size", "element_at", "array_contains")
+
+
 @dataclasses.dataclass
 class DVal:
     """A traced value: device array + optional null mask + static type info."""
@@ -522,12 +528,75 @@ class ExprBuilder:
 
         return run_cast
 
+    def _arg_array_type(self, e: ast.Expr):
+        """Static ArrayType of an argument expression, else None."""
+        if isinstance(e, ast.Col):
+            dt = e.dtype if e.dtype is not None else \
+                self.col_types.get(e.index)
+            return dt if isinstance(dt, T.ArrayType) else None
+        if isinstance(e, ast.Alias):
+            return self._arg_array_type(e.child)
+        return None
+
     def _emit_func(self, e: ast.Func) -> Callable[[Runtime], DVal]:
         name = e.name
         if name in ast.AGG_FUNCS:
             raise CompileError(
                 f"aggregate {name} outside aggregation context")
         args = [self.emit(a) for a in e.args]
+
+        # device lowering for numeric fixed-width arrays: the column binds
+        # as (values [.., L], lengths, element_nulls) plates; padding and
+        # NULL elements are excluded via the length/element-null masks
+        # (ref: SerializedArray; round-1 gap: every array op was host)
+        if name in ARRAY_DEVICE_FUNCS and e.args:
+            t0 = self._arg_array_type(e.args[0])
+            if t0 is not None:
+                if not T.is_numeric(t0.element):
+                    raise CompileError("non-numeric array op: host path")
+                arr_run = args[0]
+                if name == "size":
+                    def run_size(rt: Runtime) -> DVal:
+                        d = arr_run(rt)
+                        _vals, lengths, _en = d.value
+                        return DVal(lengths.astype(jnp.int32), d.null,
+                                    T.INT)
+
+                    return run_size
+                other = args[1]
+                if name == "element_at":
+                    def run_elem(rt: Runtime) -> DVal:
+                        d = arr_run(rt)
+                        iv = other(rt)
+                        vals, lengths, enul = d.value
+                        pos = jnp.asarray(iv.value).astype(jnp.int32) - 1
+                        pos_b = jnp.broadcast_to(pos, lengths.shape)
+                        safe = jnp.clip(pos_b, 0, vals.shape[-1] - 1)
+                        out = jnp.take_along_axis(
+                            vals, safe[..., None], axis=-1)[..., 0]
+                        el_null = jnp.take_along_axis(
+                            enul, safe[..., None], axis=-1)[..., 0]
+                        bad = (pos_b < 0) | (pos_b >= lengths) | el_null
+                        nl = _or_null(_or_null(d.null, iv.null), bad)
+                        return DVal(out, nl, t0.element)
+
+                    return run_elem
+
+                def run_contains(rt: Runtime) -> DVal:
+                    d = arr_run(rt)
+                    xv = other(rt)
+                    vals, lengths, enul = d.value
+                    L = vals.shape[-1]
+                    x = jnp.broadcast_to(jnp.asarray(xv.value),
+                                         lengths.shape)
+                    # compare under jnp promotion (a fractional needle
+                    # must NOT truncate into the int element domain)
+                    eq = vals == x[..., None]
+                    in_range = (jnp.arange(L) < lengths[..., None]) & ~enul
+                    out = (eq & in_range).any(axis=-1)
+                    return DVal(out, _or_null(d.null, xv.null), T.BOOLEAN)
+
+                return run_contains
 
         if name == "coalesce":
             def run_coalesce(rt: Runtime) -> DVal:

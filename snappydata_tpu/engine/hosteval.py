@@ -248,6 +248,20 @@ def _eval_func(e: ast.Func, cols, nulls, params, n):
             out[i] = {_plain(vs[j][i]): _plain(vs[j + 1][i])
                       for j in range(0, len(vs), 2)}
         return out, None
+    if name == "named_struct":
+        out = np.empty(n, dtype=object)
+        keys = [np.broadcast_to(args[i][0], (n,))
+                for i in range(0, len(args) - 1, 2)]
+        vals = [np.broadcast_to(args[i][0], (n,))
+                for i in range(1, len(args), 2)]
+        vnulls = [np.broadcast_to(args[i][1], (n,))
+                  if args[i][1] is not None else None
+                  for i in range(1, len(args), 2)]
+        for r in range(n):
+            out[r] = {str(k[r]): (None if vn is not None and vn[r]
+                                  else _plain(v[r]))
+                      for k, v, vn in zip(keys, vals, vnulls)}
+        return out, None
     if name in ("map_keys", "map_values"):
         v, nl = args[0]
         out = np.empty(n, dtype=object)
@@ -282,8 +296,16 @@ def _eval_func(e: ast.Func, cols, nulls, params, n):
         vals = []
         nulls_out = np.zeros(n, dtype=bool)
         for i, x in enumerate(np.broadcast_to(v, (n,))):
-            if isinstance(x, dict):  # map lookup by key, not position
-                got = x.get(_plain(idx[i]))
+            if isinstance(x, dict):  # map/struct lookup by key
+                k = _plain(idx[i])
+                got = x.get(k)
+                if got is None and isinstance(k, str):
+                    # struct field names resolve case-insensitively, like
+                    # the analyzer's StructType.field_type
+                    for kk, vv in x.items():
+                        if isinstance(kk, str) and kk.lower() == k.lower():
+                            got = vv
+                            break
                 vals.append(got)
                 nulls_out[i] = got is None
                 continue

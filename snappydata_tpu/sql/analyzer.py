@@ -144,12 +144,25 @@ def expr_type(e: ast.Expr) -> T.DataType:
             return T.ArrayType("array", T.STRING)
         if low == "array_contains":
             return T.BOOLEAN
+        if low == "named_struct":
+            fields = []
+            for i in range(0, len(e.args) - 1, 2):
+                nm = e.args[i]
+                fields.append((
+                    str(nm.value) if isinstance(nm, ast.Lit) else f"c{i//2}",
+                    expr_type(e.args[i + 1])))
+            return T.StructType("struct", tuple(fields))
         if low == "element_at":
             at = expr_type(e.args[0])
             if isinstance(at, T.ArrayType):
                 return at.element
             if isinstance(at, T.MapType):
                 return at.value
+            if isinstance(at, T.StructType) and \
+                    isinstance(e.args[1], ast.Lit):
+                ft = at.field_type(str(e.args[1].value))
+                if ft is not None:
+                    return ft
             return T.STRING
         if low in ("substr", "substring", "upper", "lower", "trim", "concat",
                    "ltrim", "rtrim"):

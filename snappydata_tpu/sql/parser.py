@@ -623,6 +623,15 @@ class Parser:
             val = self.type_name()
             self.expect_op(">")
             return T.parse_type("map", element=val, key=key)
+        if name.lower() == "struct" and self.accept_op("<"):
+            fields = []
+            while not self.at_op(">"):
+                fname = self.ident()
+                self.accept_op(":")
+                fields.append((fname, self.type_name()))
+                self.accept_op(",")
+            self.expect_op(">")
+            return T.parse_type("struct", fields=fields)
         args = []
         if self.accept_op("("):
             while not self.at_op(")"):

@@ -113,6 +113,18 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
     nulls: Dict[int, Optional[jnp.ndarray]] = {}
     for ci in col_indices:
         f = schema.fields[ci]
+        if isinstance(f.dtype, T.ArrayType) and T.is_numeric(f.dtype.element):
+            # fixed-width device layout for numeric arrays: value plates
+            # [B, C, L] + lengths [B, C] + element-null bits — feeds the
+            # device lowering of size/element_at/array_contains (ref:
+            # SerializedArray fixed-width fast path)
+            key = ("acol", ci)
+            if key not in cache:
+                cache[key] = _build_array_column(
+                    data, manifest, views, row_chunks, ci, f, b, cap,
+                    _place)
+            columns[ci], stats_min[ci], stats_max[ci], nulls[ci] = cache[key]
+            continue
         is_str = f.dtype.name == "string"
         if is_str:
             dicts[ci] = data.dictionary(ci)
@@ -175,6 +187,54 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                             _entry_bytes(cache))
     return DeviceTable(schema, b, cap, cache["valid"], columns, dicts,
                        stats_min, stats_max, manifest.total_rows(), nulls)
+
+
+def _build_array_column(data, manifest, views, row_chunks, ci, f, b, cap,
+                        _place):
+    """Numeric ARRAY column → ((values [b,cap,L], lengths [b,cap],
+    element_nulls [b,cap,L]), nan-stats, row-null mask)."""
+    edt = f.dtype.element.device_dtype()
+    sources = []
+    for i, v in enumerate(views):
+        sources.append((i, v.decoded_column(ci), v.null_mask(ci)))
+    for j, (pos, take) in enumerate(row_chunks):
+        src = np.asarray(manifest.row_arrays[ci][pos:pos + take],
+                         dtype=object)
+        rn = None
+        if manifest.row_nulls and manifest.row_nulls[ci] is not None:
+            rn = manifest.row_nulls[ci][pos:pos + take]
+        sources.append((len(views) + j, src, rn))
+    maxlen = 1
+    for _bi, dec, _nm in sources:
+        for x in dec:
+            if isinstance(x, (list, tuple, np.ndarray)) and \
+                    len(x) > maxlen:
+                maxlen = len(x)
+    L = _next_pow2(maxlen)
+    vals = np.zeros((b, cap, L), dtype=edt)
+    lens = np.zeros((b, cap), dtype=np.int32)
+    enul = np.zeros((b, cap, L), dtype=np.bool_)
+    null_mask = np.zeros((b, cap), dtype=np.bool_)
+    any_null = False
+    for bi, dec, nm in sources:
+        for r, x in enumerate(dec):
+            if isinstance(x, (list, tuple, np.ndarray)):
+                lx = len(x)
+                lens[bi, r] = lx
+                for k, el in enumerate(x):
+                    if el is None:
+                        enul[bi, r, k] = True
+                    else:
+                        vals[bi, r, k] = el
+            else:
+                null_mask[bi, r] = True
+                any_null = True
+        if nm is not None:
+            null_mask[bi, :len(nm)] |= np.asarray(nm, dtype=bool)
+            any_null = True
+    return ((_place(vals), _place(lens), _place(enul)),
+            np.full(b, np.nan), np.full(b, np.nan),
+            _place(null_mask) if any_null else None)
 
 
 def data_pow2() -> bool:
@@ -241,11 +301,9 @@ _cache_budget = _DeviceCacheBudget()
 
 
 def _entry_bytes(dt_cols: Dict) -> int:
-    total = 0
-    for v in dt_cols.values():
-        if isinstance(v, tuple):
-            arrs = [x for x in v if hasattr(x, "nbytes")]
-        else:
-            arrs = [v] if hasattr(v, "nbytes") else []
-        total += sum(int(a.nbytes) for a in arrs)
-    return total
+    def arr_bytes(v) -> int:
+        if isinstance(v, tuple):  # array-column plates nest one level
+            return sum(arr_bytes(x) for x in v)
+        return int(v.nbytes) if hasattr(v, "nbytes") else 0
+
+    return sum(arr_bytes(v) for v in dt_cols.values())

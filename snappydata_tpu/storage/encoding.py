@@ -147,10 +147,10 @@ def encode_column(values: np.ndarray, dtype: T.DataType,
         packed_validity = bitmask.pack(validity)
     else:
         validity = None
-    if dtype.name == "string":
-        # no min/max for strings: string predicates run through dictionary
-        # LUTs, never stats-based batch skipping — computing object-array
-        # min/max was pure ingest overhead
+    if dtype.name in ("string", "array", "map", "struct"):
+        # no min/max for strings (predicates run through dictionary LUTs)
+        # or complex values (dicts aren't even orderable) — stats-based
+        # batch skipping never applies to them
         nulls = int((~validity).sum()) if validity is not None else 0
         stats = ColumnStats(None, None, nulls, n)
     else:

@@ -209,6 +209,13 @@ def _dtype_to_json(dt: T.DataType) -> dict:
     if isinstance(dt, T.DecimalType):
         out["precision"] = dt.precision
         out["scale"] = dt.scale
+    elif isinstance(dt, T.ArrayType):
+        out["element"] = _dtype_to_json(dt.element)
+    elif isinstance(dt, T.MapType):
+        out["key"] = _dtype_to_json(dt.key)
+        out["value"] = _dtype_to_json(dt.value)
+    elif isinstance(dt, T.StructType):
+        out["fields"] = [[n, _dtype_to_json(t)] for n, t in dt.fields]
     return out
 
 
@@ -216,6 +223,20 @@ def _dtype_from_json(d: dict) -> T.DataType:
     if d["name"] == "decimal":
         return T.DecimalType("decimal", d.get("precision", 38),
                              d.get("scale", 2))
+    if d["name"] == "array":
+        # legacy records (pre element-type persistence) default to STRING:
+        # a non-numeric element keeps the column on the always-correct
+        # host path instead of guessing it onto the numeric device build
+        return T.ArrayType("array", _dtype_from_json(
+            d.get("element", {"name": "string"})))
+    if d["name"] == "map":
+        return T.MapType("map",
+                         _dtype_from_json(d.get("key", {"name": "string"})),
+                         _dtype_from_json(d.get("value",
+                                                {"name": "double"})))
+    if d["name"] == "struct":
+        return T.StructType("struct", tuple(
+            (n, _dtype_from_json(t)) for n, t in d.get("fields", [])))
     return T.parse_type(d["name"])
 
 

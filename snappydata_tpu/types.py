@@ -25,7 +25,7 @@ class DataType:
 
     @property
     def np_dtype(self) -> np.dtype:
-        if self.name in ("array", "map"):
+        if self.name in ("array", "map", "struct"):
             return np.dtype(object)
         return _NP[self.name]
 
@@ -62,6 +62,26 @@ class MapType(DataType):
 
     def __str__(self):
         return f"map<{self.key},{self.value}>"
+
+
+@dataclasses.dataclass(frozen=True)
+class StructType(DataType):
+    """STRUCT<name: type, ...>: python dicts keyed by field name (host
+    values); field access via element_at(col, 'name') / named_struct
+    literals (ref: SerializedRow complex values,
+    encoders/.../catalyst/util/SerializedRow.scala)."""
+
+    fields: tuple = ()   # Tuple[Tuple[str, DataType], ...]
+
+    def __str__(self):
+        inner = ", ".join(f"{n}: {t}" for n, t in self.fields)
+        return f"struct<{inner}>"
+
+    def field_type(self, name: str) -> Optional["DataType"]:
+        for n, t in self.fields:
+            if n.lower() == name.lower():
+                return t
+        return None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -116,11 +136,14 @@ _BY_NAME = {
 
 def parse_type(name: str, args: Optional[list] = None,
                element: Optional[DataType] = None,
-               key: Optional[DataType] = None) -> DataType:
+               key: Optional[DataType] = None,
+               fields: Optional[list] = None) -> DataType:
     if name.lower() == "array":
         return ArrayType("array", element or DOUBLE)
     if name.lower() == "map":
         return MapType("map", key or STRING, element or DOUBLE)
+    if name.lower() == "struct":
+        return StructType("struct", tuple(fields or ()))
     base = _BY_NAME.get(name.lower())
     if base is None:
         raise ValueError(f"unknown data type: {name}")

@@ -98,3 +98,103 @@ def test_array_persistence(tmp_path):
     s2 = SnappySession(data_dir=str(tmp_path))
     rows = s2.sql("SELECT id, v FROM t ORDER BY id").rows()
     assert rows == [(1, [1, 2]), (2, None), (3, [9])]
+
+
+# --------------------------------------------------------------------------
+# STRUCT type (ref: SerializedRow/ComplexTypeSerializer)
+# --------------------------------------------------------------------------
+
+def test_struct_ddl_insert_select(tmp_path):
+    from snappydata_tpu import SnappySession
+
+    s = SnappySession(data_dir=str(tmp_path / "st"))
+    s.sql("CREATE TABLE pts (id INT, p STRUCT<x: DOUBLE, y: DOUBLE, "
+          "label: STRING>) USING column")
+    s.sql("INSERT INTO pts VALUES "
+          "(1, named_struct('x', 1.5, 'y', 2.5, 'label', 'a')), "
+          "(2, named_struct('x', 3.0, 'y', 4.0, 'label', 'b'))")
+    rows = s.sql("SELECT id, p FROM pts ORDER BY id").rows()
+    assert rows[0][1] == {"x": 1.5, "y": 2.5, "label": "a"}
+    # field access via element_at, typed from the struct schema
+    r = s.sql("SELECT id, element_at(p, 'x') + element_at(p, 'y') AS m "
+              "FROM pts ORDER BY id").rows()
+    assert r == [(1, 4.0), (2, 7.0)]
+    # filters over struct fields
+    r = s.sql("SELECT id FROM pts WHERE element_at(p, 'label') = 'b'"
+              ).rows()
+    assert r == [(2,)]
+    # durability: checkpoint + recover preserves structs and their schema
+    s.checkpoint()
+    s.disk_store.close()
+    s2 = SnappySession(data_dir=str(tmp_path / "st"))
+    info = s2.catalog.describe("pts")
+    assert info.schema.fields[1].dtype.name == "struct"
+    assert info.schema.fields[1].dtype.field_type("label").name == "string"
+    rows = s2.sql("SELECT id, element_at(p, 'label') FROM pts "
+                  "ORDER BY id").rows()
+    assert rows == [(1, "a"), (2, "b")]
+    s2.disk_store.close()
+
+
+# --------------------------------------------------------------------------
+# device lowering of size/element_at/array_contains on numeric arrays
+# --------------------------------------------------------------------------
+
+def test_array_ops_on_device_no_fallback(session):
+    from snappydata_tpu.observability.metrics import global_registry
+
+    session.sql("CREATE TABLE av (id BIGINT, xs ARRAY<INT>) USING column")
+    n = 20_000
+    ids = np.arange(n, dtype=np.int64)
+    xs = np.empty(n, dtype=object)
+    for i in range(n):
+        xs[i] = [int(i % 7), int(i % 3), int(i % 5)][: (i % 3) + 1]
+    session.insert_arrays("av", [ids, xs])
+    before = global_registry().snapshot()["counters"].get(
+        "host_fallbacks", 0)
+    r1 = session.sql("SELECT count(*) FROM av WHERE size(xs) = 2"
+                     ).rows()[0][0]
+    r2 = session.sql("SELECT sum(element_at(xs, 1)) FROM av").rows()[0][0]
+    r3 = session.sql("SELECT count(*) FROM av WHERE array_contains(xs, 4)"
+                     ).rows()[0][0]
+    after = global_registry().snapshot()["counters"].get(
+        "host_fallbacks", 0)
+    assert after == before, "array ops fell back to host"
+    exp1 = sum(1 for v in xs if len(v) == 2)
+    exp2 = sum(v[0] for v in xs)
+    exp3 = sum(1 for v in xs if 4 in v)
+    assert r1 == exp1 and r2 == exp2 and r3 == exp3
+
+
+def test_array_ops_device_null_semantics(session):
+    session.sql("CREATE TABLE avn (id INT, xs ARRAY<DOUBLE>) USING column")
+    xs = np.empty(4, dtype=object)
+    xs[0] = [1.0, None, 3.0]
+    xs[1] = [4.0]
+    xs[2] = None
+    xs[3] = []
+    session.catalog.describe("avn").data.insert_arrays(
+        [np.arange(4, dtype=np.int32), xs],
+        nulls=[None, np.array([False, False, True, False])])
+    rows = session.sql(
+        "SELECT id, size(xs), element_at(xs, 2), "
+        "array_contains(xs, 3.0) FROM avn ORDER BY id").rows()
+    assert rows[0][1] == 3 and rows[0][2] is None and rows[0][3] is True
+    assert rows[1][1] == 1 and rows[1][2] is None and rows[1][3] is False
+    assert rows[2][2] is None
+    assert rows[3][1] == 0 and rows[3][2] is None and rows[3][3] is False
+
+
+def test_struct_bulk_insert_large(session):
+    """Regression: batch stats tried to order dict values on bulk inserts
+    (>1024 rows took the pandas min/max path and crashed)."""
+    session.sql("CREATE TABLE stl (id BIGINT, m STRUCT<a: INT>) "
+                "USING column")
+    n = 20_000
+    ms = np.empty(n, dtype=object)
+    for i in range(n):
+        ms[i] = {"a": i % 10}
+    session.insert_arrays("stl", [np.arange(n, dtype=np.int64), ms])
+    r = session.sql("SELECT count(*), sum(element_at(m, 'a')) FROM stl"
+                    ).rows()[0]
+    assert r[0] == n and r[1] == sum(i % 10 for i in range(n))
