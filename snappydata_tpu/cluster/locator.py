@@ -28,6 +28,12 @@ from snappydata_tpu import config
 
 PRIMARY_LEAD_LOCK = "__PRIMARY_LEADER_LS"
 
+# bumped whenever the member-to-member wire contract changes (Flight
+# request bodies, repartition/promote actions, WAL record format); the
+# locator refuses registration from a member on a different generation
+# (ref: SnappyDataVersion handshake)
+PROTOCOL_VERSION = 2
+
 
 @dataclasses.dataclass
 class MemberInfo:
@@ -124,12 +130,23 @@ def _dispatch(state: _State, req: dict) -> dict:
     op = req.get("op")
     now = time.time()
     if op == "register":
+        # version handshake (ref: SnappyDataVersion feature gating,
+        # cluster/.../gemxd/SnappyDataVersion.scala): a member speaking a
+        # different PROTOCOL generation is refused with a clear message
+        # instead of failing later with undecodable exchanges
+        peer = req.get("protocol", 0)
+        if peer != PROTOCOL_VERSION:
+            return {"ok": False,
+                    "error": f"protocol version mismatch: member speaks "
+                             f"{peer}, cluster speaks {PROTOCOL_VERSION}; "
+                             f"upgrade/downgrade the member"}
         with state.lock:
             info = MemberInfo(req["member_id"], req["role"], req["host"],
                               req.get("port", 0), now)
             state.members[req["member_id"]] = info
             state.view_version += 1
-            return {"ok": True, "view": state.view_version}
+            return {"ok": True, "view": state.view_version,
+                    "protocol": PROTOCOL_VERSION}
     if op == "heartbeat":
         with state.lock:
             m = state.members.get(req["member_id"])
@@ -202,7 +219,11 @@ class LocatorClient:
     def register(self) -> dict:
         resp = self._request({"op": "register", "member_id": self.member_id,
                               "role": self.role, "host": self.host,
-                              "port": self.port})
+                              "port": self.port,
+                              "protocol": PROTOCOL_VERSION})
+        if not resp.get("ok", True) and "protocol" in str(
+                resp.get("error", "")):
+            raise RuntimeError(resp["error"])
         self.last_view = resp.get("view", -1)
         return resp
 
@@ -215,9 +236,23 @@ class LocatorClient:
                     if resp.get("rejoin"):
                         self.register()
                     self.last_view = resp.get("view", self.last_view)
+                except RuntimeError as e:
+                    # protocol mismatch after a locator upgrade: say so
+                    # loudly and stop — silent sweep-out helps nobody
+                    import sys
+
+                    print(f"member {self.member_id}: {e}; stopping "
+                          f"heartbeats", file=sys.stderr)
+                    return
                 except (ConnectionError, OSError):
                     try:
                         self.register()
+                    except RuntimeError as e:
+                        import sys
+
+                        print(f"member {self.member_id}: {e}; stopping "
+                              f"heartbeats", file=sys.stderr)
+                        return
                     except (ConnectionError, OSError):
                         pass
 

@@ -86,6 +86,11 @@ def _render_dashboard(svc) -> str:
     counters = "".join(
         f"<tr><td>{esc(str(k))}</td><td>{v}</td></tr>"
         for k, v in sorted(snap["counters"].items()))
+    recent = list(reversed(svc.session.recent_queries()))[:25]
+    rows_q = "".join(
+        f"<tr><td>{esc(str(q['sql']))[:120]}</td><td>{q['ms']}</td>"
+        f"<td>{q['rows']}</td><td>{esc(str(q.get('user', '')))}</td></tr>"
+        for q in recent)
     return f"""<!doctype html><html><head><title>snappydata_tpu</title>
 <style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:
 collapse;margin:1em 0}}td,th{{border:1px solid #ccc;padding:4px 10px;
@@ -97,6 +102,10 @@ text-align:left}}h2{{margin-top:1.5em}}</style></head><body>
 <table><tr><th>table</th><th>provider</th><th>rows</th><th>batches</th>
 <th>bytes</th></tr>{rows_t}</table>
 <h2>Counters</h2><table>{counters}</table>
+<h2>Recent queries ({len(recent)})</h2>
+<table><tr><th>sql</th><th>ms</th><th>rows</th><th>user</th></tr>{rows_q}
+</table>
+<p>Plans: GET /status/api/v1/queries/plan?id=N</p>
 </body></html>"""
 
 
@@ -143,12 +152,46 @@ class RestService:
                                 "tables": svc.stats_service.current()})
                 elif path == "/status/api/v1/tables":
                     self._send(svc.stats_service.current())
+                elif path == "/status/api/v1/queries":
+                    # query text leaks literals: same auth as /jobs
+                    if self._principal_session() is None:
+                        return
+                    self._send(svc.session.recent_queries())
+                elif path.startswith("/status/api/v1/queries/plan"):
+                    # live plan view: EXPLAIN of a logged query on demand,
+                    # under the REQUEST principal so table privileges apply
+                    sess = self._principal_session()
+                    if sess is None:
+                        return
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = parse_qs(urlparse(self.path).query)
+                    try:
+                        qid = int(q.get("id", q.get("idx", ["-1"]))[0])
+                    except (TypeError, ValueError):
+                        self._send({"error": "id must be an integer"}, 400)
+                        return
+                    entry = next((e for e in svc.session.recent_queries()
+                                  if e["id"] == qid), None)
+                    if entry is None:
+                        self._send({"error": "no such query"}, 404)
+                        return
+                    try:
+                        plan = sess.sql("EXPLAIN " + entry["sql"])
+                        self._send({"sql": entry["sql"],
+                                    "plan": [r[0] for r in plan.rows()]})
+                    except Exception as e:  # noqa: BLE001
+                        self._send({"error": str(e)}, 500)
                 elif path == "/metrics/json":
                     self._send(global_registry().snapshot())
                 elif path == "/metrics/prometheus":
                     self._send(global_registry().to_prometheus().encode(),
                                content_type="text/plain")
                 elif path in ("", "/dashboard"):
+                    # shows recent query text → token-gated when auth on
+                    if svc.auth_tokens and \
+                            self._principal_session() is None:
+                        return
                     self._send(_render_dashboard(svc).encode(),
                                content_type="text/html")
                 elif path.startswith("/jobs/"):

@@ -113,11 +113,22 @@ class _RelationInput:
 def _row_table_device(info, used):
     """Row tables present the same [1, N] stacked-array interface. Under a
     mesh they are fully replicated — the reference's replicated row tables
-    whose joins never shuffle (HashJoinExec.replicatedTableJoin)."""
+    whose joins never shuffle (HashJoinExec.replicatedTableJoin).
+
+    The built DeviceTable is cached per (mutation version, mesh, columns):
+    rebuilding the string-code lookup of the whole table on EVERY bind was
+    O(table) host work per query (round-1 weak finding)."""
     from snappydata_tpu.storage.device import DeviceTable
     from snappydata_tpu.parallel.mesh import MeshContext
 
     ctx = MeshContext.current()
+    cache = getattr(info.data, "_device_cache", None)
+    if cache is None:
+        cache = info.data._device_cache = {}
+    key = (info.data.version, ctx.token if ctx else None, tuple(used))
+    hit = cache.get(key)
+    if hit is not None:
+        return hit
 
     def _place(host_array):
         if ctx is None:
@@ -150,8 +161,20 @@ def _row_table_device(info, used):
         nulls[ci] = _place(nmask) if nmask is not None else None
     valid = np.zeros((1, cap), dtype=np.bool_)
     valid[0, :n] = True
-    return DeviceTable(info.schema, 1, cap, _place(valid), cols, dicts,
-                       {}, {}, n, nulls)
+    dt = DeviceTable(info.schema, 1, cap, _place(valid), cols, dicts,
+                     {}, {}, n, nulls)
+    from snappydata_tpu.storage.device import _cache_budget
+
+    for k in [k for k in cache if k[0] != key[0]]:
+        cache.pop(k, None)   # old-version entries are dead
+        _cache_budget.forget(cache, k)
+    cache[key] = dt
+    if _cache_budget.enabled():
+        nbytes = int(dt.valid.nbytes) + sum(
+            int(c.nbytes) for c in dt.columns.values()) + sum(
+            int(nl.nbytes) for nl in dt.nulls.values() if nl is not None)
+        _cache_budget.touch(cache, key, nbytes)
+    return dt
 
 
 class CompiledPlan:
@@ -262,6 +285,14 @@ class CompiledPlan:
 
 def data_needs_mask(v, mask) -> bool:
     return int(np.prod(np.shape(v))) == mask.shape[0]
+
+
+def _row_count_of(info) -> int:
+    from snappydata_tpu.storage.table_store import RowTableData
+
+    if isinstance(info.data, RowTableData):
+        return info.data.count()
+    return info.data.snapshot().total_rows()
 
 
 _uniq_cache: Dict[Tuple[int, int, Tuple[int, ...]], tuple] = {}
@@ -1946,9 +1977,51 @@ class Executor:
         """CodegenSparkFallback analogue (core/.../execution/
         CodegenSparkFallback.scala:33): when device lowering can't handle a
         construct, evaluate on host via numpy."""
+        self._warn_large_host_fallback(node)
         if isinstance(node, ast.WindowProject):
             return hosteval.eval_window(node, params, self)
         return hosteval.eval_plan(node, params, self)
+
+    def _warn_large_host_fallback(self, node: ast.Plan) -> None:
+        """Host-path perf cliffs must not be SILENT (round-1 weak finding):
+        when a fallback touches a big table, say so once per plan shape so
+        operators can see why a query takes minutes."""
+        threshold = int(self.props.get("host_fallback_warn_rows",
+                                       1_000_000) or 0)
+        if threshold <= 0:
+            return
+        # dedup BEFORE the O(rows) count — the count itself must not tax
+        # every execution of the already-slow path it warns about
+        key = _plan_key(node, self.catalog)
+        seen = getattr(self, "_fallback_warned", None)
+        if seen is None:
+            seen = self._fallback_warned = set()
+        if key in seen:
+            return
+        total = 0
+
+        def rec(p):
+            nonlocal total
+            if isinstance(p, ast.Relation):
+                info = self.catalog.lookup_table(p.name)
+                if info is not None:
+                    try:
+                        total += _row_count_of(info)
+                    except Exception:
+                        pass
+            for k in p.children():
+                rec(k)
+
+        rec(node)
+        if total < threshold:
+            return
+        seen.add(key)
+        import sys
+
+        print(f"warning: query over ~{total:,} rows is running on the "
+              f"HOST path (single-threaded) — a construct in it has no "
+              f"device lowering yet; see the host_fallbacks metric",
+              file=sys.stderr)
 
     # -- host post-ops ----------------------------------------------------
 

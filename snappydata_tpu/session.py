@@ -58,6 +58,25 @@ class SnappySession:
         self.analyzer = Analyzer(catalog)
         self.executor = Executor(catalog, self.conf)
 
+    def _log_query(self, sql_text: str, ms: float, rows: int) -> None:
+        import collections
+        import time as _time
+
+        log = getattr(self.catalog, "_query_log", None)
+        if log is None:
+            log = self.catalog._query_log = collections.deque(maxlen=200)
+            self.catalog._query_seq = 0
+        self.catalog._query_seq += 1
+        # stable id, NOT the deque position: a full ring shifts positions
+        log.append({"id": self.catalog._query_seq, "sql": sql_text,
+                    "ms": round(ms, 2), "rows": rows,
+                    "ts": _time.time(), "user": self.user})
+
+    def recent_queries(self) -> List[dict]:
+        """Ring buffer of recent queries (sql, ms, rows, ts, user) shared
+        by every session of this catalog — the dashboard's SQL tab."""
+        return list(getattr(self.catalog, "_query_log", ()))
+
     def for_user(self, user: str, remote: bool = True,
                  authenticated: bool = False) -> "SnappySession":
         """A session for `user` sharing this session's catalog, conf and
@@ -89,6 +108,16 @@ class SnappySession:
 
     def sql(self, sql_text: str, params: Sequence[Any] = ()) -> Result:
         stmt = parse(sql_text)
+        if isinstance(stmt, ast.Query):
+            # live query log feeding the dashboard / REST plan UI (ref:
+            # SnappySQLListener capturing plan info for the SQL tab)
+            import time as _time
+
+            t0 = _time.time()
+            result = self.execute_statement(stmt, tuple(params))
+            self._log_query(sql_text, (_time.time() - t0) * 1000.0,
+                            result.num_rows)
+            return result
         ds = self.disk_store
         if ds is not None and isinstance(
                 stmt, (ast.InsertInto, ast.UpdateStmt, ast.DeleteStmt,

@@ -183,3 +183,40 @@ def test_flight_schema_without_execution_and_paging(cluster):
     total = sum(len(b.data) for b in batches)
     assert total == 200_000
     client.close()
+
+
+def test_query_log_and_plan_ui(cluster):
+    """Live query log + on-demand plan view (ref: SnappySQLListener SQL
+    tab) and member version handshake."""
+    locator, lead, server, catalog = cluster
+    lead.session.sql("CREATE TABLE ql (a INT) USING column")
+    lead.session.sql("INSERT INTO ql VALUES (1), (2), (3)")
+    lead.session.sql("SELECT count(*) FROM ql")
+    base = f"http://{lead.rest_address}"
+    qs = json.loads(urllib.request.urlopen(
+        base + "/status/api/v1/queries").read())
+    assert any("count(*)" in q["sql"] for q in qs)
+    qid = max(q["id"] for q in qs if "count(*)" in q["sql"])
+    plan = json.loads(urllib.request.urlopen(
+        base + f"/status/api/v1/queries/plan?id={qid}").read())
+    assert any("Aggregate" in line or "Relation" in line
+               for line in plan["plan"])
+    # dashboard renders the recent-query table
+    html = urllib.request.urlopen(base + "/dashboard").read().decode()
+    assert "Recent queries" in html
+
+    # protocol handshake: a member speaking another generation is refused
+    from snappydata_tpu.cluster.locator import PROTOCOL_VERSION
+
+    bad = LocatorClient(locator.address, "bad-member", "server", port=9)
+    try:
+        resp = bad._request({
+            "op": "register", "member_id": "bad-member", "role": "server",
+            "host": "127.0.0.1", "port": 9,
+            "protocol": PROTOCOL_VERSION + 1})
+        assert resp.get("ok") is False
+        assert "protocol version mismatch" in resp.get("error", "")
+        assert "bad-member" not in {
+            m.member_id for m in bad.members()}
+    finally:
+        bad.close()
