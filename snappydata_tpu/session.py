@@ -58,6 +58,55 @@ class SnappySession:
         self.analyzer = Analyzer(catalog)
         self.executor = Executor(catalog, self.conf)
 
+
+    def _rewrite_stream_windows(self, plan: ast.Plan) -> ast.Plan:
+        """FROM t WINDOW (DURATION d [, SLIDE s]) → arrival-time filter
+        over the stream table's hidden __arrival_ts column, evaluated at
+        EXECUTION time. The cutoff is a plain literal, so tokenization
+        turns it into a rebindable param — the cached compiled plan serves
+        every window evaluation (ref: WindowLogicalPlan/SchemaDStream)."""
+        import dataclasses as _dc
+        import time as _time
+
+        def rec(p: ast.Plan) -> ast.Plan:
+            if isinstance(p, ast.WindowedRelation):
+                inner = p.child
+                nm = inner.name if isinstance(inner,
+                                              ast.UnresolvedRelation) else None
+                info = self.catalog.lookup_table(nm) if nm else None
+                if info is None or all(f.name != "__arrival_ts"
+                                       for f in info.schema.fields):
+                    raise AnalysisError(
+                        "WINDOW (DURATION ...) applies only to STREAM "
+                        "tables")
+                start = _time.time() - p.duration_s
+                if p.slide_s:
+                    start = int(start / p.slide_s) * p.slide_s
+                cond = ast.BinOp(
+                    ">=",
+                    ast.Col("__arrival_ts",
+                            inner.alias or nm.split(".")[-1]),
+                    ast.Lit(int(start * 1e6), T.TIMESTAMP))
+                return ast.Filter(inner, cond)
+            kids = p.children()
+            if not kids:
+                return p
+            if isinstance(p, (ast.Join, ast.Union)):
+                p = _dc.replace(p, left=rec(p.left), right=rec(p.right))
+            else:
+                p = _dc.replace(p, child=rec(kids[0]))
+            return p
+
+        def sub_fn(e: ast.Expr) -> ast.Expr:
+            # windows inside subquery expressions (EXISTS/IN/scalar) must
+            # rewrite BEFORE decorrelation splices their plans into joins
+            if isinstance(e, (ast.ScalarSubquery, ast.InSubquery,
+                              ast.ExistsSubquery)):
+                return _dc.replace(e, plan=rec(e.plan))
+            return e
+
+        return ast.transform_plan_exprs(rec(plan), sub_fn)
+
     def _log_query(self, sql_text: str, ms: float, rows: int) -> None:
         import collections
         import time as _time
@@ -294,12 +343,13 @@ class SnappySession:
                 [None, None, None], [T.STRING, T.STRING, T.LONG])
         if isinstance(stmt, ast.DescribeTable):
             info = self.catalog.describe(stmt.name)
+            fields = [f for f in info.schema.fields
+                      if not f.name.startswith("__")]  # internal cols
             return Result(
                 ["col_name", "data_type", "nullable"],
-                [np.array(info.schema.names(), dtype=object),
-                 np.array([str(f.dtype) for f in info.schema.fields],
-                          dtype=object),
-                 np.array([f.nullable for f in info.schema.fields])],
+                [np.array([f.name for f in fields], dtype=object),
+                 np.array([str(f.dtype) for f in fields], dtype=object),
+                 np.array([f.nullable for f in fields])],
                 [None, None, None], [T.STRING, T.STRING, T.BOOLEAN])
         if isinstance(stmt, ast.SetConf):
             self.conf.set(stmt.key, stmt.value)
@@ -374,6 +424,7 @@ class SnappySession:
         from snappydata_tpu.sql.optimizer import optimize
         from snappydata_tpu.sql.analyzer import _expr_name
 
+        plan = self._rewrite_stream_windows(plan)
         plan = self._decorrelate(plan)
         optimized = optimize(plan, self.catalog)
         resolved, _ = self.analyzer.analyze_plan(optimized)
@@ -456,6 +507,7 @@ class SnappySession:
     def _run_query(self, plan: ast.Plan, user_params=()) -> Result:
         if getattr(self.catalog, "_sample_maintainers", None):
             self._refresh_samples()
+        plan = self._rewrite_stream_windows(plan)
         plan = self._decorrelate(plan)
         plan = self._rewrite_subqueries(plan, user_params)
         from snappydata_tpu.sql.optimizer import optimize
@@ -495,7 +547,8 @@ class SnappySession:
         stmt = parse(sql_text)
         if not isinstance(stmt, ast.Query):
             return T.Schema([T.Field("status", T.STRING)])
-        plan = self._decorrelate(stmt.plan)
+        plan = self._rewrite_stream_windows(stmt.plan)
+        plan = self._decorrelate(plan)
 
         def sub_placeholder(e: ast.Expr) -> ast.Expr:
             # type-only placeholders: subqueries must not EXECUTE here
@@ -659,6 +712,16 @@ class SnappySession:
         return _status()
 
     def _create_table(self, stmt: ast.CreateTable) -> Result:
+        if not stmt.name.split(".")[-1].startswith("__"):
+            # '__' column names are RESERVED for internal columns (hidden
+            # from SELECT */DESCRIBE, auto-filled on INSERT) — a user
+            # column there would silently disappear. Internal scratch
+            # tables (themselves '__'-named) may use them freely.
+            for c in stmt.columns:
+                if c.name.startswith("__"):
+                    raise ValueError(
+                        f"column names starting with '__' are reserved "
+                        f"({c.name!r})")
         if stmt.provider == "sample":
             return self._create_sample_table(stmt)
         if stmt.stream:
@@ -1108,8 +1171,12 @@ class SnappySession:
         from snappydata_tpu.streaming.query import StreamingQuery
 
         opts = {k.lower(): str(v) for k, v in stmt.options.items()}
+        # hidden arrival-time column powers DStream-style WINDOW queries
+        # (ref: WindowLogicalPlan); '__'-prefixed fields are invisible to
+        # SELECT * / DESCRIBE and auto-stamped on INSERT
         schema = T.Schema([T.Field(c.name, c.dtype, c.nullable)
-                           for c in stmt.columns])
+                           for c in stmt.columns]
+                          + [T.Field("__arrival_ts", T.TIMESTAMP, False)])
         keys = tuple(c.name for c in stmt.columns if c.primary_key)
         provider = stmt.provider if stmt.provider in ("file_stream",
                                                       "memory_stream") \
@@ -1140,7 +1207,7 @@ class SnappySession:
         query = StreamingQuery(
             self, f"stream_{tname}", source, stmt.name,
             conflation=opts.get("conflation", "false").lower() == "true",
-            interval_s=interval)
+            interval_s=interval, stamp_arrivals=True)
         self.catalog._streams[tname] = query
         query.start()
         return _status()
@@ -1278,17 +1345,29 @@ class SnappySession:
             if len(stmt.columns) != len(src.columns):
                 raise ValueError("INSERT column count mismatch")
         else:
-            if len(src.columns) != len(target_schema):
+            visible = [f for f in target_schema.fields
+                       if not f.name.startswith("__")]
+            if len(src.columns) not in (len(target_schema), len(visible)):
                 raise ValueError(
                     f"INSERT arity mismatch: {len(src.columns)} vs "
-                    f"{len(target_schema)}")
-            name_to_src = {f.name.lower(): i
-                           for i, f in enumerate(target_schema.fields)}
+                    f"{len(visible)}")
+            # internal columns (e.g. a stream table's __arrival_ts) are
+            # invisible to plain INSERTs and auto-stamped below
+            base = target_schema.fields \
+                if len(src.columns) == len(target_schema) else visible
+            name_to_src = {f.name.lower(): i for i, f in enumerate(base)}
         arrays = []
         null_masks = []
         n = src.num_rows
+        import time as _time
+
+        now_us = int(_time.time() * 1e6)
         for f in target_schema.fields:
             i = name_to_src.get(f.name.lower())
+            if i is None and f.name == "__arrival_ts":
+                arrays.append(np.full(n, now_us, dtype=np.int64))
+                null_masks.append(np.zeros(n, dtype=np.bool_))
+                continue
             if i is None:  # unmentioned column → all NULL
                 arrays.append(np.zeros(n, dtype=f.dtype.np_dtype)
                               if f.dtype.name != "string"

@@ -435,6 +435,8 @@ class Analyzer:
             if isinstance(e, ast.Star):
                 qual = e.qualifier.lower() if e.qualifier else None
                 for i, entry in enumerate(scope.entries):
+                    if entry.name.startswith("__"):
+                        continue  # internal columns (e.g. __arrival_ts)
                     if qual is None or (entry.qualifier or "").lower() == qual:
                         out.append(ast.Col(entry.name, entry.qualifier, i,
                                            entry.dtype))

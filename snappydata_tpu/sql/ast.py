@@ -343,6 +343,21 @@ class Aggregate(Plan):
 
 
 @dataclasses.dataclass(frozen=True)
+class WindowedRelation(Plan):
+    """FROM stream_table WINDOW (DURATION n SECONDS [, SLIDE m SECONDS])
+    — the DStream-style sliding window over a stream table (ref:
+    WindowLogicalPlan, core/.../sql/streaming). Rewritten per execution
+    into an arrival-time filter."""
+
+    child: Plan
+    duration_s: float = 0.0
+    slide_s: Optional[float] = None
+
+    def children(self):
+        return (self.child,)
+
+
+@dataclasses.dataclass(frozen=True)
 class Join(Plan):
     left: Plan
     right: Plan

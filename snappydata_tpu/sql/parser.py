@@ -343,8 +343,49 @@ class Parser:
                 raise SQLSyntaxError("subquery in FROM requires an alias")
             return ast.SubqueryAlias(sub, alias)
         name = self.qualified_name()
-        alias = self._table_alias()
-        return ast.UnresolvedRelation(name, alias)
+        alias = None if self._at_window_clause() else self._table_alias()
+        rel: ast.Plan = ast.UnresolvedRelation(name, alias)
+        if self._at_window_clause():
+            self.next()           # WINDOW
+            self.expect_op("(")
+            self._expect_ident("duration")
+            dur = self._window_span()
+            slide = None
+            if self.accept_op(","):
+                self._expect_ident("slide")
+                slide = self._window_span()
+            self.expect_op(")")
+            rel = ast.WindowedRelation(rel, dur, slide)
+        return rel
+
+    def _at_window_clause(self) -> bool:
+        t = self.peek()
+        if not (t.kind == "IDENT" and t.value.lower() == "window"):
+            return False
+        nxt = self.peek(1)
+        return nxt.kind == "OP" and nxt.value == "("
+
+    def _expect_ident(self, word: str) -> None:
+        t = self.next()
+        if not (t.kind in ("IDENT", "KW") and t.value.lower() == word):
+            raise SQLSyntaxError(f"expected {word.upper()}, got {t.value!r}")
+
+    def _window_span(self) -> float:
+        t = self.next()
+        if t.kind == "NUM":
+            val = float(t.value)
+        elif t.kind == "STR":
+            val = float(t.value)
+        else:
+            raise SQLSyntaxError(f"expected a number, got {t.value!r}")
+        unit = self.next()
+        u = unit.value.lower().rstrip("s") if unit.kind in ("IDENT", "KW")             else ""
+        scale = {"second": 1.0, "minute": 60.0, "hour": 3600.0,
+                 "millisecond": 0.001}.get(u)
+        if scale is None:
+            raise SQLSyntaxError(
+                f"expected SECONDS/MINUTES/HOURS, got {unit.value!r}")
+        return val * scale
 
     def _table_alias(self) -> Optional[str]:
         if self.accept_kw("as"):

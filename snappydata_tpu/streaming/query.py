@@ -97,13 +97,15 @@ class StreamingQuery:
 
     def __init__(self, session, name: str, source: Source, table: str,
                  transform: Optional[Callable] = None,
-                 conflation: bool = False, interval_s: float = 0.05):
+                 conflation: bool = False, interval_s: float = 0.05,
+                 stamp_arrivals: bool = False):
         self.session = session
         self.name = name
         self.source = source
         self.sink = SnappySink(session, name, table, conflation=conflation)
         self.transform = transform
         self.interval_s = interval_s
+        self.stamp_arrivals = stamp_arrivals
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.batches_processed = 0
@@ -132,6 +134,7 @@ class StreamingQuery:
             if _batch_empty(columns):
                 offset = new_offset  # nothing to apply; just advance
                 continue
+            columns = self._stamp(columns)
             try:
                 self.sink.process_batch(offset, columns)
                 self.batches_processed += 1
@@ -139,6 +142,16 @@ class StreamingQuery:
             except Exception as e:
                 self.last_error = e
                 time.sleep(self.interval_s)
+
+    def _stamp(self, columns):
+        """Arrival timestamps for WINDOW (DURATION ...) queries."""
+        if not self.stamp_arrivals or not columns:
+            return columns
+        n = len(np.asarray(next(iter(columns.values()))))
+        out = dict(columns)
+        out["__arrival_ts"] = np.full(n, int(time.time() * 1e6),
+                                      dtype=np.int64)
+        return out
 
     def process_available(self) -> int:
         """Synchronous drain (tests / backfills): consume until the source
@@ -152,6 +165,7 @@ class StreamingQuery:
             columns, new_offset = got
             if self.transform is not None:
                 columns = self.transform(columns)
+            columns = self._stamp(columns)
             if not _batch_empty(columns) and \
                     self.sink.process_batch(offset, columns):
                 applied += 1

@@ -130,3 +130,52 @@ def test_drop_stream_table_stops_query(s):
     assert not q.is_active
     with pytest.raises(ValueError):
         s.stream_source("st")
+
+
+def test_windowed_sql_over_stream(session):
+    """DStream-style sliding window (ref: WindowLogicalPlan): WINDOW
+    (DURATION n SECONDS) restricts the query to recently-arrived rows."""
+    import time
+
+    session.sql("CREATE STREAM TABLE ws (k INT PRIMARY KEY, v DOUBLE) "
+                "USING memory_stream OPTIONS (interval '0.01')")
+    src = session.stream_source("ws")
+    q = session.catalog._streams["ws"]
+    src.add_batch({"k": np.array([1, 2], dtype=np.int32),
+                   "v": np.array([1.0, 2.0])})
+    q.process_available()
+    time.sleep(0.35)
+    src.add_batch({"k": np.array([3], dtype=np.int32),
+                   "v": np.array([30.0])})
+    q.process_available()
+
+    # full table sees everything; the window only the recent batch
+    assert session.sql("SELECT count(*) FROM ws").rows()[0][0] == 3
+    recent = session.sql(
+        "SELECT k, v FROM ws WINDOW (DURATION 0.3 SECONDS) ORDER BY k"
+    ).rows()
+    assert recent == [(3, 30.0)]
+    both = session.sql(
+        "SELECT count(*), sum(v) FROM ws WINDOW (DURATION 1 MINUTES)"
+    ).rows()[0]
+    assert both == (3, 33.0)
+    # aggregate over the window with slide quantization parses + runs
+    session.sql("SELECT k, count(*) FROM ws WINDOW (DURATION 10 SECONDS, "
+                "SLIDE 5 SECONDS) GROUP BY k")
+
+    # the hidden arrival column stays hidden
+    assert all(not n.startswith("__") for n in
+               session.sql("SELECT * FROM ws").names)
+    d = session.sql("DESCRIBE ws").rows()
+    assert all(not r[0].startswith("__") for r in d)
+    # plain INSERT works without mentioning the hidden column and is
+    # visible to windows immediately
+    session.sql("INSERT INTO ws VALUES (9, 90.0)")
+    r = session.sql("SELECT k FROM ws WINDOW (DURATION 0.5 SECONDS) "
+                    "ORDER BY k").rows()
+    assert (9,) in r
+
+    # WINDOW on a non-stream table errors clearly
+    session.sql("CREATE TABLE plain_t (a INT) USING column")
+    with pytest.raises(Exception, match="STREAM"):
+        session.sql("SELECT * FROM plain_t WINDOW (DURATION 5 SECONDS)")
