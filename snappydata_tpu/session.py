@@ -68,14 +68,33 @@ class SnappySession:
         import dataclasses as _dc
         import time as _time
 
+        def has_window(p) -> bool:
+            if isinstance(p, ast.WindowedRelation):
+                return True
+            for k in p.children():
+                if has_window(k):
+                    return True
+            for e in ast.plan_exprs(p):
+                for x in ast.walk(e):
+                    if isinstance(x, (ast.ScalarSubquery, ast.InSubquery,
+                                      ast.ExistsSubquery)) \
+                            and has_window(x.plan):
+                        return True
+            return False
+
+        if not has_window(plan):
+            return plan  # the common case allocates nothing
+
         def rec(p: ast.Plan) -> ast.Plan:
             if isinstance(p, ast.WindowedRelation):
                 inner = p.child
                 nm = inner.name if isinstance(inner,
                                               ast.UnresolvedRelation) else None
                 info = self.catalog.lookup_table(nm) if nm else None
-                if info is None or all(f.name != "__arrival_ts"
-                                       for f in info.schema.fields):
+                if info is None:
+                    raise AnalysisError(f"table or view not found: {nm}")
+                if all(f.name != "__arrival_ts"
+                       for f in info.schema.fields):
                     raise AnalysisError(
                         "WINDOW (DURATION ...) applies only to STREAM "
                         "tables")
@@ -317,6 +336,15 @@ class SnappySession:
             if _contains_subquery(stmt.query):
                 raise AnalysisError(
                     "subqueries in view definitions are not supported yet")
+            def _contains_window(p):
+                if isinstance(p, ast.WindowedRelation):
+                    return True
+                return any(_contains_window(k) for k in p.children())
+
+            if _contains_window(stmt.query):
+                raise AnalysisError(
+                    "WINDOW (DURATION ...) is not supported inside views "
+                    "yet — query the stream table with the window directly")
             self.analyzer.analyze_plan(stmt.query)  # validate now
             # store UNRESOLVED: views re-analyze per query, so policies
             # created or dropped later apply correctly (review finding:
@@ -731,6 +759,12 @@ class SnappySession:
                     self.catalog.lookup_table(stmt.name) is not None:
                 return _status()  # no-op, do NOT re-append (review finding)
             result = self._run_query(stmt.as_select)
+            if not stmt.name.split(".")[-1].startswith("__"):
+                for n in result.names:
+                    if n.startswith("__"):
+                        raise ValueError(
+                            f"column names starting with '__' are "
+                            f"reserved ({n!r}); alias the CTAS output")
             schema = T.Schema([
                 T.Field(n, dt) for n, dt in zip(result.names, result.dtypes)])
             info = self.catalog.create_table(stmt.name, schema, stmt.provider,

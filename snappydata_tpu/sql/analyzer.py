@@ -29,6 +29,7 @@ class ScopeEntry:
     name: str
     dtype: T.DataType
     nullable: bool = True
+    hidden: bool = False   # internal base-table column (e.g. __arrival_ts)
 
 
 class Scope:
@@ -235,7 +236,8 @@ class Analyzer:
             if info is None:
                 raise AnalysisError(f"table or view not found: {plan.name}")
             alias = plan.alias or plan.name.split(".")[-1]
-            scope = Scope([ScopeEntry(alias, f.name, f.dtype, f.nullable)
+            scope = Scope([ScopeEntry(alias, f.name, f.dtype, f.nullable,
+                                      hidden=f.name.startswith("__"))
                            for f in info.schema.fields])
             resolved: ast.Plan = ast.Relation(info.name, info.schema, alias)
             # row-level security: inject policy predicates AT RESOLUTION so
@@ -253,7 +255,8 @@ class Analyzer:
             # already-resolved scan (stored view bodies re-enter analysis);
             # resolution is idempotent
             alias = plan.alias or plan.name.split(".")[-1]
-            scope = Scope([ScopeEntry(alias, f.name, f.dtype, f.nullable)
+            scope = Scope([ScopeEntry(alias, f.name, f.dtype, f.nullable,
+                                      hidden=f.name.startswith("__"))
                            for f in plan.schema.fields])
             return plan, scope
 
@@ -435,8 +438,9 @@ class Analyzer:
             if isinstance(e, ast.Star):
                 qual = e.qualifier.lower() if e.qualifier else None
                 for i, entry in enumerate(scope.entries):
-                    if entry.name.startswith("__"):
-                        continue  # internal columns (e.g. __arrival_ts)
+                    if entry.hidden:
+                        continue  # internal BASE-TABLE columns only —
+                        # user '__' select aliases still expand
                     if qual is None or (entry.qualifier or "").lower() == qual:
                         out.append(ast.Col(entry.name, entry.qualifier, i,
                                            entry.dtype))
@@ -507,7 +511,8 @@ class Analyzer:
     def _scope_of(self, plan: ast.Plan) -> List[ScopeEntry]:
         if isinstance(plan, ast.Relation):
             alias = plan.alias or plan.name
-            return [ScopeEntry(alias, f.name, f.dtype, f.nullable)
+            return [ScopeEntry(alias, f.name, f.dtype, f.nullable,
+                               hidden=f.name.startswith("__"))
                     for f in plan.schema.fields]
         if isinstance(plan, ast.SubqueryAlias):
             return [dataclasses.replace(e, qualifier=plan.alias)

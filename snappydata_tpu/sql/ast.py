@@ -425,6 +425,23 @@ class WindowProject(Plan):
 # Statements (DDL/DML — executed by the session, not the query engine)
 # --------------------------------------------------------------------------
 
+def plan_exprs(p: Plan):
+    """Iterate the expressions directly embedded in one plan node."""
+    if isinstance(p, Filter):
+        yield p.condition
+    elif isinstance(p, (Project, WindowProject)):
+        yield from p.exprs
+    elif isinstance(p, Aggregate):
+        yield from p.group_exprs
+        yield from p.agg_exprs
+    elif isinstance(p, Join):
+        if p.condition is not None:
+            yield p.condition
+    elif isinstance(p, Sort):
+        for e, _asc in p.orders:
+            yield e
+
+
 def transform_plan_exprs(p: Plan, fn) -> Plan:
     """Rebuild a plan applying `fn` to every embedded expression
     (bottom-up within each expression)."""
