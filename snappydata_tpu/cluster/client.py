@@ -126,6 +126,23 @@ class SnappyClient:
             flight.Action("promote", raw)))
         return json.loads(results[0].body.to_pybytes().decode("utf-8"))
 
+    def replicate(self, body: dict) -> dict:
+        """Redundancy restoration: this server copies its CURRENT rows of
+        body['buckets'] (table body['table']) into body['target']'s
+        replica shadow."""
+        raw = json.dumps(self._with_token(dict(body))).encode("utf-8")
+        results = list(self._client().do_action(
+            flight.Action("replicate", raw)))
+        return json.loads(results[0].body.to_pybytes().decode("utf-8"))
+
+    def purge_replica(self, body: dict) -> dict:
+        """Drop body['buckets'] rows from this server's replica shadow of
+        body['table'] (pre-copy cleanup for idempotent re-replication)."""
+        raw = json.dumps(self._with_token(dict(body))).encode("utf-8")
+        results = list(self._client().do_action(
+            flight.Action("purge_replica", raw)))
+        return json.loads(results[0].body.to_pybytes().decode("utf-8"))
+
     def _with_token(self, body: dict) -> dict:
         if self._token is not None:
             body["token"] = self._token

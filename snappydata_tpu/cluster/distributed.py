@@ -71,6 +71,11 @@ class DistributedSession:
         # follows the same map.
         n = len(self.servers)
         self.bucket_map: List[int] = [b % n for b in range(num_buckets)]
+        # replica placement is ALSO an explicit map so redundancy can be
+        # RESTORED after a failover (a fixed formula could only degrade)
+        self.replica_map: List[Optional[int]] = [
+            ((b % n) + 1) % n if n > 1 else None
+            for b in range(num_buckets)]
         self.alive: List[bool] = [True] * n
         # planning catalog: schemas only (no data) on the lead
         self.planner = SnappySession(catalog=Catalog())
@@ -81,41 +86,47 @@ class DistributedSession:
         return [(i, s) for i, s in enumerate(self.servers)
                 if self.alive[i]]
 
-    def _replica_index(self, bucket: int) -> Optional[int]:
-        """Fixed replica placement: the next ORIGINAL index after the
-        bucket's original primary (liveness-independent, so every row of
-        a bucket's replica lives on one known server)."""
+    def _next_alive(self, avoid: set, start: int = 0) -> Optional[int]:
         n = len(self.servers)
-        if n < 2:
-            return None
-        return ((bucket % n) + 1) % n
+        for off in range(n):   # rotate by `start` so placement SPREADS
+            i = (start + off) % n
+            if self.alive[i] and i not in avoid:
+                return i
+        return None
 
     def mark_server_failed(self, index: int) -> None:
         """Member-departed: re-host the dead server's buckets onto their
-        replica holders (ref: membership-driven executor/bucket recovery,
-        ExecutorInitiator.scala:71-90). Promotion moves rows from each
-        survivor's <table>__replica shadow into its primary table, so
-        queries stay COMPLETE for redundancy ≥ 1 tables."""
+        replica holders and then RE-REPLICATE so redundancy survives the
+        NEXT failure too (ref: membership-driven bucket recovery +
+        redundancy restoration, ExecutorInitiator.scala:71-90). Promotion
+        moves rows from each survivor's <table>__replica shadow into its
+        primary table, so queries stay COMPLETE for redundancy ≥ 1."""
         if not self.alive[index]:
             return
         self.alive[index] = False
-        promoted: Dict[int, List[int]] = {}  # new primary -> buckets
+        promoted: Dict[int, List[int]] = {}   # new primary -> buckets
         for b in range(self.num_buckets):
             if self.bucket_map[b] != index:
                 continue
-            r = self._replica_index(b)
+            r = self.replica_map[b]
             if r is None or not self.alive[r] or r == index:
+                self.replica_map[b] = None
                 continue  # no surviving replica: bucket is lost (r=0)
             self.bucket_map[b] = r
+            self.replica_map[b] = None  # restored below
             promoted.setdefault(r, []).append(b)
+        # buckets that lost their REPLICA (primary alive) also re-home
+        for b in range(self.num_buckets):
+            if self.replica_map[b] == index:
+                self.replica_map[b] = None
         # exchange temps were built from pre-failure placement; clear
         # FIRST so a promotion failure can't leave them stale
         getattr(self, "_bcast_cache", {}).clear()
         getattr(self, "_shuf_cache", {}).clear()
         dead_targets = set()
-        for info in self.planner.catalog.list_tables():
-            if not info.partition_by or info.redundancy <= 0:
-                continue
+        red_tables = [info for info in self.planner.catalog.list_tables()
+                      if info.partition_by and info.redundancy > 0]
+        for info in red_tables:
             for si, buckets in promoted.items():
                 if si in dead_targets:
                     continue
@@ -126,8 +137,51 @@ class DistributedSession:
                          "buckets": buckets,
                          "num_buckets": self.num_buckets})
                 except Exception:
+                    # only a DEAD target cascades; an application error
+                    # must surface, not silently fail the whole cluster
+                    if self._probe(si):
+                        raise
                     dead_targets.add(si)
-        for si in dead_targets:  # the promotion target was dead too
+        # restore redundancy: pick new replica holders and copy the
+        # bucket's CURRENT rows from its primary into the new shadow
+        if red_tables and sum(self.alive) > 1:
+            to_copy: Dict[Tuple[int, int], List[int]] = {}
+            for b in range(self.num_buckets):
+                p = self.bucket_map[b]
+                if not self.alive[p] or self.replica_map[b] is not None:
+                    continue
+                nr = self._next_alive({p} | dead_targets, start=b)
+                if nr is None:
+                    continue
+                self.replica_map[b] = nr
+                to_copy.setdefault((p, nr), []).append(b)
+            for (p, nr), buckets in to_copy.items():
+                ok = True
+                for info in red_tables:
+                    if p in dead_targets or nr in dead_targets:
+                        ok = False
+                        break
+                    try:
+                        self.servers[p].replicate(
+                            {"table": info.name,
+                             "key": info.partition_by[0],
+                             "buckets": buckets,
+                             "num_buckets": self.num_buckets,
+                             "target": self.server_addresses[nr]})
+                    except Exception:
+                        ok = False
+                        if not self._probe(p):
+                            dead_targets.add(p)
+                        elif not self._probe(nr):
+                            dead_targets.add(nr)
+                        break
+                if not ok:
+                    # NEVER claim a replica that wasn't copied (phantom
+                    # redundancy silently loses the bucket on the next
+                    # death) — degrade honestly instead
+                    for b in buckets:
+                        self.replica_map[b] = None
+        for si in dead_targets:  # a peer involved was dead too
             self.mark_server_failed(si)
 
     def replace_server(self, index: int, address: str) -> None:
@@ -362,20 +416,20 @@ class DistributedSession:
             return n
         key_ci = info.schema.index(info.partition_by[0])
         buckets = bucket_of_np(arrays[key_ci], self.num_buckets)
-        n0 = len(self.servers)
-        has_replicas = info.redundancy > 0 and n0 > 1
-        rep_target = ((buckets % n0) + 1) % n0 if has_replicas else None
+        has_replicas = info.redundancy > 0 and len(self.servers) > 1
         done = np.zeros(n, dtype=bool)
-        done_rep = np.zeros(n, dtype=bool) if has_replicas \
-            else np.ones(n, dtype=bool)
+        # where each row's replica copy LANDED (-1 = nowhere yet); used
+        # both for progress and for the promotion-dedup below
+        rep_sent_to = np.full(n, -1, dtype=np.int64)
         for _attempt in range(4):  # survives members dying MID-LOAD
             owner = np.asarray(self.bucket_map)[buckets]
-            if has_replicas:
-                # a row whose replica landed but whose primary write hit
-                # the dying server was ALREADY delivered by promotion (its
-                # new primary IS its replica holder) — resending would
-                # duplicate it
-                done[(~done) & done_rep & (rep_target == owner)] = True
+            rep = np.asarray(
+                [r if r is not None else -1 for r in self.replica_map]
+            )[buckets] if has_replicas else np.full(n, -1, dtype=np.int64)
+            # a row whose replica landed on the server that is NOW its
+            # primary was already delivered by promotion — resending
+            # would duplicate it
+            done[(~done) & (rep_sent_to == owner)] = True
             failed = None
             for si, srv in self._alive():
                 sel = np.flatnonzero((owner == si) & ~done)
@@ -386,34 +440,39 @@ class DistributedSession:
                     except Exception:
                         failed = si
                         break
-                # redundant copy to the bucket's FIXED replica holder
-                # (skipped when the holder is dead or is the primary:
-                # degraded redundancy, never duplicated data)
-                if not done_rep.all() and rep_target is not None:
+                # redundant copy to the bucket's replica holder (skipped
+                # when none is assigned: degraded, never duplicated)
+                if has_replicas:
                     rsel = np.flatnonzero(
-                        (rep_target == si) & ~done_rep & (owner != si))
+                        (rep == si) & (rep_sent_to < 0) & (owner != si))
                     if rsel.size:
                         try:
                             send(srv, to_arrow(rsel),
                                  target=f"{table}__replica")
-                            done_rep[rsel] = True
+                            rep_sent_to[rsel] = si
                         except Exception:
                             failed = si
                             break
-                    # replica collapses onto the primary → degraded, done
-                    done_rep[(rep_target == si) & (owner == si)] = True
             if failed is None:
-                if rep_target is not None:
-                    # dead replica holders: degraded redundancy, not a loop
-                    alive_mask = np.asarray(self.alive)[rep_target]
-                    done_rep[~alive_mask] = True
-                if done_rep.all():
+                pending_rep = has_replicas & (rep_sent_to < 0) \
+                    & (rep >= 0) & (rep != owner) \
+                    & np.asarray(self.alive)[np.maximum(rep, 0)]
+                if not np.any(pending_rep):
                     break
                 continue
             self.mark_server_failed(failed)
             # primary writes the dead server acked WITHOUT a replica copy
             # yet are gone with it — re-deliver them to the new owner
-            done[done & (owner == failed) & ~done_rep] = False
+            done[done & (owner == failed) & (rep_sent_to < 0)] = False
+            if has_replicas:
+                # failover re-replication just copied every APPLIED row of
+                # re-homed buckets into the new shadows — sending their
+                # replicas again would duplicate them there
+                new_rep = np.asarray(
+                    [r if r is not None else -1 for r in self.replica_map]
+                )[buckets]
+                covered = done & (new_rep >= 0) & (new_rep != rep)
+                rep_sent_to[covered] = new_rep[covered]
             if sum(self.alive) == 0:
                 raise DistributedError("all data servers failed mid-load")
         if not done.all():

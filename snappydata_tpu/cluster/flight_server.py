@@ -211,6 +211,25 @@ class SnappyFlightServer(flight.FlightServerBase):
                 sess, body["table"], body["key"],
                 frozenset(body["buckets"]), int(body["num_buckets"]))
             yield flight.Result(json.dumps({"rows": moved}).encode("utf-8"))
+        elif name == "replicate":
+            # redundancy restoration: push THIS server's rows of the
+            # given buckets into a peer's replica shadow (ref: bucket
+            # redundancy recovery after re-hosting)
+            sess = self._session_for(body)
+            sess._require(body["table"], "select")
+            n = self._replicate_buckets(
+                sess, body["table"], body["key"],
+                frozenset(body["buckets"]), int(body["num_buckets"]),
+                body["target"], body.get("token"))
+            yield flight.Result(json.dumps({"rows": n}).encode("utf-8"))
+        elif name == "purge_replica":
+            # drop the given buckets' rows from the local shadow (makes
+            # re-replication idempotent after a failed/rolled-back copy)
+            sess = self._session_for(body)
+            n = self._purge_replica(
+                sess, body["table"], body["key"],
+                frozenset(body["buckets"]), int(body["num_buckets"]))
+            yield flight.Result(json.dumps({"rows": n}).encode("utf-8"))
         elif name == "ping":
             yield flight.Result(b'{"ok": true}')
         else:
@@ -252,24 +271,32 @@ class SnappyFlightServer(flight.FlightServerBase):
             sent += int(mask.sum())
         return sent
 
+    @staticmethod
+    def _bucket_rows(sess, table: str, key: str, buckets: frozenset,
+                     num_buckets: int):
+        """Scan `table` and select the rows belonging to `buckets`.
+        Returns (result, bool row mask) — mask is None when empty."""
+        from snappydata_tpu.parallel.hashing import bucket_of_np
+
+        result = sess.sql(f"SELECT * FROM {table}")
+        n = int(result.columns[0].shape[0]) if result.columns else 0
+        if n == 0:
+            return result, None
+        ki = [c.lower() for c in result.names].index(key.lower())
+        rb = bucket_of_np(np.asarray(result.columns[ki]), num_buckets)
+        mask = np.isin(rb, np.fromiter(buckets, dtype=np.int64))
+        return result, (mask if mask.any() else None)
+
     def _promote_replica(self, sess, table: str, key: str,
                          buckets: frozenset, num_buckets: int) -> int:
         """Move rows of `buckets` from <table>__replica into <table> and
         drop them from the shadow (their old primary died)."""
-        from snappydata_tpu.parallel.hashing import bucket_of_np
-
         replica = f"{table}__replica"
-        result = sess.sql(f"SELECT * FROM {replica}")
-        n = int(result.columns[0].shape[0]) if result.columns else 0
-        if n == 0:
+        result, mask = self._bucket_rows(sess, replica, key, buckets,
+                                         num_buckets)
+        if mask is None:
             return 0
-        ki = [c.lower() for c in result.names].index(key.lower())
-        kvals = np.asarray(result.columns[ki])
-        rb = bucket_of_np(kvals, num_buckets)
-        mask = np.isin(rb, np.fromiter(buckets, dtype=np.int64))
         moved = int(mask.sum())
-        if moved == 0:
-            return 0
         from snappydata_tpu.storage.table_store import RowTableData
 
         info = self.session.catalog.describe(table)
@@ -290,6 +317,8 @@ class SnappyFlightServer(flight.FlightServerBase):
                 lambda: info.data.insert_arrays(arrays, nulls=nmask))
         # remove promoted rows from the shadow so a LATER promotion of
         # other buckets can't double-promote these
+        from snappydata_tpu.parallel.hashing import bucket_of_np
+
         rinfo = self.session.catalog.describe(replica)
 
         def pred(cols, _k=key.lower(), _bk=buckets, _nb=num_buckets):
@@ -299,6 +328,46 @@ class SnappyFlightServer(flight.FlightServerBase):
 
         rinfo.data.delete(pred)
         return moved
+
+    def _replicate_buckets(self, sess, table: str, key: str,
+                           buckets: frozenset, num_buckets: int,
+                           target: str, token: Optional[str]) -> int:
+        """Copy this server's current rows of `buckets` into `target`'s
+        <table>__replica shadow. The target PURGES those buckets from its
+        shadow first, so a retried/rolled-back restoration never leaves
+        duplicate shadow rows."""
+        from snappydata_tpu.cluster.client import SnappyClient
+
+        result, mask = self._bucket_rows(sess, table, key, buckets,
+                                         num_buckets)
+        if mask is None:
+            return 0
+        piece = result_to_arrow(result, sel=mask)
+        client = SnappyClient(address=target, token=token)
+        try:
+            client.purge_replica({"table": table, "key": key,
+                                  "buckets": sorted(buckets),
+                                  "num_buckets": num_buckets})
+            client.insert(f"{table}__replica", piece)
+        finally:
+            client.close()
+        return int(mask.sum())
+
+
+    def _purge_replica(self, sess, table: str, key: str,
+                       buckets: frozenset, num_buckets: int) -> int:
+        from snappydata_tpu.parallel.hashing import bucket_of_np
+
+        rinfo = self.session.catalog.lookup_table(f"{table}__replica")
+        if rinfo is None:
+            return 0
+
+        def pred(cols, _k=key.lower(), _bk=buckets, _nb=num_buckets):
+            vals = np.asarray(cols[_k])
+            return np.isin(bucket_of_np(vals, _nb),
+                           np.fromiter(_bk, dtype=np.int64))
+
+        return rinfo.data.delete(pred)
 
     def list_actions(self, context):
         return [("sql", "execute a statement"),

@@ -480,3 +480,47 @@ def test_collocated_join_survives_failover():
             except Exception:
                 pass
         locator.stop()
+
+
+def test_redundancy_restored_after_successive_failures():
+    """After a failover the promoted buckets are RE-REPLICATED onto a
+    surviving member, so a SECOND member death still loses nothing."""
+    locator, servers, ds = _mini_cluster(4)
+    try:
+        ds.sql("CREATE TABLE rr (k BIGINT, v DOUBLE) USING column "
+               "OPTIONS (partition_by 'k', redundancy '1')")
+        rng = np.random.default_rng(41)
+        n = 20_000
+        k = rng.integers(0, 9_000, n).astype(np.int64)
+        v = np.round(rng.random(n) * 10, 3)
+        ds.insert_arrays("rr", [k, v])
+        exact = (n, float(v.sum()))
+
+        servers[0].stop()
+        ds.mark_server_failed(0)
+        r = ds.sql("SELECT count(*), sum(v) FROM rr").rows()[0]
+        assert r[0] == exact[0] and r[1] == pytest.approx(exact[1])
+
+        # redundancy was restored → a SECOND death is survivable
+        servers[1].stop()
+        ds.mark_server_failed(1)
+        r = ds.sql("SELECT count(*), sum(v) FROM rr").rows()[0]
+        assert r[0] == exact[0], (r[0], exact[0])
+        assert r[1] == pytest.approx(exact[1])
+
+        # and the cluster still ingests + mutates exactly
+        ds.insert_arrays("rr", [np.arange(1000, dtype=np.int64),
+                                np.ones(1000)])
+        r = ds.sql("SELECT count(*) FROM rr").rows()[0][0]
+        assert r == n + 1000
+        upd = ds.sql("UPDATE rr SET v = 0.0 WHERE k < 100").rows()[0][0]
+        r2 = ds.sql("SELECT count(*) FROM rr WHERE v = 0.0").rows()[0][0]
+        assert r2 >= upd
+    finally:
+        ds.close()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        locator.stop()
