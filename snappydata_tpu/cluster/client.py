@@ -15,12 +15,19 @@ import pyarrow.flight as flight
 class SnappyClient:
     def __init__(self, address: Optional[str] = None,
                  locator: Optional[str] = None,
-                 token: Optional[str] = None):
+                 token: Optional[str] = None,
+                 user: Optional[str] = None,
+                 password: Optional[str] = None):
         """Connect directly (`address`='host:port') or discover query
         servers through a locator ('host:port' of the locator service).
         `token` authenticates every request when the server has
-        auth_tokens configured."""
+        auth_tokens configured; `user`+`password` instead log in against
+        the server's auth provider (BUILTIN/LDAP) for an ephemeral token —
+        re-acquired automatically after a failover, since tokens are
+        per-server (ref: JDBC user/password connection properties)."""
         self._token = token
+        self._user = user
+        self._password = password
         self._addresses: List[str] = []
         if address:
             self._addresses.append(address)
@@ -40,26 +47,42 @@ class SnappyClient:
         self._addresses = [f"{m.host}:{m.port}" for m in members
                            if m.port and m.role in ("server", "lead")]
 
+    def _login(self, conn: flight.FlightClient) -> None:
+        """Exchange user/password for a per-server ephemeral token."""
+        if self._user is None or self._password is None:
+            return
+        body = json.dumps({"user": self._user,
+                           "password": self._password}).encode("utf-8")
+        results = list(conn.do_action(flight.Action("login", body)))
+        self._token = json.loads(
+            results[0].body.to_pybytes().decode("utf-8"))["token"]
+
+    def _establish(self, addr: str) -> flight.FlightClient:
+        conn = flight.connect(f"grpc://{addr}")
+        list(conn.do_action(flight.Action("ping", b"")))
+        self._login(conn)
+        return conn
+
     def _client(self) -> flight.FlightClient:
         if self._conn is not None:
             return self._conn
         last_err: Optional[Exception] = None
         for addr in list(self._addresses):
             try:
-                conn = flight.connect(f"grpc://{addr}")
-                list(conn.do_action(flight.Action("ping", b"")))
-                self._conn = conn
-                return conn
+                self._conn = self._establish(addr)
+                return self._conn
+            except flight.FlightUnauthenticatedError:
+                raise   # bad credentials — failover can't fix that
             except Exception as e:  # failover to the next member
                 last_err = e
         if self._locator:
             self._refresh_from_locator()
             for addr in self._addresses:
                 try:
-                    conn = flight.connect(f"grpc://{addr}")
-                    list(conn.do_action(flight.Action("ping", b"")))
-                    self._conn = conn
-                    return conn
+                    self._conn = self._establish(addr)
+                    return self._conn
+                except flight.FlightUnauthenticatedError:
+                    raise
                 except Exception as e:
                     last_err = e
         raise ConnectionError(f"no reachable member: {last_err}")
@@ -67,41 +90,70 @@ class SnappyClient:
     def _invalidate(self) -> None:
         self._conn = None
 
+    def _request(self, once, retry: bool):
+        """Run `once` (which must connect via _client() before building
+        its payload — the token may only exist after login, and a
+        failover re-login mints a fresh per-server token). Retries once
+        on connection loss when `retry` (only for idempotent requests —
+        a blind retry of e.g. repartition would duplicate rows), and once
+        on an expired login token (re-login via reconnect)."""
+        try:
+            return once()
+        except flight.FlightUnauthenticatedError:
+            if self._user is None or self._token is None:
+                raise
+            self._invalidate()   # reconnect → fresh login
+            return once()
+        except (flight.FlightUnavailableError, ConnectionError):
+            if not retry:
+                raise
+            self._invalidate()
+            return once()
+
+    def _action(self, name: str, body: dict, retry: bool = True) -> dict:
+        def once():
+            conn = self._client()
+            raw = json.dumps(self._with_token(dict(body))).encode("utf-8")
+            results = list(conn.do_action(flight.Action(name, raw)))
+            return json.loads(results[0].body.to_pybytes().decode("utf-8"))
+
+        return self._request(once, retry)
+
     def sql(self, sql: str, params: Sequence = ()) -> pa.Table:
         """Query → Arrow table (record-batch paged by Flight)."""
-        ticket = flight.Ticket(json.dumps(
-            self._with_token({"sql": sql, "params": list(params)})
-        ).encode("utf-8"))
-        try:
-            return self._client().do_get(ticket).read_all()
-        except (flight.FlightUnavailableError, ConnectionError):
-            self._invalidate()
-            return self._client().do_get(ticket).read_all()
+        def once():
+            conn = self._client()
+            ticket = flight.Ticket(json.dumps(self._with_token(
+                {"sql": sql, "params": list(params)})).encode("utf-8"))
+            return conn.do_get(ticket).read_all()
+
+        return self._request(once, retry=True)
+
+    # leading keywords whose statements are NOT safe to blind-retry after
+    # a connection drop (the server may have applied them before the
+    # response was lost — a re-send would double-apply)
+    _NON_IDEMPOTENT = ("insert", "put", "update", "delete", "exec")
 
     def execute(self, sql: str, params: Sequence = ()) -> dict:
-        """DDL/DML via action (no result paging needed)."""
-        body = json.dumps(self._with_token(
-            {"sql": sql, "params": list(params)})).encode()
-        try:
-            results = list(self._client().do_action(
-                flight.Action("sql", body)))
-        except (flight.FlightUnavailableError, ConnectionError):
-            self._invalidate()
-            results = list(self._client().do_action(
-                flight.Action("sql", body)))
-        return json.loads(results[0].body.to_pybytes().decode("utf-8"))
+        """DDL/DML via action (no result paging needed). Queries and DDL
+        retry across failover; DML does not (re-sending an INSERT whose
+        response was lost would duplicate rows)."""
+        head = sql.lstrip().split(None, 1)[0].lower() if sql.strip() else ""
+        return self._action("sql", {"sql": sql, "params": list(params)},
+                            retry=head not in self._NON_IDEMPOTENT)
 
     def insert(self, table: str, columns: dict) -> None:
         """Bulk columnar ingest via do_put. `columns` is a name → array
         dict or a ready pyarrow Table."""
         arrow = columns if isinstance(columns, pa.Table) else \
             pa.table(columns)
+        conn = self._client()   # may log in and mint self._token
         if self._token is not None:
             descriptor = flight.FlightDescriptor.for_command(json.dumps(
                 {"table": table, "token": self._token}).encode("utf-8"))
         else:
             descriptor = flight.FlightDescriptor.for_path(table)
-        writer, _ = self._client().do_put(descriptor, arrow.schema)
+        writer, _ = conn.do_put(descriptor, arrow.schema)
         writer.write_table(arrow)
         writer.close()
 
@@ -109,10 +161,7 @@ class SnappyClient:
         """Ask this server to hash-repartition its shard of body['table']
         by body['key'] into body['dest'] across body['servers'] (the
         shuffle-exchange fan-out)."""
-        raw = json.dumps(self._with_token(dict(body))).encode("utf-8")
-        results = list(self._client().do_action(
-            flight.Action("repartition", raw)))
-        return json.loads(results[0].body.to_pybytes().decode("utf-8"))
+        return self._action("repartition", body, retry=False)
 
     def ping(self) -> None:
         """Liveness probe (raises if the member is unreachable)."""
@@ -121,27 +170,18 @@ class SnappyClient:
     def promote(self, body: dict) -> dict:
         """Failover re-hosting: move this server's replica-shadow rows of
         body['buckets'] into its primary table (body['table'])."""
-        raw = json.dumps(self._with_token(dict(body))).encode("utf-8")
-        results = list(self._client().do_action(
-            flight.Action("promote", raw)))
-        return json.loads(results[0].body.to_pybytes().decode("utf-8"))
+        return self._action("promote", body, retry=False)
 
     def replicate(self, body: dict) -> dict:
         """Redundancy restoration: this server copies its CURRENT rows of
         body['buckets'] (table body['table']) into body['target']'s
         replica shadow."""
-        raw = json.dumps(self._with_token(dict(body))).encode("utf-8")
-        results = list(self._client().do_action(
-            flight.Action("replicate", raw)))
-        return json.loads(results[0].body.to_pybytes().decode("utf-8"))
+        return self._action("replicate", body, retry=False)
 
     def purge_replica(self, body: dict) -> dict:
         """Drop body['buckets'] rows from this server's replica shadow of
         body['table'] (pre-copy cleanup for idempotent re-replication)."""
-        raw = json.dumps(self._with_token(dict(body))).encode("utf-8")
-        results = list(self._client().do_action(
-            flight.Action("purge_replica", raw)))
-        return json.loads(results[0].body.to_pybytes().decode("utf-8"))
+        return self._action("purge_replica", body)
 
     def _with_token(self, body: dict) -> dict:
         if self._token is not None:
@@ -149,10 +189,7 @@ class SnappyClient:
         return body
 
     def stats(self) -> dict:
-        body = json.dumps(self._with_token({})).encode("utf-8")
-        results = list(self._client().do_action(
-            flight.Action("stats", body)))
-        return json.loads(results[0].body.to_pybytes().decode("utf-8"))
+        return self._action("stats", {})
 
     def close(self) -> None:
         if self._conn is not None:

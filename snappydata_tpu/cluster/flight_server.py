@@ -74,18 +74,33 @@ def arrow_to_arrays(table: pa.Table):
 
 
 class SnappyFlightServer(flight.FlightServerBase):
+    # login-issued tokens expire after this long; the client re-logs-in
+    # transparently (SnappyClient retries once on Unauthenticated)
+    TOKEN_TTL_S = 8 * 3600.0
+
     def __init__(self, session, host: str = "127.0.0.1", port: int = 0,
-                 auth_tokens: Optional[dict] = None):
-        """`auth_tokens`: token → user map. When configured, EVERY request
-        must carry a valid `token` field and runs as that principal (so
-        GRANT/REVOKE applies); when absent, requests run as an
+                 auth_tokens: Optional[dict] = None, auth_provider=None,
+                 internal_token: Optional[str] = None):
+        """`auth_tokens`: pre-shared token → user map. `auth_provider`: a
+        `security.AuthProvider` (BUILTIN/LDAP) validating user+password —
+        clients `login` once for an ephemeral token (ref: SecurityUtils
+        credential check per connection). When either is configured, EVERY
+        request must carry a valid credential and runs as that principal
+        (so GRANT/REVOKE applies); when neither is, requests run as an
         UNAUTHENTICATED remote session — EXEC PYTHON is refused either way
         unless the principal is an authenticated admin (advisor finding:
-        the network surface used to run as the admin superuser)."""
+        the network surface used to run as the admin superuser).
+        `internal_token`: cluster-shared secret (conf `auth_cluster_token`)
+        for server↔server traffic — login tokens are per-server, so peer
+        calls (repartition/replicate do_put) authenticate with this
+        instead of forwarding a caller's token."""
         location = f"grpc://{host}:{port}"
         super().__init__(location)
         self.session = session
         self.auth_tokens = auth_tokens or {}
+        self.auth_provider = auth_provider
+        self.internal_token = internal_token
+        self._issued_tokens: dict = {}   # token -> (user, expiry)
         self.host = host
         self._location = location
 
@@ -93,16 +108,58 @@ class SnappyFlightServer(flight.FlightServerBase):
     def actual_port(self) -> int:
         return self.port
 
+    def wait_ready(self, timeout: float = 10.0) -> None:
+        """Block until the gRPC loop actually accepts connections. The port
+        is bound at __init__, so a nonzero port does NOT mean serve() is
+        running yet — probing with a real connection is the only reliable
+        readiness signal."""
+        client = flight.connect(f"grpc://{self.host}:{self.port}")
+        try:
+            client.wait_for_available(timeout=int(max(1, timeout)))
+        finally:
+            client.close()
+
+    def _auth_enabled(self) -> bool:
+        return bool(self.auth_tokens) or self.auth_provider is not None
+
     def _session_for(self, body: Optional[dict]):
         """Per-request principal session (ref: SnappySessionPerConnection,
         SparkSQLExecuteImpl.scala:99)."""
-        if self.auth_tokens:
-            user = self.auth_tokens.get((body or {}).get("token"))
+        if not self._auth_enabled():
+            return self.session.for_user(self.session.user,
+                                         authenticated=False)
+        body = body or {}
+        token = body.get("token")
+        user = None
+        if token:
+            import hmac as _hmac
+
+            if self.internal_token is not None and _hmac.compare_digest(
+                    token.encode("utf-8"),
+                    self.internal_token.encode("utf-8")):
+                # peer server: runs as this node's own (admin) principal
+                user = self.session.user
+            else:
+                user = self.auth_tokens.get(token)
             if user is None:
-                raise flight.FlightUnauthenticatedError(
-                    "missing or invalid token")
-            return self.session.for_user(user, authenticated=True)
-        return self.session.for_user(self.session.user, authenticated=False)
+                import time as _t
+
+                entry = self._issued_tokens.get(token)
+                if entry is not None:
+                    if entry[1] > _t.time():
+                        user = entry[0]
+                    else:
+                        self._issued_tokens.pop(token, None)
+        if user is None and self.auth_provider is not None:
+            # inline credentials (clients normally `login` once instead —
+            # this path hits the provider, e.g. an LDAP bind, per request)
+            u, p = body.get("user"), body.get("password")
+            if u and p and self.auth_provider.authenticate(u, p):
+                user = u
+        if user is None:
+            raise flight.FlightUnauthenticatedError(
+                "missing or invalid token/credentials")
+        return self.session.for_user(user, authenticated=True)
 
     # -- queries ----------------------------------------------------------
 
@@ -176,9 +233,32 @@ class SnappyFlightServer(flight.FlightServerBase):
                        "rows": [[_json_val(v) for v in r]
                                 for r in result.rows()[:1000]]}
             yield flight.Result(json.dumps(payload).encode("utf-8"))
+        elif name == "login":
+            # credential → ephemeral session token (ref: per-connection
+            # authentication in SecurityUtils; the token plays the role of
+            # the authenticated connection)
+            if self.auth_provider is None:
+                raise flight.FlightUnauthenticatedError(
+                    "no auth provider configured (login unavailable)")
+            u, p = body.get("user"), body.get("password")
+            if not u or not p or not self.auth_provider.authenticate(u, p):
+                raise flight.FlightUnauthenticatedError(
+                    "invalid credentials")
+            import secrets
+            import time as _t
+
+            now = _t.time()
+            # prune expired tokens so the table can't grow without bound
+            for stale in [t for t, (_, exp) in self._issued_tokens.items()
+                          if exp <= now]:
+                self._issued_tokens.pop(stale, None)
+            tok = secrets.token_hex(16)
+            self._issued_tokens[tok] = (u, now + self.TOKEN_TTL_S)
+            yield flight.Result(json.dumps(
+                {"token": tok, "user": u}).encode("utf-8"))
         elif name == "checkpoint":
             sess = self._session_for(body)
-            if self.auth_tokens and sess.user != "admin":
+            if self._auth_enabled() and sess.user != "admin":
                 raise flight.FlightServerError("checkpoint requires admin")
             self.session.checkpoint()
             yield flight.Result(b"{}")
@@ -200,7 +280,8 @@ class SnappyFlightServer(flight.FlightServerBase):
             n = self._repartition_shard(
                 sess, body["table"], body["key"], body["dest"],
                 body["servers"], int(body["num_buckets"]),
-                body.get("token"), body.get("bucket_owners"))
+                self.internal_token or body.get("token"),
+                body.get("bucket_owners"))
             yield flight.Result(json.dumps({"rows": n}).encode("utf-8"))
         elif name == "promote":
             # failover re-hosting: replica-shadow rows of the given
@@ -220,7 +301,7 @@ class SnappyFlightServer(flight.FlightServerBase):
             n = self._replicate_buckets(
                 sess, body["table"], body["key"],
                 frozenset(body["buckets"]), int(body["num_buckets"]),
-                body["target"], body.get("token"))
+                body["target"], self.internal_token or body.get("token"))
             yield flight.Result(json.dumps({"rows": n}).encode("utf-8"))
         elif name == "purge_replica":
             # drop the given buckets' rows from the local shadow (makes

@@ -58,17 +58,31 @@ class _MemberNode:
     def _start_flight(self) -> int:
         from snappydata_tpu.cluster.flight_server import SnappyFlightServer
 
+        from snappydata_tpu.security import make_provider
+
         tokens = self.session.conf.get("auth_tokens") or None
+        provider = make_provider(self.session.conf)
+        cluster_token = self.session.conf.get("auth_cluster_token")
+        if provider is not None and not cluster_token:
+            # login tokens are per-server: without a cluster-shared secret,
+            # server→server traffic (repartition/replicate do_put) would be
+            # rejected by peers mid-operation — fail at boot, not mid-shuffle
+            raise ValueError(
+                "auth_provider is configured but auth_cluster_token is not: "
+                "cluster members need a shared secret to authenticate "
+                "server-to-server traffic (set auth_cluster_token to the "
+                "same value on every member)")
         self.flight = SnappyFlightServer(self.session, self.host,
                                          self._flight_port,
-                                         auth_tokens=tokens)
+                                         auth_tokens=tokens,
+                                         auth_provider=provider,
+                                         internal_token=cluster_token)
         self._flight_thread = threading.Thread(target=self.flight.serve,
                                                daemon=True)
         self._flight_thread.start()
-        # wait for the port to materialize
-        deadline = time.time() + 5
-        while self.flight.port == 0 and time.time() < deadline:
-            time.sleep(0.01)
+        # the port is bound at construction; wait for the serve loop to
+        # actually accept connections before registering with the locator
+        self.flight.wait_ready(timeout=10)
         return self.flight.port
 
     def _join(self, port: int) -> None:
@@ -151,11 +165,15 @@ class LeadNode(_MemberNode):
         from snappydata_tpu.observability import TableStatsService
 
         self.stats_service = TableStatsService(self.session.catalog).start()
+        from snappydata_tpu.security import make_provider
+
         self.rest = RestService(self.session, self.stats_service,
                                 membership=self.membership,
                                 host=self.host, port=self.rest_port,
                                 auth_tokens=self.session.conf.get(
-                                    "auth_tokens") or None).start()
+                                    "auth_tokens") or None,
+                                auth_provider=make_provider(
+                                    self.session.conf)).start()
         self.is_primary = True
 
     def _step_down(self) -> None:

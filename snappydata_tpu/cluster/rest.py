@@ -110,18 +110,27 @@ text-align:left}}h2{{margin-top:1.5em}}</style></head><body>
 
 
 class RestService:
+    # validated Basic credentials are cached this long, bounding both the
+    # per-request provider cost (LDAP bind) and the revocation latency
+    BASIC_CACHE_TTL_S = 300.0
+
     def __init__(self, session, stats_service, membership=None,
                  host: str = "127.0.0.1", port: int = 0,
-                 auth_tokens=None):
+                 auth_tokens=None, auth_provider=None):
         """`auth_tokens`: token → user map. When configured, job submission
         requires `Authorization: Bearer <token>` (or `X-Snappy-Token`) and
         runs as that principal; when absent, jobs run as an unauthenticated
         remote session (EXEC PYTHON refused — advisor finding: the job
-        endpoint used to execute arbitrary SQL as the admin superuser)."""
+        endpoint used to execute arbitrary SQL as the admin superuser).
+        `auth_provider` (BUILTIN/LDAP) additionally accepts
+        `Authorization: Basic <user:password>` credentials; validated
+        principals are cached so LDAP isn't bound per request."""
         self.session = session
         self.stats_service = stats_service
         self.membership = membership
         self.auth_tokens = auth_tokens or {}
+        self.auth_provider = auth_provider
+        self._basic_cache = {}   # sha256(user:password) -> (user, expiry)
         self.jobs = JobRegistry(session)
         svc = self
 
@@ -189,8 +198,8 @@ class RestService:
                                content_type="text/plain")
                 elif path in ("", "/dashboard"):
                     # shows recent query text → token-gated when auth on
-                    if svc.auth_tokens and \
-                            self._principal_session() is None:
+                    if (svc.auth_tokens or svc.auth_provider is not None) \
+                            and self._principal_session() is None:
                         return
                     self._send(_render_dashboard(svc).encode(),
                                content_type="text/html")
@@ -210,20 +219,42 @@ class RestService:
 
             def _principal_session(self):
                 """Resolve the request principal; None → 401 already sent."""
+                auth = self.headers.get("Authorization", "")
                 token = self.headers.get("X-Snappy-Token")
-                if token is None:
-                    auth = self.headers.get("Authorization", "")
-                    if auth.startswith("Bearer "):
-                        token = auth[len("Bearer "):]
-                if svc.auth_tokens:
-                    user = svc.auth_tokens.get(token)
-                    if user is None:
-                        self._send({"error": "missing or invalid token"},
-                                   401)
-                        return None
-                    return svc.session.for_user(user, authenticated=True)
-                return svc.session.for_user(svc.session.user,
-                                            authenticated=False)
+                if token is None and auth.startswith("Bearer "):
+                    token = auth[len("Bearer "):]
+                if not svc.auth_tokens and svc.auth_provider is None:
+                    return svc.session.for_user(svc.session.user,
+                                                authenticated=False)
+                user = svc.auth_tokens.get(token) if token else None
+                if user is None and svc.auth_provider is not None \
+                        and auth.startswith("Basic "):
+                    import base64
+                    import hashlib
+                    import time as _t
+                    try:
+                        raw = base64.b64decode(auth[len("Basic "):],
+                                               validate=True)
+                        u, _, p = raw.decode("utf-8").partition(":")
+                    except Exception:
+                        raw, u, p = b"", "", ""
+                    digest = hashlib.sha256(raw).hexdigest()
+                    now = _t.time()
+                    cached = svc._basic_cache.get(digest)
+                    if cached is not None and cached[0] == u \
+                            and cached[1] > now:
+                        user = u
+                    elif u and p and svc.auth_provider.authenticate(u, p):
+                        # short TTL: a revoked/changed credential stops
+                        # working within BASIC_CACHE_TTL_S, not never
+                        svc._basic_cache[digest] = (
+                            u, now + svc.BASIC_CACHE_TTL_S)
+                        user = u
+                if user is None:
+                    self._send({"error": "missing or invalid "
+                                         "token/credentials"}, 401)
+                    return None
+                return svc.session.for_user(user, authenticated=True)
 
             def do_POST(self):
                 path = self.path.rstrip("/")

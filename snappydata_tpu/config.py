@@ -85,14 +85,16 @@ class Properties:
                 value = int(value)
             setattr(self, key_norm, value)
         else:
-            self.extra[key] = value
+            # store under the NORMALIZED key so `SET auth-provider` and
+            # `conf.get("auth_provider")` see the same entry
+            self.extra[key_norm] = value
 
     def get(self, key: str, default: Any = None) -> Any:
         key_norm = key.replace("spark.snappydata.", "").replace(
             "snappydata.", "").replace("-", "_").replace(".", "_")
         if hasattr(self, key_norm) and key_norm != "extra":
             return getattr(self, key_norm)
-        return self.extra.get(key, default)
+        return self.extra.get(key_norm, default)
 
 
 _global = Properties(

@@ -1,0 +1,449 @@
+"""Pluggable authentication: BUILTIN and LDAP providers end-to-end.
+
+Reference behavior: `auth-provider=BUILTIN|LDAP` with `auth-ldap-server`
+/ `auth-ldap-search-base` (ClusterManagerLDAPTestBase.scala:97-102);
+network servers authenticate principals and statements run under the
+principal's session so GRANT/REVOKE applies (SecurityUtils).
+
+The LDAP tests run against an in-process mini LDAP server that speaks
+genuine BER over TCP — binds and single-equality searches — so the
+pure-python client in `security/auth.py` is exercised on real sockets.
+"""
+
+import socket
+import threading
+
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.config import Properties
+from snappydata_tpu.security import (
+    BuiltinAuthProvider,
+    LdapAuthProvider,
+    make_provider,
+)
+from snappydata_tpu.security.auth import (
+    LDAP_AUTH_SIMPLE,
+    LDAP_BIND_REQUEST,
+    LDAP_BIND_RESPONSE,
+    LDAP_SEARCH_DONE,
+    LDAP_SEARCH_ENTRY,
+    LDAP_SEARCH_REQUEST,
+    LDAP_UNBIND_REQUEST,
+    RESULT_INVALID_CREDENTIALS,
+    RESULT_SUCCESS,
+    ber,
+    ber_children,
+    ber_int,
+    ber_read,
+    escape_dn_value,
+    read_ber_message,
+)
+
+
+# ---------------------------------------------------------------------------
+# BER codec
+# ---------------------------------------------------------------------------
+
+
+def test_ber_roundtrip():
+    for payload in (b"", b"x", b"a" * 127, b"b" * 128, b"c" * 70000):
+        enc = ber(0x04, payload)
+        tag, content, off = ber_read(enc)
+        assert (tag, content, off) == (0x04, payload, len(enc))
+    for v in (0, 1, 3, 127, 128, 255, 256, -1, 49):
+        tag, content, _ = ber_read(ber_int(v))
+        assert tag == 0x02
+        assert int.from_bytes(content, "big", signed=True) == v
+
+
+def test_escape_dn_value():
+    assert escape_dn_value("alice") == "alice"
+    assert escape_dn_value("a,b=c") == "a\\,b\\=c"
+    assert escape_dn_value(" lead") == "\\ lead"
+
+
+# ---------------------------------------------------------------------------
+# BUILTIN
+# ---------------------------------------------------------------------------
+
+
+def test_builtin_plain_and_hashed():
+    p = BuiltinAuthProvider({
+        "alice": "secret",
+        "bob": BuiltinAuthProvider.hash_password("hunter2")})
+    assert p.authenticate("alice", "secret")
+    assert p.authenticate("ALICE", "secret")   # user names fold case
+    assert not p.authenticate("alice", "wrong")
+    assert not p.authenticate("alice", "")
+    assert p.authenticate("bob", "hunter2")
+    assert not p.authenticate("bob", "hunter3")
+    assert not p.authenticate("carol", "x")
+
+
+def test_make_provider_from_conf():
+    conf = Properties()
+    assert make_provider(conf) is None
+    # SET-style (dash) keys normalize to the same entry
+    conf.set("auth-provider", "BUILTIN")
+    conf.set("auth_builtin_users", "alice:pw1,bob:pw2")
+    p = make_provider(conf)
+    assert p.authenticate("alice", "pw1") and p.authenticate("bob", "pw2")
+    assert not p.authenticate("alice", "pw2")
+    conf.set("auth-provider", "nosuch")
+    with pytest.raises(ValueError, match="unknown auth_provider"):
+        make_provider(conf)
+
+
+# ---------------------------------------------------------------------------
+# Mini LDAP server
+# ---------------------------------------------------------------------------
+
+
+class MiniLdapServer:
+    """Just enough LDAPv3 to test the client: simple bind against a
+    dn→password table, single-equality subtree search over uid→dn."""
+
+    def __init__(self, passwords, uids=None, allow_anonymous=True):
+        self.passwords = passwords        # dn (lowercased) -> password
+        self.uids = uids or {}            # uid -> dn
+        self.allow_anonymous = allow_anonymous
+        self.binds = []                   # observed (dn, password)
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("127.0.0.1", 0))
+        self._sock.listen(8)
+        self.port = self._sock.getsockname()[1]
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self._stop = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._handle, args=(conn,),
+                             daemon=True).start()
+
+    def _handle(self, conn):
+        authed = False
+        try:
+            while True:
+                _, content = read_ber_message(conn)
+                children = ber_children(content)
+                msg_id = int.from_bytes(children[0][1], "big", signed=True)
+                op_tag, op_body = children[1]
+                if op_tag == LDAP_BIND_REQUEST:
+                    parts = ber_children(op_body)
+                    dn = parts[1][1].decode("utf-8")
+                    assert parts[2][0] == LDAP_AUTH_SIMPLE
+                    password = parts[2][1].decode("utf-8")
+                    self.binds.append((dn, password))
+                    if dn == "" and password == "":
+                        code = RESULT_SUCCESS if self.allow_anonymous \
+                            else RESULT_INVALID_CREDENTIALS
+                        authed = self.allow_anonymous
+                    elif self.passwords.get(dn.lower()) == password \
+                            and password != "":
+                        code, authed = RESULT_SUCCESS, True
+                    else:
+                        code, authed = RESULT_INVALID_CREDENTIALS, False
+                    conn.sendall(ber(0x30, ber_int(msg_id) + ber(
+                        LDAP_BIND_RESPONSE,
+                        ber_int(code, 0x0A) + ber(0x04, b"") +
+                        ber(0x04, b""))))
+                elif op_tag == LDAP_SEARCH_REQUEST:
+                    parts = ber_children(op_body)
+                    filt_tag, filt = parts[6]
+                    assert filt_tag == 0xA3, "equalityMatch expected"
+                    attr, value = [b.decode("utf-8")
+                                   for _, b in ber_children(filt)]
+                    dn = self.uids.get(value) if authed and attr == "uid" \
+                        else None
+                    out = b""
+                    if dn is not None:
+                        out += ber(0x30, ber_int(msg_id) + ber(
+                            LDAP_SEARCH_ENTRY,
+                            ber(0x04, dn.encode()) + ber(0x30, b"")))
+                    out += ber(0x30, ber_int(msg_id) + ber(
+                        LDAP_SEARCH_DONE,
+                        ber_int(RESULT_SUCCESS, 0x0A) + ber(0x04, b"") +
+                        ber(0x04, b"")))
+                    conn.sendall(out)
+                elif op_tag == LDAP_UNBIND_REQUEST:
+                    return
+        except (ConnectionError, ValueError, OSError):
+            pass
+        finally:
+            conn.close()
+
+
+DIRECTORY = {
+    "uid=alice,ou=people,dc=example,dc=com": "wonderland",
+    "uid=bob,ou=people,dc=example,dc=com": "builder",
+    "cn=admin,dc=example,dc=com": "adminpw",
+}
+UIDS = {
+    "alice": "uid=alice,ou=people,dc=example,dc=com",
+    "bob": "uid=bob,ou=people,dc=example,dc=com",
+}
+
+
+@pytest.fixture()
+def ldap_server():
+    server = MiniLdapServer(DIRECTORY, UIDS)
+    yield server
+    server.close()
+
+
+def test_ldap_template_bind(ldap_server):
+    p = LdapAuthProvider(
+        f"ldap://127.0.0.1:{ldap_server.port}",
+        user_dn_template="uid={user},ou=people,dc=example,dc=com")
+    assert p.authenticate("alice", "wonderland")
+    assert p.authenticate("bob", "builder")
+    assert not p.authenticate("alice", "builder")
+    assert not p.authenticate("mallory", "x")
+    # RFC 4513: empty password must be refused client-side, no bind sent
+    n_binds = len(ldap_server.binds)
+    assert not p.authenticate("alice", "")
+    assert len(ldap_server.binds) == n_binds
+
+
+def test_ldap_template_escapes_dn_metacharacters(ldap_server):
+    p = LdapAuthProvider(
+        f"ldap://127.0.0.1:{ldap_server.port}",
+        user_dn_template="uid={user},ou=people,dc=example,dc=com")
+    assert not p.authenticate("alice,ou=people", "x")
+    sent_dn = ldap_server.binds[-1][0]
+    assert "\\," in sent_dn   # the comma travelled escaped
+
+
+def test_ldap_search_then_bind_anonymous(ldap_server):
+    p = LdapAuthProvider(
+        f"ldap://127.0.0.1:{ldap_server.port}",
+        search_base="dc=example,dc=com")
+    assert p.authenticate("alice", "wonderland")
+    assert not p.authenticate("alice", "nope")
+    assert not p.authenticate("eve", "x")     # no entry found
+
+
+def test_ldap_search_then_bind_with_admin(ldap_server):
+    p = LdapAuthProvider(
+        f"ldap://127.0.0.1:{ldap_server.port}",
+        search_base="dc=example,dc=com",
+        bind_dn="cn=admin,dc=example,dc=com",
+        bind_password="adminpw")
+    assert p.authenticate("bob", "builder")
+    wrong = LdapAuthProvider(
+        f"ldap://127.0.0.1:{ldap_server.port}",
+        search_base="dc=example,dc=com",
+        bind_dn="cn=admin,dc=example,dc=com",
+        bind_password="wrongpw")
+    assert not wrong.authenticate("bob", "builder")
+
+
+def test_ldap_server_down_is_refusal_not_crash():
+    p = LdapAuthProvider("ldap://127.0.0.1:1",   # nothing listens there
+                         user_dn_template="uid={user},dc=x")
+    assert not p.authenticate("alice", "pw")
+
+
+# ---------------------------------------------------------------------------
+# End-to-end on the network surfaces
+# ---------------------------------------------------------------------------
+
+
+def _serve_flight(session, provider):
+    from snappydata_tpu.cluster.flight_server import SnappyFlightServer
+
+    server = SnappyFlightServer(session, "127.0.0.1", 0,
+                                auth_provider=provider)
+    threading.Thread(target=server.serve, daemon=True).start()
+    server.wait_ready(timeout=10)
+    return server
+
+
+def test_flight_login_with_builtin_provider():
+    from snappydata_tpu.cluster import SnappyClient
+
+    s = SnappySession()
+    s.sql("CREATE TABLE auth_bt (a INT) USING column")
+    s.sql("INSERT INTO auth_bt VALUES (1), (2), (3)")
+    provider = BuiltinAuthProvider({"admin": "adminpw", "carol": "carolpw"})
+    server = _serve_flight(s, provider)
+    try:
+        with pytest.raises(Exception, match="(?i)token|credential"):
+            SnappyClient(address=f"127.0.0.1:{server.port}").sql(
+                "SELECT * FROM auth_bt")
+        with pytest.raises(Exception, match="(?i)invalid credentials"):
+            SnappyClient(address=f"127.0.0.1:{server.port}",
+                         user="carol", password="wrong").sql(
+                "SELECT * FROM auth_bt")
+        carol = SnappyClient(address=f"127.0.0.1:{server.port}",
+                             user="carol", password="carolpw")
+        with pytest.raises(Exception, match="(?i)lacks"):
+            carol.sql("SELECT * FROM auth_bt")  # authed but not granted
+        s.sql("GRANT SELECT ON auth_bt TO carol")
+        assert carol.sql(
+            "SELECT count(*) FROM auth_bt").column(0).to_pylist() == [3]
+        with pytest.raises(Exception, match="EXEC PYTHON|may not run"):
+            carol.execute("EXEC PYTHON 'result = [1]'")
+        carol.close()
+        admin = SnappyClient(address=f"127.0.0.1:{server.port}",
+                             user="admin", password="adminpw")
+        assert admin.execute("EXEC PYTHON 'result = [9]'")["rows"] == [[9]]
+        admin.close()
+    finally:
+        server.shutdown()
+
+
+def test_flight_login_with_ldap_provider(ldap_server):
+    from snappydata_tpu.cluster import SnappyClient
+
+    s = SnappySession()
+    s.sql("CREATE TABLE auth_lt (a INT) USING column")
+    s.sql("INSERT INTO auth_lt VALUES (7)")
+    s.sql("GRANT SELECT ON auth_lt TO alice")
+    provider = LdapAuthProvider(
+        f"ldap://127.0.0.1:{ldap_server.port}",
+        user_dn_template="uid={user},ou=people,dc=example,dc=com")
+    server = _serve_flight(s, provider)
+    try:
+        alice = SnappyClient(address=f"127.0.0.1:{server.port}",
+                             user="alice", password="wonderland")
+        assert alice.sql("SELECT a FROM auth_lt").column(0).to_pylist() == [7]
+        alice.close()
+        with pytest.raises(Exception, match="(?i)invalid credentials"):
+            SnappyClient(address=f"127.0.0.1:{server.port}",
+                         user="alice", password="red-queen").sql(
+                "SELECT a FROM auth_lt")
+    finally:
+        server.shutdown()
+
+
+def test_expired_login_token_triggers_transparent_relogin():
+    import time
+
+    from snappydata_tpu.cluster import SnappyClient
+
+    s = SnappySession()
+    s.sql("CREATE TABLE auth_exp (a INT) USING column")
+    s.sql("INSERT INTO auth_exp VALUES (1)")
+    server = _serve_flight(s, BuiltinAuthProvider({"admin": "pw"}))
+    server.TOKEN_TTL_S = 0.2   # instance override for the test
+    try:
+        c = SnappyClient(address=f"127.0.0.1:{server.port}",
+                         user="admin", password="pw")
+        assert c.sql("SELECT a FROM auth_exp").column(0).to_pylist() == [1]
+        time.sleep(0.3)        # token expires server-side
+        # the client re-logs-in transparently and the query still works
+        assert c.sql("SELECT a FROM auth_exp").column(0).to_pylist() == [1]
+        c.close()
+    finally:
+        server.shutdown()
+
+
+def test_internal_cluster_token_accepted_as_node_principal():
+    from snappydata_tpu.cluster import SnappyClient
+
+    s = SnappySession()   # node session is admin
+    s.sql("CREATE TABLE auth_int (a INT) USING column")
+    s.sql("INSERT INTO auth_int VALUES (4)")
+    from snappydata_tpu.cluster.flight_server import SnappyFlightServer
+
+    server = SnappyFlightServer(s, "127.0.0.1", 0,
+                                auth_provider=BuiltinAuthProvider({}),
+                                internal_token="cluster-secret")
+    threading.Thread(target=server.serve, daemon=True).start()
+    server.wait_ready(timeout=10)
+    try:
+        peer = SnappyClient(address=f"127.0.0.1:{server.port}",
+                            token="cluster-secret")
+        assert peer.sql(
+            "SELECT a FROM auth_int").column(0).to_pylist() == [4]
+        peer.close()
+        with pytest.raises(Exception, match="(?i)token|credential"):
+            SnappyClient(address=f"127.0.0.1:{server.port}",
+                         token="wrong").sql("SELECT a FROM auth_int")
+    finally:
+        server.shutdown()
+
+
+def test_rest_malformed_basic_header_is_401():
+    import urllib.error
+    import urllib.request
+
+    from snappydata_tpu.cluster.rest import RestService
+
+    s = SnappySession()
+    svc = RestService(s, None, host="127.0.0.1", port=0,
+                      auth_provider=BuiltinAuthProvider({"x": "y"})).start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{svc.port}/jobs", data=b"{}",
+            headers={"Content-Type": "application/json",
+                     "Authorization": "Basic %%%not-base64%%%"})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(req)
+        assert exc.value.code == 401
+    finally:
+        svc.stop()
+
+
+def test_rest_basic_auth_with_provider():
+    import base64
+    import json
+    import urllib.error
+    import urllib.request
+
+    from snappydata_tpu.cluster.rest import RestService
+
+    s = SnappySession()
+    s.sql("CREATE TABLE r (a INT) USING column")
+    s.sql("INSERT INTO r VALUES (5)")
+    s.sql("GRANT SELECT ON r TO dave")
+    provider = BuiltinAuthProvider({"dave": "davepw"})
+    svc = RestService(s, None, host="127.0.0.1", port=0,
+                      auth_provider=provider).start()
+    try:
+        url = f"http://127.0.0.1:{svc.port}/jobs"
+        payload = json.dumps({"sql": "SELECT a FROM r"}).encode()
+
+        def submit(headers):
+            req = urllib.request.Request(url, data=payload, headers={
+                "Content-Type": "application/json", **headers})
+            return json.loads(urllib.request.urlopen(req).read())
+
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            submit({})
+        assert exc.value.code == 401
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            bad = base64.b64encode(b"dave:wrongpw").decode()
+            submit({"Authorization": f"Basic {bad}"})
+        assert exc.value.code == 401
+        cred = base64.b64encode(b"dave:davepw").decode()
+        job = submit({"Authorization": f"Basic {cred}"})
+        status = None
+        import time
+        for _ in range(100):
+            status = json.loads(urllib.request.urlopen(
+                urllib.request.Request(
+                    f"http://127.0.0.1:{svc.port}/jobs/{job['jobId']}",
+                    headers={"Authorization": f"Basic {cred}"})).read())
+            if status.get("status") in ("FINISHED", "ERROR"):
+                break
+            time.sleep(0.05)
+        assert status["status"] == "FINISHED", status
+        assert status["rows"] == [[5]]
+    finally:
+        svc.stop()

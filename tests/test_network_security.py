@@ -8,7 +8,6 @@ LDAP hooks) and query routing runs per-connection sessions
 
 import json
 import threading
-import time
 import urllib.error
 import urllib.request
 
@@ -26,9 +25,7 @@ def _serve(session, auth_tokens=None):
                                 auth_tokens=auth_tokens)
     th = threading.Thread(target=server.serve, daemon=True)
     th.start()
-    deadline = time.time() + 5
-    while server.port == 0 and time.time() < deadline:
-        time.sleep(0.01)
+    server.wait_ready(timeout=10)
     return server
 
 
