@@ -28,6 +28,8 @@ class SnappyClient:
         self._token = token
         self._user = user
         self._password = password
+        self._catalog_cache: Optional[dict] = None
+        self._catalog_fetched_at = 0.0
         self._addresses: List[str] = []
         if address:
             self._addresses.append(address)
@@ -105,9 +107,11 @@ class SnappyClient:
             self._invalidate()   # reconnect → fresh login
             return once()
         except (flight.FlightUnavailableError, ConnectionError):
+            # ALWAYS drop the dead connection so the next call fails over;
+            # only re-issuing this request is gated on idempotency
+            self._invalidate()
             if not retry:
                 raise
-            self._invalidate()
             return once()
 
     def _action(self, name: str, body: dict, retry: bool = True) -> dict:
@@ -147,15 +151,22 @@ class SnappyClient:
         dict or a ready pyarrow Table."""
         arrow = columns if isinstance(columns, pa.Table) else \
             pa.table(columns)
-        conn = self._client()   # may log in and mint self._token
-        if self._token is not None:
-            descriptor = flight.FlightDescriptor.for_command(json.dumps(
-                {"table": table, "token": self._token}).encode("utf-8"))
-        else:
-            descriptor = flight.FlightDescriptor.for_path(table)
-        writer, _ = conn.do_put(descriptor, arrow.schema)
-        writer.write_table(arrow)
-        writer.close()
+
+        def once():
+            conn = self._client()   # may log in and mint self._token
+            if self._token is not None:
+                descriptor = flight.FlightDescriptor.for_command(
+                    json.dumps({"table": table,
+                                "token": self._token}).encode("utf-8"))
+            else:
+                descriptor = flight.FlightDescriptor.for_path(table)
+            writer, _ = conn.do_put(descriptor, arrow.schema)
+            writer.write_table(arrow)
+            writer.close()
+
+        # retry=False: an insert whose response was lost may have been
+        # applied — only expired-token re-login is safe to retry
+        self._request(once, retry=False)
 
     def repartition(self, body: dict) -> dict:
         """Ask this server to hash-repartition its shard of body['table']
@@ -190,6 +201,47 @@ class SnappyClient:
 
     def stats(self) -> dict:
         return self._action("stats", {})
+
+    # -- thin-client catalog (ref: ConnectorExternalCatalog's cached
+    # catalog tables keyed on catalog version, invalidated wholesale on
+    # any DDL — SmartConnectorExternalCatalog.invalidate) ---------------
+
+    # catalog snapshots are trusted this long before refetching — remote
+    # DDL (a bumped server generation) is observed within the TTL, like
+    # SmartConnectorExternalCatalog's version check per access
+    CATALOG_TTL_S = 5.0
+
+    def catalog(self, refresh: bool = False) -> dict:
+        """Full catalog metadata in ONE round trip: {generation, tables:
+        {name: {columns, provider, partition_by, buckets, ...}}, views}.
+        Served from cache within CATALOG_TTL_S; `refresh=True` or
+        `invalidate_catalog()` forces a refetch."""
+        import time
+
+        now = time.monotonic()
+        if self._catalog_cache is None or refresh or \
+                now - self._catalog_fetched_at > self.CATALOG_TTL_S:
+            self._catalog_cache = self._action("catalog", {})
+            self._catalog_fetched_at = now
+        return self._catalog_cache
+
+    def invalidate_catalog(self) -> None:
+        self._catalog_cache = None
+
+    def tables(self, refresh: bool = False) -> dict:
+        """table name → metadata (schema columns, provider, placement)."""
+        return self.catalog(refresh=refresh)["tables"]
+
+    def describe(self, table: str, refresh: bool = False) -> dict:
+        """One table's metadata; a miss refetches once before raising —
+        the cached snapshot may simply predate the table's DDL."""
+        name = table.lower().removeprefix("app.")
+        tables = self.tables(refresh=refresh)
+        if name not in tables and not refresh:
+            tables = self.tables(refresh=True)
+        if name not in tables:
+            raise KeyError(f"no such table: {table}")
+        return tables[name]
 
     def close(self) -> None:
         if self._conn is not None:

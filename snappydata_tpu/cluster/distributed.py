@@ -1116,18 +1116,19 @@ def _apply_outer(result, outer: List, planner, names=None):
             # resolve order refs against the result by name/position
             orders = []
             lower = [n.lower() for n in result.names]
-            for e, asc in op.orders:
+            for e, asc, *rest in op.orders:
+                nf = rest[0] if rest else None
                 target = e.child if isinstance(e, ast.Alias) else e
                 if isinstance(target, ast.Col) and \
                         target.name.lower() in lower:
                     idx = lower.index(target.name.lower())
                     orders.append((ast.Col(target.name, None, idx,
-                                           result.dtypes[idx]), asc))
+                                           result.dtypes[idx]), asc, nf))
                 elif isinstance(target, ast.Lit) and \
                         isinstance(target.value, int):
                     idx = target.value - 1
                     orders.append((ast.Col(result.names[idx], None, idx,
-                                           result.dtypes[idx]), asc))
+                                           result.dtypes[idx]), asc, nf))
                 else:
                     raise DistributedError(
                         "distributed ORDER BY must reference output "

@@ -101,6 +101,7 @@ class SnappyFlightServer(flight.FlightServerBase):
         self.auth_provider = auth_provider
         self.internal_token = internal_token
         self._issued_tokens: dict = {}   # token -> (user, expiry)
+        self._token_lock = threading.Lock()
         self.host = host
         self._location = location
 
@@ -130,6 +131,8 @@ class SnappyFlightServer(flight.FlightServerBase):
                                          authenticated=False)
         body = body or {}
         token = body.get("token")
+        if token is not None and not isinstance(token, str):
+            raise flight.FlightUnauthenticatedError("malformed token")
         user = None
         if token:
             import hmac as _hmac
@@ -144,12 +147,13 @@ class SnappyFlightServer(flight.FlightServerBase):
             if user is None:
                 import time as _t
 
-                entry = self._issued_tokens.get(token)
-                if entry is not None:
-                    if entry[1] > _t.time():
-                        user = entry[0]
-                    else:
-                        self._issued_tokens.pop(token, None)
+                with self._token_lock:
+                    entry = self._issued_tokens.get(token)
+                    if entry is not None:
+                        if entry[1] > _t.time():
+                            user = entry[0]
+                        else:
+                            self._issued_tokens.pop(token, None)
         if user is None and self.auth_provider is not None:
             # inline credentials (clients normally `login` once instead —
             # this path hits the provider, e.g. an LDAP bind, per request)
@@ -248,12 +252,13 @@ class SnappyFlightServer(flight.FlightServerBase):
             import time as _t
 
             now = _t.time()
-            # prune expired tokens so the table can't grow without bound
-            for stale in [t for t, (_, exp) in self._issued_tokens.items()
-                          if exp <= now]:
-                self._issued_tokens.pop(stale, None)
             tok = secrets.token_hex(16)
-            self._issued_tokens[tok] = (u, now + self.TOKEN_TTL_S)
+            with self._token_lock:
+                # prune expired tokens so the table can't grow unbounded
+                for stale in [t for t, (_, exp)
+                              in self._issued_tokens.items() if exp <= now]:
+                    self._issued_tokens.pop(stale, None)
+                self._issued_tokens[tok] = (u, now + self.TOKEN_TTL_S)
             yield flight.Result(json.dumps(
                 {"token": tok, "user": u}).encode("utf-8"))
         elif name == "checkpoint":
@@ -262,6 +267,15 @@ class SnappyFlightServer(flight.FlightServerBase):
                 raise flight.FlightServerError("checkpoint requires admin")
             self.session.checkpoint()
             yield flight.Result(b"{}")
+        elif name == "catalog":
+            # thin-client catalog protocol (ref: StoreHiveCatalog serving
+            # getCatalogMetadata to connectors; SmartConnectorExternalCatalog
+            # caches per catalog version and invalidates all entries on any
+            # DDL): one round trip returns the FULL table/view inventory
+            # plus the catalog generation the client caches against.
+            self._session_for(body)   # catalog metadata: credential gate
+            yield flight.Result(json.dumps(
+                self._catalog_payload()).encode("utf-8"))
         elif name == "stats":
             self._session_for(body)  # catalog metadata: token when auth on
             from snappydata_tpu.observability import TableStatsService
@@ -315,6 +329,34 @@ class SnappyFlightServer(flight.FlightServerBase):
             yield flight.Result(b'{"ok": true}')
         else:
             raise flight.FlightServerError(f"unknown action {name}")
+
+    def _catalog_payload(self) -> dict:
+        """Serialize the catalog: table schemas + placement metadata +
+        the generation DDL bumps (the connector's invalidation key)."""
+        catalog = self.session.catalog
+        tables = {}
+        for info in catalog.list_tables():
+            snap_rows = None
+            try:
+                snap_rows = int(info.data.snapshot().total_rows())
+            except Exception:
+                pass
+            tables[info.name] = {
+                "provider": info.provider,
+                "columns": [{"name": f.name, "type": str(f.dtype),
+                             "nullable": bool(f.nullable)}
+                            for f in info.schema.fields],
+                "key_columns": list(info.key_columns),
+                "partition_by": list(info.partition_by),
+                "buckets": info.buckets,
+                "colocate_with": info.colocate_with,
+                "redundancy": info.redundancy,
+                "base_table": info.base_table,
+                "row_count": snap_rows,
+            }
+        return {"generation": catalog.generation,
+                "tables": tables,
+                "views": sorted(catalog._views.keys())}
 
     def _repartition_shard(self, sess, table: str, key: str, dest: str,
                            servers, num_buckets: int,

@@ -91,6 +91,14 @@ def _render_dashboard(svc) -> str:
         f"<tr><td>{esc(str(q['sql']))[:120]}</td><td>{q['ms']}</td>"
         f"<td>{q['rows']}</td><td>{esc(str(q.get('user', '')))}</td></tr>"
         for q in recent)
+    streams = svc.session.streaming_queries()
+    rows_s = "".join(
+        f"<tr><td>{esc(str(q['name']))}</td><td>{esc(str(q['table']))}</td>"
+        f"<td>{'yes' if q['active'] else 'NO'}</td>"
+        f"<td>{q['batches_processed']}</td><td>{q['rows_processed']:,}</td>"
+        f"<td>{q['rows_per_s']:,}</td>"
+        f"<td>{esc(str(q['last_error'] or ''))[:80]}</td></tr>"
+        for q in streams)
     return f"""<!doctype html><html><head><title>snappydata_tpu</title>
 <style>body{{font-family:sans-serif;margin:2em}}table{{border-collapse:
 collapse;margin:1em 0}}td,th{{border:1px solid #ccc;padding:4px 10px;
@@ -101,6 +109,9 @@ text-align:left}}h2{{margin-top:1.5em}}</style></head><body>
 <h2>Tables ({len(tables)})</h2>
 <table><tr><th>table</th><th>provider</th><th>rows</th><th>batches</th>
 <th>bytes</th></tr>{rows_t}</table>
+<h2>Streaming queries ({len(streams)})</h2>
+<table><tr><th>query</th><th>table</th><th>active</th><th>batches</th>
+<th>rows</th><th>rows/s</th><th>last error</th></tr>{rows_s}</table>
 <h2>Counters</h2><table>{counters}</table>
 <h2>Recent queries ({len(recent)})</h2>
 <table><tr><th>sql</th><th>ms</th><th>rows</th><th>user</th></tr>{rows_q}
@@ -161,6 +172,13 @@ class RestService:
                                 "tables": svc.stats_service.current()})
                 elif path == "/status/api/v1/tables":
                     self._send(svc.stats_service.current())
+                elif path == "/status/api/v1/streaming":
+                    # streaming query progress (ref: the structured-
+                    # streaming UI tab / StreamingQueryManager.active);
+                    # last_error may embed SQL/data → same auth as /queries
+                    if self._principal_session() is None:
+                        return
+                    self._send(svc.session.streaming_queries())
                 elif path == "/status/api/v1/queries":
                     # query text leaks literals: same auth as /jobs
                     if self._principal_session() is None:

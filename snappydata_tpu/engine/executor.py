@@ -37,8 +37,9 @@ import numpy as np
 from snappydata_tpu import config
 from snappydata_tpu import types as T
 from snappydata_tpu.engine import hosteval
-from snappydata_tpu.engine.exprs import (CompileError, DVal, ExprBuilder,
-                                         Runtime, _or_null)
+from snappydata_tpu.engine.exprs import (STRING_VALUE_FUNCS, CompileError,
+                                         DVal, ExprBuilder, Runtime,
+                                         _or_null)
 from snappydata_tpu.engine.result import Result, empty_result
 from snappydata_tpu.sql import ast
 from snappydata_tpu.sql.analyzer import expr_type, _expr_name
@@ -506,7 +507,7 @@ class Compiler:
                 raise CompileError(f"window {wf.name}: host path")
             if wf.name in ("rank", "dense_rank") and not wf.order_by:
                 raise CompileError("rank without ORDER BY: host path")
-            for oe, _asc in wf.order_by:
+            for oe, *_ in wf.order_by:
                 odt = expr_type(oe)
                 if odt is None or odt.name in ("string", "array", "map"):
                     raise CompileError("window ORDER BY on non-numeric "
@@ -539,8 +540,9 @@ class Compiler:
             if gk not in groups:
                 groups[gk] = {
                     "part": [builder.emit(p) for p in wf.partition_by],
-                    "order": [(builder.emit(oe), asc)
-                              for oe, asc in wf.order_by],
+                    "order": [(builder.emit(o[0]), o[1],
+                               o[2] if len(o) > 2 else None)
+                              for o in wf.order_by],
                 }
             specs.append((wf, gk, arg_run, arg_dtype, offset))
 
@@ -594,17 +596,25 @@ class Compiler:
                     else jnp.zeros(n, dtype=jnp.int64)
                 pk = jnp.where(flatmask, pk, jnp.int64(_I64_MAX))
                 okeys = []
-                for r, asc in g["order"]:
+                for r, asc, nf in g["order"]:
                     v, nl = flat(r(rt))
                     if v.dtype == jnp.bool_:
                         v = v.astype(jnp.int32)
                     kv = v if asc else -v
-                    if nl is not None:  # NULLS LAST within the partition
-                        big = jnp.asarray(
-                            np.inf if jnp.issubdtype(kv.dtype, jnp.floating)
-                            else np.iinfo(np.dtype(kv.dtype.name)).max,
-                            dtype=kv.dtype)
-                        kv = jnp.where(nl, big, kv)
+                    if nl is not None:
+                        # Spark: ASC → NULLS FIRST, DESC → NULLS LAST,
+                        # unless an explicit NULLS FIRST/LAST overrides
+                        nulls_first = nf if nf is not None else asc
+                        if jnp.issubdtype(kv.dtype, jnp.floating):
+                            ext = jnp.asarray(
+                                -np.inf if nulls_first else np.inf,
+                                dtype=kv.dtype)
+                        else:
+                            info = np.iinfo(np.dtype(kv.dtype.name))
+                            ext = jnp.asarray(
+                                info.min if nulls_first else info.max,
+                                dtype=kv.dtype)
+                        kv = jnp.where(nl, ext, kv)
                     okeys.append(kv)
                 perm = jnp.lexsort(tuple(reversed(okeys)) + (pk,))
                 inv = jnp.argsort(perm)
@@ -831,10 +841,17 @@ class Compiler:
         equi, residual = _split_equi(plan.condition, nleft)
         if not equi:
             raise CompileError("non-equi join not supported on device")
-        if residual is not None and how in ("semi", "anti"):
+        if how in ("right", "full"):
+            # the device join only NULL-extends the PROBE side; right/full
+            # need unmatched BUILD rows too — host path (which implements
+            # the full pair/NULL-extension semantics)
+            raise CompileError(f"{how} outer join: host path")
+        if residual is not None and how != "inner":
+            # an ON-clause residual on an outer join NULL-extends failing
+            # pairs — the device's post-join filter would DROP them; and
             # semi/anti drop the right columns before the residual could
-            # run; host path evaluates it per matched pair
-            raise CompileError("semi/anti join with residual: host path")
+            # run. Host path evaluates residuals per candidate pair.
+            raise CompileError(f"{how} join with residual: host path")
 
         # The device join is sort+searchsorted: ONE build-side match per
         # probe row. That is exact only when the build (right) side is
@@ -1056,6 +1073,15 @@ class Compiler:
             gt = expr_type(g)
             if gt.name == "string":
                 provider = self._derived_dict_provider(g, scope)
+                if provider is None:
+                    raise CompileError(
+                        "string group key without a dictionary: host path")
+                base_g = g.child if isinstance(g, ast.Alias) else g
+                if not isinstance(base_g, ast.Col):
+                    # grouping is by CODE: a non-injective derived value
+                    # map (upper() collapsing 'a'/'A') would silently
+                    # split groups — verified per bind, host path if so
+                    provider = _unique_dict_or_host(provider)
                 si = self._add_static(
                     lambda p=provider: _padded_size(len(p())))
                 key_infos.append(("dict", si, provider))
@@ -1298,6 +1324,18 @@ class Compiler:
         if isinstance(base, ast.Col) and base.dtype is not None \
                 and base.dtype.name == "string":
             return scope[base.index].dict_provider
+        if isinstance(base, ast.Func) and base.name in STRING_VALUE_FUNCS:
+            # derivable transforms (concat(s, '_x'), upper(s), ...) share
+            # the base column's codes with a value-mapped dictionary
+            try:
+                ci, fn = self._builder_for(scope)._string_value_transform(
+                    base)
+            except CompileError:
+                return None
+            if ci is None or scope[ci].dict_provider is None:
+                return None
+            prov = scope[ci].dict_provider
+            return lambda: np.array([fn(v) for v in prov()], dtype=object)
         return None
 
 
@@ -1376,6 +1414,20 @@ def _dict_provider(info, ci):
     if isinstance(info.data, RowTableData):
         return lambda: info.data.string_dict(ci)
     return lambda: info.data.dictionary(ci)
+
+
+def _unique_dict_or_host(provider):
+    """Wrap a derived-dictionary provider: grouping relies on code↔value
+    bijection, so duplicate derived values reroute to the host path."""
+    def wrapped():
+        d = provider()
+        vals = d.tolist()
+        if len(set(vals)) != len(vals):
+            raise CompileError(
+                "derived group dictionary is not value-unique: host path")
+        return d
+
+    return wrapped
 
 
 def _padded_size(n: int) -> int:

@@ -43,6 +43,12 @@ class CompileError(Exception):
 # other operator); executor._validate_array_usage enforces the same set
 ARRAY_DEVICE_FUNCS = ("size", "element_at", "array_contains")
 
+# string-valued functions computable per-dictionary-value on the host and
+# carried as derived dictionaries (codes never leave the device)
+STRING_VALUE_FUNCS = frozenset(
+    {"upper", "lower", "trim", "ltrim", "rtrim", "substr", "substring",
+     "replace", "concat"})
+
 
 @dataclasses.dataclass
 class DVal:
@@ -56,6 +62,14 @@ class DVal:
     @property
     def is_string(self) -> bool:
         return self.dtype is not None and self.dtype.name == "string"
+
+
+def _no_string_operands(dvals, name: str) -> None:
+    """String DVals carry dictionary CODES — value comparisons across
+    columns would compare insertion order, not text. Host path instead."""
+    for d in dvals:
+        if d.dtype is not None and d.dtype.name == "string":
+            raise CompileError(f"{name} over string operands: host path")
 
 
 def _or_null(a, b):
@@ -251,10 +265,84 @@ class ExprBuilder:
         """If e is (an alias of) a raw string column, return its ordinal."""
         if isinstance(e, ast.Alias):
             return self._string_operand_info(e.child)
-        if isinstance(e, ast.Col) and e.dtype is not None \
-                and e.dtype.name == "string":
-            return e.index
+        if isinstance(e, ast.Col):
+            dt = e.dtype if e.dtype is not None \
+                else self.col_types.get(e.index)
+            if dt is not None and dt.name == "string":
+                return e.index
         return None
+
+    def _string_value_transform(self, e: ast.Expr):
+        """(col_idx | None, fn: dict value → derived value) for a
+        string-valued expression computable from ONE column's dictionary
+        values plus literals — compositions like upper(concat(s, '_x'))
+        included. col_idx None means literal-only. Raises CompileError
+        when not derivable (two columns, non-literal args, ...)."""
+        if isinstance(e, ast.Alias):
+            return self._string_value_transform(e.child)
+        if isinstance(e, ast.Lit):
+            lit = None if e.value is None else str(e.value)
+            return None, lambda v: lit
+        ci = self._string_operand_info(e)
+        if ci is not None:
+            return ci, lambda v: v
+        if not isinstance(e, ast.Func) or \
+                e.name not in STRING_VALUE_FUNCS:
+            raise CompileError("not a derivable string expression")
+        name = e.name
+        if name == "concat":
+            parts = [self._string_value_transform(a) for a in e.args]
+            cis = {c for c, _ in parts if c is not None}
+            if len(cis) > 1:
+                raise CompileError("concat over two string columns")
+
+            def fn_concat(v, parts=parts):
+                out = []
+                for _, pf in parts:
+                    pv = pf(v)
+                    if pv is None:   # SQL concat: any NULL → NULL
+                        return None
+                    out.append(pv)
+                return "".join(out)
+
+            return (cis.pop() if cis else None), fn_concat
+        ci, base = self._string_value_transform(e.args[0])
+        extra = []
+        for a in e.args[1:]:
+            if not isinstance(a, ast.Lit):
+                raise CompileError(f"{name} with non-literal args")
+            extra.append(a.value)
+
+        def op(v):
+            if v is None:
+                return None
+            if name == "upper":
+                return v.upper()
+            if name == "lower":
+                return v.lower()
+            if name == "trim":
+                return v.strip()
+            if name == "ltrim":
+                return v.lstrip()
+            if name == "rtrim":
+                return v.rstrip()
+            if name in ("substr", "substring"):
+                start = int(extra[0]) - 1 if extra and \
+                    extra[0] is not None else 0
+                ln = int(extra[1]) if len(extra) > 1 and \
+                    extra[1] is not None else None
+                return v[start:start + ln] if ln is not None else v[start:]
+            if name == "replace":
+                if not extra or extra[0] is None or \
+                        (len(extra) > 1 and extra[1] is None):
+                    # NULL search/replacement → NULL result (Spark):
+                    # host path implements that
+                    raise CompileError("replace with NULL argument")
+                return v.replace(str(extra[0]),
+                                 str(extra[1]) if len(extra) > 1 else "")
+            raise CompileError(name)
+
+        return ci, lambda v: op(base(v))
 
     def _emit_binop(self, e: ast.BinOp) -> Callable[[Runtime], DVal]:
         op = e.op
@@ -262,11 +350,16 @@ class ExprBuilder:
         if op in ("=", "!=", "<", "<=", ">", ">="):
             lcol = self._string_operand_info(e.left)
             rcol = self._string_operand_info(e.right)
-            if lcol is not None and self._is_literalish(e.right):
-                return self._emit_string_cmp(lcol, op, e.right)
-            if rcol is not None and self._is_literalish(e.left):
-                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
-                return self._emit_string_cmp(rcol, flip.get(op, op), e.left)
+            if self._is_literalish(e.right):
+                ci, fnt = self._try_string_transform(e.left)
+                if ci is not None:
+                    return self._emit_string_cmp(ci, op, e.right, fnt)
+            if self._is_literalish(e.left):
+                ci, fnt = self._try_string_transform(e.right)
+                if ci is not None:
+                    flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+                    return self._emit_string_cmp(ci, flip.get(op, op),
+                                                 e.left, fnt)
             if lcol is not None and rcol is not None:
                 return self._emit_string_colcmp(lcol, rcol, op)
 
@@ -325,16 +418,31 @@ class ExprBuilder:
 
         return run_bin
 
-    def _emit_string_cmp(self, col_idx: int, op: str, lit_expr
-                         ) -> Callable[[Runtime], DVal]:
+    def _try_string_transform(self, e: ast.Expr):
+        """(col_idx, value fn) when e is a derivable string expression of
+        one column (raw column included), else (None, None)."""
+        try:
+            ci, fnt = self._string_value_transform(e)
+        except CompileError:
+            return None, None
+        return (ci, fnt) if ci is not None else (None, None)
+
+    def _emit_string_cmp(self, col_idx: int, op: str, lit_expr,
+                         transform=None) -> Callable[[Runtime], DVal]:
         get_lit = (lambda params: self._param_value(lit_expr, params))
         ops = {"=": np.equal, "!=": np.not_equal,
                "<": np.less, "<=": np.less_equal,
                ">": np.greater, ">=": np.greater_equal}
         cmp = ops[op]
+        fnt = transform or (lambda v: v)
+
+        def one(v, params):
+            tv = fnt(v)
+            return tv is not None and bool(cmp(tv, get_lit(params)))
+
         aux_i = self._string_pred_lut(
             col_idx, lambda d, params: np.array(
-                [v is not None and bool(cmp(v, get_lit(params))) for v in d],
+                [one(v, params) for v in d],
                 dtype=np.bool_) if len(d) else np.zeros(0, np.bool_))
         return self._lut_runner(col_idx, aux_i)
 
@@ -350,9 +458,10 @@ class ExprBuilder:
 
         def run(rt: Runtime) -> DVal:
             a, b = rt.cols[li], rt.cols[ri]
-            if a.dictionary is not None and b.dictionary is not None and \
-                    a.dictionary is not b.dictionary and \
-                    list(a.dictionary) != list(b.dictionary):
+            da = a.dictionary() if callable(a.dictionary) else a.dictionary
+            db = b.dictionary() if callable(b.dictionary) else b.dictionary
+            if da is not None and db is not None and da is not db and \
+                    list(da) != list(db):
                 raise CompileError("cross-dictionary string comparison "
                                    "not supported on device")
             v = (a.value != b.value) if neg else (a.value == b.value)
@@ -454,7 +563,7 @@ class ExprBuilder:
         return run_in
 
     def _emit_like(self, e: ast.Like) -> Callable[[Runtime], DVal]:
-        col_idx = self._string_operand_info(e.child)
+        col_idx, fnt = self._try_string_transform(e.child)
         if col_idx is None:
             raise CompileError("LIKE requires a string column")
         # SQL LIKE: % = any run, _ = any single char
@@ -462,10 +571,14 @@ class ExprBuilder:
             "^" + re.escape(e.pattern).replace("%", ".*").replace("_", ".")
             .replace("\\%", "%").replace("\\_", "_") + "$", re.DOTALL)
         negated = e.negated
+
+        def one(v):
+            tv = fnt(v)
+            return tv is not None and regex.match(tv) is not None
+
         aux_i = self._string_pred_lut(
             col_idx, lambda d, params: np.array(
-                [v is not None and regex.match(v) is not None for v in d],
-                dtype=np.bool_))
+                [one(v) for v in d], dtype=np.bool_))
         base = self._lut_runner(col_idx, aux_i)
         if not negated:
             return base
@@ -680,12 +793,83 @@ class ExprBuilder:
 
             return run_datepart
 
-        # string functions via derived dictionaries
-        col_idx = self._string_operand_info(e.args[0]) if e.args else None
-        if col_idx is not None and name in ("upper", "lower", "trim",
-                                            "ltrim", "rtrim", "substr",
-                                            "substring", "length"):
-            return self._emit_string_func(e, col_idx)
+        if name == "sign":
+            return self._unary_math(args[0], lambda x: jnp.sign(
+                x.astype(_float_dtype())))
+        if name in ("floor", "ceil", "ceiling"):
+            jfn = jnp.floor if name == "floor" else jnp.ceil
+
+            def run_fc(rt: Runtime) -> DVal:
+                c = args[0](rt)
+                return DVal(jfn(c.value.astype(_float_dtype()))
+                            .astype(jnp.int64), c.null, T.LONG)
+
+            return run_fc
+        if name in ("mod", "pmod"):
+            pos = name == "pmod"
+
+            def run_mod(rt: Runtime) -> DVal:
+                a, b = args[0](rt), args[1](rt)
+                zero = b.value == 0
+                bs = jnp.where(zero, jnp.ones_like(b.value), b.value)
+                # mod keeps the dividend's sign (Spark %); pmod >= 0
+                out = jnp.mod(jnp.mod(a.value, bs) + bs, bs) if pos \
+                    else jnp.fmod(a.value, bs)
+                null = _or_null(_or_null(a.null, b.null),
+                                jnp.broadcast_to(zero, jnp.shape(out)))
+                return DVal(out, null, _promote(a.dtype, b.dtype))
+
+            return run_mod
+        if name == "nullif":
+            def run_nullif(rt: Runtime) -> DVal:
+                a, b = args[0](rt), args[1](rt)
+                _no_string_operands((a, b), name)
+                eq = a.value == b.value
+                if b.null is not None:
+                    eq = eq & ~b.null
+                return DVal(a.value,
+                            eq if a.null is None else (a.null | eq),
+                            a.dtype)
+
+            return run_nullif
+        if name in ("greatest", "least"):
+            pickmax = name == "greatest"
+
+            def run_gl(rt: Runtime) -> DVal:
+                dvs = [a(rt) for a in args]
+                _no_string_operands(dvs, name)
+                dt = None
+                for d in dvs:
+                    dt = _promote(dt, d.dtype)
+                np_dt = dt.device_dtype()
+                if jnp.issubdtype(np_dt, jnp.floating):
+                    ident = -jnp.inf if pickmax else jnp.inf
+                else:
+                    info = np.iinfo(np_dt)
+                    ident = info.min if pickmax else info.max
+                acc = None
+                for d in dvs:
+                    v = d.value.astype(np_dt)
+                    if d.null is not None:
+                        # a NULL argument is skipped, not contagious
+                        v = jnp.where(d.null, ident, v)
+                    acc = v if acc is None else (
+                        jnp.maximum(acc, v) if pickmax
+                        else jnp.minimum(acc, v))
+                if any(d.null is None for d in dvs):
+                    out_null = None   # NULL only when EVERY arg is NULL
+                else:
+                    out_null = dvs[0].null
+                    for d in dvs[1:]:
+                        out_null = out_null & d.null
+                return DVal(acc, out_null, dt)
+
+            return run_gl
+
+        # string functions via derived dictionaries (incl. compositions:
+        # upper(concat(s, '_x')), instr(lower(s), 'q'), ...)
+        if name in STRING_VALUE_FUNCS or name in ("length", "instr"):
+            return self._emit_string_func(e)
 
         raise CompileError(f"unsupported function on device: {name}")
 
@@ -697,16 +881,35 @@ class ExprBuilder:
 
         return run
 
-    def _emit_string_func(self, e: ast.Func, col_idx: int
-                          ) -> Callable[[Runtime], DVal]:
+    def _emit_string_func(self, e: ast.Func) -> Callable[[Runtime], DVal]:
+        """String expressions as DERIVED DICTIONARIES: codes stay on
+        device untouched; the per-distinct-value transform runs once over
+        the (small) dictionary on the host. length/instr additionally
+        lower to int LUT gathers so they compose with device filters."""
         name = e.name
-        getter = self.dict_getters[col_idx]
 
-        if name == "length":
-            def build_len(params):
+        if name in ("length", "instr"):
+            col_idx, base = self._string_value_transform(e.args[0])
+            if col_idx is None:
+                raise CompileError(f"{name} of literal-only expression")
+            if name == "instr":
+                if len(e.args) < 2 or not isinstance(e.args[1], ast.Lit):
+                    raise CompileError("instr with non-literal needle")
+                needle = str(e.args[1].value)
+
+                def val_of(v):
+                    bv = base(v)
+                    return bv.find(needle) + 1 if bv is not None else 0
+            else:
+                def val_of(v):
+                    bv = base(v)
+                    return len(bv) if bv is not None else 0
+
+            getter = self.dict_getters[col_idx]
+
+            def build_ilut(params):
                 d = getter()
-                lut = np.array([len(v) if v is not None else 0 for v in d],
-                               dtype=np.int32)
+                lut = np.array([val_of(v) for v in d], dtype=np.int32)
                 n = max(1, len(lut))
                 padded = 1 << (n - 1).bit_length()
                 if padded > len(lut):
@@ -714,43 +917,28 @@ class ExprBuilder:
                                                         np.int32)])
                 return lut
 
-            aux_i = self._register_aux(build_len)
+            aux_i = self._register_aux(build_ilut)
 
-            def run_len(rt: Runtime) -> DVal:
+            def run_ilut(rt: Runtime) -> DVal:
                 c = rt.cols[col_idx]
                 return DVal(rt.aux[aux_i][c.value], c.null, T.INT)
 
-            return run_len
+            return run_ilut
 
-        # value-to-value string transforms: derived dictionary, same codes
-        extra = [a.value if isinstance(a, ast.Lit) else None
-                 for a in e.args[1:]]
+        col_idx, fn = self._string_value_transform(e)
+        if col_idx is None:
+            raise CompileError("literal-only string expression")
+        getter = self.dict_getters[col_idx]
 
-        def transform(v: str):
-            if v is None:
-                return None
-            if name == "upper":
-                return v.upper()
-            if name == "lower":
-                return v.lower()
-            if name == "trim":
-                return v.strip()
-            if name == "ltrim":
-                return v.lstrip()
-            if name == "rtrim":
-                return v.rstrip()
-            if name in ("substr", "substring"):
-                start = int(extra[0]) - 1 if extra and extra[0] is not None else 0
-                ln = int(extra[1]) if len(extra) > 1 and extra[1] is not None \
-                    else None
-                return v[start:start + ln] if ln is not None else v[start:]
-            raise CompileError(name)
+        def derived_dict():
+            # CALLABLE dictionary: re-derived from the CURRENT table
+            # dictionary at assemble time, so codes minted after this
+            # plan was traced still decode correctly
+            return np.array([fn(v) for v in getter()], dtype=object)
 
         def run_strfn(rt: Runtime) -> DVal:
             c = rt.cols[col_idx]
-            d = getter()
-            derived = np.array([transform(v) for v in d], dtype=object)
-            return DVal(c.value, c.null, T.STRING, dictionary=derived)
+            return DVal(c.value, c.null, T.STRING, dictionary=derived_dict)
 
         return run_strfn
 

@@ -232,6 +232,63 @@ def _eval_func(e: ast.Func, cols, nulls, params, n):
         v, nl = args[0]
         return np.array([len(str(x)) if x is not None else 0 for x in v],
                         dtype=np.int32), nl
+    if name == "nullif":
+        a_v, a_n = args[0]
+        b_v, b_n = args[1]
+        av = np.broadcast_to(a_v, (n,))
+        eq = _safe_cmp(av, np.broadcast_to(b_v, (n,)), "=")
+        if b_n is not None:
+            eq = eq & ~np.broadcast_to(b_n, (n,))
+        out_n = np.array(eq, copy=True)
+        if a_n is not None:
+            out_n |= np.broadcast_to(a_n, (n,))
+        return np.array(av, copy=True), (out_n if out_n.any() else None)
+    if name in ("floor", "ceil", "ceiling"):
+        fn = np.floor if name == "floor" else np.ceil
+        return fn(np.asarray(args[0][0]).astype(np.float64)) \
+            .astype(np.int64), args[0][1]
+    if name in ("mod", "pmod"):
+        a_v = np.broadcast_to(args[0][0], (n,))
+        b_v = np.broadcast_to(args[1][0], (n,))
+        nl = _or_null(args[0][1], args[1][1])
+        zero = b_v == 0
+        if zero.any():
+            nl = _or_null(nl, zero)
+        b_safe = np.where(zero, 1, b_v)
+        # mod keeps the dividend's sign (Spark %); pmod is non-negative
+        out = np.fmod(a_v, b_safe) if name == "mod" \
+            else np.mod(np.mod(a_v, b_safe) + b_safe, b_safe)
+        return out, nl
+    if name in ("greatest", "least"):
+        vs = np.stack([np.asarray(np.broadcast_to(a[0], (n,)))
+                       for a in args])
+        nls = np.stack([np.broadcast_to(a[1], (n,)) if a[1] is not None
+                        else np.zeros(n, dtype=bool) for a in args])
+        masked = np.ma.masked_array(vs, mask=nls)
+        picked = masked.max(axis=0) if name == "greatest" \
+            else masked.min(axis=0)
+        out_n = nls.all(axis=0)   # NULL only when every argument is NULL
+        return np.asarray(picked.filled(0)), (out_n if out_n.any()
+                                              else None)
+    if name == "replace":
+        v, nl = args[0]
+        if args[1][1] is not None or \
+                (len(args) > 2 and args[2][1] is not None):
+            # Spark: NULL search/replacement → NULL result
+            return np.full(n, None, dtype=object), np.ones(n, dtype=bool)
+        search = str(np.asarray(args[1][0]).flat[0])
+        repl = str(np.asarray(args[2][0]).flat[0]) if len(args) > 2 else ""
+        return np.array([str(x).replace(search, repl)
+                         if x is not None else None for x in v],
+                        dtype=object), nl
+    if name == "sign":
+        return np.sign(np.asarray(args[0][0]).astype(np.float64)), \
+            args[0][1]
+    if name == "instr":
+        v, nl = args[0]
+        sub = str(np.asarray(args[1][0]).flat[0])
+        return np.array([str(x).find(sub) + 1 if x is not None else 0
+                         for x in v], dtype=np.int32), nl
     if name == "array":
         vs = [np.broadcast_to(a[0], (n,)) for a in args]
         nls = [a[1] for a in args]
@@ -392,7 +449,10 @@ def sort(result: Result, orders, params) -> Result:
     if n == 0:
         return result
     keys = []
-    for e, asc in reversed(list(orders)):
+    for item in reversed(list(orders)):
+        e, asc = item[0], item[1]
+        nulls_first = item[2] if len(item) > 2 and item[2] is not None \
+            else asc   # Spark default: ASC → NULLS FIRST, DESC → LAST
         v, nl = eval_expr(e, result.columns, result.nulls, params, n)
         v = np.broadcast_to(v, (n,))
         isnull = np.broadcast_to(nl, (n,)).copy() if nl is not None \
@@ -410,9 +470,8 @@ def sort(result: Result, orders, params) -> Result:
             else:
                 v = -v
         keys.append(v)
-        # Spark semantics: ASC → NULLS FIRST, DESC → NULLS LAST; in both
-        # cases nulls carry indicator 0(first)/1(last) sorted ascending
-        keys.append(~isnull if asc else isnull.astype(np.int8))
+        # null indicator sorts ascending: False before True
+        keys.append(~isnull if nulls_first else isnull)
     idx = np.lexsort(keys) if keys else np.arange(n)
     return _take(result, idx)
 
@@ -576,12 +635,19 @@ def _window_values(w, cols, nulls, params, n):
     # intra-partition order
     if w.order_by:
         order_keys = []
-        for e, asc in reversed(list(w.order_by)):
-            v, _ = eval_expr(e, cols, nulls, params, n)
+        for item in reversed(list(w.order_by)):
+            e, asc = item[0], item[1]
+            nulls_first = item[2] if len(item) > 2 and item[2] is not None \
+                else asc   # Spark: ASC → NULLS FIRST, DESC → NULLS LAST
+            v, nl = eval_expr(e, cols, nulls, params, n)
             v = np.broadcast_to(v, (n,))
+            isnull = np.broadcast_to(nl, (n,)).copy() if nl is not None \
+                else np.zeros(n, dtype=bool)
             if v.dtype == object:
+                isnull = isnull | np.array([x is None for x in v])
                 v = np.array([str(x) if x is not None else "" for x in v])
             order_keys.append(v if asc else _desc_key(v))
+            order_keys.append(~isnull if nulls_first else isnull)
         order_keys.append(group_ids)
         sorted_idx = np.lexsort(order_keys)
     else:
@@ -598,7 +664,7 @@ def _window_values(w, cols, nulls, params, n):
     if name in ("rank", "dense_rank"):
         # tie groups: consecutive sorted rows equal on ALL order keys
         ok_sorted = []
-        for e, _asc in w.order_by:
+        for e, *_ in w.order_by:
             v, _ = eval_expr(e, cols, nulls, params, n)
             v = np.broadcast_to(v, (n,))
             if v.dtype == object:
@@ -674,7 +740,7 @@ def _window_values(w, cols, nulls, params, n):
             # order keys) share the frame: compute running values, then
             # take the LAST value of each tie group
             ok_sorted = []
-            for e, _asc in w.order_by:
+            for e, *_ in w.order_by:
                 vv, _ = eval_expr(e, cols, nulls, params, n)
                 vv = np.broadcast_to(vv, (n,))
                 if vv.dtype == object:
@@ -841,12 +907,12 @@ def _eval_join(plan: ast.Join, params, executor):
             isnull |= np.array([v is None for v in arr])
         return isnull
 
-    sentineled: List[str] = []
-
     def _null_proof_pair(li, rj):
         """SQL: NULL join keys never match — but pandas merge matches
         NaN==NaN. Replace null-key entries with side-unique sentinels
-        (and move both sides to object dtype so the merge still works)."""
+        (and move both sides to object dtype so the merge still works).
+        Output values are taken from the ORIGINAL arrays by row index,
+        so sentinels never leak into results."""
         lname, rname = f"l{li}", f"r{rj}"
         lmask = _null_mask_of(ldf, lname, lc[li], ln[li])
         rmask = _null_mask_of(rdf, rname, rc[rj], rn[rj])
@@ -858,7 +924,6 @@ def _eval_join(plan: ast.Join, params, executor):
         robj = rdf[rname].astype(object).copy()
         robj[rmask] = [f"__Rnull{i}" for i in np.flatnonzero(rmask)]
         rdf[rname] = robj
-        sentineled.extend([lname, rname])
 
     equi = []
     residual = None
@@ -885,96 +950,83 @@ def _eval_join(plan: ast.Join, params, executor):
     flatten(plan.condition)
     for li, rj in equi:
         _null_proof_pair(li, rj)
-    how = {"inner": "inner", "left": "left", "right": "right",
-           "full": "outer", "cross": "cross"}.get(plan.how)
-    if how is None:  # semi/anti
-        lk = [f"l{i}" for i, _ in equi]
-        rk = [f"r{j}" for _, j in equi]
-        if residual is None:
-            merged = ldf.merge(rdf[rk].drop_duplicates(), left_on=lk,
-                               right_on=rk, how="left", indicator=True)
-            hit_mask = (merged["_merge"] == "both").to_numpy()
-        else:
-            # EXISTS with extra non-equi correlation (TPC-H Q21's
-            # l2.suppkey <> l1.suppkey): pair up on the equi keys, apply
-            # the residual per pair, keep left rows with ≥1 surviving pair
-            ldf2 = ldf.copy()
-            ldf2["__rowid"] = np.arange(len(ldf2))
-            merged = ldf2.merge(rdf, left_on=lk, right_on=rk, how="inner")
-            mn = len(merged)
-            mcols, mnulls = [], []
-            for i, dt in enumerate(lt):
-                s = merged[f"l{i}"]
-                mcols.append(_from_pandas(s, dt))
-                mnulls.append(s.isna().to_numpy() if s.isna().any()
-                              else None)
-            for j, dt in enumerate(rt):
-                s = merged[f"r{j}"]
-                mcols.append(_from_pandas(s, dt))
-                mnulls.append(s.isna().to_numpy() if s.isna().any()
-                              else None)
-            v, nl2 = eval_expr(residual, mcols, mnulls, params, mn)
-            ok = np.broadcast_to(v, (mn,)).astype(bool)
-            if nl2 is not None:
-                ok = ok & ~nl2
-            hit_ids = merged["__rowid"].to_numpy()[ok]
-            hit_mask = np.zeros(len(ldf), dtype=bool)
-            hit_mask[hit_ids] = True
-        keep = hit_mask if plan.how == "semi" else ~hit_mask
+    nl_rows, nr_rows = len(ldf), len(rdf)
+
+    # 1) candidate (left,right) ROW-INDEX pairs: equi keys via pandas
+    #    inner merge, otherwise the cross product. Values are then taken
+    #    from the ORIGINAL arrays by index, so merge dtype mangling and
+    #    sentinel restoration never touch the output.
+    if equi:
+        ldf["__lrow"] = np.arange(nl_rows)
+        rdf["__rrow"] = np.arange(nr_rows)
+        pairs = ldf.merge(rdf, left_on=[f"l{i}" for i, _ in equi],
+                          right_on=[f"r{j}" for _, j in equi], how="inner")
+        lpair = pairs["__lrow"].to_numpy()
+        rpair = pairs["__rrow"].to_numpy()
+    else:
+        lpair = np.repeat(np.arange(nl_rows), nr_rows)
+        rpair = np.tile(np.arange(nr_rows), nl_rows)
+
+    # 2) residual ON-condition applied PER PAIR — an outer join's
+    #    failing pairs must NULL-extend, not drop (ON-clause semantics)
+    if residual is not None and len(lpair):
+        mn = len(lpair)
+        mcols = [c[lpair] for c in lc] + [c[rpair] for c in rc]
+        mnulls = [nm[lpair] if nm is not None else None for nm in ln] + \
+                 [nm[rpair] if nm is not None else None for nm in rn]
+        v, nl2 = eval_expr(residual, mcols, mnulls, params, mn)
+        ok = np.broadcast_to(v, (mn,)).astype(bool)
+        if nl2 is not None:
+            ok = ok & ~np.broadcast_to(nl2, (mn,))
+        lpair, rpair = lpair[ok], rpair[ok]
+
+    # 3) dispatch on join kind
+    if plan.how in ("semi", "anti"):
+        hit = np.zeros(nl_rows, dtype=bool)
+        hit[lpair] = True
+        keep = hit if plan.how == "semi" else ~hit
         idx = np.nonzero(keep)[0]
         return ([c[idx] for c in lc],
                 [nm[idx] if nm is not None else None for nm in ln],
                 lnames, lt, len(idx))
-    if how == "cross":
-        merged = ldf.merge(rdf, how="cross")
-    else:
-        merged = ldf.merge(rdf, left_on=[f"l{i}" for i, _ in equi],
-                           right_on=[f"r{j}" for _, j in equi], how=how)
-    # restore NULLs where sentinels rode through (outer joins keep them)
-    for name in set(sentineled):
-        if name in merged.columns:
-            col = merged[name]
-            hit = col.apply(lambda v: isinstance(v, str)
-                            and (v.startswith("__Lnull")
-                                 or v.startswith("__Rnull")))
-            if hit.any():
-                merged[name] = col.where(~hit, np.nan)
-    n = len(merged)
+    l_idx, r_idx = lpair, rpair
+    if plan.how in ("left", "full"):
+        miss = np.setdiff1d(np.arange(nl_rows), lpair)
+        l_idx = np.concatenate([l_idx, miss])
+        r_idx = np.concatenate([r_idx, np.full(len(miss), -1)])
+    if plan.how in ("right", "full"):
+        miss = np.setdiff1d(np.arange(nr_rows), rpair)
+        l_idx = np.concatenate([l_idx, np.full(len(miss), -1)])
+        r_idx = np.concatenate([r_idx, miss])
+
+    def take(arr, nm, idx, dt):
+        """arr[idx] with idx == -1 meaning the NULL-extended side."""
+        ext = idx < 0
+        if len(arr) == 0:
+            vals = np.zeros(len(idx), dtype=dt.np_dtype)
+        else:
+            vals = np.asarray(arr)[np.where(ext, 0, idx)]
+        null = ext.copy()
+        if nm is not None:
+            null |= np.where(ext, True, np.asarray(nm)[np.where(ext, 0,
+                                                               idx)])
+        if vals.dtype == object:
+            vals = vals.copy()
+            vals[null] = None
+        elif ext.any():
+            vals = np.where(ext, np.zeros(1, dtype=vals.dtype), vals)
+        return vals, (null if null.any() else None)
+
     cols, nulls = [], []
     for i, dt in enumerate(lt):
-        s = merged[f"l{i}"]
-        cols.append(_from_pandas(s, dt))
-        nulls.append(s.isna().to_numpy() if s.isna().any() else None)
+        v, nm2 = take(lc[i], ln[i], l_idx, dt)
+        cols.append(v)
+        nulls.append(nm2)
     for j, dt in enumerate(rt):
-        s = merged[f"r{j}"]
-        cols.append(_from_pandas(s, dt))
-        nulls.append(s.isna().to_numpy() if s.isna().any() else None)
-    names = lnames + rnames
-    dtypes = lt + rt
-    res_cols, res_nulls, res_n = cols, nulls, n
-    if residual is not None:
-        v, nl2 = eval_expr(residual, cols, nulls, params, n)
-        keep = np.broadcast_to(v, (n,)).astype(bool)
-        if nl2 is not None:
-            keep &= ~nl2
-        idx = np.nonzero(keep)[0]
-        res_cols = [c[idx] for c in cols]
-        res_nulls = [nm[idx] if nm is not None else None for nm in nulls]
-        res_n = len(idx)
-    return res_cols, res_nulls, names, dtypes, res_n
-
-
-def _from_pandas(s, dt):
-    if dt.name == "string":
-        return s.astype(object).where(~s.isna(), None).to_numpy(dtype=object)
-    arr = s.to_numpy()
-    if arr.dtype == object or np.issubdtype(arr.dtype, np.floating):
-        filled = np.where(s.isna().to_numpy(), 0, arr)
-        try:
-            return filled.astype(dt.np_dtype)
-        except (ValueError, TypeError):
-            return filled
-    return arr
+        v, nm2 = take(rc[j], rn[j], r_idx, dt)
+        cols.append(v)
+        nulls.append(nm2)
+    return cols, nulls, lnames + rnames, lt + rt, len(l_idx)
 
 
 def _eval_aggregate(plan: ast.Aggregate, params, executor):

@@ -927,8 +927,8 @@ class SnappySession:
                     corr_residual.append(c)
                     continue
                 inner_only.append(c)
-            if not corr:
-                return None
+            if not corr and not corr_residual:
+                return None   # uncorrelated: not this rewrite's job
             if want_select:
                 return inner_rel, corr, inner_only, select_exprs, \
                     corr_residual
@@ -963,7 +963,7 @@ class SnappySession:
             if not isinstance(inner, ast.Filter):
                 return None
             got = split_correlation(inner, None)
-            if got is None or got[3]:
+            if got is None or got[3] or not got[1]:
                 return None  # non-equi correlation: can't group-then-join
             inner_rel, corr, inner_only, _res = got
             # every column in the select must belong to the inner scope
@@ -1245,6 +1245,12 @@ class SnappySession:
         self.catalog._streams[tname] = query
         query.start()
         return _status()
+
+    def streaming_queries(self) -> List[dict]:
+        """Progress of every registered stream (ref:
+        StreamingQueryManager.active + the structured-streaming UI)."""
+        return [q.progress() for q in
+                getattr(self.catalog, "_streams", {}).values()]
 
     def stream_source(self, table: str):
         """The MemorySource feeding a memory_stream table (programmatic
@@ -1619,7 +1625,7 @@ def _referenced_tables(plan: ast.Plan):
         if isinstance(p, ast.Values):
             return [e for row in p.rows for e in row]
         if isinstance(p, ast.Sort):
-            return [e for e, _ in p.orders]
+            return [e for e, *_ in p.orders]
         return []
 
     rec(plan)

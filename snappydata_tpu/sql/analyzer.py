@@ -166,10 +166,20 @@ def expr_type(e: ast.Expr) -> T.DataType:
                     return ft
             return T.STRING
         if low in ("substr", "substring", "upper", "lower", "trim", "concat",
-                   "ltrim", "rtrim"):
+                   "ltrim", "rtrim", "replace"):
             return T.STRING
-        if low in ("sqrt", "exp", "ln", "log", "pow", "power", "round"):
+        if low in ("sqrt", "exp", "ln", "log", "pow", "power", "round",
+                   "sign"):
             return T.DOUBLE
+        if low == "nullif":
+            return expr_type(e.args[0])
+        if low in ("floor", "ceil", "ceiling"):
+            return T.LONG
+        if low in ("mod", "pmod", "greatest", "least"):
+            t = expr_type(e.args[0])
+            for a in e.args[1:]:
+                t = T.common_type(t, expr_type(a))
+            return t
         if e.dtype is not None:
             return e.dtype
         raise AnalysisError(f"unknown function: {e.name}")
@@ -326,10 +336,12 @@ class Analyzer:
             child, scope = self.analyze_plan(plan.child)
             orders = []
             hidden: List[ast.Expr] = []
-            for e, asc in plan.orders:
+            for e, asc, *rest in plan.orders:
+                nf = rest[0] if rest else None
                 try:
                     orders.append(
-                        (self._resolve_order_expr(e, scope, child), asc))
+                        (self._resolve_order_expr(e, scope, child), asc,
+                         nf))
                 except AnalysisError:
                     # ORDER BY an input column absent from the select list:
                     # append a hidden projection, sort, then trim
@@ -342,7 +354,7 @@ class Analyzer:
                     orders.append((ast.Col(
                         f"__sort{len(hidden) - 1}", None,
                         len(child.exprs) + len(hidden) - 1,
-                        expr_type(resolved)), asc))
+                        expr_type(resolved)), asc, nf))
             if hidden:
                 widened_cls = type(child)
                 widened = widened_cls(
@@ -541,6 +553,12 @@ class Analyzer:
 # Literal tokenization (plan-cache key normalization)
 # --------------------------------------------------------------------------
 
+# literal args of these functions stay literal under tokenization: they
+# derive string dictionaries at compile time (see exprs._emit_string_func)
+_STRUCTURAL_LIT_FUNCS = frozenset(
+    {"substr", "substring", "replace", "instr", "concat"})
+
+
 def tokenize_plan(plan: ast.Plan) -> Tuple[ast.Plan, Tuple[Any, ...]]:
     """Replace every Lit in expression position with ParamLiteral(pos),
     collecting values — the tokenized plan is the plan-cache key and the
@@ -551,6 +569,15 @@ def tokenize_plan(plan: ast.Plan) -> Tuple[ast.Plan, Tuple[Any, ...]]:
 
     def tok_expr(e: ast.Expr) -> ast.Expr:
         def rec(node: ast.Expr) -> ast.Expr:
+            if isinstance(node, ast.Func) and \
+                    node.name in _STRUCTURAL_LIT_FUNCS:
+                # these functions' literal args are STRUCTURAL (they shape
+                # derived string dictionaries, like a LIKE pattern) — a
+                # tokenized substr(s, 2) rebound to substr(s, 3) would
+                # silently reuse the start=2 derived dictionary
+                return dataclasses.replace(node, args=tuple(
+                    a if isinstance(a, ast.Lit) else rec(a)
+                    for a in node.args))
             if isinstance(node, ast.Lit) and node.value is not None:
                 params.append(T.python_value(node.dtype, node.value)
                               if node.dtype else node.value)
@@ -589,8 +616,9 @@ def tokenize_plan(plan: ast.Plan) -> Tuple[ast.Plan, Tuple[Any, ...]]:
             cond = tok_expr(p.condition) if p.condition is not None else None
             return ast.Join(tok(p.left), tok(p.right), p.how, cond)
         if isinstance(p, ast.Sort):
-            return ast.Sort(tok(p.child), tuple((tok_expr(e), a)
-                                                for e, a in p.orders))
+            return ast.Sort(tok(p.child),
+                            tuple((tok_expr(o[0]),) + tuple(o[1:])
+                                  for o in p.orders))
         if isinstance(p, ast.Limit):
             return ast.Limit(tok(p.child), p.n)
         if isinstance(p, ast.Distinct):
@@ -638,7 +666,8 @@ def assign_param_positions(plan: ast.Plan, offset: int) -> ast.Plan:
             return ast.Join(fix(p.left), fix(p.right), p.how, cond)
         if isinstance(p, ast.Sort):
             return ast.Sort(fix(p.child),
-                            tuple((fix_expr(e), a) for e, a in p.orders))
+                            tuple((fix_expr(o[0]),) + tuple(o[1:])
+                                  for o in p.orders))
         if isinstance(p, ast.Limit):
             return ast.Limit(fix(p.child), p.n)
         if isinstance(p, ast.Distinct):

@@ -240,18 +240,20 @@ class WindowFunc(Expr):
     name: str = ""
     args: Tuple[Expr, ...] = ()
     partition_by: Tuple[Expr, ...] = ()
-    order_by: Tuple[Tuple[Expr, bool], ...] = ()
+    # (expr, ascending, nulls_first) — nulls_first None = Spark default
+    order_by: Tuple[Tuple[Expr, bool, Optional[bool]], ...] = ()
     dtype: Optional["T.DataType"] = None
 
     def children(self):
         return tuple(self.args) + tuple(self.partition_by) + tuple(
-            e for e, _ in self.order_by)
+            e for e, *_ in self.order_by)
 
     def map_children(self, fn):
         return dataclasses.replace(
             self, args=tuple(fn(a) for a in self.args),
             partition_by=tuple(fn(p) for p in self.partition_by),
-            order_by=tuple((fn(e), a) for e, a in self.order_by))
+            order_by=tuple((fn(o[0]),) + tuple(o[1:])
+                           for o in self.order_by))
 
 
 WINDOW_FUNCS = {"row_number", "rank", "dense_rank", "lag", "lead",
@@ -371,7 +373,9 @@ class Join(Plan):
 @dataclasses.dataclass(frozen=True)
 class Sort(Plan):
     child: Plan
-    orders: Tuple[Tuple[Expr, bool], ...]  # (expr, ascending)
+    # (expr, ascending, nulls_first) — nulls_first None = Spark default
+    # (ASC → NULLS FIRST, DESC → NULLS LAST)
+    orders: Tuple[Tuple[Expr, bool, Optional[bool]], ...]
 
     def children(self):
         return (self.child,)
@@ -438,7 +442,7 @@ def plan_exprs(p: Plan):
         if p.condition is not None:
             yield p.condition
     elif isinstance(p, Sort):
-        for e, _asc in p.orders:
+        for e, *_ in p.orders:
             yield e
 
 
@@ -461,7 +465,7 @@ def transform_plan_exprs(p: Plan, fn) -> Plan:
                     t(p.condition) if p.condition is not None else None)
     if isinstance(p, Sort):
         return Sort(transform_plan_exprs(p.child, fn),
-                    tuple((t(e), a) for e, a in p.orders))
+                    tuple((t(o[0]),) + tuple(o[1:]) for o in p.orders))
     if isinstance(p, Limit):
         return Limit(transform_plan_exprs(p.child, fn), p.n)
     if isinstance(p, Distinct):

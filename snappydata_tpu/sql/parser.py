@@ -263,17 +263,24 @@ class Parser:
             plan = ast.Limit(plan, int(t.value))
         return plan
 
-    def sort_item(self) -> Tuple[ast.Expr, bool]:
+    def sort_item(self) -> Tuple[ast.Expr, bool, Optional[bool]]:
+        """(expr, ascending, nulls_first) — nulls_first None means the
+        Spark default (ASC → NULLS FIRST, DESC → NULLS LAST)."""
         e = self.expr()
         asc = True
         if self.accept_kw("desc"):
             asc = False
         else:
             self.accept_kw("asc")
+        nulls_first = None
         if self.accept_kw("nulls"):
-            if not (self.accept_kw("first") or self.accept_kw("last")):
+            if self.accept_kw("first"):
+                nulls_first = True
+            elif self.accept_kw("last"):
+                nulls_first = False
+            else:
                 raise SQLSyntaxError("expected FIRST or LAST after NULLS")
-        return (e, asc)
+        return (e, asc, nulls_first)
 
     def select_item(self) -> ast.Expr:
         if self.at_op("*"):

@@ -137,8 +137,14 @@ def render_plan(p: ast.Plan) -> str:
     if having is not None:
         parts.append("HAVING " + render_expr(having))
     if orders:
-        parts.append("ORDER BY " + ", ".join(
-            render_expr(e) + ("" if asc else " DESC") for e, asc in orders))
+        def _ord(o):
+            sql = render_expr(o[0]) + ("" if o[1] else " DESC")
+            nf = o[2] if len(o) > 2 else None
+            if nf is not None:
+                sql += " NULLS FIRST" if nf else " NULLS LAST"
+            return sql
+
+        parts.append("ORDER BY " + ", ".join(_ord(o) for o in orders))
     if limit is not None:
         parts.append(f"LIMIT {limit}")
     return " ".join(parts)

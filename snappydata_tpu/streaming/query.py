@@ -109,6 +109,9 @@ class StreamingQuery:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.batches_processed = 0
+        self.rows_processed = 0
+        self.started_at = time.time()
+        self.last_batch_ts: Optional[float] = None
         self.last_error: Optional[BaseException] = None
 
     # offset == batch id: deterministic replay after restart
@@ -136,8 +139,8 @@ class StreamingQuery:
                 continue
             columns = self._stamp(columns)
             try:
-                self.sink.process_batch(offset, columns)
-                self.batches_processed += 1
+                applied = self.sink.process_batch(offset, columns)
+                self._note_batch(columns if applied else None)
                 offset = new_offset
             except Exception as e:
                 self.last_error = e
@@ -166,11 +169,22 @@ class StreamingQuery:
             if self.transform is not None:
                 columns = self.transform(columns)
             columns = self._stamp(columns)
-            if not _batch_empty(columns) and \
-                    self.sink.process_batch(offset, columns):
+            did_apply = not _batch_empty(columns) and \
+                self.sink.process_batch(offset, columns)
+            if did_apply:
                 applied += 1
-            self.batches_processed += 1
+            # rows count only when APPLIED: a replayed batch the exactly-
+            # once sink deduplicated must not inflate progress metrics
+            self._note_batch(columns if did_apply else None)
             offset = new_offset
+
+    def _note_batch(self, columns) -> None:
+        """columns=None → the batch was seen but deduplicated (replay)."""
+        self.batches_processed += 1
+        if columns:
+            self.rows_processed += int(
+                len(np.asarray(next(iter(columns.values())))))
+        self.last_batch_ts = time.time()
 
     def stop(self) -> None:
         self._stop.set()
@@ -180,3 +194,21 @@ class StreamingQuery:
     @property
     def is_active(self) -> bool:
         return self._thread is not None and self._thread.is_alive()
+
+    def progress(self) -> dict:
+        """Status snapshot (ref: StreamingQueryManager's query progress —
+        the reference's structured-streaming UI tab reads the same
+        fields: batches, input rows, processing rate, last error)."""
+        elapsed = max(time.time() - self.started_at, 1e-9)
+        return {
+            "name": self.name,
+            "table": self.sink.table,
+            "active": self.is_active,
+            "batches_processed": self.batches_processed,
+            "rows_processed": self.rows_processed,
+            "rows_per_s": round(self.rows_processed / elapsed, 1),
+            "last_batch_id": self.sink.last_batch_id(),
+            "last_batch_ts": self.last_batch_ts,
+            "interval_s": self.interval_s,
+            "last_error": str(self.last_error) if self.last_error else None,
+        }

@@ -1,0 +1,90 @@
+"""Thin-client catalog protocol: one-round-trip metadata + generation-
+keyed caching (ref: StoreHiveCatalog serves catalog metadata to
+connectors; SmartConnectorExternalCatalog caches tables per catalog
+version and invalidates wholesale on DDL)."""
+
+import threading
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.cluster import SnappyClient
+from snappydata_tpu.cluster.flight_server import SnappyFlightServer
+
+
+def _serve(session):
+    server = SnappyFlightServer(session, "127.0.0.1", 0)
+    threading.Thread(target=server.serve, daemon=True).start()
+    server.wait_ready(timeout=10)
+    return server
+
+
+def test_client_catalog_discovery_and_cache():
+    s = SnappySession()
+    s.sql("CREATE TABLE cc_orders (o_id BIGINT, o_cust INT, "
+          "o_total DOUBLE, o_status VARCHAR) USING column "
+          "OPTIONS (PARTITION_BY 'o_cust', BUCKETS '8', REDUNDANCY '1')")
+    s.sql("CREATE TABLE cc_cust (c_id INT PRIMARY KEY, c_name VARCHAR) "
+          "USING row")
+    s.sql("CREATE VIEW cc_big AS SELECT * FROM cc_orders "
+          "WHERE o_total > 100")
+    s.sql("INSERT INTO cc_orders VALUES (1, 7, 50.0, 'N'), "
+          "(2, 9, 200.0, 'Y')")
+    server = _serve(s)
+    try:
+        c = SnappyClient(address=f"127.0.0.1:{server.port}")
+        tables = c.tables()
+        assert "cc_orders" in tables and "cc_cust" in tables
+
+        orders = c.describe("CC_ORDERS")     # case-insensitive lookup
+        assert orders["provider"] == "column"
+        assert orders["partition_by"] == ["o_cust"]
+        assert orders["buckets"] == 8
+        assert orders["redundancy"] == 1
+        assert [col["name"] for col in orders["columns"]] == \
+            ["o_id", "o_cust", "o_total", "o_status"]
+        assert [col["type"] for col in orders["columns"]] == \
+            ["long", "int", "double", "string"]
+        assert orders["row_count"] == 2
+
+        cust = c.describe("cc_cust")
+        assert cust["provider"] == "row"
+        assert cust["key_columns"] == ["c_id"]
+
+        assert "cc_big" in c.catalog()["views"]
+
+        # cached: no round trip, same object
+        gen0 = c.catalog()["generation"]
+        assert c.catalog() is c.catalog()
+
+        # DDL on the server bumps the generation; a refetch sees both the
+        # new table and the new generation
+        s.sql("CREATE TABLE cc_new (x INT) USING column")
+        assert "cc_new" not in c.tables()          # stale cache by design
+        new = c.describe("cc_new")                 # miss → auto refetch
+        assert new["provider"] == "column"
+        assert c.catalog()["generation"] > gen0
+        c.close()
+    finally:
+        server.shutdown()
+
+
+def test_client_catalog_respects_auth():
+    import pytest
+
+    from snappydata_tpu.security import BuiltinAuthProvider
+
+    s = SnappySession()
+    s.sql("CREATE TABLE cc_sec (a INT) USING column")
+    server = SnappyFlightServer(
+        s, "127.0.0.1", 0,
+        auth_provider=BuiltinAuthProvider({"eve": "evepw"}))
+    threading.Thread(target=server.serve, daemon=True).start()
+    server.wait_ready(timeout=10)
+    try:
+        with pytest.raises(Exception, match="(?i)token|credential"):
+            SnappyClient(address=f"127.0.0.1:{server.port}").tables()
+        eve = SnappyClient(address=f"127.0.0.1:{server.port}",
+                           user="eve", password="evepw")
+        assert "cc_sec" in eve.tables()
+        eve.close()
+    finally:
+        server.shutdown()

@@ -1,0 +1,91 @@
+"""Join semantics at the edges the device path hands to the host
+evaluator: non-equi conditions, ON-clause residuals on outer joins
+(NULL-extension, not filtering), and NULL join keys. Ref: Spark/Catalyst
+join semantics the reference inherits (SnappyStrategies join selection
+falls back the same way)."""
+
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+
+
+@pytest.fixture()
+def s():
+    sess = SnappySession(catalog=Catalog())
+    sess.sql("CREATE TABLE jl (id INT, v INT) USING column")
+    sess.sql("INSERT INTO jl VALUES (1, 10), (2, 20), (3, NULL)")
+    sess.sql("CREATE TABLE jr (id INT, w INT) USING column")
+    sess.sql("INSERT INTO jr VALUES (2, 200), (3, 300), (4, NULL)")
+    yield sess
+    sess.stop()
+
+
+def test_non_equi_inner_join(s):
+    got = s.sql("SELECT a.id, b.id FROM jl a JOIN jr b ON a.id < b.id "
+                "ORDER BY a.id, b.id").rows()
+    assert got == [(1, 2), (1, 3), (1, 4), (2, 3), (2, 4), (3, 4)]
+
+
+def test_non_equi_join_on_values(s):
+    got = s.sql("SELECT a.id, b.id FROM jl a JOIN jr b ON a.v > b.w "
+                "ORDER BY a.id, b.id").rows()
+    assert got == []   # NULL comparisons never match
+    got = s.sql("SELECT a.id, b.id FROM jl a JOIN jr b ON a.v < b.w "
+                "ORDER BY a.id, b.id").rows()
+    assert got == [(1, 2), (1, 3), (2, 2), (2, 3)]
+
+
+def test_left_join_residual_null_extends_not_drops(s):
+    """ON-clause residuals on an OUTER join NULL-extend failing rows —
+    filtering them out (the old behavior) loses left rows entirely."""
+    got = s.sql(
+        "SELECT a.id, b.id FROM jl a LEFT JOIN jr b "
+        "ON a.id = b.id AND b.w > 250 ORDER BY a.id").rows()
+    # id=2 matches id 2 but w=200 fails the residual -> NULL-extended
+    assert got == [(1, None), (2, None), (3, 3)]
+
+
+def test_right_and_full_outer_with_residual(s):
+    got = s.sql(
+        "SELECT a.id, b.id FROM jl a RIGHT JOIN jr b "
+        "ON a.id = b.id AND a.v >= 20 ORDER BY b.id").rows()
+    assert got == [(2, 2), (None, 3), (None, 4)]
+    got = s.sql(
+        "SELECT a.id, b.id FROM jl a FULL JOIN jr b "
+        "ON a.id = b.id AND a.v >= 20 "
+        "ORDER BY a.id NULLS LAST, b.id NULLS LAST").rows()
+    assert got == [(1, None), (2, 2), (3, None), (None, 3), (None, 4)]
+
+
+def test_left_join_pure_non_equi(s):
+    got = s.sql("SELECT a.id, b.id FROM jl a LEFT JOIN jr b "
+                "ON a.v < b.w ORDER BY a.id, b.id NULLS LAST").rows()
+    assert got == [(1, 2), (1, 3), (2, 2), (2, 3), (3, None)]
+
+
+def test_exists_with_pure_non_equi_correlation(s):
+    got = s.sql("SELECT id FROM jl a WHERE EXISTS "
+                "(SELECT 1 FROM jr b WHERE b.w > a.v) "
+                "ORDER BY id").rows()
+    assert got == [(1,), (2,)]
+    got = s.sql("SELECT id FROM jl a WHERE NOT EXISTS "
+                "(SELECT 1 FROM jr b WHERE b.w > a.v) "
+                "ORDER BY id").rows()
+    assert got == [(3,)]
+
+
+def test_cross_join(s):
+    got = s.sql("SELECT count(*) FROM jl CROSS JOIN jr").rows()
+    assert got == [(9,)]
+
+
+def test_null_keys_never_match_in_outer_join(s):
+    s.sql("CREATE TABLE nk1 (k VARCHAR, x INT) USING column")
+    s.sql("INSERT INTO nk1 VALUES ('a', 1), (NULL, 2)")
+    s.sql("CREATE TABLE nk2 (k VARCHAR, y INT) USING column")
+    s.sql("INSERT INTO nk2 VALUES ('a', 10), (NULL, 20)")
+    got = s.sql("SELECT n1.x, n2.y FROM nk1 n1 FULL JOIN nk2 n2 "
+                "ON n1.k = n2.k ORDER BY n1.x NULLS LAST, "
+                "n2.y NULLS LAST").rows()
+    assert got == [(1, 10), (2, None), (None, 20)]

@@ -1,0 +1,106 @@
+"""Scalar function coverage: the Spark-SQL surface the reference
+inherits (nullif/floor/ceil/mod/pmod/greatest/least/replace/sign/instr,
+string concat via ||) — device execution via derived dictionaries and
+int LUTs wherever a single string column + literals is involved, plus
+ON-device numeric lowering; pandas host path stays the oracle for the
+rest. Ref: core SnappySession function registry (Spark functions)."""
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.observability.metrics import global_registry
+
+
+@pytest.fixture()
+def s():
+    sess = SnappySession(catalog=Catalog())
+    sess.sql("CREATE TABLE sf (a INT, b DOUBLE, s VARCHAR, t VARCHAR) "
+             "USING column")
+    sess.sql("INSERT INTO sf VALUES "
+             "(1, 2.5, 'abcdef', 'u'), (2, 3.5, 'XYZ', 'v'), "
+             "(3, -1.25, NULL, 'w'), (4, NULL, 'abcdef', NULL)")
+    yield sess
+    sess.stop()
+
+
+def _device(s, sql, expect):
+    """Assert result AND that no host fallback was taken."""
+    reg = global_registry()
+    before = reg.snapshot()["counters"].get("host_fallbacks", 0)
+    got = [tuple(r) for r in s.sql(sql).rows()]
+    assert got == expect, f"{sql}: {got}"
+    after = reg.snapshot()["counters"].get("host_fallbacks", 0)
+    assert after == before, f"{sql} fell back to host"
+
+
+def test_numeric_functions_on_device(s):
+    _device(s, "SELECT floor(b), ceil(b) FROM sf WHERE a = 1", [(2, 3)])
+    _device(s, "SELECT floor(b), ceil(b) FROM sf WHERE a = 3", [(-2, -1)])
+    _device(s, "SELECT mod(a, 2) FROM sf ORDER BY a",
+            [(1,), (0,), (1,), (0,)])
+    _device(s, "SELECT sign(b) FROM sf ORDER BY a",
+            [(1.0,), (1.0,), (-1.0,), (None,)])
+    _device(s, "SELECT nullif(a, 2) FROM sf ORDER BY a",
+            [(1,), (None,), (3,), (4,)])
+    # greatest/least SKIP NULLs (NULL only when all args are NULL)
+    _device(s, "SELECT greatest(b, 0.0) FROM sf ORDER BY a",
+            [(2.5,), (3.5,), (0.0,), (0.0,)])
+    _device(s, "SELECT least(b, 3.0) FROM sf ORDER BY a",
+            [(2.5,), (3.0,), (-1.25,), (3.0,)])
+
+
+def test_mod_sign_conventions(s):
+    # mod keeps the dividend's sign (Spark %); pmod is non-negative
+    assert s.sql("SELECT mod(-3, 2)").rows()[0][0] == -1
+    assert s.sql("SELECT pmod(-3, 2)").rows()[0][0] == 1
+    # division/mod by zero is NULL, not an error
+    _device(s, "SELECT mod(a, 0) FROM sf WHERE a = 1", [(None,)])
+
+
+def test_string_functions_via_derived_dictionaries(s):
+    _device(s, "SELECT concat(s, '_x') FROM sf ORDER BY a",
+            [("abcdef_x",), ("XYZ_x",), (None,), ("abcdef_x",)])
+    _device(s, "SELECT 'p_' || s || '_q' FROM sf WHERE a = 2",
+            [("p_XYZ_q",)])
+    _device(s, "SELECT replace(s, 'a', 'z') FROM sf WHERE a = 1",
+            [("zbcdef",)])
+    _device(s, "SELECT instr(s, 'c') FROM sf ORDER BY a",
+            [(3,), (0,), (None,), (3,)])
+    # substr literals are STRUCTURAL: rebinding the same query shape with
+    # different offsets must not reuse the old derived dictionary
+    _device(s, "SELECT substr(s, 2) FROM sf WHERE a = 1", [("bcdef",)])
+    _device(s, "SELECT substr(s, 3) FROM sf WHERE a = 1", [("cdef",)])
+    _device(s, "SELECT substr(s, 2, 3) FROM sf WHERE a = 1", [("bcd",)])
+
+
+def test_composed_string_transforms_on_device(s):
+    _device(s, "SELECT upper(concat(s, '_t')) FROM sf WHERE a = 1",
+            [("ABCDEF_T",)])
+    _device(s, "SELECT a FROM sf WHERE upper(s) = 'XYZ'", [(2,)])
+    _device(s, "SELECT a FROM sf WHERE lower(s) LIKE 'abc%' ORDER BY a",
+            [(1,), (4,)])
+    _device(s, "SELECT count(*) FROM sf WHERE instr(lower(s), 'x') > 0",
+            [(1,)])
+    _device(s, "SELECT a FROM sf WHERE substr(s, 1, 3) = 'abc' "
+            "ORDER BY a", [(1,), (4,)])
+    _device(s, "SELECT length(trim(concat('  ', s))) FROM sf WHERE a = 2",
+            [(3,)])
+
+
+def test_functions_in_aggregation_context(s):
+    _device(s, "SELECT sum(a) FROM sf WHERE mod(a, 2) = 1", [(4,)])
+    # Spark default ordering: ASC → NULLS FIRST
+    _device(s, "SELECT concat(s, '!'), count(*) FROM sf "
+            "GROUP BY concat(s, '!') ORDER BY 1",
+            [(None, 1), ("XYZ!", 1), ("abcdef!", 2)])
+    _device(s, "SELECT concat(s, '!'), count(*) FROM sf "
+            "GROUP BY concat(s, '!') ORDER BY 1 NULLS LAST",
+            [("XYZ!", 1), ("abcdef!", 2), (None, 1)])
+
+
+def test_host_oracle_agrees_for_two_column_concat(s):
+    # two DIFFERENT string columns: host path, still correct
+    got = s.sql("SELECT concat(s, t) FROM sf WHERE a = 1").rows()
+    assert got == [("abcdefu",)]

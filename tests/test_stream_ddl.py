@@ -179,3 +179,36 @@ def test_windowed_sql_over_stream(session):
     session.sql("CREATE TABLE plain_t (a INT) USING column")
     with pytest.raises(Exception, match="STREAM"):
         session.sql("SELECT * FROM plain_t WINDOW (DURATION 5 SECONDS)")
+
+
+def test_streaming_progress_and_rest_endpoint(s):
+    """StreamingQueryManager parity: progress snapshots via the session
+    API and the /status/api/v1/streaming REST route (ref: the
+    structured-streaming UI tab reads batches/rows/rates)."""
+    import urllib.request
+
+    from snappydata_tpu.cluster.rest import RestService
+
+    s.sql("CREATE STREAM TABLE prog (id INT PRIMARY KEY, v DOUBLE) "
+          "USING memory_stream OPTIONS (interval '0.02')")
+    src = s.stream_source("prog")
+    src.add_batch({"id": np.array([1, 2, 3]),
+                   "v": np.array([0.5, 1.5, 2.5])})
+    assert _wait_rows(s, "prog", 3) == 3
+
+    progress = s.streaming_queries()
+    assert len(progress) == 1
+    p = progress[0]
+    assert p["name"] == "stream_prog" and p["table"] == "prog"
+    assert p["active"] is True
+    assert p["batches_processed"] >= 1
+    assert p["rows_processed"] == 3
+    assert p["last_batch_id"] >= 0 and p["last_error"] is None
+
+    svc = RestService(s, None, host="127.0.0.1", port=0).start()
+    try:
+        got = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{svc.port}/status/api/v1/streaming").read())
+        assert got and got[0]["rows_processed"] == 3
+    finally:
+        svc.stop()

@@ -217,3 +217,42 @@ def test_device_window_null_handling():
     rows = r.rows()
     assert [x[2] for x in rows] == [10.0, 10.0, 40.0, None, None]
     assert [x[3] for x in rows] == [1, 1, 2, 0, 0]
+
+
+def test_window_order_null_placement_spark_defaults():
+    """ASC → NULLS FIRST (Spark default): a NULL order key ranks FIRST;
+    explicit NULLS LAST overrides — honored on device AND host paths."""
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE wnp (g VARCHAR, v DOUBLE) USING column")
+    s.sql("INSERT INTO wnp VALUES ('a', 2.0), ('a', NULL), ('a', 1.0)")
+    got = s.sql("SELECT v, row_number() OVER (PARTITION BY g ORDER BY v) "
+                "FROM wnp ORDER BY 2").rows()
+    assert got == [(None, 1), (1.0, 2), (2.0, 3)], got
+    got = s.sql("SELECT v, row_number() OVER "
+                "(PARTITION BY g ORDER BY v NULLS LAST) "
+                "FROM wnp ORDER BY 2").rows()
+    assert got == [(1.0, 1), (2.0, 2), (None, 3)], got
+    got = s.sql("SELECT v, row_number() OVER "
+                "(PARTITION BY g ORDER BY v DESC) "
+                "FROM wnp ORDER BY 2").rows()
+    assert got == [(2.0, 1), (1.0, 2), (None, 3)], got
+    got = s.sql("SELECT v, row_number() OVER "
+                "(PARTITION BY g ORDER BY v DESC NULLS FIRST) "
+                "FROM wnp ORDER BY 2").rows()
+    assert got == [(None, 1), (2.0, 2), (1.0, 3)], got
+    s.stop()
+
+
+def test_top_level_order_by_nulls_first_last():
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE onp (v DOUBLE) USING column")
+    s.sql("INSERT INTO onp VALUES (2.0), (NULL), (1.0)")
+    assert s.sql("SELECT v FROM onp ORDER BY v").rows() == \
+        [(None,), (1.0,), (2.0,)]
+    assert s.sql("SELECT v FROM onp ORDER BY v NULLS LAST").rows() == \
+        [(1.0,), (2.0,), (None,)]
+    assert s.sql("SELECT v FROM onp ORDER BY v DESC").rows() == \
+        [(2.0,), (1.0,), (None,)]
+    assert s.sql("SELECT v FROM onp ORDER BY v DESC NULLS FIRST").rows() \
+        == [(None,), (2.0,), (1.0,)]
+    s.stop()
