@@ -338,6 +338,31 @@ class DistributedSession:
             from snappydata_tpu.engine.result import empty_result
 
             return empty_result(["status"], [T.STRING])
+        if isinstance(stmt, (ast.DeployStmt, ast.UndeployStmt,
+                             ast.ListDeployed)):
+            # DEPLOY installs the artifact on every member (ref:
+            # DeployCommand runs on each node's classloader); servers
+            # share the artifact path's filesystem in this topology
+            result = self.planner.execute_statement(stmt)
+            if not isinstance(stmt, ast.ListDeployed):
+                try:
+                    self._fan(lambda srv: srv.execute(sql_text))
+                except Exception as e:
+                    if "refused on network surfaces" not in str(e) and \
+                            "nauthenticated" not in str(e):
+                        raise
+                    # servers refuse code-surface DDL from an
+                    # unauthenticated peer: the planner-side install above
+                    # covers in-process servers (shared interpreter); for
+                    # multi-process clusters configure auth_cluster_token
+                    # so the fan authenticates as a peer admin
+                    import sys as _sys
+
+                    print("warning: DEPLOY applied on the lead only — "
+                          "servers refused the unauthenticated fan-out "
+                          "(set auth_cluster_token for cluster-wide "
+                          "deploy)", file=_sys.stderr)
+            return result
         if isinstance(stmt, ast.InsertInto) and isinstance(stmt.source,
                                                            ast.Values):
             return self._insert_values(stmt)
@@ -504,6 +529,21 @@ class DistributedSession:
     def _query(self, plan: ast.Plan):
         plan = self._plan_exchanges(plan)
         self._check_scatterable(plan)
+        # a query touching ONLY replicated tables has the full data on
+        # every server: answer from ONE (scatter-merge would double-count
+        # — and the reference's replicated-region reads are single-member)
+        if not self._touches_partitioned(plan):
+            sql_text = render_plan(plan)
+            for si, srv in self._alive():
+                try:
+                    import pyarrow as pa
+
+                    return _arrow_to_result(srv.sql(sql_text), self.planner)
+                except Exception:
+                    if self._probe(si):
+                        raise
+                    self.mark_server_failed(si)
+            raise DistributedError("all data servers failed")
         # peel ORDER BY / LIMIT: they apply after the merge
         outer: List = []
         node = plan
@@ -520,6 +560,22 @@ class DistributedSession:
         else:
             result = self._scatter_concat(node, outer)
         return result
+
+    def _touches_partitioned(self, plan: ast.Plan) -> bool:
+        found = False
+
+        def rec(p):
+            nonlocal found
+            if isinstance(p, ast.UnresolvedRelation):
+                info = self.planner.catalog.lookup_table(p.name)
+                # unknown relation (e.g. a view): conservatively scatter
+                if info is None or info.partition_by:
+                    found = True
+            for k in p.children():
+                rec(k)
+
+        rec(plan)
+        return found
 
     # ------------------------------------------------------------------
     # exchange planning: broadcast + hash-repartition (shuffle)

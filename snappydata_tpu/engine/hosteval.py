@@ -959,7 +959,12 @@ def _eval_join(plan: ast.Join, params, executor):
     if equi:
         ldf["__lrow"] = np.arange(nl_rows)
         rdf["__rrow"] = np.arange(nr_rows)
-        pairs = ldf.merge(rdf, left_on=[f"l{i}" for i, _ in equi],
+        rmerge = rdf
+        if residual is None and plan.how in ("semi", "anti"):
+            # only existence matters: dedup the build side so a hot key
+            # doesn't materialize the full many-to-many pair table
+            rmerge = rdf.drop_duplicates(subset=[f"r{j}" for _, j in equi])
+        pairs = ldf.merge(rmerge, left_on=[f"l{i}" for i, _ in equi],
                           right_on=[f"r{j}" for _, j in equi], how="inner")
         lpair = pairs["__lrow"].to_numpy()
         rpair = pairs["__rrow"].to_numpy()

@@ -258,6 +258,21 @@ class SnappySession:
                 ds.save_catalog(self.catalog)
             elif isinstance(stmt, (ast.GrantStmt, ast.RevokeStmt)):
                 ds.save_catalog(self.catalog)  # grants persist like DDL
+            elif isinstance(stmt, ast.DeployStmt):
+                # persist a DDL that points at the STORED copies so
+                # recovery replays cleanly even after the original source
+                # path disappears
+                entry = self._deployed().get(stmt.name.lower())
+                if not hasattr(self.catalog, "_aux_ddl"):
+                    self.catalog._aux_ddl = {}
+                self.catalog._aux_ddl[f"deploy:{stmt.name.lower()}"] = (
+                    f"DEPLOY {stmt.kind.upper()} {stmt.name} "
+                    f"'{', '.join(entry['files'])}'")
+                ds.save_catalog(self.catalog)
+            elif isinstance(stmt, ast.UndeployStmt):
+                getattr(self.catalog, "_aux_ddl", {}).pop(
+                    f"deploy:{stmt.name.lower()}", None)
+                ds.save_catalog(self.catalog)
         return result
 
     def execute_statement(self, stmt: ast.Statement, user_params=()) -> Result:
@@ -383,18 +398,18 @@ class SnappySession:
             self.conf.set(stmt.key, stmt.value)
             return _status()
         if isinstance(stmt, ast.ExecCode):
-            # EXEC PYTHON is arbitrary code execution: on network-derived
-            # sessions it requires an AUTHENTICATED admin principal — an
-            # unauthenticated network caller must never reach it (advisor
-            # finding: REST/Flight ran as the admin superuser, an RCE).
-            if getattr(self, "remote", False) and not (
-                    getattr(self, "authenticated", False)
-                    and self.user == "admin"):
-                raise PermissionError(
-                    "EXEC PYTHON is refused on network surfaces unless an "
-                    "authenticated admin principal is established "
-                    "(configure auth_tokens and pass the admin token)")
+            self._gate_code_surface("EXEC PYTHON")
             return self._exec_code(stmt.code)
+        if isinstance(stmt, ast.DeployStmt):
+            # deploying artifacts makes them importable from EXEC PYTHON —
+            # same code-execution surface, same gate
+            self._gate_code_surface("DEPLOY")
+            return self._deploy(stmt)
+        if isinstance(stmt, ast.UndeployStmt):
+            self._gate_code_surface("UNDEPLOY")
+            return self._undeploy(stmt.name)
+        if isinstance(stmt, ast.ListDeployed):
+            return self._list_deployed(stmt.kind)
         if isinstance(stmt, ast.ExplainStmt):
             return self._explain(stmt.query)
         if isinstance(stmt, ast.CreatePolicy):
@@ -499,6 +514,140 @@ class SnappySession:
         walk_plan(resolved, 0)
         return Result(["plan"], [np.array(lines, dtype=object)],
                       [None], [T.STRING])
+
+    def _gate_code_surface(self, what: str) -> None:
+        """Code-execution surfaces (EXEC PYTHON, DEPLOY) on network-derived
+        sessions require an AUTHENTICATED admin principal — an
+        unauthenticated network caller must never reach them (advisor
+        finding: REST/Flight ran as the admin superuser, an RCE)."""
+        if getattr(self, "remote", False) and not (
+                getattr(self, "authenticated", False)
+                and self.user == "admin"):
+            raise PermissionError(
+                f"{what} is refused on network surfaces unless an "
+                "authenticated admin principal is established "
+                "(configure auth_tokens and pass the admin token)")
+
+    # -- DEPLOY JAR/PACKAGE (ref: DeployCommand/UnDeployCommand/
+    # ListPackageJarsCommand, core/.../execution/ddl.scala; the reference
+    # resolves maven coordinates and installs jars on every member's
+    # classloader — here artifacts are Python wheels/zips/modules added to
+    # the interpreter path, copied into the disk store so they survive
+    # restarts) ----------------------------------------------------------
+
+    def _deployed(self) -> Dict[str, dict]:
+        if not hasattr(self.catalog, "_deployed"):
+            self.catalog._deployed = {}
+        return self.catalog._deployed
+
+    def _deploy(self, stmt: ast.DeployStmt) -> Result:
+        import os
+        import shutil
+
+        name = stmt.name.lower()
+        paths = [p.strip() for p in stmt.coordinates.split(",")
+                 if p.strip()]
+        if not paths:
+            raise ValueError("DEPLOY: empty artifact list")
+        resolved = []
+        for p in paths:
+            if not os.path.exists(p):
+                hint = ("" if os.sep in p else
+                        " (this build has no network egress: DEPLOY takes "
+                        "local wheel/zip/.py paths, not remote "
+                        "maven/pypi coordinates)")
+                raise ValueError(f"DEPLOY: artifact not found: {p!r}{hint}")
+            resolved.append(os.path.abspath(p))
+        stored = resolved
+        if self.disk_store is not None:
+            root = os.path.join(self.disk_store.path, "deploy", name)
+            os.makedirs(root, exist_ok=True)
+            stored = []
+            for p in resolved:
+                d = os.path.abspath(os.path.join(root, os.path.basename(p)))
+                if d != p:  # recovery replay re-deploys the stored copy
+                    if os.path.isdir(p):
+                        shutil.copytree(p, d, dirs_exist_ok=True)
+                    else:
+                        shutil.copy2(p, d)
+                stored.append(d)
+        deployed = self._deployed()
+        old = deployed.pop(name, None)
+        deployed[name] = {"kind": stmt.kind, "files": list(stored),
+                          "coordinates": stmt.coordinates}
+        if old is not None:
+            self._sys_path_sync()
+        for f in stored:
+            self._sys_path_add(f)
+        self.catalog.generation += 1
+        return _status()
+
+    def _undeploy(self, name: str) -> Result:
+        import os
+        import shutil
+
+        key = name.lower()
+        deployed = self._deployed()
+        if key not in deployed:
+            raise ValueError(f"nothing deployed as {name!r}")
+        deployed.pop(key)
+        self._sys_path_sync()
+        if self.disk_store is not None:
+            shutil.rmtree(
+                os.path.join(self.disk_store.path, "deploy", key),
+                ignore_errors=True)
+        self.catalog.generation += 1
+        return _status()
+
+    def _list_deployed(self, kind: str) -> Result:
+        want = "package" if kind == "packages" else "jar"
+        rows = [(n, e["coordinates"], e["kind"] == "package")
+                for n, e in sorted(self._deployed().items())
+                if e["kind"] == want]
+        return Result(
+            ["name", "coordinates", "isPackage"],
+            [np.array([r[0] for r in rows], dtype=object),
+             np.array([r[1] for r in rows], dtype=object),
+             np.array([r[2] for r in rows], dtype=bool)],
+            [None, None, None], [T.STRING, T.STRING, T.BOOLEAN])
+
+    @staticmethod
+    def _import_root(path: str) -> str:
+        """sys.path entry that makes `path` importable: zips/wheels import
+        via zipimport directly, a module file imports via its parent dir."""
+        import os
+
+        low = path.lower()
+        if os.path.isdir(path) or low.endswith(
+                (".whl", ".zip", ".egg", ".jar")):
+            return path
+        return os.path.dirname(path)
+
+    def _sys_path_add(self, f: str) -> None:
+        import importlib
+        import sys as _sys
+
+        root = self._import_root(f)
+        if root not in _sys.path:
+            _sys.path.append(root)
+        if not hasattr(self.catalog, "_deploy_roots"):
+            self.catalog._deploy_roots = set()
+        self.catalog._deploy_roots.add(root)
+        importlib.invalidate_caches()
+
+    def _sys_path_sync(self) -> None:
+        """Drop sys.path entries no longer referenced by any deployed
+        artifact (two artifacts may share an import root — only remove
+        roots with zero remaining references)."""
+        import sys as _sys
+
+        live = {self._import_root(f)
+                for e in self._deployed().values() for f in e["files"]}
+        added = getattr(self.catalog, "_deploy_roots", set())
+        for root in added - live:
+            while root in _sys.path:
+                _sys.path.remove(root)
+        self.catalog._deploy_roots = added & live
 
     def _exec_code(self, code: str) -> Result:
         """EXEC PYTHON: per-session interpreter namespace persisting across
@@ -843,7 +992,8 @@ class SnappySession:
                              ast.CreatePolicy,
                              ast.DropPolicy, ast.CreateIndex,
                              ast.DropIndex, ast.ExecCode, ast.SetConf,
-                             ast.CreateView, ast.DropView)):
+                             ast.CreateView, ast.DropView,
+                             ast.DeployStmt, ast.UndeployStmt)):
             raise PermissionError(
                 f"user {self.user!r} may not run "
                 f"{type(stmt).__name__} (DDL is admin-only)")

@@ -650,3 +650,34 @@ class ExecCode(Statement):
     SCALA, cluster/.../remote/interpreter/SnappyInterpreterExecute)."""
 
     code: str
+
+
+@dataclasses.dataclass(frozen=True)
+class DeployStmt(Statement):
+    """DEPLOY PACKAGE|JAR name 'paths' — register Python artifacts
+    (wheel/zip/dir/.py) on the cluster, importable from EXEC PYTHON and
+    persisted in the catalog so they re-install on restart (ref:
+    DeployCommand, core/.../execution/ddl.scala; grammar
+    SnappyDDLParser.deployPackages:858). REPOS/PATH clauses are parsed
+    for dialect parity; this build has no network egress, so coordinates
+    must resolve to local files."""
+
+    name: str
+    kind: str = "jar"        # 'jar' | 'package'
+    coordinates: str = ""    # comma-separated local artifact paths
+    repos: str = ""
+    cache_path: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class UndeployStmt(Statement):
+    """UNDEPLOY name (ref: UnDeployCommand, core/.../execution/ddl.scala)."""
+
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ListDeployed(Statement):
+    """LIST PACKAGES | LIST JARS (ref: ListPackageJarsCommand)."""
+
+    kind: str = "packages"

@@ -150,7 +150,53 @@ class Parser:
         if low == "values":
             plan = self.values_clause()
             return self._finishing(ast.Query(plan))
+        if low == "deploy":
+            return self._finishing(self.deploy_stmt())
+        if low == "undeploy":
+            self.next()
+            return self._finishing(ast.UndeployStmt(self.qualified_name()))
+        if low == "list" or (t.kind == "IDENT" and
+                             t.value.lower() == "list"):
+            self.next()
+            what = self.next()
+            if what.value.lower() not in ("packages", "jars"):
+                raise SQLSyntaxError(
+                    f"LIST expects PACKAGES or JARS, found {what.value!r}")
+            return self._finishing(ast.ListDeployed(what.value.lower()))
         raise SQLSyntaxError(f"cannot parse statement starting at {t.value!r}")
+
+    def deploy_stmt(self) -> ast.Statement:
+        """DEPLOY PACKAGE name 'coords' [REPOS 'r'] [PATH 'p'] |
+        DEPLOY JAR name 'paths' (ref grammar:
+        SnappyDDLParser.deployPackages:858)."""
+        self.next()  # DEPLOY
+        kind_t = self.peek()
+        kind = kind_t.value.lower()
+        if kind not in ("package", "jar"):
+            raise SQLSyntaxError(
+                f"DEPLOY expects PACKAGE or JAR, found {kind_t.value!r}")
+        self.next()
+        name = self.qualified_name()
+        coords_t = self.next()
+        if coords_t.kind != "STR":
+            raise SQLSyntaxError("DEPLOY expects a quoted path list")
+        repos = cache_path = ""
+        if kind == "package":
+            nxt = self.peek()
+            if nxt.kind in ("KW", "IDENT") and nxt.value.lower() == "repos":
+                self.next()
+                rt = self.next()
+                if rt.kind != "STR":
+                    raise SQLSyntaxError("REPOS expects a quoted string")
+                repos = rt.value
+            nxt = self.peek()
+            if nxt.kind in ("KW", "IDENT") and nxt.value.lower() == "path":
+                self.next()
+                pt = self.next()
+                if pt.kind != "STR":
+                    raise SQLSyntaxError("PATH expects a quoted string")
+                cache_path = pt.value
+        return ast.DeployStmt(name, kind, coords_t.value, repos, cache_path)
 
     def _finishing(self, stmt: ast.Statement) -> ast.Statement:
         self._finish()

@@ -1,0 +1,132 @@
+"""DEPLOY JAR/PACKAGE — cluster artifact deploy surface.
+
+Reference: DeployCommand / UnDeployCommand / ListPackageJarsCommand
+(core/.../execution/ddl.scala; grammar SnappyDDLParser.deployPackages:858).
+The reference resolves maven jars onto every member's classloader; here
+artifacts are Python wheels/zips/modules added to the interpreter path,
+copied into the disk store, and re-installed by catalog recovery.
+"""
+
+import os
+import sys
+import zipfile
+
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+
+
+def _write_module(tmp_path, name="depmod", value=41):
+    p = tmp_path / f"{name}.py"
+    p.write_text(f"MAGIC = {value}\n\ndef answer():\n    return MAGIC + 1\n")
+    return str(p)
+
+
+def _drop_modules(*names):
+    for n in names:
+        sys.modules.pop(n, None)
+
+
+@pytest.fixture(autouse=True)
+def _clean_modules():
+    before = list(sys.path)
+    yield
+    sys.path[:] = before  # deploys are process-wide; isolate tests
+    _drop_modules("depmod", "zipmod", "othermod")
+
+
+def test_deploy_module_and_exec(tmp_path):
+    s = SnappySession(catalog=Catalog())
+    path = _write_module(tmp_path)
+    s.sql(f"DEPLOY JAR depjar '{path}'")
+    r = s.sql("EXEC PYTHON 'import depmod; result = [depmod.answer()]'")
+    assert r.rows()[0][0] == 42
+
+    rows = s.sql("LIST JARS").rows()
+    assert [r[0] for r in rows] == ["depjar"]
+    assert rows[0][2] is False or rows[0][2] == False  # noqa: E712
+    assert s.sql("LIST PACKAGES").num_rows == 0
+
+
+def test_deploy_zip_package(tmp_path):
+    s = SnappySession(catalog=Catalog())
+    zpath = str(tmp_path / "zippkg.zip")
+    with zipfile.ZipFile(zpath, "w") as z:
+        z.writestr("zipmod.py", "VALUE = 'from-zip'\n")
+    s.sql(f"DEPLOY PACKAGE zpkg '{zpath}'")
+    r = s.sql("EXEC PYTHON 'import zipmod; result = [zipmod.VALUE]'")
+    assert r.rows()[0][0] == "from-zip"
+    rows = s.sql("LIST PACKAGES").rows()
+    assert [x[0] for x in rows] == ["zpkg"]
+    assert bool(rows[0][2]) is True
+
+
+def test_undeploy_removes_path(tmp_path):
+    s = SnappySession(catalog=Catalog())
+    path = _write_module(tmp_path)
+    s.sql(f"DEPLOY JAR depjar '{path}'")
+    root = os.path.dirname(path)
+    assert root in sys.path
+    s.sql("UNDEPLOY depjar")
+    assert root not in sys.path
+    assert s.sql("LIST JARS").num_rows == 0
+    with pytest.raises(ValueError, match="nothing deployed"):
+        s.sql("UNDEPLOY depjar")
+
+
+def test_deploy_missing_artifact_is_loud():
+    s = SnappySession(catalog=Catalog())
+    with pytest.raises(ValueError, match="not found"):
+        s.sql("DEPLOY JAR nope '/no/such/file.whl'")
+    # maven-style coordinates get the no-egress hint
+    with pytest.raises(ValueError, match="egress"):
+        s.sql("DEPLOY PACKAGE gavfmt 'com.example:artifact:1.0'")
+
+
+def test_deploy_requires_admin(tmp_path):
+    s = SnappySession(catalog=Catalog())
+    path = _write_module(tmp_path)
+    s.sql("CREATE TABLE t (x INT) USING column")
+    user = s.for_user("alice")
+    with pytest.raises(PermissionError):
+        user.sql(f"DEPLOY JAR depjar '{path}'")
+
+
+def test_deploy_persists_across_recovery(tmp_path):
+    data = str(tmp_path / "store")
+    src = _write_module(tmp_path, value=7)
+    s = SnappySession(data_dir=data)
+    s.sql(f"DEPLOY JAR persisted '{src}'")
+    # artifact is copied INTO the store: the original may vanish
+    os.remove(src)
+    s.checkpoint()
+
+    _drop_modules("depmod")
+    root = os.path.dirname(src)
+    while root in sys.path:
+        sys.path.remove(root)
+
+    s2 = SnappySession(data_dir=data)
+    r = s2.sql("EXEC PYTHON 'import depmod; result = [depmod.answer()]'")
+    assert r.rows()[0][0] == 8
+    assert [x[0] for x in s2.sql("LIST JARS").rows()] == ["persisted"]
+
+    # undeploy persists too
+    s2.sql("UNDEPLOY persisted")
+    s3 = SnappySession(data_dir=data)
+    assert s3.sql("LIST JARS").num_rows == 0
+
+
+def test_redeploy_replaces(tmp_path):
+    s = SnappySession(catalog=Catalog())
+    p1 = _write_module(tmp_path, value=1)
+    s.sql(f"DEPLOY JAR depjar '{p1}'")
+    sub = tmp_path / "v2"
+    sub.mkdir()
+    p2 = _write_module(sub, value=100)
+    s.sql(f"DEPLOY JAR depjar '{p2}'")
+    _drop_modules("depmod")
+    r = s.sql("EXEC PYTHON 'import depmod; result = [depmod.answer()]'")
+    assert r.rows()[0][0] == 101
+    assert s.sql("LIST JARS").num_rows == 1
