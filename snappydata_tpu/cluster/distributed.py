@@ -33,6 +33,7 @@ from snappydata_tpu.catalog import Catalog
 from snappydata_tpu.parallel.hashing import bucket_of_np
 from snappydata_tpu.sql import ast
 from snappydata_tpu.sql.parser import parse
+from snappydata_tpu.engine.partial_agg import ddl_type as _ddl_type
 from snappydata_tpu.sql.render import RenderError, render_expr, render_plan
 
 
@@ -176,7 +177,7 @@ class DistributedSession:
                             dead_targets.add(nr)
                         break
                 if not ok:
-                    # NEVER claim a replica that wasn't copied (phantom
+                    # NEVER2 claim a replica that wasn't copied (phantom
                     # redundancy silently loses the bucket on the next
                     # death) — degrade honestly instead
                     for b in buckets:
@@ -961,74 +962,15 @@ class DistributedSession:
     def _scatter_aggregate(self, agg: ast.Aggregate, having, full_plan,
                            outer: List):
         """Decompose → scatter partial SQL → gather → local merge SQL."""
+        from snappydata_tpu.engine.partial_agg import (NotDecomposableError,
+                                                       decompose_aggregate)
+
         groups = list(agg.group_exprs)
-        partial_items: List[ast.Expr] = []
-        for gi, g in enumerate(groups):
-            partial_items.append(ast.Alias(g, f"__g{gi}"))
-        slots: List[Tuple[str, Optional[ast.Expr]]] = []
-
-        def slot_of(kind, arg) -> int:
-            for i, (k, a) in enumerate(slots):
-                if k == kind and a == arg:
-                    return i
-            slots.append((kind, arg))
-            return len(slots) - 1
-
-        def decompose(e: ast.Expr) -> ast.Expr:
-            if isinstance(e, ast.Func) and e.name in ast.AGG_FUNCS:
-                arg = e.args[0] if e.args else None
-                if e.name == "count" and arg is None:
-                    return _merge_ref(slot_of("count_star", None), "sum")
-                if e.name == "count":
-                    return _merge_ref(slot_of("count", arg), "sum")
-                if e.name == "sum":
-                    return _merge_ref(slot_of("sum", arg), "sum")
-                if e.name == "min":
-                    return _merge_ref(slot_of("min", arg), "min")
-                if e.name == "max":
-                    return _merge_ref(slot_of("max", arg), "max")
-                if e.name == "avg":
-                    s = _merge_ref(slot_of("sum", arg), "sum")
-                    c = _merge_ref(slot_of("count", arg), "sum")
-                    return ast.BinOp("/", s, c)
-                if e.name in ("stddev", "variance"):
-                    s = _merge_ref(slot_of("sum", arg), "sum")
-                    s2 = _merge_ref(slot_of("sumsq", arg), "sum")
-                    c = _merge_ref(slot_of("count", arg), "sum")
-                    mean = ast.BinOp("/", s, c)
-                    var = ast.BinOp("-", ast.BinOp("/", s2, c),
-                                    ast.BinOp("*", mean, mean))
-                    return var if e.name == "variance" else \
-                        ast.Func("sqrt", (var,))
-                raise DistributedError(
-                    f"aggregate {e.name} not distributable")
-            for gi, g in enumerate(groups):
-                if e == g:
-                    return ast.Col(f"__g{gi}")
-            return e.map_children(decompose)
-
-        merged_select: List[ast.Expr] = []
-        for e in agg.agg_exprs:
-            name = e.name if isinstance(e, ast.Alias) else None
-            base = e.child if isinstance(e, ast.Alias) else e
-            rewritten = decompose(base)
-            merged_select.append(ast.Alias(rewritten, name)
-                                 if name else rewritten)
-
-        for si, (kind, arg) in enumerate(slots):
-            if kind == "count_star":
-                partial_items.append(ast.Alias(ast.Func("count", ()),
-                                               f"__p{si}"))
-            elif kind == "sumsq":
-                partial_items.append(ast.Alias(
-                    ast.Func("sum", (ast.BinOp("*", arg, arg),)),
-                    f"__p{si}"))
-            else:
-                partial_items.append(ast.Alias(ast.Func(kind, (arg,)),
-                                               f"__p{si}"))
-
-        partial_plan = ast.Aggregate(agg.child, tuple(groups),
-                                     tuple(partial_items))
+        try:
+            partial_plan, merged_select, n_slots, merge_having = \
+                decompose_aggregate(agg, having)
+        except NotDecomposableError as e:
+            raise DistributedError(str(e))
         partial_sql = render_plan(partial_plan)
 
         import pyarrow as pa
@@ -1042,7 +984,7 @@ class DistributedSession:
         fields = []
         for gi, g in enumerate(groups):
             fields.append(f"__g{gi} {_sql_type(merged.schema[gi])}")
-        for si in range(len(slots)):
+        for si in range(n_slots):
             fields.append(
                 f"__p{si} {_sql_type(merged.schema[len(groups) + si])}")
         self.planner.sql(
@@ -1059,8 +1001,8 @@ class DistributedSession:
         merge_sql = f"SELECT {merge_items} FROM {scratch}"
         if groups:
             merge_sql += f" GROUP BY {group_cols}"
-        if having is not None:
-            merge_sql += f" HAVING {render_expr(_having_rewrite(having, groups))}"
+        if merge_having is not None:
+            merge_sql += f" HAVING {render_expr(merge_having)}"
         result = self.planner.sql(merge_sql)
         return _apply_outer(result, outer, self.planner,
                             names=[_out_name(e) for e in agg.agg_exprs])
@@ -1133,25 +1075,6 @@ def _rename_tables(plan: ast.Plan, mapping: Dict[str, str]) -> ast.Plan:
     return rename(plan)
 
 
-def _merge_ref(slot: int, merge_fn: str) -> ast.Expr:
-    return ast.Func(merge_fn, (ast.Col(f"__p{slot}"),))
-
-
-def _having_rewrite(having: ast.Expr, groups=()) -> ast.Expr:
-    """HAVING over merged output: group expressions become __gN columns of
-    the scratch table; aggregate calls not in the select list are
-    rejected with a clear error."""
-    def rec(e):
-        for gi, g in enumerate(groups):
-            if e == g:
-                return ast.Col(f"__g{gi}")
-        if isinstance(e, ast.Func) and e.name in ast.AGG_FUNCS:
-            raise DistributedError(
-                "HAVING with aggregates not in the select list is not "
-                "supported distributed yet")
-        return e.map_children(rec)
-
-    return rec(having)
 
 
 def _out_name(e: ast.Expr) -> str:
@@ -1191,13 +1114,6 @@ def _apply_outer(result, outer: List, planner, names=None):
                         "columns by name or position")
             result = hosteval.sort(result, orders, ())
     return result
-
-
-def _ddl_type(dt) -> str:
-    return {"string": "STRING", "int": "INT", "long": "BIGINT",
-            "double": "DOUBLE", "float": "REAL", "boolean": "BOOLEAN",
-            "date": "DATE", "timestamp": "TIMESTAMP", "short": "SMALLINT",
-            "byte": "TINYINT", "decimal": "DOUBLE"}.get(dt.name, "DOUBLE")
 
 
 def _sql_type(field) -> str:

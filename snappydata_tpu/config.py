@@ -62,6 +62,14 @@ class Properties:
     # to host, from which they rebuild on next access)
     device_cache_bytes: int = 0               # 0 = unlimited
 
+    # Tiled scans ("table ≫ HBM"): when one column table's decoded bind
+    # exceeds this budget, aggregate queries stream the batch axis through
+    # the same compiled program tile by tile and merge partials (ref:
+    # batch-at-a-time ColumnFormatIterator disk read-ahead — the
+    # reference never materializes a table to scan it). 0 = auto: half
+    # the accelerator's reported memory when known, else unlimited.
+    scan_tile_bytes: int = 0
+
     # Cluster
     num_buckets: int = 128                    # default buckets per partitioned table (ref DDL BUCKETS)
     redundancy: int = 0

@@ -20,7 +20,8 @@ from snappydata_tpu.engine.executor import Executor
 from snappydata_tpu.engine.result import Result, empty_result
 from snappydata_tpu.engine import hosteval
 from snappydata_tpu.sql import ast
-from snappydata_tpu.sql.analyzer import Analyzer, AnalysisError, tokenize_plan
+from snappydata_tpu.sql.analyzer import (Analyzer, AnalysisError,
+                                         _expr_name, tokenize_plan)
 from snappydata_tpu.sql.parser import parse
 from snappydata_tpu.storage.table_store import ColumnTableData, RowTableData
 
@@ -515,6 +516,183 @@ class SnappySession:
         return Result(["plan"], [np.array(lines, dtype=object)],
                       [None], [T.STRING])
 
+    # -- tiled scans: table ≫ HBM (SURVEY §5 "long-context" analogue) ----
+
+    def _tile_budget(self) -> int:
+        """Effective byte budget for one scan tile. conf.scan_tile_bytes:
+        >0 explicit, 0 auto (half the accelerator's reported memory when
+        known), <0 disabled."""
+        b = self.conf.scan_tile_bytes
+        if b != 0:
+            return max(0, b)
+        try:
+            import jax
+
+            stats = jax.local_devices()[0].memory_stats()
+            limit = (stats or {}).get("bytes_limit")
+            if limit:
+                return int(limit) // 2
+        except Exception:
+            pass
+        return 0  # unknown memory (e.g. CPU): tiling off unless explicit
+
+    def _maybe_tiled_aggregate(self, plan: ast.Plan,
+                               user_params) -> Optional[Result]:
+        """Execute an aggregate over ONE oversized column table as a
+        streamed tile pass: bind `scan_tile_bytes`-sized windows of the
+        batch axis through the SAME compiled partial program, then merge
+        partials (avg = sum/count etc.) — the reference scans batch-at-a-
+        time off disk for the same reason (ColumnFormatIterator read-ahead,
+        core/.../columnar/impl/ColumnFormatIterator.scala:60-162); HBM
+        never holds the whole table. Returns None → run untiled."""
+        if getattr(self, "_in_tile", False) or user_params:
+            return None
+        budget = self._tile_budget()
+        if budget <= 0:
+            return None
+        # shape: [Sort|Limit]* [Filter(having)] Aggregate(single table)
+        outer: List[ast.Plan] = []
+        node = plan
+        while isinstance(node, (ast.Sort, ast.Limit)):
+            outer.append(node)
+            node = node.children()[0]
+        having = None
+        if isinstance(node, ast.Filter) and isinstance(node.child,
+                                                       ast.Aggregate):
+            having = node.condition
+            node = node.child
+        if not isinstance(node, ast.Aggregate):
+            return None
+
+        rels: List[str] = []
+        exprs: List[ast.Expr] = []
+
+        def rec(p):
+            if isinstance(p, (ast.WindowedRelation, ast.WindowProject,
+                              ast.Values, ast.Join, ast.Union,
+                              ast.Distinct)):
+                rels.append("__unsupported__")
+                return
+            if isinstance(p, ast.UnresolvedRelation):
+                rels.append(p.name)
+            import dataclasses as _dc
+
+            for fld in _dc.fields(p):
+                v = getattr(p, fld.name)
+                items = v if isinstance(v, tuple) else (v,)
+                for x in items:
+                    if isinstance(x, ast.Expr):
+                        exprs.append(x)
+            for k in p.children():
+                rec(k)
+
+        rec(node)
+        if having is not None:
+            exprs.append(having)
+        if len(set(rels)) != 1 or "__unsupported__" in rels:
+            return None
+        for e in exprs:
+            for sub in ast.walk(e):
+                if isinstance(sub, (ast.ScalarSubquery, ast.InSubquery,
+                                    ast.ExistsSubquery, ast.WindowFunc)):
+                    return None
+        info = self.catalog.lookup_table(rels[0])
+        if info is None or not isinstance(info.data, ColumnTableData):
+            return None
+        data = info.data
+
+        from snappydata_tpu.storage.device import (scan_unit_count,
+                                                   scan_window)
+
+        manifest = data.snapshot()
+        units = scan_unit_count(data, manifest)
+        if units <= 1:
+            return None
+        used = {c.name.lower() for e in exprs for c in ast.walk(e)
+                if isinstance(c, ast.Col)}
+        cap = data.capacity
+        unit_bytes = cap  # shared validity mask
+        for f in info.schema.fields:
+            if f.name.lower() not in used:
+                continue
+            if isinstance(f.dtype, (T.ArrayType, T.MapType, T.StructType)):
+                return None  # complex plates don't tile yet
+            per = 4 if f.dtype.name == "string" \
+                else np.dtype(f.dtype.device_dtype()).itemsize
+            unit_bytes += cap * (per + 1)
+        if unit_bytes * units <= budget:
+            return None
+        tile_units = max(1, int(budget // unit_bytes))
+        if self.conf.batches_pow2_bucketing and tile_units > 1:
+            tile_units = 1 << (tile_units.bit_length() - 1)
+
+        from snappydata_tpu.engine.partial_agg import (
+            NotDecomposableError, decompose_aggregate, ddl_type)
+        from snappydata_tpu.sql.render import RenderError, render_expr, \
+            render_plan
+
+        try:
+            partial_plan, merged_select, _, merge_having = \
+                decompose_aggregate(node, having)
+            partial_sql = render_plan(partial_plan)
+        except (NotDecomposableError, RenderError):
+            return None
+        # outer ORDER BY must reference output columns by name/position
+        out_names = [_expr_name(e).lower() for e in node.agg_exprs]
+        for op in outer:
+            if isinstance(op, ast.Sort):
+                for o in op.orders:
+                    tgt = o[0].child if isinstance(o[0], ast.Alias) else o[0]
+                    if isinstance(tgt, ast.Col) and \
+                            tgt.name.lower() in out_names:
+                        continue
+                    if isinstance(tgt, ast.Lit) and \
+                            isinstance(tgt.value, int):
+                        continue
+                    return None
+
+        from snappydata_tpu.observability.metrics import global_registry
+
+        pieces: List[Result] = []
+        self._in_tile = True
+        try:
+            for lo in range(0, units, tile_units):
+                with scan_window(data, lo, min(lo + tile_units, units),
+                                 manifest):
+                    pieces.append(self.sql(partial_sql))
+                global_registry().inc("scan_tiles")
+        finally:
+            self._in_tile = False
+
+        # merge in a THROWAWAY in-memory session (never journaled/persisted)
+        from snappydata_tpu.catalog import Catalog as _Cat
+
+        scratch_sess = SnappySession(catalog=_Cat(), conf=self.conf)
+        first = pieces[0]
+        fields_sql = ", ".join(
+            f"{nm} {ddl_type(dt)}"
+            for nm, dt in zip(first.names, first.dtypes))
+        scratch_sess.sql(f"CREATE TABLE __tile_partials ({fields_sql}) "
+                         f"USING column")
+        sdata = scratch_sess.catalog.describe("__tile_partials").data
+        for piece in pieces:
+            if piece.num_rows:
+                nmask = piece.nulls \
+                    if any(m is not None for m in piece.nulls) else None
+                sdata.insert_arrays(piece.columns, nulls=nmask)
+        merge_items = ", ".join(render_expr(e) for e in merged_select)
+        msql = f"SELECT {merge_items} FROM __tile_partials"
+        if node.group_exprs:
+            msql += " GROUP BY " + ", ".join(
+                f"__g{gi}" for gi in range(len(node.group_exprs)))
+        if merge_having is not None:
+            msql += f" HAVING {render_expr(merge_having)}"
+        result = scratch_sess.sql(msql)
+        result.names = [_expr_name(e) for e in node.agg_exprs]
+        from snappydata_tpu.cluster.distributed import _apply_outer
+
+        return _apply_outer(result, outer, self)
+
     def _gate_code_surface(self, what: str) -> None:
         """Code-execution surfaces (EXEC PYTHON, DEPLOY) on network-derived
         sessions require an AUTHENTICATED admin principal — an
@@ -563,8 +741,12 @@ class SnappySession:
             root = os.path.join(self.disk_store.path, "deploy", name)
             os.makedirs(root, exist_ok=True)
             stored = []
-            for p in resolved:
-                d = os.path.abspath(os.path.join(root, os.path.basename(p)))
+            bases = [os.path.basename(p) for p in resolved]
+            for i, p in enumerate(resolved):
+                base = bases[i]
+                if bases.count(base) > 1:  # '/a/util.py, /b/util.py'
+                    base = f"{i}_{base}"   # must not silently overwrite
+                d = os.path.abspath(os.path.join(root, base))
                 if d != p:  # recovery replay re-deploys the stored copy
                     if os.path.isdir(p):
                         shutil.copytree(p, d, dirs_exist_ok=True)
@@ -685,6 +867,9 @@ class SnappySession:
         if getattr(self.catalog, "_sample_maintainers", None):
             self._refresh_samples()
         plan = self._rewrite_stream_windows(plan)
+        tiled = self._maybe_tiled_aggregate(plan, user_params)
+        if tiled is not None:
+            return tiled
         plan = self._decorrelate(plan)
         plan = self._rewrite_subqueries(plan, user_params)
         from snappydata_tpu.sql.optimizer import optimize

@@ -16,6 +16,8 @@ ColumnTableScan.scala:115-130).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
 import dataclasses
 from typing import Dict, Optional, Sequence, Tuple
 
@@ -29,6 +31,39 @@ from snappydata_tpu.storage.table_store import ColumnTableData, Manifest
 
 def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+# --- tiled scans: bind a WINDOW of the batch axis ------------------------
+# For tables whose decoded columns exceed the HBM budget, the session
+# streams scan units (column batches + row-buffer chunks) through the same
+# compiled program tile by tile (ref: batch-at-a-time iteration in
+# ColumnFormatIterator, SURVEY §5 "long-context" — table ≫ HBM).
+
+_scan_windows: contextvars.ContextVar = contextvars.ContextVar(
+    "scan_windows", default=None)
+
+
+@contextlib.contextmanager
+def scan_window(data, lo: int, hi: int, manifest=None):
+    """Restrict build_device_table for `data` to units [lo, hi).
+    `manifest` pins one snapshot across a multi-tile pass so concurrent
+    mutations can't make tiles disagree about the table version."""
+    cur = dict(_scan_windows.get() or {})
+    cur[id(data)] = (int(lo), int(hi), manifest)
+    tok = _scan_windows.set(cur)
+    try:
+        yield
+    finally:
+        _scan_windows.reset(tok)
+
+
+def scan_unit_count(data, manifest=None) -> int:
+    """Number of bindable units (column batches + row-buffer chunks)."""
+    if manifest is None:
+        manifest = data.snapshot()
+    n_chunks = -(-manifest.row_count // data.capacity) \
+        if manifest.row_count > 0 else 0
+    return len(manifest.views) + n_chunks
 
 
 @dataclasses.dataclass
@@ -57,11 +92,18 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
     from snappydata_tpu.parallel.mesh import MeshContext
 
     ctx = MeshContext.current()
+    wentry = (_scan_windows.get() or {}).get(id(data))
+    window = None
+    if wentry is not None:
+        window = (wentry[0], wentry[1])
+        if wentry[2] is not None:
+            manifest = wentry[2]   # pinned snapshot for the tile pass
     if manifest is None:
         manifest = data.snapshot()
     # cache key includes the mesh token (placement differs under a mesh;
     # token is process-unique, unlike id() which gets reused after GC)
-    cache_key = (manifest.version, ctx.token if ctx else None)
+    # and the scan window (tiles of one version coexist under the LRU)
+    cache_key = (manifest.version, ctx.token if ctx else None, window)
     cache = data._device_cache.setdefault(cache_key, {})
     # prune stale versions AND stale mesh placements (keep only this exact
     # placement + the previous version of it) so a loop that recreates
@@ -71,6 +113,14 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                                          and k[0] >= manifest.version - 1)]:
         data._device_cache.pop(k, None)
         _cache_budget.forget(data._device_cache, k)
+    if window is not None and not _cache_budget.enabled():
+        # no byte budget to evict for us: a tile pass must not accumulate
+        # every window's arrays (the table is oversized by definition —
+        # that would re-materialize it on device); keep only this tile
+        for k in [k for k in data._device_cache
+                  if k != cache_key and k[2] is not None]:
+            data._device_cache.pop(k, None)
+            _cache_budget.forget(data._device_cache, k)
 
     schema = data.schema
     cap = data.capacity
@@ -83,6 +133,11 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
             take = min(cap, manifest.row_count - pos)
             row_chunks.append((pos, take))
             pos += take
+    if window is not None:
+        units = [("v", v) for v in views] + [("r", rc) for rc in row_chunks]
+        units = units[window[0]:window[1]]
+        views = [u for k, u in units if k == "v"]
+        row_chunks = [u for k, u in units if k == "r"]
     b_actual = len(views) + len(row_chunks)
     b = _next_pow2(b_actual) if data_pow2() else max(1, b_actual)
     b = max(b, 1)
@@ -104,6 +159,8 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
             valid[i] = v.live_mask()
         for j, (_, take) in enumerate(row_chunks):
             valid[len(views) + j, :take] = True
+        if window is not None:  # tile row count ≠ manifest total
+            cache["nrows"] = int(valid.sum())
         cache["valid"] = _place(valid)
 
     columns: Dict[int, jnp.ndarray] = {}
@@ -186,7 +243,8 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
         _cache_budget.touch(data._device_cache, cache_key,
                             _entry_bytes(cache))
     return DeviceTable(schema, b, cap, cache["valid"], columns, dicts,
-                       stats_min, stats_max, manifest.total_rows(), nulls)
+                       stats_min, stats_max,
+                       cache.get("nrows", manifest.total_rows()), nulls)
 
 
 def _build_array_column(data, manifest, views, row_chunks, ci, f, b, cap,

@@ -130,3 +130,18 @@ def test_redeploy_replaces(tmp_path):
     r = s.sql("EXEC PYTHON 'import depmod; result = [depmod.answer()]'")
     assert r.rows()[0][0] == 101
     assert s.sql("LIST JARS").num_rows == 1
+
+
+def test_deploy_same_basename_no_overwrite(tmp_path):
+    a = tmp_path / "a"
+    b = tmp_path / "b"
+    a.mkdir(), b.mkdir()
+    (a / "util.py").write_text("WHO = 'a'\n")
+    (b / "util.py").write_text("WHO = 'b'\n")
+    data = str(tmp_path / "store")
+    s = SnappySession(catalog=Catalog(), data_dir=data)
+    s.sql(f"DEPLOY JAR both '{a / 'util.py'}, {b / 'util.py'}'")
+    import os as _os
+    stored = s._deployed()["both"]["files"]
+    assert len(stored) == len(set(stored)) == 2
+    assert all(_os.path.exists(f) for f in stored)
