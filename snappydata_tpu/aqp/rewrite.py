@@ -46,7 +46,8 @@ def approx_rewrite(plan: ast.Plan, catalog) -> Optional[ast.Plan]:
         if isinstance(p, ast.Aggregate):
             child = rewrite_rel(p.child)
             return ast.Aggregate(child, p.group_exprs,
-                                 tuple(_scale(e) for e in p.agg_exprs))
+                                 tuple(_scale(e) for e in p.agg_exprs),
+                                 grouping_sets=p.grouping_sets)
         if isinstance(p, ast.Filter):
             return ast.Filter(rewrite_rel(p.child), p.condition)
         if isinstance(p, ast.Project):

@@ -965,6 +965,10 @@ class DistributedSession:
         from snappydata_tpu.engine.partial_agg import (NotDecomposableError,
                                                        decompose_aggregate)
 
+        if agg.grouping_sets:
+            raise DistributedError(
+                "ROLLUP/CUBE/GROUPING SETS are not supported distributed "
+                "yet — run on a single member")
         groups = list(agg.group_exprs)
         try:
             partial_plan, merged_select, n_slots, merge_having = \
@@ -1067,7 +1071,7 @@ def _rename_tables(plan: ast.Plan, mapping: Dict[str, str]) -> ast.Plan:
         kids = p.children()
         if not kids:
             return p
-        if isinstance(p, (ast.Join, ast.Union)):
+        if isinstance(p, (ast.Join, ast.Union, ast.SetOp)):
             return dataclasses.replace(p, left=rename(p.left),
                                        right=rename(p.right))
         return dataclasses.replace(p, child=rename(kids[0]))

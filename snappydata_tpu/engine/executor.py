@@ -1783,6 +1783,10 @@ class Executor:
             left = self.execute(node.left, params)
             right = self.execute(node.right, params)
             return hosteval.union(left, right)
+        if isinstance(node, ast.SetOp):
+            left = self.execute(node.left, params)
+            right = self.execute(node.right, params)
+            return hosteval.set_op(left, right, node.op)
 
         from snappydata_tpu.observability.metrics import global_registry
 

@@ -47,7 +47,8 @@ ARRAY_DEVICE_FUNCS = ("size", "element_at", "array_contains")
 # carried as derived dictionaries (codes never leave the device)
 STRING_VALUE_FUNCS = frozenset(
     {"upper", "lower", "trim", "ltrim", "rtrim", "substr", "substring",
-     "replace", "concat"})
+     "replace", "concat", "lpad", "rpad", "initcap", "repeat", "reverse",
+     "translate", "split_part"})
 
 
 @dataclasses.dataclass
@@ -340,6 +341,38 @@ class ExprBuilder:
                     raise CompileError("replace with NULL argument")
                 return v.replace(str(extra[0]),
                                  str(extra[1]) if len(extra) > 1 else "")
+            if name in ("lpad", "rpad"):
+                n2 = int(extra[0])
+                if n2 <= 0:
+                    return ""
+                pad = str(extra[1]) if len(extra) > 1 and \
+                    extra[1] is not None else " "
+                if len(v) >= n2:
+                    return v[:n2]
+                fill = (pad * n2)[:n2 - len(v)] if pad else ""
+                return fill + v if name == "lpad" else v + fill
+            if name == "initcap":
+                return " ".join(p[:1].upper() + p[1:].lower()
+                                for p in v.split(" "))
+            if name == "repeat":
+                return v * max(0, int(extra[0]))
+            if name == "reverse":
+                return v[::-1]
+            if name == "translate":
+                frm = str(extra[0]) if extra and extra[0] is not None else ""
+                to = str(extra[1]) if len(extra) > 1 and \
+                    extra[1] is not None else ""
+                table = {ord(f): (to[i] if i < len(to) else None)
+                         for i, f in enumerate(frm)}
+                return v.translate(table)
+            if name == "split_part":
+                delim = str(extra[0])
+                idx = int(extra[1])
+                parts = v.split(delim) if delim else [v]
+                if idx == 0:
+                    raise CompileError("split_part index must not be 0")
+                pos = idx - 1 if idx > 0 else len(parts) + idx
+                return parts[pos] if 0 <= pos < len(parts) else ""
             raise CompileError(name)
 
         return ci, lambda v: op(base(v))
@@ -779,19 +812,161 @@ class ExprBuilder:
 
             return run_pow
 
-        if name in ("year", "month", "day"):
-            part = name
+        if name in ("year", "month", "day", "dayofmonth", "quarter",
+                    "dayofyear", "dayofweek", "weekofyear"):
+            part = "day" if name == "dayofmonth" else name
 
             def run_datepart(rt: Runtime) -> DVal:
                 c = args[0](rt)
-                days = c.value
-                if c.dtype is not None and c.dtype.name == "timestamp":
-                    days = (c.value // 86_400_000_000).astype(jnp.int32)
+                days = _to_days(c)
                 y, m, d = _civil_from_days(days)
-                out = {"year": y, "month": m, "day": d}[part]
-                return DVal(out, c.null, T.INT)
+                if part in ("year", "month", "day"):
+                    out = {"year": y, "month": m, "day": d}[part]
+                elif part == "quarter":
+                    out = (m + 2) // 3
+                elif part == "dayofyear":
+                    out = days - _days_from_civil(y, jnp.ones_like(m),
+                                                  jnp.ones_like(d)) + 1
+                elif part == "dayofweek":
+                    # Spark: 1=Sunday..7=Saturday (1970-01-01 Thu → 5)
+                    out = (days + 4) % 7 + 1
+                else:  # weekofyear: ISO-8601 week via the Thursday trick
+                    wd = (days + 3) % 7 + 1          # ISO weekday, Mon=1
+                    thu = days + (4 - wd)
+                    ty, _, _ = _civil_from_days(thu)
+                    jan1 = _days_from_civil(ty, jnp.ones_like(ty,
+                                            dtype=jnp.int32),
+                                            jnp.ones_like(ty,
+                                            dtype=jnp.int32))
+                    out = (thu - jan1) // 7 + 1
+                return DVal(out.astype(jnp.int32), c.null, T.INT)
 
             return run_datepart
+
+        if name in ("hour", "minute", "second"):
+            divisor, modulo = {"hour": (3_600_000_000, 24),
+                               "minute": (60_000_000, 60),
+                               "second": (1_000_000, 60)}[name]
+
+            def run_timepart(rt: Runtime) -> DVal:
+                c = args[0](rt)
+                if c.dtype is not None and c.dtype.name == "timestamp":
+                    out = (c.value // divisor) % modulo
+                else:  # DATE has no time component
+                    out = jnp.zeros_like(c.value)
+                return DVal(out.astype(jnp.int32), c.null, T.INT)
+
+            return run_timepart
+
+        if name in ("date_add", "date_sub"):
+            sign = 1 if name == "date_add" else -1
+
+            def run_dateadd(rt: Runtime) -> DVal:
+                a, b = args[0](rt), args[1](rt)
+                out = _to_days(a) + sign * b.value.astype(jnp.int32)
+                return DVal(out.astype(jnp.int32),
+                            _or_null(a.null, b.null), T.DATE)
+
+            return run_dateadd
+
+        if name == "datediff":
+            def run_datediff(rt: Runtime) -> DVal:
+                a, b = args[0](rt), args[1](rt)
+                return DVal((_to_days(a) - _to_days(b)).astype(jnp.int32),
+                            _or_null(a.null, b.null), T.INT)
+
+            return run_datediff
+
+        if name == "add_months":
+            def run_addmonths(rt: Runtime) -> DVal:
+                a, b = args[0](rt), args[1](rt)
+                y, m, d = _civil_from_days(_to_days(a))
+                m0 = y.astype(jnp.int64) * 12 + (m - 1) + \
+                    b.value.astype(jnp.int64)
+                y2 = (m0 // 12).astype(jnp.int32)
+                m2 = (m0 % 12 + 1).astype(jnp.int32)
+                d2 = jnp.minimum(d, _days_in_month(y2, m2))
+                return DVal(_days_from_civil(y2, m2, d2),
+                            _or_null(a.null, b.null), T.DATE)
+
+            return run_addmonths
+
+        if name == "last_day":
+            def run_lastday(rt: Runtime) -> DVal:
+                c = args[0](rt)
+                y, m, _ = _civil_from_days(_to_days(c))
+                return DVal(_days_from_civil(y, m, _days_in_month(y, m)),
+                            c.null, T.DATE)
+
+            return run_lastday
+
+        if name == "trunc":
+            fmt = e.args[1].value if len(e.args) > 1 and \
+                isinstance(e.args[1], ast.Lit) else None
+            if fmt is None:
+                raise CompileError("trunc needs a literal format")
+            fmt = str(fmt).upper()
+
+            def run_trunc(rt: Runtime) -> DVal:
+                c = args[0](rt)
+                days = _to_days(c)
+                y, m, d = _civil_from_days(days)
+                one = jnp.ones_like(m)
+                if fmt in ("YEAR", "YYYY", "YY"):
+                    out = _days_from_civil(y, one, one)
+                elif fmt in ("MONTH", "MM", "MON"):
+                    out = _days_from_civil(y, m, one)
+                elif fmt in ("QUARTER", "Q"):
+                    out = _days_from_civil(y, ((m - 1) // 3) * 3 + 1, one)
+                elif fmt == "WEEK":
+                    out = days - (days + 3) % 7   # ISO Monday
+                else:
+                    raise CompileError(f"trunc format {fmt!r}")
+                return DVal(out.astype(jnp.int32), c.null, T.DATE)
+
+            return run_trunc
+
+        if name == "months_between":
+            def run_mb(rt: Runtime) -> DVal:
+                a, b = args[0](rt), args[1](rt)
+                y1, m1, d1 = _civil_from_days(_to_days(a))
+                y2, m2, d2 = _civil_from_days(_to_days(b))
+                whole = ((y1 - y2) * 12 + (m1 - m2)).astype(_float_dtype())
+                last1 = _days_in_month(y1, m1)
+                last2 = _days_in_month(y2, m2)
+                same = (d1 == d2) | ((d1 == last1) & (d2 == last2))
+                frac = jnp.where(same, 0.0,
+                                 (d1 - d2).astype(_float_dtype()) / 31.0)
+                return DVal(whole + frac, _or_null(a.null, b.null),
+                            T.DOUBLE)
+
+            return run_mb
+
+        if name == "unix_timestamp":
+            def run_unix(rt: Runtime) -> DVal:
+                c = args[0](rt)
+                if c.dtype is not None and c.dtype.name == "timestamp":
+                    out = c.value // 1_000_000
+                else:
+                    out = c.value.astype(jnp.int64) * 86_400
+                return DVal(out.astype(jnp.int64), c.null, T.LONG)
+
+            return run_unix
+
+        if name == "to_date" and args:
+            # date/timestamp input: pure conversion; a string COLUMN is
+            # handled below via the dictionary int-LUT path
+            try:
+                self._string_value_transform(e.args[0])
+                string_input = True
+            except CompileError:
+                string_input = False
+            if not string_input:
+                def run_todate(rt: Runtime) -> DVal:
+                    c = args[0](rt)
+                    return DVal(_to_days(c), c.null, T.DATE)
+
+                return run_todate
 
         if name == "sign":
             return self._unary_math(args[0], lambda x: jnp.sign(
@@ -868,7 +1043,8 @@ class ExprBuilder:
 
         # string functions via derived dictionaries (incl. compositions:
         # upper(concat(s, '_x')), instr(lower(s), 'q'), ...)
-        if name in STRING_VALUE_FUNCS or name in ("length", "instr"):
+        if name in STRING_VALUE_FUNCS or name in ("length", "instr",
+                                                  "ascii", "to_date"):
             return self._emit_string_func(e)
 
         raise CompileError(f"unsupported function on device: {name}")
@@ -888,10 +1064,11 @@ class ExprBuilder:
         lower to int LUT gathers so they compose with device filters."""
         name = e.name
 
-        if name in ("length", "instr"):
+        if name in ("length", "instr", "ascii", "to_date"):
             col_idx, base = self._string_value_transform(e.args[0])
             if col_idx is None:
                 raise CompileError(f"{name} of literal-only expression")
+            out_dtype = T.DATE if name == "to_date" else T.INT
             if name == "instr":
                 if len(e.args) < 2 or not isinstance(e.args[1], ast.Lit):
                     raise CompileError("instr with non-literal needle")
@@ -900,6 +1077,25 @@ class ExprBuilder:
                 def val_of(v):
                     bv = base(v)
                     return bv.find(needle) + 1 if bv is not None else 0
+            elif name == "ascii":
+                def val_of(v):
+                    bv = base(v)
+                    return ord(bv[0]) if bv else 0
+            elif name == "to_date":
+                import datetime as _dt
+
+                epoch = _dt.date(1970, 1, 1).toordinal()
+                _BAD = np.iinfo(np.int32).min   # unparseable sentinel
+
+                def val_of(v):
+                    bv = base(v)
+                    if bv is None:
+                        return _BAD
+                    try:
+                        return _dt.date.fromisoformat(
+                            str(bv)[:10]).toordinal() - epoch
+                    except ValueError:
+                        return _BAD   # → NULL via the sentinel mask
             else:
                 def val_of(v):
                     bv = base(v)
@@ -918,10 +1114,17 @@ class ExprBuilder:
                 return lut
 
             aux_i = self._register_aux(build_ilut)
+            wants_bad_mask = name == "to_date"
 
             def run_ilut(rt: Runtime) -> DVal:
                 c = rt.cols[col_idx]
-                return DVal(rt.aux[aux_i][c.value], c.null, T.INT)
+                out = rt.aux[aux_i][c.value]
+                null = c.null
+                if wants_bad_mask:
+                    bad = out == np.iinfo(np.int32).min
+                    out = jnp.where(bad, 0, out)
+                    null = _or_null(null, bad)
+                return DVal(out, null, out_dtype)
 
             return run_ilut
 
@@ -958,6 +1161,32 @@ def _float_dtype():
     from snappydata_tpu import config
 
     return jnp.float64 if config.use_float64() else jnp.float32
+
+
+def _to_days(c: "DVal"):
+    """date/timestamp DVal → days-since-epoch int32."""
+    if c.dtype is not None and c.dtype.name == "timestamp":
+        return (c.value // 86_400_000_000).astype(jnp.int32)
+    return c.value.astype(jnp.int32)
+
+
+def _days_from_civil(y, m, d):
+    """(year, month, day) → days-since-epoch, vectorized (inverse of
+    _civil_from_days; Hinnant's days_from_civil)."""
+    y = y.astype(jnp.int64) - (m <= 2)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+def _days_in_month(y, m):
+    dim = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                      dtype=jnp.int32)[m - 1]
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    return jnp.where((m == 2) & leap, 29, dim).astype(jnp.int32)
 
 
 def _civil_from_days(days):

@@ -177,6 +177,46 @@ def _eval_binop(e: ast.BinOp, cols, nulls, params, n):
     return fn(a, b), nl
 
 
+
+def _np_to_days(v, dt_in):
+    v = np.asarray(v)
+    if dt_in is not None and dt_in.name == "timestamp":
+        return (v.astype(np.int64) // 86_400_000_000).astype(np.int64)
+    return v.astype(np.int64)
+
+
+def _np_civil_from_days(days):
+    """Vectorized Hinnant civil_from_days (numpy twin of exprs.py)."""
+    z = np.asarray(days, dtype=np.int64) + 719468
+    era = np.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = np.where(mp < 10, mp + 3, mp - 9)
+    y = np.where(m <= 2, y + 1, y)
+    return y.astype(np.int64), m.astype(np.int64), d.astype(np.int64)
+
+
+def _np_days_from_civil(y, m, d):
+    y = np.asarray(y, dtype=np.int64) - (np.asarray(m) <= 2)
+    era = np.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = np.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(np.int64)
+
+
+def _np_days_in_month(y, m):
+    dim = np.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                     dtype=np.int64)[np.asarray(m, dtype=np.int64) - 1]
+    leap = ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+    return np.where((np.asarray(m) == 2) & leap, 29, dim)
+
+
 def _eval_func(e: ast.Func, cols, nulls, params, n):
     name = e.name
     args = [eval_expr(a, cols, nulls, params, n) for a in e.args]
@@ -203,21 +243,182 @@ def _eval_func(e: ast.Func, cols, nulls, params, n):
     if name in ("pow", "power"):
         return np.power(args[0][0].astype(np.float64), args[1][0]), \
             _or_null(args[0][1], args[1][1])
-    if name in ("year", "month", "day"):
+    if name in ("year", "month", "day", "dayofmonth", "quarter",
+                "dayofyear", "dayofweek", "weekofyear"):
+        v, nl = args[0]
+        days = _np_to_days(v, expr_type(e.args[0]))
+        y, m, d = _np_civil_from_days(days)
+        if name in ("year",):
+            part = y
+        elif name == "month":
+            part = m
+        elif name in ("day", "dayofmonth"):
+            part = d
+        elif name == "quarter":
+            part = (m + 2) // 3
+        elif name == "dayofyear":
+            part = days - _np_days_from_civil(y, np.ones_like(m),
+                                              np.ones_like(d)) + 1
+        elif name == "dayofweek":
+            part = (days + 4) % 7 + 1
+        else:  # weekofyear (ISO)
+            wd = (days + 3) % 7 + 1
+            thu = days + (4 - wd)
+            ty, _, _ = _np_civil_from_days(thu)
+            jan1 = _np_days_from_civil(ty, np.ones_like(ty),
+                                       np.ones_like(ty))
+            part = (thu - jan1) // 7 + 1
+        return part.astype(np.int32), nl
+    if name in ("hour", "minute", "second"):
+        v, nl = args[0]
+        divisor, modulo = {"hour": (3_600_000_000, 24),
+                           "minute": (60_000_000, 60),
+                           "second": (1_000_000, 60)}[name]
+        if expr_type(e.args[0]).name == "timestamp":
+            out = (np.asarray(v, dtype=np.int64) // divisor) % modulo
+        else:
+            out = np.zeros_like(np.asarray(v, dtype=np.int64))
+        return out.astype(np.int32), nl
+    if name in ("date_add", "date_sub"):
+        sign = 1 if name == "date_add" else -1
+        a, an = args[0]
+        b, bn = args[1]
+        days = _np_to_days(a, expr_type(e.args[0]))
+        out = days + sign * np.asarray(b, dtype=np.int64)
+        return out.astype(np.int32), _or_null(an, bn)
+    if name == "datediff":
+        a, an = args[0]
+        b, bn = args[1]
+        out = _np_to_days(a, expr_type(e.args[0])) - \
+            _np_to_days(b, expr_type(e.args[1]))
+        return out.astype(np.int32), _or_null(an, bn)
+    if name == "add_months":
+        a, an = args[0]
+        b, bn = args[1]
+        y, m, d = _np_civil_from_days(_np_to_days(a, expr_type(e.args[0])))
+        m0 = y * 12 + (m - 1) + np.asarray(b, dtype=np.int64)
+        y2, m2 = m0 // 12, m0 % 12 + 1
+        d2 = np.minimum(d, _np_days_in_month(y2, m2))
+        return _np_days_from_civil(y2, m2, d2).astype(np.int32), \
+            _or_null(an, bn)
+    if name == "last_day":
+        v, nl = args[0]
+        y, m, _d = _np_civil_from_days(_np_to_days(v, expr_type(e.args[0])))
+        return _np_days_from_civil(y, m, _np_days_in_month(y, m)) \
+            .astype(np.int32), nl
+    if name == "trunc":
+        v, nl = args[0]
+        if len(e.args) < 2 or not isinstance(e.args[1], ast.Lit):
+            raise HostEvalError("trunc needs a literal format")
+        fmt = str(e.args[1].value).upper()
+        days = _np_to_days(v, expr_type(e.args[0]))
+        y, m, d = _np_civil_from_days(days)
+        one = np.ones_like(m)
+        if fmt in ("YEAR", "YYYY", "YY"):
+            out = _np_days_from_civil(y, one, one)
+        elif fmt in ("MONTH", "MM", "MON"):
+            out = _np_days_from_civil(y, m, one)
+        elif fmt in ("QUARTER", "Q"):
+            out = _np_days_from_civil(y, ((m - 1) // 3) * 3 + 1, one)
+        elif fmt == "WEEK":
+            out = days - (days + 3) % 7
+        else:
+            raise ValueError(f"trunc format {fmt!r}")
+        return out.astype(np.int32), nl
+    if name == "months_between":
+        a, an = args[0]
+        b, bn = args[1]
+        y1, m1, d1 = _np_civil_from_days(_np_to_days(a, expr_type(e.args[0])))
+        y2, m2, d2 = _np_civil_from_days(_np_to_days(b, expr_type(e.args[1])))
+        whole = ((y1 - y2) * 12 + (m1 - m2)).astype(np.float64)
+        same = (d1 == d2) | ((d1 == _np_days_in_month(y1, m1))
+                             & (d2 == _np_days_in_month(y2, m2)))
+        frac = np.where(same, 0.0, (d1 - d2).astype(np.float64) / 31.0)
+        return whole + frac, _or_null(an, bn)
+    if name == "unix_timestamp":
+        v, nl = args[0]
+        if expr_type(e.args[0]).name == "timestamp":
+            out = np.asarray(v, dtype=np.int64) // 1_000_000
+        else:
+            out = np.asarray(v, dtype=np.int64) * 86_400
+        return out, nl
+    if name == "to_date":
         v, nl = args[0]
         dt_in = expr_type(e.args[0])
-        days = (v // 86_400_000_000).astype(np.int64) \
-            if dt_in.name == "timestamp" else v.astype(np.int64)
-        dates = np.array([datetime.date.fromordinal(
-            int(d) + datetime.date(1970, 1, 1).toordinal()) for d in days])
-        part = np.array([getattr(d, name) for d in dates], dtype=np.int32)
-        return part, nl
-    if name in ("upper", "lower", "trim", "ltrim", "rtrim"):
+        if dt_in.name in ("date", "timestamp"):
+            return _np_to_days(v, dt_in).astype(np.int32), nl
+        epoch = datetime.date(1970, 1, 1).toordinal()
+        out = np.zeros(len(v), dtype=np.int32)
+        bad = np.zeros(len(v), dtype=bool)
+        for i, x in enumerate(v):
+            if x is None:
+                bad[i] = True
+                continue
+            try:
+                out[i] = datetime.date.fromisoformat(
+                    str(x)[:10]).toordinal() - epoch
+            except ValueError:
+                bad[i] = True
+        return out, _or_null(nl, bad if bad.any() else None)
+    if name == "ascii":
+        v, nl = args[0]
+        return np.array([ord(str(x)[0]) if x is not None and str(x)
+                         else 0 for x in v], dtype=np.int32), nl
+    if name in ("upper", "lower", "trim", "ltrim", "rtrim", "initcap",
+                "reverse"):
         fn = {"upper": str.upper, "lower": str.lower, "trim": str.strip,
-              "ltrim": str.lstrip, "rtrim": str.rstrip}[name]
+              "ltrim": str.lstrip, "rtrim": str.rstrip,
+              "initcap": lambda s: " ".join(
+                  p[:1].upper() + p[1:].lower() for p in s.split(" ")),
+              "reverse": lambda s: s[::-1]}[name]
         v, nl = args[0]
         return np.array([fn(str(x)) if x is not None else None for x in v],
                         dtype=object), nl
+    if name in ("lpad", "rpad"):
+        v, nl = args[0]
+        n2 = int(np.asarray(args[1][0]).flat[0])
+        pad = str(np.asarray(args[2][0]).flat[0]) if len(args) > 2 else " "
+
+        def padfn(x):
+            if x is None:
+                return None
+            if n2 <= 0:
+                return ""
+            sx = str(x)
+            if len(sx) >= n2:
+                return sx[:n2]
+            fill = (pad * n2)[:n2 - len(sx)] if pad else ""
+            return fill + sx if name == "lpad" else sx + fill
+
+        return np.array([padfn(x) for x in v], dtype=object), nl
+    if name == "repeat":
+        v, nl = args[0]
+        times = int(np.asarray(args[1][0]).flat[0])
+        return np.array([str(x) * max(0, times) if x is not None else None
+                         for x in v], dtype=object), nl
+    if name == "translate":
+        v, nl = args[0]
+        frm = str(np.asarray(args[1][0]).flat[0])
+        to = str(np.asarray(args[2][0]).flat[0]) if len(args) > 2 else ""
+        table = {ord(f): (to[i] if i < len(to) else None)
+                 for i, f in enumerate(frm)}
+        return np.array([str(x).translate(table) if x is not None else None
+                         for x in v], dtype=object), nl
+    if name == "split_part":
+        v, nl = args[0]
+        delim = str(np.asarray(args[1][0]).flat[0])
+        idx = int(np.asarray(args[2][0]).flat[0])
+        if idx == 0:
+            raise HostEvalError("split_part index must not be 0")
+
+        def part(x):
+            if x is None:
+                return None
+            parts = str(x).split(delim) if delim else [str(x)]
+            pos = idx - 1 if idx > 0 else len(parts) + idx
+            return parts[pos] if 0 <= pos < len(parts) else ""
+
+        return np.array([part(x) for x in v], dtype=object), nl
     if name in ("substr", "substring"):
         v, nl = args[0]
         start = int(np.asarray(args[1][0]).flat[0]) - 1 if len(args) > 1 else 0
@@ -512,6 +713,39 @@ def union(a: Result, b: Result) -> Result:
             b.num_rows, dtype=bool)
         merged = np.concatenate([na, nb])
         nulls.append(merged if merged.any() else None)
+    return Result(a.names, cols, nulls, a.dtypes)
+
+
+def set_op(a: Result, b: Result, op: str) -> Result:
+    """INTERSECT / EXCEPT with SQL set semantics: DISTINCT output, and
+    NULLs compare EQUAL (unlike joins) — row-tuples with None make that
+    free in Python."""
+    def row_tuples(r: Result):
+        out = []
+        for i in range(r.num_rows):
+            row = []
+            for c, nm in zip(r.columns, r.nulls):
+                if (nm is not None and nm[i]) or \
+                        (c.dtype == object and c[i] is None):
+                    row.append(None)
+                else:
+                    v = c[i]
+                    row.append(v.item() if hasattr(v, "item") else v)
+            out.append(tuple(row))
+        return out
+
+    right = set(row_tuples(b))
+    seen = set()
+    keep_idx = []
+    for i, row in enumerate(row_tuples(a)):
+        if row in seen:
+            continue
+        seen.add(row)
+        if (op == "intersect") == (row in right):
+            keep_idx.append(i)
+    idx = np.asarray(keep_idx, dtype=np.int64)
+    cols = [c[idx] for c in a.columns]
+    nulls = [nm[idx] if nm is not None else None for nm in a.nulls]
     return Result(a.names, cols, nulls, a.dtypes)
 
 
@@ -882,7 +1116,7 @@ def _eval_rel(plan: ast.Plan, params, executor):
         return _eval_aggregate(plan, params, executor)
 
     if isinstance(plan, (ast.Sort, ast.Limit, ast.Distinct, ast.Union,
-                         ast.Values, ast.WindowProject)):
+                         ast.SetOp, ast.Values, ast.WindowProject)):
         r = executor.execute(plan, params)
         return r.columns, r.nulls, r.names, r.dtypes, r.num_rows
 

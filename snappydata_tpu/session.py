@@ -111,7 +111,7 @@ class SnappySession:
             kids = p.children()
             if not kids:
                 return p
-            if isinstance(p, (ast.Join, ast.Union)):
+            if isinstance(p, (ast.Join, ast.Union, ast.SetOp)):
                 p = _dc.replace(p, left=rec(p.left), right=rec(p.right))
             else:
                 p = _dc.replace(p, child=rec(kids[0]))
@@ -501,6 +501,8 @@ class SnappySession:
                 return "Distinct (host)"
             if isinstance(p, ast.Union):
                 return "Union"
+            if isinstance(p, ast.SetOp):
+                return p.op.capitalize() + " (host)"
             if isinstance(p, ast.SubqueryAlias):
                 return f"SubqueryAlias {p.alias}"
             if isinstance(p, ast.Values):
@@ -563,6 +565,8 @@ class SnappySession:
             node = node.child
         if not isinstance(node, ast.Aggregate):
             return None
+        if node.grouping_sets:
+            return None  # expands to a union at analysis; never tile raw
 
         rels: List[str] = []
         exprs: List[ast.Expr] = []
@@ -570,7 +574,7 @@ class SnappySession:
         def rec(p):
             if isinstance(p, (ast.WindowedRelation, ast.WindowProject,
                               ast.Values, ast.Join, ast.Union,
-                              ast.Distinct)):
+                              ast.SetOp, ast.Distinct)):
                 rels.append("__unsupported__")
                 return
             if isinstance(p, ast.UnresolvedRelation):
@@ -1440,7 +1444,7 @@ class SnappySession:
             kids = p.children()
             if not kids:
                 return p
-            if isinstance(p, (ast.Join, ast.Union)):
+            if isinstance(p, (ast.Join, ast.Union, ast.SetOp)):
                 return _dc.replace(p, left=walk_plans(p.left),
                                    right=walk_plans(p.right))
             return _dc.replace(p, child=walk_plans(kids[0]))
@@ -1925,7 +1929,7 @@ def _output_schema(plan: ast.Plan) -> T.Schema:
         left = _output_schema(plan.left)
         right = _output_schema(plan.right)
         return T.Schema(list(left.fields) + list(right.fields))
-    if isinstance(plan, ast.Union):
+    if isinstance(plan, (ast.Union, ast.SetOp)):
         return _output_schema(plan.left)
     if isinstance(plan, ast.Values):
         row = plan.rows[0]

@@ -128,8 +128,21 @@ def expr_type(e: ast.Expr) -> T.DataType:
             return at if at.name == "decimal" else T.DOUBLE
         if low in ("sum", "min", "max", "first", "last", "abs", "coalesce"):
             return expr_type(e.args[0])
-        if low in ("year", "month", "day", "length", "instr", "size"):
+        if low in ("year", "month", "day", "length", "instr", "size",
+                   "dayofmonth", "dayofweek", "dayofyear", "weekofyear",
+                   "quarter", "hour", "minute", "second", "datediff",
+                   "ascii"):
             return T.INT
+        if low in ("date_add", "date_sub", "add_months", "last_day",
+                   "trunc", "to_date"):
+            return T.DATE
+        if low == "unix_timestamp":
+            return T.LONG
+        if low == "months_between":
+            return T.DOUBLE
+        if low in ("lpad", "rpad", "initcap", "repeat", "reverse",
+                   "translate", "split_part"):
+            return T.STRING
         if low == "array":
             elem = expr_type(e.args[0]) if e.args else T.DOUBLE
             return T.ArrayType("array", elem)
@@ -234,6 +247,16 @@ class Analyzer:
     # --- plans -----------------------------------------------------------
 
     def analyze_plan(self, plan: ast.Plan) -> Tuple[ast.Plan, Scope]:
+        # ROLLUP/CUBE/GROUPING SETS expand HERE, not in the session, so
+        # the rewrite also reaches view bodies and subquery plans (review
+        # finding: a view over a ROLLUP silently lost its total rows)
+        if isinstance(plan, ast.Filter) and \
+                isinstance(plan.child, ast.Aggregate) and \
+                plan.child.grouping_sets:
+            return self.analyze_plan(
+                self._expand_grouping(plan.child, plan.condition))
+        if isinstance(plan, ast.Aggregate) and plan.grouping_sets:
+            return self.analyze_plan(self._expand_grouping(plan, None))
         if isinstance(plan, ast.UnresolvedRelation):
             view = self.catalog.lookup_view(plan.name)
             if view is not None:
@@ -383,6 +406,14 @@ class Analyzer:
                 raise AnalysisError("UNION children must have equal arity")
             return ast.Union(left, right, plan.all), ls
 
+        if isinstance(plan, ast.SetOp):
+            left, ls = self.analyze_plan(plan.left)
+            right, rs = self.analyze_plan(plan.right)
+            if len(ls.entries) != len(rs.entries):
+                raise AnalysisError(
+                    f"{plan.op.upper()} children must have equal arity")
+            return ast.SetOp(left, right, plan.op), ls
+
         raise AnalysisError(f"cannot analyze plan node {type(plan).__name__}")
 
     def _resolve_having(self, cond: ast.Expr, agg: ast.Aggregate,
@@ -432,13 +463,89 @@ class Analyzer:
 
     # --- expressions -----------------------------------------------------
 
+    def _expand_grouping(self, agg: ast.Aggregate, having) -> ast.Plan:
+        """ROLLUP/CUBE/GROUPING SETS → UNION ALL of plain aggregates with
+        NULL-filled absent keys (ref: Spark's Expand-node lowering, which
+        SnappyData inherits). The full grouping set comes first so the
+        union's output names/types anchor there; a HAVING directly above
+        applies per variant. Absent keys become NULLs in a PROJECT above
+        each aggregate — constant select items inside a grouped aggregate
+        are a shape hazard — and real exprs are renamed __gsN inside so
+        the project references them unambiguously."""
+        base_agg = dataclasses.replace(agg, grouping_sets=None)
+        resolved, _ = self.analyze_plan(base_agg)
+        gtypes = [expr_type(g) for g in resolved.group_exprs]
+        variants = []
+        for sset in agg.grouping_sets:
+            keep = set(sset)
+
+            def gone_idx(e):
+                """index of the absent group expr this item IS."""
+                b = e.child if isinstance(e, ast.Alias) else e
+                for gi, g in enumerate(agg.group_exprs):
+                    if b == g and gi not in keep:
+                        return gi
+                return None
+
+            def repl(e):
+                for gi, g in enumerate(agg.group_exprs):
+                    if e == g and gi not in keep:
+                        return ast.Cast(ast.Lit(None), gtypes[gi])
+                return e.map_children(repl)
+
+            inner, outer_items = [], []
+            for i, e in enumerate(agg.agg_exprs):
+                name = _expr_name(e)
+                gi = gone_idx(e)
+                if gi is not None:
+                    outer_items.append(
+                        ast.Alias(ast.Cast(ast.Lit(None), gtypes[gi]),
+                                  name))
+                    continue
+                b = e.child if isinstance(e, ast.Alias) else e
+                inner.append(ast.Alias(repl(b), f"__gs{i}"))
+                outer_items.append(ast.Alias(ast.Col(f"__gs{i}"), name))
+            v: ast.Plan = ast.Aggregate(
+                agg.child,
+                tuple(agg.group_exprs[i] for i in sset),
+                tuple(inner))
+            if having is not None:
+                v = ast.Filter(v, repl(having))
+            variants.append(ast.Project(v, tuple(outer_items)))
+        merged = variants[0]
+        for v in variants[1:]:
+            merged = ast.Union(merged, v, all=True)
+        return merged
+
     def resolve_expr(self, e: ast.Expr, scope: Scope) -> ast.Expr:
         def rec(node: ast.Expr) -> ast.Expr:
             if isinstance(node, ast.Col):
-                idx, entry = scope.resolve(node.name, node.qualifier)
+                try:
+                    idx, entry = scope.resolve(node.name, node.qualifier)
+                except AnalysisError:
+                    # bare SQL-standard CURRENT_DATE / CURRENT_TIMESTAMP
+                    # (no parens) parse as columns; a REAL column of that
+                    # name wins, otherwise fold like the call form
+                    if node.qualifier is None and node.name.lower() in (
+                            "current_date", "current_timestamp"):
+                        return rec(ast.Func(node.name.lower(), ()))
+                    raise
                 return ast.Col(entry.name, entry.qualifier, idx, entry.dtype)
             if isinstance(node, ast.Star):
                 raise AnalysisError("* is only allowed in a select list")
+            if isinstance(node, ast.Func) and not node.args and \
+                    node.name in ("current_date", "current_timestamp",
+                                  "now"):
+                # folded PER EXECUTION (analysis runs on every sql() call,
+                # cache hit or not) into a plain literal, which tokenizes
+                # into a rebound parameter — a cached plan never bakes a
+                # stale clock (same mechanism as the stream-window cutoff)
+                import time as _time
+
+                now = _time.time()
+                if node.name == "current_date":
+                    return ast.Lit(int(now // 86400), T.DATE)
+                return ast.Lit(int(now * 1_000_000), T.TIMESTAMP)
             return node.map_children(rec)
 
         return rec(e)
@@ -500,6 +607,19 @@ class Analyzer:
         try:
             return self.resolve_expr(e, scope)
         except AnalysisError:
+            # output-NAME match: ORDER BY year(d) over a union/rollup whose
+            # output column is literally named "year(d)" — the inputs are
+            # gone, only the output name survives. Never for plain Cols
+            # (they have real resolution + hidden-projection handling),
+            # and only on a UNIQUE match.
+            if not isinstance(e, ast.Col):
+                nm = _expr_name(e).lower()
+                hits = [(i, entry) for i, entry in enumerate(scope.entries)
+                        if entry.name.lower() == nm]
+                if len(hits) == 1:
+                    i, entry = hits[0]
+                    return ast.Col(entry.name, entry.qualifier, i,
+                                   entry.dtype)
             # structural match against aggregate/project output, e.g.
             # ORDER BY sum(x) when select list has Alias(sum(x), 'revenue')
             if isinstance(child, (ast.Aggregate, ast.Project)):
@@ -541,7 +661,7 @@ class Analyzer:
             if plan.how in ("semi", "anti"):
                 return self._scope_of(plan.left)
             return self._scope_of(plan.left) + self._scope_of(plan.right)
-        if isinstance(plan, ast.Union):
+        if isinstance(plan, (ast.Union, ast.SetOp)):
             return self._scope_of(plan.left)
         if isinstance(plan, ast.Values):
             return [ScopeEntry(None, f"col{i + 1}", expr_type(e))
@@ -556,7 +676,8 @@ class Analyzer:
 # literal args of these functions stay literal under tokenization: they
 # derive string dictionaries at compile time (see exprs._emit_string_func)
 _STRUCTURAL_LIT_FUNCS = frozenset(
-    {"substr", "substring", "replace", "instr", "concat"})
+    {"substr", "substring", "replace", "instr", "concat", "trunc",
+     "lpad", "rpad", "repeat", "translate", "split_part"})
 
 
 def tokenize_plan(plan: ast.Plan) -> Tuple[ast.Plan, Tuple[Any, ...]]:
@@ -625,6 +746,8 @@ def tokenize_plan(plan: ast.Plan) -> Tuple[ast.Plan, Tuple[Any, ...]]:
             return ast.Distinct(tok(p.child))
         if isinstance(p, ast.Union):
             return ast.Union(tok(p.left), tok(p.right), p.all)
+        if isinstance(p, ast.SetOp):
+            return ast.SetOp(tok(p.left), tok(p.right), p.op)
         if isinstance(p, ast.SubqueryAlias):
             return ast.SubqueryAlias(tok(p.child), p.alias)
         return p
@@ -674,6 +797,8 @@ def assign_param_positions(plan: ast.Plan, offset: int) -> ast.Plan:
             return ast.Distinct(fix(p.child))
         if isinstance(p, ast.Union):
             return ast.Union(fix(p.left), fix(p.right), p.all)
+        if isinstance(p, ast.SetOp):
+            return ast.SetOp(fix(p.left), fix(p.right), p.op)
         if isinstance(p, ast.SubqueryAlias):
             return ast.SubqueryAlias(fix(p.child), p.alias)
         if isinstance(p, ast.Values):

@@ -339,6 +339,10 @@ class Aggregate(Plan):
     child: Plan
     group_exprs: Tuple[Expr, ...]
     agg_exprs: Tuple[Expr, ...]  # full select list incl. group cols
+    # ROLLUP/CUBE/GROUPING SETS: tuples of indices into group_exprs; the
+    # session expands them into a UNION ALL of plain aggregates with
+    # NULL-filled absent keys before planning (ref: Spark's Expand node)
+    grouping_sets: Optional[Tuple[Tuple[int, ...], ...]] = None
 
     def children(self):
         return (self.child,)
@@ -409,6 +413,21 @@ class Union(Plan):
 
 
 @dataclasses.dataclass(frozen=True)
+class SetOp(Plan):
+    """INTERSECT / EXCEPT (both DISTINCT semantics, SQL default). Executed
+    host-side over materialized children (ref: Spark ReplaceIntersectWith
+    SemiJoin / ReplaceExceptWithAntiJoin rewrites feed its exec; set ops
+    are driver-small here)."""
+
+    left: Plan = None
+    right: Plan = None
+    op: str = "intersect"   # 'intersect' | 'except'
+
+    def children(self):
+        return (self.left, self.right)
+
+
+@dataclasses.dataclass(frozen=True)
 class Values(Plan):
     rows: Tuple[Tuple[Expr, ...], ...]
 
@@ -458,7 +477,8 @@ def transform_plan_exprs(p: Plan, fn) -> Plan:
     if isinstance(p, Aggregate):
         return Aggregate(transform_plan_exprs(p.child, fn),
                          tuple(t(g) for g in p.group_exprs),
-                         tuple(t(e) for e in p.agg_exprs))
+                         tuple(t(e) for e in p.agg_exprs),
+                         grouping_sets=p.grouping_sets)
     if isinstance(p, Join):
         return Join(transform_plan_exprs(p.left, fn),
                     transform_plan_exprs(p.right, fn), p.how,
@@ -473,6 +493,9 @@ def transform_plan_exprs(p: Plan, fn) -> Plan:
     if isinstance(p, Union):
         return Union(transform_plan_exprs(p.left, fn),
                      transform_plan_exprs(p.right, fn), p.all)
+    if isinstance(p, SetOp):
+        return SetOp(transform_plan_exprs(p.left, fn),
+                     transform_plan_exprs(p.right, fn), p.op)
     if isinstance(p, SubqueryAlias):
         return SubqueryAlias(transform_plan_exprs(p.child, fn), p.alias)
     if isinstance(p, Values):

@@ -23,7 +23,8 @@ KEYWORDS = {
     "as", "and", "or", "not", "in", "between", "like", "is", "null",
     "case", "when", "then", "else", "end", "cast", "distinct", "all",
     "join", "inner", "left", "right", "full", "outer", "cross", "semi",
-    "anti", "natural", "on", "using", "union", "asc", "desc", "nulls",
+    "anti", "natural", "on", "using", "union", "intersect",
+    "except", "minus", "asc", "desc", "nulls",
     "first", "last", "exists", "create", "table", "drop", "truncate",
     "insert", "put", "overwrite", "into", "values", "update", "set",
     "delete", "if", "temporary", "view", "replace", "show", "tables",

@@ -30,12 +30,16 @@ def optimize(plan: ast.Plan, catalog) -> ast.Plan:
         return ast.Limit(optimize(plan.child, catalog), plan.n)
     if isinstance(plan, ast.Distinct):
         return ast.Distinct(optimize(plan.child, catalog))
+    if isinstance(plan, ast.SetOp):
+        return ast.SetOp(optimize(plan.left, catalog),
+                         optimize(plan.right, catalog), plan.op)
     if isinstance(plan, ast.Union):
         return ast.Union(optimize(plan.left, catalog),
                          optimize(plan.right, catalog), plan.all)
     if isinstance(plan, ast.Aggregate):
         return ast.Aggregate(optimize(plan.child, catalog),
-                             plan.group_exprs, plan.agg_exprs)
+                             plan.group_exprs, plan.agg_exprs,
+                             grouping_sets=plan.grouping_sets)
     if isinstance(plan, ast.Project):
         return ast.Project(optimize(plan.child, catalog), plan.exprs)
     if isinstance(plan, ast.WindowProject):

@@ -101,6 +101,10 @@ class Parser:
             plan = self.query_expr()
             self._finish()
             return ast.Query(plan)
+        if low == "with":
+            plan = self.with_query()
+            self._finish()
+            return ast.Query(plan)
         if low == "create":
             return self._finishing(self.create_stmt())
         if low == "drop":
@@ -211,18 +215,56 @@ class Parser:
     # --- queries ----------------------------------------------------------
 
     def query_expr(self) -> ast.Plan:
-        left = self.query_term()
-        while self.at_kw("union"):
-            self.next()
-            all_ = self.accept_kw("all")
-            if not all_:
+        left = self.intersect_term()
+        while self.at_kw("union", "except", "minus"):
+            op = self.next().value.lower()
+            if op == "union":
+                all_ = self.accept_kw("all")
+                if not all_:
+                    self.accept_kw("distinct")
+                right = self.intersect_term()
+                left = ast.Union(left, right, all=all_)
+                if not all_:
+                    left = ast.Distinct(left)
+            else:  # EXCEPT / MINUS (DISTINCT semantics, like Spark)
                 self.accept_kw("distinct")
-            right = self.query_term()
-            left = ast.Union(left, right, all=all_)
-            if not all_:
-                left = ast.Distinct(left)
+                right = self.intersect_term()
+                left = ast.SetOp(left, right, "except")
         # trailing ORDER BY / LIMIT apply to the union result
         left = self._order_limit(left)
+        return left
+
+    def with_query(self) -> ast.Plan:
+        """WITH name AS (query) [, ...] query — non-recursive CTEs,
+        spliced by substitution like views (each CTE sees the ones
+        defined before it)."""
+        self.expect_kw("with")
+        ctes = []
+        while True:
+            name = self.ident()
+            self.expect_kw("as")
+            self.expect_op("(")
+            sub = self.query_expr()
+            self.expect_op(")")
+            ctes.append((name, sub))
+            if not self.accept_op(","):
+                break
+        main = self.query_expr()
+        resolved = []
+        for name, sub in ctes:
+            for pn, pp in resolved:
+                sub = _substitute_cte(sub, pn, pp)
+            resolved.append((name, sub))
+        for pn, pp in resolved:
+            main = _substitute_cte(main, pn, pp)
+        return main
+
+    def intersect_term(self) -> ast.Plan:
+        left = self.query_term()
+        while self.at_kw("intersect"):
+            self.next()
+            self.accept_kw("distinct")
+            left = ast.SetOp(left, self.query_term(), "intersect")
         return left
 
     def query_term(self) -> ast.Plan:
@@ -270,12 +312,61 @@ class Parser:
             plan = ast.Filter(plan, self.expr())
 
         group_exprs: List[ast.Expr] = []
+        grouping_sets = None
         if self.at_kw("group"):
             self.next()
             self.expect_kw("by")
-            group_exprs.append(self.expr())
-            while self.accept_op(","):
+            t2 = self.peek()
+            word = t2.value.lower() if t2.kind in ("IDENT", "KW") else ""
+            if word in ("rollup", "cube"):
+                self.next()
+                self.expect_op("(")
                 group_exprs.append(self.expr())
+                while self.accept_op(","):
+                    group_exprs.append(self.expr())
+                self.expect_op(")")
+                n = len(group_exprs)
+                if word == "rollup":
+                    grouping_sets = tuple(
+                        tuple(range(n - i)) for i in range(n + 1))
+                else:  # cube: all subsets, full set first
+                    grouping_sets = tuple(sorted(
+                        (tuple(j for j in range(n) if (mask >> j) & 1)
+                         for mask in range(1 << n)),
+                        key=lambda sset: -len(sset)))
+            elif word == "grouping":
+                self.next()
+                nxt = self.next()
+                if nxt.value.lower() != "sets":
+                    raise SQLSyntaxError("expected SETS after GROUPING")
+                self.expect_op("(")
+                raw_sets = []
+                while True:
+                    self.expect_op("(")
+                    one = []
+                    if not self.at_op(")"):
+                        one.append(self.expr())
+                        while self.accept_op(","):
+                            one.append(self.expr())
+                    self.expect_op(")")
+                    raw_sets.append(one)
+                    if not self.accept_op(","):
+                        break
+                self.expect_op(")")
+                # group_exprs = first-appearance order over all sets
+                sets_idx = []
+                for one in raw_sets:
+                    idxs = []
+                    for e in one:
+                        if e not in group_exprs:
+                            group_exprs.append(e)
+                        idxs.append(group_exprs.index(e))
+                    sets_idx.append(tuple(idxs))
+                grouping_sets = tuple(sets_idx)
+            else:
+                group_exprs.append(self.expr())
+                while self.accept_op(","):
+                    group_exprs.append(self.expr())
 
         having = None
         if self.accept_kw("having"):
@@ -283,7 +374,9 @@ class Parser:
 
         has_agg = any(ast.is_aggregate(e) for e in select_list)
         if group_exprs or has_agg or having is not None:
-            plan = ast.Aggregate(plan, tuple(group_exprs), tuple(select_list))
+            plan = ast.Aggregate(plan, tuple(group_exprs),
+                                 tuple(select_list),
+                                 grouping_sets=grouping_sets)
             if having is not None:
                 plan = ast.Filter(plan, having)
         else:
@@ -291,7 +384,8 @@ class Parser:
 
         if distinct:
             plan = ast.Distinct(plan)
-        plan = self._order_limit(plan)
+        # ORDER BY / LIMIT are applied by query_expr AFTER any set-op
+        # chain: `a UNION b ORDER BY k` sorts the union, not b
         return plan
 
     def _order_limit(self, plan: ast.Plan) -> ast.Plan:
@@ -619,7 +713,36 @@ class Parser:
             base = ast.Func("element_at", (base, idx1))
         return base
 
+    _EXTRACT_PARTS = {
+        "year": "year", "yyyy": "year", "yy": "year",
+        "month": "month", "mon": "month", "mm": "month",
+        "day": "day", "dd": "day", "week": "weekofyear",
+        "quarter": "quarter", "hour": "hour", "minute": "minute",
+        "second": "second", "dow": "dayofweek", "doy": "dayofyear",
+    }
+
     def func_call(self, name: str) -> ast.Expr:
+        low0 = name.lower()
+        if low0 == "extract":
+            # EXTRACT(part FROM expr) → part(expr)
+            self.expect_op("(")
+            part_t = self.next()
+            part = self._EXTRACT_PARTS.get(part_t.value.lower())
+            if part is None:
+                raise SQLSyntaxError(
+                    f"EXTRACT field {part_t.value!r} not supported")
+            self.expect_kw("from")
+            e = self.expr()
+            self.expect_op(")")
+            return ast.Func(part, (e,))
+        if low0 == "position":
+            # position(needle IN haystack) → instr(haystack, needle)
+            self.expect_op("(")
+            needle = self.add_expr()   # stop below the IN operator
+            self.expect_kw("in")
+            hay = self.expr()
+            self.expect_op(")")
+            return ast.Func("instr", (hay, needle))
         self.expect_op("(")
         if self.at_op("*"):
             self.next()
@@ -1002,3 +1125,30 @@ class Parser:
 
 def parse(sql: str) -> ast.Statement:
     return Parser(sql).parse_statement()
+
+
+def _substitute_cte(p, name: str, sub):
+    """Replace UnresolvedRelation(name) with SubqueryAlias(sub) anywhere in
+    the plan/expression tree (incl. subquery expressions)."""
+    import dataclasses as _dc
+
+    if isinstance(p, ast.UnresolvedRelation) and \
+            p.name.lower() == name.lower():
+        return ast.SubqueryAlias(sub, p.alias or name)
+    if not _dc.is_dataclass(p) or not isinstance(p, (ast.Plan, ast.Expr)):
+        return p
+
+    def fix(v):
+        if isinstance(v, (ast.Plan, ast.Expr)):
+            return _substitute_cte(v, name, sub)
+        if isinstance(v, tuple):
+            return tuple(fix(x) for x in v)
+        return v
+
+    changes = {}
+    for f in _dc.fields(p):
+        v = getattr(p, f.name)
+        nv = fix(v)
+        if nv is not v and nv != v:
+            changes[f.name] = nv
+    return _dc.replace(p, **changes) if changes else p

@@ -1,0 +1,214 @@
+"""Widened SQL surface: CTEs, INTERSECT/EXCEPT, ROLLUP/CUBE/GROUPING SETS,
+date/time functions, string functions, EXTRACT/position special forms.
+
+Reference dialect: SnappyParser.scala (full Spark 2.1 function library via
+Catalyst). Date math is integer civil-calendar arithmetic on device
+(days-since-epoch int32, Hinnant's algorithms) — no datetime objects in
+the hot path.
+"""
+
+import datetime
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+
+
+@pytest.fixture
+def sess():
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE t (k STRING, v BIGINT, d DATE) USING column")
+    s.sql("INSERT INTO t VALUES ('a', 1, DATE '2020-01-15'), "
+          "('b', 2, DATE '2020-06-30'), ('a', 3, DATE '2021-02-28')")
+    return s
+
+
+# -- CTEs -------------------------------------------------------------------
+
+def test_cte_basic(sess):
+    r = sess.sql("WITH x AS (SELECT k, sum(v) AS s FROM t GROUP BY k) "
+                 "SELECT * FROM x ORDER BY k").rows()
+    assert r == [("a", 4), ("b", 2)]
+
+
+def test_cte_chained_and_joined(sess):
+    r = sess.sql(
+        "WITH big AS (SELECT k, v FROM t WHERE v >= 2), "
+        "     agg AS (SELECT k, count(*) AS n FROM big GROUP BY k) "
+        "SELECT t.k, agg.n FROM t JOIN agg ON t.k = agg.k "
+        "ORDER BY t.k, agg.n").rows()
+    assert r == [("a", 1), ("a", 1), ("b", 1)]
+
+
+def test_cte_shadows_table(sess):
+    r = sess.sql("WITH t AS (SELECT 99 AS v) SELECT v FROM t").rows()
+    assert r == [(99,)]
+
+
+# -- set operations ---------------------------------------------------------
+
+def test_intersect_except(sess):
+    assert sess.sql("SELECT k FROM t INTERSECT SELECT 'a'").rows() == \
+        [("a",)]
+    assert sess.sql("SELECT k FROM t EXCEPT SELECT 'a'").rows() == [("b",)]
+    assert sess.sql("SELECT k FROM t MINUS SELECT 'a'").rows() == [("b",)]
+
+
+def test_set_op_null_semantics(sess):
+    # set ops treat NULLs as equal (unlike joins)
+    sess.sql("CREATE TABLE n1 (x BIGINT) USING column")
+    sess.sql("CREATE TABLE n2 (x BIGINT) USING column")
+    sess.sql("INSERT INTO n1 VALUES (1), (NULL), (NULL)")
+    sess.sql("INSERT INTO n2 VALUES (NULL), (2)")
+    assert sess.sql("SELECT x FROM n1 INTERSECT SELECT x FROM n2").rows() \
+        == [(None,)]
+    r = sess.sql("SELECT x FROM n1 EXCEPT SELECT x FROM n2").rows()
+    assert r == [(1,)]
+
+
+def test_set_op_precedence_and_order(sess):
+    # INTERSECT binds tighter than UNION; ORDER BY applies to the result
+    r = sess.sql("SELECT k FROM t INTERSECT SELECT k FROM t "
+                 "UNION SELECT 'z' ORDER BY k").rows()
+    assert r == [("a",), ("b",), ("z",)]
+
+
+def test_order_by_binds_to_union_not_right_arm(sess):
+    r = sess.sql("SELECT k FROM t UNION SELECT 'z' ORDER BY k").rows()
+    assert r == [("a",), ("b",), ("z",)]
+
+
+# -- grouping sets ----------------------------------------------------------
+
+def test_rollup(sess):
+    r = sess.sql("SELECT k, count(*), sum(v) FROM t "
+                 "GROUP BY ROLLUP(k) ORDER BY k").rows()
+    assert r == [(None, 3, 6), ("a", 2, 4), ("b", 1, 2)]
+
+
+def test_cube_two_level():
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE sales (region STRING, product STRING, amt BIGINT) "
+          "USING column")
+    s.sql("INSERT INTO sales VALUES ('e','x',10),('e','y',20),('w','x',5)")
+    r = set(s.sql("SELECT region, product, sum(amt) FROM sales "
+                  "GROUP BY CUBE(region, product)").rows())
+    assert r == {(None, None, 35), ("e", None, 30), ("w", None, 5),
+                 ("e", "x", 10), ("e", "y", 20), ("w", "x", 5),
+                 (None, "x", 15), (None, "y", 20)}
+
+
+def test_grouping_sets_with_having(sess):
+    r = sess.sql("SELECT k, sum(v) FROM t "
+                 "GROUP BY GROUPING SETS((k), ()) "
+                 "HAVING sum(v) > 2 ORDER BY k").rows()
+    assert r == [(None, 6), ("a", 4)]
+
+
+# -- date/time functions ----------------------------------------------------
+
+def _days(iso: str) -> int:
+    return (datetime.date.fromisoformat(iso)
+            - datetime.date(1970, 1, 1)).days
+
+
+def test_date_functions_scalar(sess):
+    one = lambda q: sess.sql(q).rows()[0][0]  # noqa: E731
+    assert one("SELECT date_add(DATE '2020-01-01', 31)") == \
+        _days("2020-02-01")
+    assert one("SELECT date_sub(DATE '2020-01-01', 1)") == \
+        _days("2019-12-31")
+    assert one("SELECT datediff(DATE '2020-03-01', DATE '2020-02-01')") == 29
+    assert one("SELECT add_months(DATE '2020-01-31', 1)") == \
+        _days("2020-02-29")  # leap-year clamp
+    assert one("SELECT last_day(DATE '2021-02-03')") == _days("2021-02-28")
+    assert one("SELECT trunc(DATE '2020-02-15', 'MM')") == \
+        _days("2020-02-01")
+    assert one("SELECT trunc(DATE '2020-02-15', 'YEAR')") == \
+        _days("2020-01-01")
+    assert one("SELECT months_between(DATE '2020-03-15', "
+               "DATE '2020-01-15')") == 2.0
+    assert one("SELECT to_date('2020-07-04')") == _days("2020-07-04")
+    assert one("SELECT unix_timestamp(TIMESTAMP '1970-01-02 00:00:00')") \
+        == 86400
+    assert one("SELECT extract(year FROM DATE '2020-01-02')") == 2020
+    assert one("SELECT quarter(DATE '2020-05-15')") == 2
+    assert one("SELECT dayofweek(DATE '2020-02-15')") == 7   # Saturday
+    assert one("SELECT dayofyear(DATE '2020-03-01')") == 61  # leap year
+    assert one("SELECT weekofyear(DATE '2021-01-01')") == 53  # ISO
+    assert one("SELECT hour(TIMESTAMP '2020-01-01 10:30:05')") == 10
+    assert one("SELECT minute(TIMESTAMP '2020-01-01 10:30:05')") == 30
+    assert one("SELECT second(TIMESTAMP '2020-01-01 10:30:05')") == 5
+    assert one("SELECT current_date() IS NOT NULL")
+    assert one("SELECT current_timestamp() IS NOT NULL")
+
+
+def test_date_functions_on_columns_device(sess):
+    """Columnar date math runs through the device path (civil-calendar
+    integer arithmetic) — verify against python datetime per row."""
+    r = sess.sql("SELECT k, year(d), month(d), day(d), quarter(d), "
+                 "dayofweek(d), date_add(d, 10) FROM t ORDER BY k, d").rows()
+    expect_dates = {("a", "2020-01-15"), ("a", "2021-02-28"),
+                    ("b", "2020-06-30")}
+    got = set()
+    for k, y, m, dd, q, dow, plus10 in r:
+        date = datetime.date(y, m, dd)
+        got.add((k, date.isoformat()))
+        assert q == (m + 2) // 3
+        assert dow == date.isoweekday() % 7 + 1
+        assert plus10 == _days(date.isoformat()) + 10
+    assert got == expect_dates
+
+
+def test_group_by_date_part(sess):
+    r = sess.sql("SELECT year(d), count(*) FROM t GROUP BY year(d) "
+                 "ORDER BY year(d)").rows()
+    assert r == [(2020, 2), (2021, 1)]
+
+
+# -- string functions -------------------------------------------------------
+
+def test_string_functions_scalar(sess):
+    one = lambda q: sess.sql(q).rows()[0]  # noqa: E731
+    assert one("SELECT lpad('x', 3, '0'), rpad('x', 3, '0')") == \
+        ("00x", "x00")
+    assert one("SELECT lpad('abcdef', 3, '0')") == ("abc",)  # truncates
+    assert one("SELECT initcap('hello wORLD')") == ("Hello World",)
+    assert one("SELECT repeat('ab', 3), reverse('abc')") == \
+        ("ababab", "cba")
+    assert one("SELECT split_part('a,b,c', ',', 2)") == ("b",)
+    assert one("SELECT split_part('a,b,c', ',', -1)") == ("c",)
+    assert one("SELECT split_part('a,b,c', ',', 9)") == ("",)
+    assert one("SELECT translate('abcba', 'ab', 'x')") == ("xcx",)
+    assert one("SELECT position('b' IN 'abc')") == (2,)
+    assert one("SELECT ascii('A')") == (65,)
+
+
+def test_string_functions_on_columns(sess):
+    """String column transforms ride derived dictionaries — codes never
+    leave the device."""
+    r = sess.sql("SELECT DISTINCT initcap(repeat(k, 2)) FROM t "
+                 "ORDER BY 1").rows()
+    assert r == [("Aa",), ("Bb",)]
+    r2 = sess.sql("SELECT count(*) FROM t WHERE ascii(k) = 97").rows()
+    assert r2 == [(2,)]
+
+
+def test_to_date_string_column_device():
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE logs (ts STRING) USING column")
+    s.sql("INSERT INTO logs VALUES ('2020-01-01'), ('2020-01-01'), "
+          "('2021-12-31')")
+    r = s.sql("SELECT to_date(ts), count(*) FROM logs GROUP BY to_date(ts) "
+              "ORDER BY 1").rows()
+    assert r == [(_days("2020-01-01"), 2), (_days("2021-12-31"), 1)]
+
+
+def test_current_date_not_baked_into_plan_cache(sess):
+    """current_date folds per EXECUTION: the cached plan must rebind, not
+    bake a stale clock (ref: tokenized-literal rebinding)."""
+    r1 = sess.sql("SELECT count(*) FROM t WHERE d < current_date()").rows()
+    r2 = sess.sql("SELECT count(*) FROM t WHERE d < current_date()").rows()
+    assert r1 == r2 == [(3,)]
