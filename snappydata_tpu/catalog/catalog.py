@@ -86,6 +86,11 @@ class Catalog:
                                          props.column_max_delta_rows))
                 data = ColumnTableData(schema, capacity=cap,
                                        max_delta_rows=max_delta)
+                if "eviction_bytes" in opts:
+                    # per-table EVICTION clause analogue (ref: per-table
+                    # EVICTION BY in the reference's DDL; memory docs
+                    # :86-103) — this table spills above its own budget
+                    data.eviction_bytes = int(opts["eviction_bytes"])
             info = TableInfo(
                 name=key, schema=schema, provider=provider, options=opts,
                 data=data, key_columns=key_columns, partition_by=partition_by,

@@ -43,8 +43,15 @@ class Properties:
     # Host memory budget for resident column batches; above it the
     # coldest batches spill to disk as memmaps (transparently reloaded
     # through the OS page cache). 0 = unlimited. Ref:
-    # SnappyUnifiedMemoryManager eviction-heap-percentage.
+    # SnappyUnifiedMemoryManager eviction-heap-percentage. Per-table
+    # override: CREATE TABLE ... OPTIONS (eviction_bytes 'N').
     host_store_bytes: int = 0
+    # Fail-fast ceiling (ref: critical-heap-percentage rejects new work
+    # instead of dying, SnappyUnifiedMemoryManager.scala:379-401 /
+    # docs/best_practices/memory_management.md:86-103): when process RSS
+    # exceeds this, INSERTs raise CriticalMemoryError — reads and
+    # deletes still run. 0 = disabled.
+    critical_host_bytes: int = 0
 
     # Planner (ref: Literals.scala:153 HashJoinSize 100MB, :161 HashAggregateSize)
     hash_join_size: int = 100 * 1024 * 1024   # max build-side bytes for broadcast join
@@ -73,6 +80,13 @@ class Properties:
     # Cluster
     num_buckets: int = 128                    # default buckets per partitioned table (ref DDL BUCKETS)
     redundancy: int = 0
+    # Gather-to-lead fallback budget: a distributed query with no scatter
+    # or partial-merge strategy pulls the referenced shards to the lead
+    # and runs single-node, but only up to this many bytes (ref: the
+    # lead plans over real executors, SparkSQLExecuteImpl.scala:75 — here
+    # the lead IS an engine, so small-table full-surface queries run on
+    # it; big ones must be expressible as scatter/merge or error).
+    dist_gather_bytes: int = 512 * 1024 * 1024
     member_timeout_s: float = 5.0             # ref: ClusterManagerTestBase.scala:72
     stats_interval_s: float = 5.0             # ref: Constant.DEFAULT_CALC_TABLE_SIZE_SERVICE_INTERVAL
 

@@ -25,7 +25,8 @@ def merge_ref(slot: int, merge_fn: str) -> ast.Expr:
     return ast.Func(merge_fn, (ast.Col(f"__p{slot}"),))
 
 
-def decompose_aggregate(agg: ast.Aggregate, having=None):
+def decompose_aggregate(agg: ast.Aggregate, having=None,
+                        distinct_ok_cols=frozenset()):
     """→ (partial_plan, merged_select, n_slots, merged_having).
 
     `partial_plan` evaluates per shard/tile, emitting group exprs as
@@ -34,6 +35,12 @@ def decompose_aggregate(agg: ast.Aggregate, having=None):
     output expressions. A HAVING predicate decomposes through the same
     slot table, so aggregates appearing only in HAVING get partial slots
     too.
+
+    `distinct_ok_cols`: column names (lowercase) that are HASH PARTITION
+    KEYS of the shards being decomposed over — count(DISTINCT col) on
+    one of them decomposes because equal values share a shard, so the
+    per-shard distinct sets are disjoint and their counts sum. Tiled
+    scans must NOT pass this (a value can recur across tiles).
     """
     groups = list(agg.group_exprs)
     partial_items: List[ast.Expr] = []
@@ -65,6 +72,14 @@ def decompose_aggregate(agg: ast.Aggregate, having=None):
                 s = merge_ref(slot_of("sum", arg), "sum")
                 c = merge_ref(slot_of("count", arg), "sum")
                 return ast.BinOp("/", s, c)
+            if e.name == "count_distinct":
+                if isinstance(arg, ast.Col) and \
+                        arg.name.lower() in distinct_ok_cols:
+                    return merge_ref(slot_of("count_distinct", arg),
+                                     "sum")
+                raise NotDecomposableError(
+                    "count(DISTINCT x) only decomposes when the data is "
+                    "hash-partitioned on x")
             if e.name in ("stddev", "variance"):
                 s = merge_ref(slot_of("sum", arg), "sum")
                 s2 = merge_ref(slot_of("sumsq", arg), "sum")
@@ -99,6 +114,9 @@ def decompose_aggregate(agg: ast.Aggregate, having=None):
             partial_items.append(ast.Alias(
                 ast.Func("sum", (ast.BinOp("*", arg, arg),)),
                 f"__p{si}"))
+        elif kind == "count_distinct":
+            partial_items.append(ast.Alias(
+                ast.Func("count_distinct", (arg,)), f"__p{si}"))
         else:
             partial_items.append(ast.Alias(ast.Func(kind, (arg,)),
                                            f"__p{si}"))

@@ -68,9 +68,44 @@ def hash_int64_np(values: np.ndarray, seed=SPARK_SEED) -> np.ndarray:
         return _fmix(h1, 8).astype(np.int32)
 
 
+def hash_bytes(b: bytes, seed=SPARK_SEED) -> int:
+    """Spark Murmur3_x86_32.hashUnsafeBytes: little-endian 4-byte words,
+    then each remaining byte mixed as a full (sign-extended) word."""
+    with np.errstate(over="ignore"):
+        n = len(b)
+        aligned = n - n % 4
+        h1 = np.uint32(seed)
+        if aligned:
+            for w in np.frombuffer(b, dtype="<u4", count=aligned // 4):
+                h1 = _mix_h1(h1, _mix_k1(np.uint32(w)))
+        for i in range(aligned, n):
+            byte = b[i]
+            if byte > 127:
+                byte -= 256
+            h1 = _mix_h1(h1, _mix_k1(np.uint32(byte & 0xFFFFFFFF)))
+        return int(np.int32(_fmix(h1, n)))
+
+
+def hash_strings_np(values, seed=SPARK_SEED) -> np.ndarray:
+    """UTF8 murmur3 for an object/str column; NULL hashes to the seed
+    (Spark's HashPartitioning skips null children, leaving the seed)."""
+    cache = {}
+    out = np.empty(len(values), dtype=np.int32)
+    for i, v in enumerate(values):
+        h = cache.get(v)
+        if h is None:
+            h = int(np.int32(np.uint32(seed))) if v is None else \
+                hash_bytes(str(v).encode("utf-8"), seed)
+            cache[v] = h
+        out[i] = h
+    return out
+
+
 def murmur3_hash_np(values: np.ndarray, seed=SPARK_SEED) -> np.ndarray:
-    """Hash a numeric column the way Spark's HashPartitioning would."""
+    """Hash a column the way Spark's HashPartitioning would."""
     values = np.asarray(values)
+    if values.dtype.kind in ("O", "U", "S"):
+        return hash_strings_np(values, seed)
     if values.dtype in (np.dtype(np.int8), np.dtype(np.int16),
                         np.dtype(np.int32), np.dtype(np.bool_)):
         return hash_int32_np(values, seed)

@@ -1294,10 +1294,12 @@ class SnappySession:
                     if isinstance(x, ast.Func) and x.name in ast.AGG_FUNCS]
             # empty-group semantics: sum/avg/min/max yield NULL (the inner
             # join's dropped row ≡ comparison-with-NULL = false); count
-            # would need 0 via a left join — host error stays for it
-            if not aggs or any(a.name not in ("sum", "avg", "min", "max")
-                               for a in aggs):
+            # yields 0, which needs a LEFT join + coalesce(__sv, 0) so
+            # outer rows with no inner match still compare against 0
+            if not aggs or any(a.name not in ("sum", "avg", "min", "max",
+                                              "count") for a in aggs):
                 return None
+            needs_left = any(a.name == "count" for a in aggs)
             inner = node.child
             if not isinstance(inner, ast.Filter):
                 return None
@@ -1314,7 +1316,7 @@ class SnappySession:
                                 else x.name.lower() in inner_cols[0])
                     if not in_inner:
                         return None
-            return inner_rel, corr, inner_only, sel
+            return inner_rel, corr, inner_only, sel, needs_left
 
         import itertools as _it
 
@@ -1378,7 +1380,7 @@ class SnappySession:
                         got = split_scalar_agg(sub.plan)
                         if got is None:
                             continue
-                        inner_rel, corr, inner_only, sel = got
+                        inner_rel, corr, inner_only, sel, needs_left = got
                         if inner_only:
                             inner_rel = ast.Filter(inner_rel,
                                                    _and_all(inner_only))
@@ -1394,11 +1396,20 @@ class SnappySession:
                             ast.BinOp("=", oc,
                                       ast.Col(f"__ck{j}", alias))
                             for j, (oc, _ic) in enumerate(corr)])
-                        join_specs.append((sq, "inner", join_cond))
+                        # count's empty group is 0, not NULL: LEFT join
+                        # keeps unmatched outer rows and coalesce restores
+                        # the 0 (sum/avg/min/max keep the inner join —
+                        # their NULL compares false, dropping the row)
+                        sv = ast.Col("__sv", alias)
+                        if needs_left:
+                            join_specs.append((sq, "left", join_cond))
+                            sv = ast.Func("coalesce",
+                                          (sv, ast.Lit(0, T.LONG)))
+                        else:
+                            join_specs.append((sq, "inner", join_cond))
                         import dataclasses as _dc2
 
-                        post.append(_dc2.replace(
-                            e, **{side: ast.Col("__sv", alias)}))
+                        post.append(_dc2.replace(e, **{side: sv}))
                         changed = done = True
                         break
                     if done:
@@ -1454,8 +1465,8 @@ class SnappySession:
     def _rewrite_subqueries(self, plan: ast.Plan, user_params) -> ast.Plan:
         """Pre-evaluate UNCORRELATED subqueries and substitute literals
         (scalar → Lit, IN → InList, EXISTS → bool). Correlated subqueries
-        surface a clear error (reference supports them via Catalyst; a
-        later round here)."""
+        were already decorrelated into joins by _decorrelate; any shape
+        it cannot handle surfaces a clear unsupported error here."""
         return ast.transform_plan_exprs(plan, self._subquery_fn(user_params))
 
     def _subquery_fn(self, user_params):

@@ -498,6 +498,9 @@ def transform_plan_exprs(p: Plan, fn) -> Plan:
                      transform_plan_exprs(p.right, fn), p.op)
     if isinstance(p, SubqueryAlias):
         return SubqueryAlias(transform_plan_exprs(p.child, fn), p.alias)
+    if isinstance(p, WindowProject):
+        return WindowProject(transform_plan_exprs(p.child, fn),
+                             tuple(t(e) for e in p.exprs))
     if isinstance(p, Values):
         return Values(tuple(tuple(t(e) for e in row) for row in p.rows))
     return p

@@ -74,6 +74,35 @@ def render_expr(e: ast.Expr) -> str:
             return f"count(DISTINCT {render_expr(e.args[0])})"
         args = ", ".join(render_expr(a) for a in e.args)
         return f"{e.name}({args})"
+    if isinstance(e, ast.WindowFunc):
+        if e.name == "count" and not e.args:
+            call = "count(*)"
+        else:
+            call = f"{e.name}(" + \
+                ", ".join(render_expr(a) for a in e.args) + ")"
+        over = []
+        if e.partition_by:
+            over.append("PARTITION BY " + ", ".join(
+                render_expr(p) for p in e.partition_by))
+        if e.order_by:
+            def _ord(o):
+                sql = render_expr(o[0]) + ("" if o[1] else " DESC")
+                nf = o[2] if len(o) > 2 else None
+                if nf is not None:
+                    sql += " NULLS FIRST" if nf else " NULLS LAST"
+                return sql
+
+            over.append("ORDER BY " + ", ".join(_ord(o)
+                                                for o in e.order_by))
+        return f"{call} OVER ({' '.join(over)})"
+    if isinstance(e, ast.ScalarSubquery):
+        return f"({render_plan(e.plan)})"
+    if isinstance(e, ast.InSubquery):
+        neg = "NOT " if e.negated else ""
+        return f"({render_expr(e.child)} {neg}IN ({render_plan(e.plan)}))"
+    if isinstance(e, ast.ExistsSubquery):
+        neg = "NOT " if e.negated else ""
+        return f"({neg}EXISTS ({render_plan(e.plan)}))"
     raise RenderError(f"cannot render {type(e).__name__}")
 
 
@@ -91,6 +120,28 @@ def _render_lit(e: ast.Lit) -> str:
     return f"'{escaped}'"
 
 
+def _desugar_semi_joins(p: ast.Plan) -> ast.Plan:
+    """Semi/anti joins (from decorrelation) render as correlated
+    [NOT] EXISTS filters — the textual inverse of the rewrite that made
+    them, so the receiving server's own decorrelator restores them."""
+    import dataclasses as _dc
+
+    if isinstance(p, ast.Join) and p.how in ("semi", "anti"):
+        left = _desugar_semi_joins(p.left)
+        right = _desugar_semi_joins(p.right)
+        inner = ast.Filter(right, p.condition) \
+            if p.condition is not None else right
+        return ast.Filter(
+            left, ast.ExistsSubquery(inner, negated=(p.how == "anti")))
+    kids = p.children()
+    if not kids:
+        return p
+    if isinstance(p, (ast.Join, ast.Union, ast.SetOp)):
+        return _dc.replace(p, left=_desugar_semi_joins(p.left),
+                           right=_desugar_semi_joins(p.right))
+    return _dc.replace(p, child=_desugar_semi_joins(kids[0]))
+
+
 def render_plan(p: ast.Plan) -> str:
     """Render a single-block SELECT tree (Project|Aggregate over
     FROM-chain with optional Filter)."""
@@ -100,8 +151,9 @@ def render_plan(p: ast.Plan) -> str:
     having: Optional[ast.Expr] = None
     orders = []
     limit = None
+    distinct = False
 
-    node = p
+    node = _desugar_semi_joins(p)
     while True:
         if isinstance(node, ast.Limit):
             limit = node.n
@@ -109,25 +161,58 @@ def render_plan(p: ast.Plan) -> str:
         elif isinstance(node, ast.Sort):
             orders = list(node.orders)
             node = node.child
+        elif isinstance(node, ast.Distinct):
+            distinct = True
+            node = node.child
         else:
             break
     if isinstance(node, ast.Filter) and isinstance(node.child, ast.Aggregate):
         having = node.condition
         node = node.child
     if isinstance(node, ast.Aggregate):
+        if node.grouping_sets:
+            raise RenderError("cannot render GROUPING SETS")
         select_list = list(node.agg_exprs)
         group_by = list(node.group_exprs)
         node = node.child
-    elif isinstance(node, ast.Project):
+    elif isinstance(node, (ast.Project, ast.WindowProject)):
         select_list = list(node.exprs)
         node = node.child
-    if isinstance(node, ast.Filter):
-        where = node.condition
+    while isinstance(node, ast.Filter):
+        # stacked filters (decorrelated EXISTS above the base WHERE)
+        # collapse into one conjunctive WHERE clause
+        where = node.condition if where is None \
+            else ast.BinOp("and", where, node.condition)
         node = node.child
+    # hoist filters off the join spine into WHERE (decorrelation wraps
+    # the original filtered FROM-chain in new joins); commutes for
+    # inner/cross both sides and for the PRESERVED side of a left join
+    hoisted: List[ast.Expr] = []
+
+    def _hoist(n):
+        import dataclasses as _dc
+
+        if not isinstance(n, ast.Join):
+            return n
+        left, right = _hoist(n.left), _hoist(n.right)
+        if n.how in ("inner", "cross", "left"):
+            while isinstance(left, ast.Filter):
+                hoisted.append(left.condition)
+                left = _hoist(left.child)
+        if n.how in ("inner", "cross"):
+            while isinstance(right, ast.Filter):
+                hoisted.append(right.condition)
+                right = _hoist(right.child)
+        return _dc.replace(n, left=left, right=right)
+
+    node = _hoist(node)
+    for c in hoisted:
+        where = c if where is None else ast.BinOp("and", where, c)
     from_sql = _render_from(node)
     if select_list is None:
         select_list = [ast.Star()]
-    parts = ["SELECT " + ", ".join(render_expr(e) for e in select_list),
+    parts = ["SELECT " + ("DISTINCT " if distinct else "") +
+             ", ".join(render_expr(e) for e in select_list),
              "FROM " + from_sql]
     if where is not None:
         parts.append("WHERE " + render_expr(where))
@@ -157,14 +242,16 @@ def _render_from(node: ast.Plan) -> str:
         return f"({render_plan(node.child)}) {node.alias}"
     if isinstance(node, ast.Filter):
         # filtered factor (from pushdown): render as subquery
-        inner = _render_from(node.child)
         base = node.child
-        alias = base.alias if isinstance(base, ast.UnresolvedRelation) \
-            and base.alias else None
-        sub = (f"(SELECT * FROM {inner.split(' ')[0]} WHERE "
-               f"{render_expr(node.condition)})")
-        return f"{sub} {alias}" if alias else \
-            f"{sub} {inner.split(' ')[0].split('.')[-1]}"
+        if isinstance(base, ast.UnresolvedRelation):
+            alias = base.alias or base.name.split(".")[-1]
+            return (f"(SELECT * FROM {base.name} WHERE "
+                    f"{render_expr(node.condition)}) {alias}")
+        # non-relation factor: full derived table (bare column names
+        # survive; outer QUALIFIED references into it would not — those
+        # shapes are hoisted into WHERE by render_plan instead)
+        return (f"(SELECT * FROM {_render_from(base)} WHERE "
+                f"{render_expr(node.condition)}) __f")
     if isinstance(node, ast.Join):
         left = _render_from(node.left)
         right = _render_from(node.right)

@@ -40,6 +40,33 @@ def _dir() -> str:
     return _spill_dir
 
 
+class CriticalMemoryError(MemoryError):
+    """Raised when process RSS crosses critical_host_bytes: new writes
+    are refused so the member stays alive to serve reads (ref:
+    critical-heap-percentage LowMemoryException fail-fast)."""
+
+
+def process_rss_bytes() -> int:
+    """Resident set size of this process (Linux /proc, no psutil)."""
+    try:
+        with open("/proc/self/statm") as fh:
+            return int(fh.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, ValueError, IndexError):
+        return 0
+
+
+def check_critical_memory() -> None:
+    from snappydata_tpu import config
+
+    crit = config.global_properties().critical_host_bytes
+    if crit and process_rss_bytes() > crit:
+        raise CriticalMemoryError(
+            f"host memory critical: RSS {process_rss_bytes() >> 20}MiB "
+            f"exceeds critical_host_bytes ({crit >> 20}MiB); insert "
+            f"refused (reads still served — free memory or raise the "
+            f"limit)")
+
+
 def resident_bytes(arr: Optional[np.ndarray]) -> int:
     """SPILLABLE bytes an array keeps in host RAM. memmaps count 0 (the
     page cache owns them); object-dtype arrays count 0 too — they CANNOT

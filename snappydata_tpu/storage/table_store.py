@@ -244,6 +244,9 @@ class ColumnTableData:
 
         `nulls[i]` is an optional bool mask marking SQL NULLs in column i
         (values at those positions are fillers)."""
+        from snappydata_tpu.storage import hoststore
+
+        hoststore.check_critical_memory()
         arrays = [np.asarray(a) for a in arrays]
         if len(arrays) != len(self.schema.fields):
             raise ValueError(
@@ -297,10 +300,13 @@ class ColumnTableData:
     def _maybe_spill(self) -> None:
         """Evict the coldest batches to disk when the host budget is
         exceeded (ref: SnappyStorageEvictor region eviction,
-        SnappyUnifiedMemoryManager.scala:379-401)."""
+        SnappyUnifiedMemoryManager.scala:379-401). A per-table
+        EVICTION-clause analogue (OPTIONS eviction_bytes 'N') overrides
+        the global budget."""
         from snappydata_tpu import config
 
-        budget = config.global_properties().host_store_bytes
+        budget = getattr(self, "eviction_bytes", None) \
+            or config.global_properties().host_store_bytes
         if budget:
             from snappydata_tpu.storage import hoststore
 
@@ -630,6 +636,9 @@ class RowTableData:
         return self._version
 
     def insert_arrays(self, arrays: Sequence[np.ndarray]) -> int:
+        from snappydata_tpu.storage import hoststore
+
+        hoststore.check_critical_memory()
         arrays = [np.asarray(a) for a in arrays]
         n = int(arrays[0].shape[0])
         with self._lock:

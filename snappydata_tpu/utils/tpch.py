@@ -400,3 +400,97 @@ WHERE s_suppkey = l1.l_suppkey AND o_orderkey = l1.l_orderkey
   AND s_nationkey = n_nationkey AND n_name = 'SAUDI ARABIA'
 GROUP BY s_name ORDER BY numwait DESC, s_name LIMIT 100"""
 
+# The remaining queries, adapted to the generator's columns the same way
+# the single-node suite adapts them (tests/test_tpch_full.py) — together
+# with Q1-Q21 above this is the full 22-query set (ref harness:
+# cluster/src/test/scala/io/snappydata/benchmark/TPCH_Queries.scala).
+
+Q7 = """SELECT n1.n_name, n2.n_name, sum(l_extendedprice * (1 - l_discount)) AS rev
+FROM supplier, lineitem, orders, customer, nation n1, nation n2
+WHERE s_suppkey = l_suppkey AND o_orderkey = l_orderkey
+  AND c_custkey = o_custkey AND s_nationkey = n1.n_nationkey
+  AND c_nationkey = n2.n_nationkey
+  AND ((n1.n_name = 'FRANCE' AND n2.n_name = 'GERMANY')
+       OR (n1.n_name = 'GERMANY' AND n2.n_name = 'FRANCE'))
+GROUP BY n1.n_name, n2.n_name ORDER BY 1, 2"""
+
+Q8 = """SELECT n_name, sum(CASE WHEN o_shippriority = 1
+                   THEN l_extendedprice * (1 - l_discount)
+                   ELSE 0 END) / sum(l_extendedprice * (1 - l_discount)) AS share
+FROM lineitem, orders, supplier, nation
+WHERE o_orderkey = l_orderkey AND s_suppkey = l_suppkey
+  AND s_nationkey = n_nationkey
+GROUP BY n_name ORDER BY n_name"""
+
+Q9 = """SELECT n_name, sum(l_extendedprice * (1 - l_discount)
+                   - ps_supplycost * l_quantity) AS profit
+FROM lineitem, partsupp, supplier, nation, part
+WHERE ps_partkey = l_partkey AND ps_suppkey = l_suppkey
+  AND s_suppkey = l_suppkey AND s_nationkey = n_nationkey
+  AND p_partkey = l_partkey AND p_type LIKE 'PROMO%'
+GROUP BY n_name ORDER BY profit DESC, n_name"""
+
+Q11 = """SELECT ps_partkey, sum(ps_supplycost * ps_availqty) AS val
+FROM partsupp, supplier, nation
+WHERE ps_suppkey = s_suppkey AND s_nationkey = n_nationkey
+  AND n_name = 'GERMANY'
+GROUP BY ps_partkey
+HAVING sum(ps_supplycost * ps_availqty) > (
+    SELECT sum(ps_supplycost * ps_availqty) * 0.05
+    FROM partsupp, supplier, nation
+    WHERE ps_suppkey = s_suppkey AND s_nationkey = n_nationkey
+      AND n_name = 'GERMANY')
+ORDER BY val DESC, ps_partkey"""
+
+Q13 = """SELECT c_count, count(*) AS custdist FROM (
+    SELECT c_custkey, count(o_orderkey) AS c_count
+    FROM customer LEFT JOIN orders ON c_custkey = o_custkey
+    GROUP BY c_custkey) c_orders
+GROUP BY c_count ORDER BY custdist DESC, c_count DESC"""
+
+Q15_VIEW = """CREATE OR REPLACE VIEW revenue_v AS
+SELECT l_suppkey AS supplier_no,
+       sum(l_extendedprice * (1 - l_discount)) AS total_rev
+FROM lineitem GROUP BY l_suppkey"""
+
+Q15 = """SELECT s_suppkey, s_name, total_rev
+FROM supplier, revenue_v
+WHERE s_suppkey = supplier_no
+  AND total_rev = (SELECT max(total_rev) FROM revenue_v)
+ORDER BY s_suppkey"""
+
+Q16 = """SELECT p_brand, p_size, count(DISTINCT ps_suppkey) AS supplier_cnt
+FROM partsupp, part
+WHERE p_partkey = ps_partkey AND p_brand <> 'Brand#45'
+  AND p_size IN (1, 4, 7)
+  AND ps_suppkey NOT IN (
+    SELECT s_suppkey FROM supplier WHERE s_acctbal < -900)
+GROUP BY p_brand, p_size
+ORDER BY supplier_cnt DESC, p_brand, p_size"""
+
+Q19 = """SELECT sum(l_extendedprice * (1 - l_discount)) AS revenue
+FROM lineitem, part
+WHERE p_partkey = l_partkey AND (
+    (p_brand = 'Brand#12' AND p_size BETWEEN 1 AND 5
+     AND l_quantity >= 1 AND l_quantity <= 11)
+    OR (p_brand = 'Brand#23' AND p_size BETWEEN 1 AND 10
+        AND l_quantity >= 10 AND l_quantity <= 20)
+    OR (p_brand = 'Brand#34' AND p_size BETWEEN 1 AND 15
+        AND l_quantity >= 20 AND l_quantity <= 30))"""
+
+Q22 = """SELECT c_nationkey, count(*) AS numcust, sum(c_acctbal) AS totacctbal
+FROM customer
+WHERE c_nationkey IN (1, 3, 5, 7)
+  AND c_acctbal > (SELECT avg(c_acctbal) FROM customer
+                   WHERE c_acctbal > 0.0
+                     AND c_nationkey IN (1, 3, 5, 7))
+  AND NOT EXISTS (SELECT 1 FROM orders
+                  WHERE o_custkey = c_custkey)
+GROUP BY c_nationkey ORDER BY c_nationkey"""
+
+#: qnum → SQL for all 22 queries (Q15 additionally needs Q15_VIEW first)
+ALL_QUERIES = {1: Q1, 2: Q2, 3: Q3, 4: Q4, 5: Q5, 6: Q6, 7: Q7, 8: Q8,
+               9: Q9, 10: Q10, 11: Q11, 12: Q12, 13: Q13, 14: Q14,
+               15: Q15, 16: Q16, 17: Q17, 18: Q18, 19: Q19, 20: Q20,
+               21: Q21, 22: Q22}
+

@@ -1,0 +1,124 @@
+"""Round-3 perf scratch: where does per-query time go? (not committed)"""
+import time
+
+import numpy as np
+
+from snappydata_tpu import SnappySession, config
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.utils import tpch
+from snappydata_tpu.sql.parser import parse
+from snappydata_tpu.sql import ast
+
+import jax
+
+print("devices:", jax.devices())
+platform = jax.devices()[0].platform
+config.global_properties().decimal_as_float64 = platform == "cpu"
+
+s = SnappySession(catalog=Catalog())
+t0 = time.time()
+tpch.load_tpch(s, sf=2.0, seed=17)
+print(f"load: {time.time()-t0:.1f}s")
+n_rows = s.catalog.lookup_table("lineitem").data.snapshot().total_rows()
+print("rows:", n_rows)
+
+for name, q in (("q1", tpch.Q1), ("q6", tpch.Q6)):
+    s.sql(q)  # warm
+    # 1. end-to-end
+    best = min(
+        (lambda t: (s.sql(q), time.time() - t)[1])(time.time())
+        for _ in range(8))
+    print(f"{name}: end-to-end {best*1e3:.2f}ms  "
+          f"({n_rows/best/1e9:.2f}B rows/s)")
+
+    # 2. parse only
+    t0 = time.time()
+    for _ in range(20):
+        stmt = parse(q)
+    print(f"{name}: parse {1e3*(time.time()-t0)/20:.2f}ms")
+
+    # 3. front half of _run_query (rewrites..tokenize)
+    from snappydata_tpu.sql.optimizer import optimize
+    from snappydata_tpu.sql.analyzer import tokenize_plan
+    plan0 = stmt.plan
+
+    def front():
+        plan = s._rewrite_stream_windows(plan0)
+        plan = s._decorrelate(plan)
+        plan = s._rewrite_subqueries(plan, ())
+        plan = optimize(plan, s.catalog)
+        resolved, _ = s.analyzer.analyze_plan(plan)
+        return tokenize_plan(resolved)
+
+    t0 = time.time()
+    for _ in range(20):
+        tokenized, lit_params = front()
+    print(f"{name}: front-half {1e3*(time.time()-t0)/20:.2f}ms")
+
+    # 4. executor.execute on pre-tokenized plan
+    t0 = time.time()
+    for _ in range(8):
+        s.executor.execute(tokenized, tuple(lit_params))
+    print(f"{name}: executor.execute {1e3*(time.time()-t0)/8:.2f}ms")
+
+    # 5. compiled.execute directly
+    from snappydata_tpu.engine.executor import _plan_key
+    host_ops = []
+    node = tokenized
+    while isinstance(node, (ast.Sort, ast.Limit, ast.Distinct)):
+        host_ops.append(node)
+        node = node.children()[0]
+    key = (_plan_key(node, s.catalog), s.catalog.generation)
+    compiled = s.executor._plan_cache.get(key)
+    print(f"{name}: compiled found: {compiled is not None}")
+    if compiled is None:
+        continue
+    t0 = time.time()
+    for _ in range(8):
+        compiled.execute(tuple(lit_params))
+    print(f"{name}: compiled.execute {1e3*(time.time()-t0)/8:.2f}ms")
+
+    # 6. device-only: rebuild the exact args once, then time fn alone
+    params = tuple(lit_params)
+    import jax.numpy as jnp
+    from snappydata_tpu.engine.executor import _param_scalar
+    tables = [r.bind() for r in compiled.relations]
+    arrays = []
+    for r, dt in zip(compiled.relations, tables):
+        keep = r.keep_mask(dt, params)
+        for ci in r.used:
+            arrays.append((dt.columns[ci], dt.nulls.get(ci)))
+        arrays.append(dt.valid)
+    aux = [jnp.asarray(b(params)) for b in compiled.aux_builders]
+    static = tuple(p() for p in compiled.static_providers)
+    pvals = tuple(_param_scalar(v) for v in params)
+    fn = compiled._jitted.get(static)
+    print(f"{name}: jitted found: {fn is not None}, keep={keep}")
+    outs = fn(tuple(arrays), tuple(aux), pvals)
+    jax.block_until_ready(outs)
+    t0 = time.time()
+    for _ in range(8):
+        outs = fn(tuple(arrays), tuple(aux), pvals)
+        jax.block_until_ready(outs)
+    dev = (time.time() - t0) / 8
+    print(f"{name}: device-only {dev*1e3:.2f}ms  "
+          f"({n_rows/dev/1e9:.2f}B rows/s)")
+
+    # 7. bind-only cost
+    t0 = time.time()
+    for _ in range(8):
+        tables = [r.bind() for r in compiled.relations]
+        arrays = []
+        for r, dt in zip(compiled.relations, tables):
+            keep = r.keep_mask(dt, params)
+            for ci in r.used:
+                arrays.append((dt.columns[ci], dt.nulls.get(ci)))
+            arrays.append(dt.valid)
+        aux = [jnp.asarray(b(params)) for b in compiled.aux_builders]
+    print(f"{name}: bind-only {1e3*(time.time()-t0)/8:.2f}ms")
+
+    # 8. device_get cost
+    t0 = time.time()
+    for _ in range(8):
+        jax.device_get(outs)
+    print(f"{name}: device_get {1e3*(time.time()-t0)/8:.2f}ms")
