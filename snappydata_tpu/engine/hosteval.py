@@ -788,7 +788,8 @@ def eval_values(node: ast.Values, params) -> Result:
 
 
 # --------------------------------------------------------------------------
-# Window functions (host-evaluated; device windows are a later round)
+# Window functions (host fallback for shapes the device window path in
+# engine/executor.py does not cover — e.g. exotic frames / ntile)
 # --------------------------------------------------------------------------
 
 def eval_window(plan, params, executor) -> Result:

@@ -8,9 +8,9 @@ predicate batch-skipping in ColumnTableScan filter codegen).
 
 TPU-first physical design: the encoded form lives on host as numpy; decode
 targets a fixed `capacity`-row device plate so XLA compiles one kernel per
-table shape, with on-device decode for RLE (jnp.repeat with
-total_repeat_length) and dictionary (gather). Strings never reach the
-device: they stay dictionary codes (int32) with the dictionary host-side —
+table shape. Decode here runs host-side (`decode_to_numpy`) — the
+encodings save disk and host RAM. Strings never reach the device: they
+stay dictionary codes (int32) with the dictionary host-side —
 group-by/join on strings runs on codes, mirroring the reference's
 dictionary fast path (DictionaryOptimizedMapAccessor).
 """

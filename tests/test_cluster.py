@@ -10,6 +10,8 @@ import urllib.request
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # heavy/XLA-compile-bound; deselect with -m 'not slow'
+
 from snappydata_tpu import SnappySession
 from snappydata_tpu.catalog import Catalog
 from snappydata_tpu.cluster import (LeadNode, LocatorNode, ServerNode,

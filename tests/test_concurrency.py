@@ -21,6 +21,8 @@ import threading
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # heavy/XLA-compile-bound; deselect with -m 'not slow'
+
 from snappydata_tpu import SnappySession
 from snappydata_tpu.catalog import Catalog
 

@@ -9,6 +9,8 @@ import numpy as np
 import pandas as pd
 import pytest
 
+pytestmark = pytest.mark.slow  # heavy/XLA-compile-bound; deselect with -m 'not slow'
+
 from snappydata_tpu import SnappySession
 from snappydata_tpu.catalog import Catalog
 from snappydata_tpu.utils import tpch
