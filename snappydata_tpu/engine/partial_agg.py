@@ -36,12 +36,21 @@ def decompose_aggregate(agg: ast.Aggregate, having=None,
     slot table, so aggregates appearing only in HAVING get partial slots
     too.
 
-    `distinct_ok_cols`: column names (lowercase) that are HASH PARTITION
-    KEYS of the shards being decomposed over — count(DISTINCT col) on
-    one of them decomposes because equal values share a shard, so the
-    per-shard distinct sets are disjoint and their counts sum. Tiled
-    scans must NOT pass this (a value can recur across tiles).
+    `distinct_ok_cols`: which count(DISTINCT col) arguments decompose —
+    either a callable `Col -> bool` (preferred: the distributed layer
+    resolves the column to its source table and answers True only when it
+    is THAT table's hash partition key, so a replicated table's column
+    merely sharing a name with a partition key is rejected), or a legacy
+    set of lowercase bare column names. Decomposition is valid because
+    equal partition-key values share a shard, so per-shard distinct sets
+    are disjoint and their counts sum. Tiled scans must NOT pass this
+    (a value can recur across tiles).
     """
+    if callable(distinct_ok_cols):
+        distinct_col_ok = distinct_ok_cols
+    else:
+        _names = {c.lower() for c in distinct_ok_cols}
+        distinct_col_ok = lambda col: col.name.lower() in _names  # noqa: E731
     groups = list(agg.group_exprs)
     partial_items: List[ast.Expr] = []
     for gi, g in enumerate(groups):
@@ -73,8 +82,7 @@ def decompose_aggregate(agg: ast.Aggregate, having=None,
                 c = merge_ref(slot_of("count", arg), "sum")
                 return ast.BinOp("/", s, c)
             if e.name == "count_distinct":
-                if isinstance(arg, ast.Col) and \
-                        arg.name.lower() in distinct_ok_cols:
+                if isinstance(arg, ast.Col) and distinct_col_ok(arg):
                     return merge_ref(slot_of("count_distinct", arg),
                                      "sum")
                 raise NotDecomposableError(

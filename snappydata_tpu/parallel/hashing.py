@@ -94,8 +94,15 @@ def hash_strings_np(values, seed=SPARK_SEED) -> np.ndarray:
     for i, v in enumerate(values):
         h = cache.get(v)
         if h is None:
-            h = int(np.int32(np.uint32(seed))) if v is None else \
-                hash_bytes(str(v).encode("utf-8"), seed)
+            if v is None:
+                h = int(np.int32(np.uint32(seed)))
+            elif isinstance(v, bytes):
+                # numpy 'S' arrays route here: hash the UTF-8 content,
+                # not the repr "b'...'" (the same logical value stored as
+                # str vs bytes must land in the same bucket)
+                h = hash_bytes(v, seed)
+            else:
+                h = hash_bytes(str(v).encode("utf-8"), seed)
             cache[v] = h
         out[i] = h
     return out

@@ -43,12 +43,20 @@ class SnappySession:
         LDAP-auth'd connections; "admin" is the superuser)."""
         self.user = user.lower()
         self.disk_store = None
+        needs_recovery = False
         if data_dir is not None:
             from snappydata_tpu.storage.persistence import DiskStore
 
             self.disk_store = DiskStore(data_dir)
             if catalog is None and recover:
-                catalog = self.disk_store.recover_catalog()
+                # recovery must replay against THIS session (not a
+                # throwaway) so anything it re-registers — stream queries
+                # above all — binds the DURABLE session. A stream bound to
+                # a store-less replay session silently stopped journaling
+                # every post-recovery write (round-4 Kafka SIGKILL
+                # battery caught the loss).
+                needs_recovery = True
+                catalog = Catalog()   # placeholder; recovery swaps it in
         if catalog is None:
             with SnappySession._default_lock:
                 if SnappySession._default_catalog is None:
@@ -58,6 +66,11 @@ class SnappySession:
         self.conf = conf or config.global_properties()
         self.analyzer = Analyzer(catalog)
         self.executor = Executor(catalog, self.conf)
+        # optional per-session device mesh: queries run GSPMD-sharded
+        # over it (a data server's local chips — see ServerNode(mesh=…))
+        self.default_mesh = None
+        if needs_recovery:
+            self.disk_store.recover_catalog(session=self)
 
 
     def _rewrite_stream_windows(self, plan: ast.Plan) -> ast.Plan:
@@ -160,6 +173,7 @@ class SnappySession:
         # sessions keep the compiled-plan cache warm
         s.analyzer = self.analyzer
         s.executor = self.executor
+        s.default_mesh = self.default_mesh
         s.remote = remote
         s.authenticated = authenticated
         return s
@@ -279,6 +293,15 @@ class SnappySession:
     def execute_statement(self, stmt: ast.Statement, user_params=()) -> Result:
         self._authorize(stmt)
         if isinstance(stmt, ast.Query):
+            # HAC surface: WITH ERROR and/or error functions route
+            # through stratified estimation (ref hac_contracts.md:38-82)
+            if stmt.with_error is not None or \
+                    getattr(self.catalog, "_sample_maintainers", None):
+                from snappydata_tpu.aqp.error_estimation import (
+                    execute_error_query, query_has_error_surface)
+
+                if query_has_error_surface(stmt):
+                    return execute_error_query(self, stmt, user_params)
             return self._run_query(stmt.plan, user_params)
         if isinstance(stmt, ast.GrantStmt):
             if self.user != "admin":
@@ -341,7 +364,11 @@ class SnappySession:
                 maints = getattr(self.catalog, "_sample_maintainers", {})
                 for nm in [n for n, m in maints.items()
                            if n == tname or m.base_info.name == tname]:
-                    maints.pop(nm)
+                    m = maints.pop(nm)
+                    try:  # unhook the base feed (else it leaks per drop)
+                        m.base_info.data.on_insert.remove(m.on_insert)
+                    except (ValueError, AttributeError):
+                        pass
             return _status()
         if isinstance(stmt, ast.TruncateTable):
             self.catalog.describe(stmt.name).data.truncate()
@@ -887,6 +914,17 @@ class SnappySession:
 
             tokenized, lit_params = assign_param_positions(resolved, 0), ()
         params = tuple(lit_params) + tuple(user_params)
+        if self.default_mesh is not None:
+            from snappydata_tpu.parallel.mesh import MeshContext
+
+            if MeshContext.current() is None:
+                # mesh × cluster composition: a data server that owns a
+                # local device submesh runs EVERY query GSPMD-sharded
+                # over it, so distributed execution is scatter →
+                # per-server SPMD → merge (ref: embedded executors per
+                # store JVM, ExecutorInitiator.scala:45-105)
+                with MeshContext(self.default_mesh):
+                    return self.executor.execute(tokenized, params)
         return self.executor.execute(tokenized, params)
 
     # ------------------------------------------------------------------
@@ -1386,27 +1424,52 @@ class SnappySession:
                                                    _and_all(inner_only))
                         alias = f"__sq{next(sq_counter)}"
                         group = tuple(ic for _oc, ic in corr)
+                        # count's empty group is 0, not NULL: LEFT join
+                        # keeps unmatched outer rows, and each COUNT term
+                        # is coalesced to 0 INDIVIDUALLY — a whole-expr
+                        # coalesce would turn count(*)+sum(x) (NULL for an
+                        # empty group: 0 + NULL) or count(*)+1 (1) into a
+                        # bare 0 (advisor r3 finding). sum/avg/min/max
+                        # terms stay NULL so mixed expressions keep
+                        # single-node semantics; all-non-count selects
+                        # keep the inner join (their NULL compares false,
+                        # dropping the row).
+                        slot_funcs: List[ast.Func] = []
+
+                        def _slot(f: ast.Func) -> int:
+                            for k, g in enumerate(slot_funcs):
+                                if g == f:
+                                    return k
+                            slot_funcs.append(f)
+                            return len(slot_funcs) - 1
+
+                        def _externalize(x: ast.Expr) -> ast.Expr:
+                            if isinstance(x, ast.Func) and \
+                                    x.name in ast.AGG_FUNCS:
+                                ref: ast.Expr = ast.Col(
+                                    f"__sv{_slot(x)}", alias)
+                                if needs_left and x.name == "count":
+                                    ref = ast.Func(
+                                        "coalesce",
+                                        (ref, ast.Lit(0, T.LONG)))
+                                return ref
+                            return x.map_children(_externalize)
+
+                        sv = _externalize(sel)
                         aggs = tuple(
                             ast.Alias(ic, f"__ck{j}")
                             for j, (_oc, ic) in enumerate(corr)
-                        ) + (ast.Alias(sel, "__sv"),)
+                        ) + tuple(ast.Alias(f, f"__sv{k}")
+                                  for k, f in enumerate(slot_funcs))
                         sq = ast.SubqueryAlias(
                             ast.Aggregate(inner_rel, group, aggs), alias)
                         join_cond = _and_all([
                             ast.BinOp("=", oc,
                                       ast.Col(f"__ck{j}", alias))
                             for j, (oc, _ic) in enumerate(corr)])
-                        # count's empty group is 0, not NULL: LEFT join
-                        # keeps unmatched outer rows and coalesce restores
-                        # the 0 (sum/avg/min/max keep the inner join —
-                        # their NULL compares false, dropping the row)
-                        sv = ast.Col("__sv", alias)
-                        if needs_left:
-                            join_specs.append((sq, "left", join_cond))
-                            sv = ast.Func("coalesce",
-                                          (sv, ast.Lit(0, T.LONG)))
-                        else:
-                            join_specs.append((sq, "inner", join_cond))
+                        join_specs.append(
+                            (sq, "left" if needs_left else "inner",
+                             join_cond))
                         import dataclasses as _dc2
 
                         post.append(_dc2.replace(e, **{side: sv}))
@@ -1525,8 +1588,8 @@ class SnappySession:
         reservoir_size 'n') — stratified reservoir over the base table,
         schema = base schema + snappy_sampler_weight."""
         from snappydata_tpu.aqp.sampling import (
-            RESERVOIR_WEIGHT_COLUMN, SampleTableMaintainer,
-            StratifiedReservoir)
+            RESERVOIR_WEIGHT_COLUMN, STRATUM_ID_COLUMN,
+            SampleTableMaintainer, StratifiedReservoir)
 
         opts = {k.lower(): str(v) for k, v in stmt.options.items()}
         base_name = opts.get("basetable") or opts.get("base_table")
@@ -1538,7 +1601,8 @@ class SnappySession:
         base = self.catalog.describe(base_name)
         schema = T.Schema(list(base.schema.fields)
                           + [T.Field(RESERVOIR_WEIGHT_COLUMN, T.DOUBLE,
-                                     False)])
+                                     False),
+                             T.Field(STRATUM_ID_COLUMN, T.LONG, False)])
         info = self.catalog.create_table(stmt.name, schema, "sample",
                                          stmt.options, stmt.if_not_exists)
         self.register_sample(info)
@@ -1561,9 +1625,18 @@ class SnappySession:
         schema = T.Schema([T.Field(c.name, c.dtype, c.nullable)
                            for c in stmt.columns]
                           + [T.Field("__arrival_ts", T.TIMESTAMP, False)])
+        # key columns: inline PRIMARY KEY or the keyColumns relation
+        # option (ref: the sink reads keyColumns off the table options,
+        # SnappySinkCallback.scala:68-80 — exactly-once replay dedup
+        # REQUIRES them)
         keys = tuple(c.name for c in stmt.columns if c.primary_key)
+        opt_keys = opts.get("key_columns") or opts.get("keycolumns")
+        if not keys and opt_keys:
+            keys = tuple(c.strip() for c in opt_keys.split(",")
+                         if c.strip())
         provider = stmt.provider if stmt.provider in ("file_stream",
-                                                      "memory_stream") \
+                                                      "memory_stream",
+                                                      "kafka_stream") \
             else opts.get("provider", "memory_stream")
         if not hasattr(self.catalog, "_streams"):
             self.catalog._streams = {}
@@ -1581,6 +1654,21 @@ class SnappySession:
                 raise ValueError(
                     "file_stream requires OPTIONS (directory '...')")
             source = FileSource(directory, schema.names())
+        elif provider == "kafka_stream":
+            from snappydata_tpu.streaming.kafka import (KafkaSource,
+                                                        resolve_broker)
+
+            topic = opts.get("topic") or opts.get("subscribe")
+            brokers = opts.get("brokers") or opts.get(
+                "kafka.bootstrap.servers")
+            if not topic or not brokers:
+                raise ValueError("kafka_stream requires OPTIONS "
+                                 "(topic '...', brokers '...')")
+            source = KafkaSource(
+                self, f"stream_{tname}", resolve_broker(brokers), topic,
+                [n for n in schema.names() if not n.startswith("__")],
+                max_records_per_batch=int(
+                    opts.get("maxrecordsperbatch", "10000")))
         else:
             source = MemorySource()
         # backing storage: a normal column table holding the stream's
@@ -1619,11 +1707,22 @@ class SnappySession:
         opts = info.options
         base = self.catalog.describe(opts.get("basetable")
                                      or opts.get("base_table"))
+        from snappydata_tpu.aqp.sampling import STRATUM_ID_COLUMN
+
+        # migration: sample tables persisted before error estimation
+        # lack the hidden stratum-id column; the sample's contents are
+        # rebuilt from the reservoir on refresh anyway, so adding the
+        # field is complete
+        if all(f.name.lower() != STRATUM_ID_COLUMN
+               for f in info.schema.fields):
+            info.data.add_column(T.Field(STRATUM_ID_COLUMN, T.LONG, False))
+            info.schema = info.data.schema   # analyzer resolves from info
         qcs = [c.strip().lower() for c in opts.get("qcs", "").split(",")
                if c.strip()]
         reservoir = StratifiedReservoir(
             [base.schema.index(c) for c in qcs], len(base.schema),
-            reservoir_size=int(opts.get("reservoir_size", 50)))
+            reservoir_size=int(opts.get("reservoir_size", 50)),
+            seed=int(opts.get("seed", 0)))
         maintainer = SampleTableMaintainer(info, base, reservoir)
         base.data.on_insert.append(maintainer.on_insert)
         if not hasattr(self.catalog, "_sample_maintainers"):
@@ -1650,6 +1749,11 @@ class SnappySession:
         if not isinstance(stmt, ast.Query):
             raise ValueError("approx_sql expects a query")
         self._authorize(stmt)  # same privileges as the exact query
+        from snappydata_tpu.aqp.error_estimation import (
+            execute_error_query, query_has_error_surface)
+
+        if query_has_error_surface(stmt):
+            return execute_error_query(self, stmt, tuple(params))
         rewritten = approx_rewrite(stmt.plan, self.catalog)
         if rewritten is None:
             return self._run_query(stmt.plan, tuple(params))
@@ -1817,12 +1921,34 @@ class SnappySession:
         resolved = self.analyzer.resolve_expr(where, scope)
         return fold_constants(resolved)
 
+    @staticmethod
+    def _assign_expr_params(e: ast.Expr, counter: list) -> ast.Expr:
+        """Positional '?' assignment for mutation statements: the query
+        path does this in assign_param_positions, but UPDATE/DELETE
+        expressions are resolved standalone — without this every '?'
+        kept pos=-1 and evaluated to params[-1] (round-4 finding: a
+        two-param DELETE bound both markers to the LAST value)."""
+        def rec(node: ast.Expr) -> ast.Expr:
+            if isinstance(node, ast.Param) and node.pos < 0:
+                p = ast.Param(counter[0], node.dtype)
+                counter[0] += 1
+                return p
+            return node.map_children(rec)
+
+        return rec(e)
+
     def _update(self, stmt: ast.UpdateStmt, user_params) -> int:
         info = self.catalog.describe(stmt.table)
-        where = self._resolve_where(info, stmt.where, user_params) \
-            if stmt.where is not None else ast.Lit(True, T.BOOLEAN)
+        # '?' positions follow SQL text order: SET expressions, then WHERE
+        counter = [0]
+        assignments = [(name, self._assign_expr_params(e, counter))
+                       for name, e in stmt.assignments]
+        raw_where = self._assign_expr_params(stmt.where, counter) \
+            if stmt.where is not None else None
+        where = self._resolve_where(info, raw_where, user_params) \
+            if raw_where is not None else ast.Lit(True, T.BOOLEAN)
         assigns = {}
-        for name, e in stmt.assignments:
+        for name, e in assignments:
             resolved = self._resolve_where(info, e, user_params)
             assigns[name] = self._host_value_fn(info, resolved, user_params)
         pred = self._host_pred_fn(info, where, user_params)
@@ -1830,8 +1956,10 @@ class SnappySession:
 
     def _delete(self, stmt: ast.DeleteStmt, user_params) -> int:
         info = self.catalog.describe(stmt.table)
-        where = self._resolve_where(info, stmt.where, user_params) \
-            if stmt.where is not None else ast.Lit(True, T.BOOLEAN)
+        raw_where = self._assign_expr_params(stmt.where, [0]) \
+            if stmt.where is not None else None
+        where = self._resolve_where(info, raw_where, user_params) \
+            if raw_where is not None else ast.Lit(True, T.BOOLEAN)
         pred = self._host_pred_fn(info, where, user_params)
         return info.data.delete(pred)
 

@@ -56,11 +56,12 @@ def optimize(plan: ast.Plan, catalog) -> ast.Plan:
 
 
 def _optimize_filter(plan: ast.Filter, catalog) -> ast.Plan:
-    factors = _join_factors(plan.child)
-    if factors is None:
+    got = _join_factors(plan.child)
+    if got is None:
         return ast.Filter(optimize(plan.child, catalog), plan.condition)
+    factors, join_conds = got
 
-    conjuncts: List[ast.Expr] = []
+    conjuncts: List[ast.Expr] = list(join_conds)
     _flatten_and(plan.condition, conjuncts)
 
     # name map: alias → set of column names (lowered)
@@ -108,7 +109,10 @@ def _optimize_filter(plan: ast.Filter, catalog) -> ast.Plan:
     by_alias = {}
     for f in factors:
         alias, _, _ = _factor_info(f, catalog)
-        node: ast.Plan = f
+        # derived-table factors carry their own filter/join trees:
+        # optimize them in their own scope before placement
+        node: ast.Plan = f if isinstance(f, ast.UnresolvedRelation) \
+            else optimize(f, catalog)
         if alias in single:
             cond = _and_all(single[alias])
             node = ast.Filter(node, cond)
@@ -139,18 +143,25 @@ def _optimize_filter(plan: ast.Filter, catalog) -> ast.Plan:
     return tree
 
 
-def _join_factors(plan: ast.Plan) -> Optional[List[ast.Plan]]:
-    """Flatten a pure cross/inner-without-condition join chain into factors;
-    None when the subtree isn't such a chain (explicit JOIN..ON is kept)."""
-    if isinstance(plan, ast.Join) and plan.how == "cross" \
-            and plan.condition is None:
+def _join_factors(plan: ast.Plan):
+    """Flatten a cross/INNER join chain into (factors, lifted ON
+    conditions); None when the subtree isn't such a chain (outer/semi
+    trees are kept intact). Inner-join ON conditions are safe to lift
+    into the conjunct pool — inner join ≡ cross + filter — which lets
+    `FROM a, b, c JOIN (subquery) s ON …` shapes reorder too (round-4
+    finding: Q2's re-rendered distributed plan kept a 5-way cross join
+    under the WHERE, exploding the host fallback)."""
+    if isinstance(plan, ast.Join) and plan.how in ("cross", "inner"):
         left = _join_factors(plan.left)
         right = _join_factors(plan.right)
         if left is not None and right is not None:
-            return left + right
+            conds = left[1] + right[1]
+            if plan.condition is not None:
+                _flatten_and(plan.condition, conds)
+            return left[0] + right[0], conds
         return None
     if isinstance(plan, (ast.UnresolvedRelation, ast.SubqueryAlias)):
-        return [plan]
+        return [plan], []
     return None
 
 
@@ -166,8 +177,36 @@ def _factor_info(f: ast.Plan, catalog):
             else info.data.snapshot().total_rows()
         return alias, {n.lower() for n in info.schema.names()}, size
     if isinstance(f, ast.SubqueryAlias):
-        return None, set(), 0  # subquery factors: no reordering
+        # derived table: alias + output columns are known; size is not —
+        # rank it smallest so it lands on the build side
+        cols = _subquery_out_cols(f.child)
+        if cols is not None:
+            return f.alias.lower(), cols, 0
+        return None, set(), 0
     return None, set(), 0
+
+
+def _subquery_out_cols(node: ast.Plan) -> Optional[Set[str]]:
+    """Output column names of a derived table's top project/aggregate."""
+    while isinstance(node, (ast.Sort, ast.Limit, ast.Distinct,
+                            ast.SubqueryAlias)):
+        node = node.children()[0]
+    exprs = None
+    if isinstance(node, ast.Project) or isinstance(node, ast.WindowProject):
+        exprs = node.exprs
+    elif isinstance(node, ast.Aggregate):
+        exprs = node.agg_exprs
+    if exprs is None:
+        return None
+    out: Set[str] = set()
+    for e in exprs:
+        if isinstance(e, ast.Alias):
+            out.add(e.name.lower())
+        elif isinstance(e, ast.Col):
+            out.add(e.name.lower())
+        else:
+            return None  # unnamed computed column: bail on reordering
+    return out
 
 
 def _flatten_and(e: ast.Expr, out: List[ast.Expr]) -> None:
