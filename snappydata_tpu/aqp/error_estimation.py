@@ -1,0 +1,659 @@
+"""Closed-form stratified error estimation — the "A" in AQP.
+
+Implements the reference's High-level Accuracy Contract surface
+(docs/sde/hac_contracts.md:38-82; hook surface
+core/src/main/scala/org/apache/spark/sql/SnappyContextFunctions.scala:42-85):
+
+* projection error functions `absolute_error(alias)`,
+  `relative_error(alias)`, `lower_bound(alias)`, `upper_bound(alias)`
+  for SUM / AVG / COUNT aggregates;
+* the `WITH ERROR <frac> [CONFIDENCE <p>] [BEHAVIOR <b>]` clause with
+  behaviors do_nothing / local_omit / strict / run_on_full_table /
+  partial_run_on_base_table;
+* `sample_`-aliased aggregates returning TRUE sample-table answers.
+
+Estimator: classic stratified-SRS closed forms. The sample keeps, per
+stratum h (one QCS combination), n_h rows of the N_h observed, each with
+weight w_h = N_h / n_h. For an aggregate over x with a WHERE/GROUP
+qualification, let y = x·1(row qualifies) and (m, Σx, Σx²) be the
+qualifying-row moments within the stratum. Then
+
+    T̂(sum)  = Σ_h w_h·Σx                         (Horvitz-Thompson)
+    Var(T̂)  = Σ_h n_h·w_h·(w_h−1)·s²_h,  s²_h = (Σx² − (Σx)²/n_h)/(n_h−1)
+
+(the n_h·w_h·(w_h−1) factor is N_h²·(1−n_h/N_h)/n_h rewritten — the
+finite-population-corrected SRS variance). COUNT is the same with the
+0/1 qualification indicator; AVG = S/C uses the delta-method ratio
+variance (Var S − 2R·Cov(S,C) + R²·Var C)/C² with the per-stratum
+covariance Cov_h = n_h·w_h·(w_h−1)·(Σx − Σx·m/n_h)/(n_h−1).
+
+TPU-first layout: the per-(group, stratum) moment reduction is a regular
+engine aggregate — ONE compiled XLA program over the sample's device
+plates; only the tiny strata-merge (#groups × #strata rows) runs
+host-side in numpy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from statistics import NormalDist
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from snappydata_tpu import types as T
+from snappydata_tpu.engine.result import Result
+from snappydata_tpu.sql import ast
+
+ERROR_FUNCS = ("absolute_error", "relative_error", "lower_bound",
+               "upper_bound")
+_ESTIMABLE = ("sum", "avg", "count")
+
+
+class AQPUnsupported(ValueError):
+    """Query shape outside the AQP error-estimation scope (the reference
+    limits error functions to SUM/AVG/COUNT over a sampled FROM — the
+    same scope applies here)."""
+
+
+class HACViolation(RuntimeError):
+    """BEHAVIOR strict: an output row missed the accuracy contract."""
+
+
+def query_has_error_surface(stmt: ast.Query) -> bool:
+    """True when the statement needs the AQP error path: a WITH ERROR
+    clause or any error function in the select list."""
+    if stmt.with_error is not None:
+        return True
+    for node in _walk_plan(stmt.plan):
+        for e in ast.plan_exprs(node):
+            for x in ast.walk(e):
+                if isinstance(x, ast.Func) and x.name in ERROR_FUNCS:
+                    return True
+    return False
+
+
+def _walk_plan(p):
+    yield p
+    for k in p.children():
+        yield from _walk_plan(k)
+
+
+@dataclasses.dataclass
+class _Item:
+    """One select-list output column."""
+    kind: str                    # group | agg | errfunc
+    name: str                    # output column name
+    expr: ast.Expr = None
+    agg_name: str = ""           # sum/avg/count/min/max (kind=agg)
+    arg: Optional[ast.Expr] = None
+    sample_true: bool = False    # `sample_` alias: unscaled sample answer
+    err_kind: str = ""           # absolute_error/... (kind=errfunc)
+    target: int = -1             # index of the agg item it refers to
+    group_idx: int = -1          # (kind=group)
+
+
+def execute_error_query(session, stmt: ast.Query, user_params=()):
+    """Entry: run `stmt` with error estimation / HAC enforcement."""
+    clause = stmt.with_error
+    plan = stmt.plan
+
+    outer_orders = None
+    limit_n = None
+    node = plan
+    while isinstance(node, (ast.Sort, ast.Limit)):
+        if isinstance(node, ast.Sort):
+            outer_orders = node.orders
+        else:
+            limit_n = node.n
+        node = node.children()[0]
+    if isinstance(node, ast.Filter) and isinstance(node.child,
+                                                   ast.Aggregate):
+        raise AQPUnsupported(
+            "HAVING is not supported with error estimation; filter on "
+            "the exact query or drop the error clause")
+    if not isinstance(node, ast.Aggregate) or node.grouping_sets:
+        raise AQPUnsupported(
+            "error estimation applies to plain aggregate queries "
+            "(SUM/AVG/COUNT [GROUP BY ...]) over a sampled table")
+    agg = node
+
+    samples = {}
+    for info in session.catalog.list_tables():
+        if info.provider == "sample" and info.base_table:
+            samples.setdefault(info.base_table.lower(), info.name)
+
+    items, agg_items = _classify_select(agg)
+
+    sampled_name = _find_sampled_relation(agg.child, samples)
+    if sampled_name is None:
+        # contract: on the base table the error functions answer 0 and
+        # the bounds NULL (docs/sde/hac_contracts.md:62-64)
+        exact = _run_exact(session, agg, user_params)
+        return _finalize(_exact_to_rows(exact, items, agg_items),
+                         items, exact, outer_orders, limit_n, z=0.0)
+
+    session._refresh_samples()
+    sample_rel = samples[sampled_name]
+
+    conf = clause.confidence if clause is not None else 0.95
+    z = NormalDist().inv_cdf(0.5 + conf / 2.0)
+
+    est = _estimate(session, agg, items, agg_items, sampled_name,
+                    sample_rel, z, user_params)
+
+    if clause is not None and clause.error < 1.0:
+        est = _apply_behavior(session, est, clause, agg, items, agg_items,
+                              user_params)
+
+    return _finalize(est.rows, items, est.proto, outer_orders, limit_n,
+                     z=est.z)
+
+
+# ---------------------------------------------------------------------
+# select-list classification
+# ---------------------------------------------------------------------
+
+def _classify_select(agg: ast.Aggregate):
+    groups = list(agg.group_exprs)
+    items: List[_Item] = []
+    agg_items: List[_Item] = []
+    out_names: List[str] = []
+    for e in agg.agg_exprs:
+        alias = None
+        inner = e
+        if isinstance(inner, ast.Alias):
+            alias, inner = inner.name, inner.child
+        gi = next((i for i, g in enumerate(groups) if g == inner), -1)
+        if gi >= 0:
+            nm = alias or (inner.name if isinstance(inner, ast.Col)
+                           else f"_c{len(items)}")
+            items.append(_Item("group", nm, expr=inner, group_idx=gi))
+            out_names.append(nm.lower())
+            continue
+        if isinstance(inner, ast.Func) and inner.name in ERROR_FUNCS:
+            if len(inner.args) != 1 or not isinstance(inner.args[0],
+                                                      ast.Col):
+                raise AQPUnsupported(
+                    f"{inner.name} expects the alias of an aggregate "
+                    f"in this select list")
+            nm = alias or f"{inner.name}({inner.args[0].name})"
+            items.append(_Item("errfunc", nm, err_kind=inner.name,
+                               expr=inner.args[0]))
+            out_names.append(nm.lower())
+            continue
+        fn = inner
+        if isinstance(fn, ast.Func) and fn.name == "count_distinct":
+            raise AQPUnsupported(
+                "count(DISTINCT) has no closed-form sample estimator; "
+                "run the exact query")
+        if not (isinstance(fn, ast.Func)
+                and fn.name in ("sum", "avg", "count", "min", "max")):
+            raise AQPUnsupported(
+                "error estimation supports bare SUM/AVG/COUNT/MIN/MAX "
+                f"aggregates in the select list, got {e}")
+        arg = fn.args[0] if fn.args else None
+        nm = alias or f"{fn.name}"
+        it = _Item("agg", nm, expr=inner, agg_name=fn.name, arg=arg,
+                   sample_true=bool(alias)
+                   and alias.lower().startswith("sample_"))
+        items.append(it)
+        agg_items.append(it)
+        out_names.append(nm.lower())
+
+    # resolve error-function targets against the aggregate aliases
+    for it in items:
+        if it.kind != "errfunc":
+            continue
+        want = it.expr.name.lower()
+        tgt = next((j for j, a in enumerate(agg_items)
+                    if a.name.lower() == want), None)
+        if tgt is None:
+            raise AQPUnsupported(
+                f"{it.err_kind}({want}): no aggregate aliased {want!r} "
+                f"in this select list")
+        if agg_items[tgt].agg_name not in _ESTIMABLE:
+            raise AQPUnsupported(
+                f"{it.err_kind} applies to SUM/AVG/COUNT aggregates, "
+                f"not {agg_items[tgt].agg_name}")
+        it.target = tgt
+    return items, agg_items
+
+
+def _find_sampled_relation(p: ast.Plan, samples) -> Optional[str]:
+    for node in _walk_plan(p):
+        if isinstance(node, ast.UnresolvedRelation) and \
+                node.name.lower() in samples:
+            return node.name.lower()
+    return None
+
+
+def _swap_to_sample(p: ast.Plan, base: str, sample: str) -> ast.Plan:
+    def rec(n):
+        if isinstance(n, ast.UnresolvedRelation) and \
+                n.name.lower() == base:
+            return ast.UnresolvedRelation(
+                sample, alias=n.alias or n.name.split(".")[-1])
+        kids = n.children()
+        if not kids:
+            return n
+        if isinstance(n, (ast.Join, ast.Union, ast.SetOp)):
+            return dataclasses.replace(n, left=rec(n.left),
+                                       right=rec(n.right))
+        return dataclasses.replace(n, child=rec(kids[0]))
+
+    return rec(p)
+
+
+# ---------------------------------------------------------------------
+# estimation
+# ---------------------------------------------------------------------
+
+@dataclasses.dataclass
+class _Estimate:
+    """Per-group estimation state: rows maps group-key tuple → dict with
+    'groups' (values), per-agg 'est', 'var', and the z scale."""
+    rows: List[dict]
+    z: float
+    proto: Result           # phase-A result (dtype source for groups)
+
+
+def _estimate(session, agg, items, agg_items, base_name, sample_rel, z,
+              user_params) -> _Estimate:
+    from snappydata_tpu.aqp.sampling import (RESERVOIR_WEIGHT_COLUMN,
+                                             STRATUM_ID_COLUMN)
+
+    groups = list(agg.group_exprs)
+    child = _swap_to_sample(agg.child, base_name, sample_rel)
+
+    # ---- phase A: per-(group, stratum) moments — one engine program
+    a_exprs: List[ast.Expr] = [ast.Alias(g, f"__g{i}")
+                               for i, g in enumerate(groups)]
+    a_exprs.append(ast.Alias(ast.Col(STRATUM_ID_COLUMN), "__h"))
+    slots: List[Tuple[str, Optional[ast.Expr]]] = []
+
+    def slot(kind, arg) -> int:
+        for i, (k, a) in enumerate(slots):
+            if k == kind and a == arg:
+                return i
+        slots.append((kind, arg))
+        return len(slots) - 1
+
+    for it in agg_items:
+        if it.agg_name == "count" and it.arg is None:
+            it._slot = slot("cstar", None)
+        elif it.agg_name in ("sum", "avg", "count"):
+            it._slot = slot("moments", it.arg)
+        else:                      # min / max
+            it._slot = slot(it.agg_name, it.arg)
+
+    for si, (kind, arg) in enumerate(slots):
+        if kind == "cstar":
+            a_exprs.append(ast.Alias(ast.Func("count", ()), f"__s{si}_m"))
+        elif kind == "moments":
+            a_exprs.append(ast.Alias(ast.Func("count", (arg,)),
+                                     f"__s{si}_m"))
+            a_exprs.append(ast.Alias(ast.Func("sum", (arg,)),
+                                     f"__s{si}_sx"))
+            a_exprs.append(ast.Alias(
+                ast.Func("sum", (ast.BinOp("*", arg, arg),)),
+                f"__s{si}_sxx"))
+        else:
+            a_exprs.append(ast.Alias(ast.Func(kind, (arg,)),
+                                     f"__s{si}_{kind}"))
+
+    phase_a = ast.Aggregate(
+        child, tuple(groups) + (ast.Col(STRATUM_ID_COLUMN),),
+        tuple(a_exprs))
+    res_a = session._run_query(phase_a, user_params)
+
+    # ---- phase B: UNFILTERED per-stratum totals (n_h, w_h) — the
+    # stratum size is a property of the sample, not of the query
+    phase_b = ast.Aggregate(
+        ast.UnresolvedRelation(sample_rel),
+        (ast.Col(STRATUM_ID_COLUMN),),
+        (ast.Alias(ast.Col(STRATUM_ID_COLUMN), "__h"),
+         ast.Alias(ast.Func("count", ()), "__n"),
+         ast.Alias(ast.Func("max", (ast.Col(RESERVOIR_WEIGHT_COLUMN),)),
+                   "__w")))
+    res_b = session._run_query(phase_b, user_params)
+    n_of: Dict[int, float] = {}
+    w_of: Dict[int, float] = {}
+    for h, n, w in res_b.rows():
+        n_of[int(h)] = float(n)
+        w_of[int(h)] = float(w)
+
+    # ---- host combine: strata → per-group estimate + variance
+    ng = len(groups)
+    a_rows = res_a.rows()
+    col_idx = {nm.lower(): i for i, nm in enumerate(res_a.names)}
+    by_group: Dict[tuple, List[tuple]] = {}
+    for row in a_rows:
+        by_group.setdefault(tuple(row[:ng]), []).append(row)
+
+    out_rows: List[dict] = []
+    for gkey, rows in by_group.items():
+        rec = {"groups": gkey, "est": [], "var": [], "violate": [],
+               "from_base": False}
+        for it in agg_items:
+            si = it._slot
+            if it.agg_name in ("min", "max"):
+                vals = [r[col_idx[f"__s{si}_{it.agg_name}"]] for r in rows
+                        if r[col_idx[f"__s{si}_{it.agg_name}"]] is not None]
+                v = (min(vals) if it.agg_name == "min" else max(vals)) \
+                    if vals else None
+                rec["est"].append(v)
+                rec["var"].append(None)
+                continue
+            S = C = 0.0
+            var_s = var_c = cov_sc = 0.0
+            true_cnt = 0.0
+            true_sum = 0.0
+            for r in rows:
+                h = int(r[col_idx["__h"]])
+                n_h, w_h = n_of[h], w_of[h]
+                fpc = n_h * w_h * (w_h - 1.0)
+                if it.agg_name == "count" and it.arg is None:
+                    m = float(r[col_idx[f"__s{si}_m"]] or 0)
+                    sx, sxx = m, m
+                else:
+                    m = float(r[col_idx[f"__s{si}_m"]] or 0)
+                    sx = float(r[col_idx[f"__s{si}_sx"]] or 0.0)
+                    sxx = float(r[col_idx[f"__s{si}_sxx"]] or 0.0)
+                true_cnt += m
+                true_sum += sx
+                S += w_h * sx
+                C += w_h * m
+                if n_h > 1:
+                    inv = 1.0 / (n_h - 1.0)
+                    s2x = max(0.0, (sxx - sx * sx / n_h) * inv)
+                    s2c = max(0.0, (m - m * m / n_h) * inv)
+                    sxy = (sx - sx * m / n_h) * inv
+                    var_s += fpc * s2x
+                    var_c += fpc * s2c
+                    cov_sc += fpc * sxy
+            if it.agg_name == "sum":
+                est, var = (true_sum, 0.0) if it.sample_true else (S, var_s)
+            elif it.agg_name == "count":
+                est, var = (true_cnt, 0.0) if it.sample_true else (C, var_c)
+            else:                  # avg — self-normalized ratio
+                if C <= 0:
+                    rec["est"].append(None)
+                    rec["var"].append(None)
+                    continue
+                if it.sample_true:
+                    est = true_sum / true_cnt if true_cnt else None
+                    var = 0.0
+                else:
+                    R = S / C
+                    var = max(0.0, (var_s - 2.0 * R * cov_sc
+                                    + R * R * var_c)) / (C * C)
+                    est = R
+            rec["est"].append(est)
+            rec["var"].append(var)
+        out_rows.append(rec)
+
+    # a grouped query with an empty sample yields no rows; a GLOBAL
+    # aggregate still answers one row (count 0 / sum NULL)
+    if not out_rows and ng == 0:
+        rec = {"groups": (), "est": [], "var": [], "violate": [],
+               "from_base": False}
+        for it in agg_items:
+            rec["est"].append(0.0 if it.agg_name == "count" else None)
+            rec["var"].append(0.0 if it.agg_name == "count" else None)
+        out_rows.append(rec)
+
+    est = _Estimate(out_rows, z, res_a)
+    return est
+
+
+# ---------------------------------------------------------------------
+# behavior enforcement
+# ---------------------------------------------------------------------
+
+def _rel_error(est_v, var_v, z) -> Optional[float]:
+    if est_v is None or var_v is None:
+        return None
+    abs_err = z * math.sqrt(var_v)
+    if est_v == 0:
+        return math.inf if abs_err > 0 else 0.0
+    return abs_err / abs(est_v)
+
+
+def _apply_behavior(session, est: _Estimate, clause, agg, items,
+                    agg_items, user_params) -> _Estimate:
+    violating: List[int] = []
+    for ri, rec in enumerate(est.rows):
+        bad = []
+        for ai, it in enumerate(agg_items):
+            if it.agg_name not in _ESTIMABLE or it.sample_true:
+                bad.append(False)
+                continue
+            rel = _rel_error(rec["est"][ai], rec["var"][ai], est.z)
+            bad.append(rel is not None and rel > clause.error)
+        rec["violate"] = bad
+        if any(bad):
+            violating.append(ri)
+
+    if not violating or clause.behavior == "do_nothing":
+        return est
+    if clause.behavior == "strict":
+        raise HACViolation(
+            f"{len(violating)} output row(s) exceed relative error "
+            f"{clause.error} at confidence {clause.confidence}")
+    if clause.behavior == "local_omit":
+        for ri in violating:
+            rec = est.rows[ri]
+            for ai, bad in enumerate(rec["violate"]):
+                if bad:
+                    rec["est"][ai] = None
+                    rec["var"][ai] = None
+        return est
+
+    # run_on_full_table / partial_run_on_base_table
+    groups = list(agg.group_exprs)
+    partial = clause.behavior == "partial_run_on_base_table" and groups \
+        and all(isinstance(g, ast.Col) for g in groups)
+    exact_agg = agg
+    if partial:
+        keys = [est.rows[ri]["groups"] for ri in violating]
+        disj = []
+        for kt in keys:
+            conj = []
+            for g, v in zip(groups, kt):
+                if v is None:
+                    conj.append(ast.IsNull(g))
+                else:
+                    conj.append(ast.BinOp("=", g, ast.Lit(v)))
+            c = conj[0]
+            for x in conj[1:]:
+                c = ast.BinOp("and", c, x)
+            disj.append(c)
+        cond = disj[0]
+        for x in disj[1:]:
+            cond = ast.BinOp("or", cond, x)
+        exact_agg = dataclasses.replace(
+            agg, child=ast.Filter(agg.child, cond))
+    exact = _run_exact(session, exact_agg, user_params)
+    exact_rows = _exact_to_rows(exact, items, agg_items)
+
+    ng = len(groups)
+    if not partial:
+        for r in exact_rows:
+            r["from_base"] = True
+        return _Estimate(exact_rows, est.z, est.proto)
+    by_key = {tuple(r["groups"][:ng]): r for r in exact_rows}
+    for ri in violating:
+        key = tuple(est.rows[ri]["groups"])
+        hit = by_key.get(key)
+        if hit is not None:
+            hit["from_base"] = True
+            est.rows[ri] = hit
+    return est
+
+
+def _run_exact(session, agg: ast.Aggregate, user_params) -> Result:
+    """The original aggregate with error functions stripped, on base."""
+    keep = tuple(e for e in agg.agg_exprs
+                 if not (isinstance(
+                     e.child if isinstance(e, ast.Alias) else e, ast.Func)
+                     and (e.child if isinstance(e, ast.Alias) else e).name
+                     in ERROR_FUNCS))
+    return session._run_query(dataclasses.replace(agg, agg_exprs=keep),
+                              user_params)
+
+
+def _exact_to_rows(exact: Result, items, agg_items) -> List[dict]:
+    """Map an exact engine result into estimation rows: errors 0,
+    bounds NULL (docs/sde/hac_contracts.md:62-64). `groups` is indexed
+    by GROUP BY position (matching _estimate's phase-A tuples), NOT by
+    select-list order — SELECT b, a ... GROUP BY a, b would otherwise
+    swap columns in _finalize and break the partial-run key match."""
+    rows = exact.rows()
+    out: List[dict] = []
+    nongroup = [it for it in items if it.kind != "errfunc"]
+    ng = max((it.group_idx + 1 for it in items if it.kind == "group"),
+             default=0)
+    for row in rows:
+        gvals: List = [None] * ng
+        evals = []
+        for it, v in zip(nongroup, row):
+            if it.kind == "group":
+                gvals[it.group_idx] = v
+            else:
+                evals.append(v)
+        out.append({"groups": tuple(gvals), "est": evals,
+                    "var": [0.0 if it.agg_name in _ESTIMABLE else None
+                            for it in agg_items],
+                    "violate": [], "from_base": True})
+    return out
+
+
+# ---------------------------------------------------------------------
+# result assembly
+# ---------------------------------------------------------------------
+
+def _finalize(rows: List[dict], items, proto: Result, orders,
+              limit_n, z: float) -> Result:
+    names: List[str] = [it.name for it in items]
+    cols: List[list] = [[] for _ in range(len(items))]
+
+    for rec in rows:
+        for ci, it in enumerate(items):
+            if it.kind == "group":
+                cols[ci].append(rec["groups"][it.group_idx]
+                                if it.group_idx < len(rec["groups"])
+                                else None)
+            elif it.kind == "agg":
+                v = rec["est"][_agg_index(items, it)]
+                if it.agg_name == "count" and v is not None:
+                    v = int(round(v))
+                cols[ci].append(v)
+            else:  # errfunc
+                t = it.target
+                cols[ci].append(_error_value(
+                    it.err_kind, rec["est"][t], rec["var"][t], rec, z))
+
+    # dtypes: groups from the phase-A/exact proto result, aggregates by
+    # kind (count → LONG, others → DOUBLE), error funcs DOUBLE
+    dtypes: List[T.DataType] = []
+    proto_types = {nm.lower(): dt
+                   for nm, dt in zip(proto.names, proto.dtypes)}
+    for i, it in enumerate(items):
+        if it.kind == "group":
+            dtypes.append(proto_types.get(f"__g{it.group_idx}")
+                          or proto_types.get(it.name.lower()) or T.STRING)
+        elif it.kind == "agg":
+            dtypes.append(T.LONG if it.agg_name == "count" else T.DOUBLE)
+        else:
+            dtypes.append(T.DOUBLE)
+
+    np_cols: List[np.ndarray] = []
+    nulls: List[Optional[np.ndarray]] = []
+    for ci, dt in enumerate(dtypes):
+        vals = cols[ci]
+        mask = np.array([v is None for v in vals], dtype=bool)
+        if dt.name == "string":
+            np_cols.append(np.array(
+                ["" if v is None else v for v in vals], dtype=object))
+        else:
+            npdt = dt.np_dtype
+            np_cols.append(np.array(
+                [0 if v is None else v for v in vals], dtype=npdt))
+        nulls.append(mask if mask.any() else None)
+
+    res = Result(names, np_cols, nulls, dtypes)
+    if orders:
+        res = _host_sort(res, orders)
+    if limit_n is not None:
+        res = Result(res.names,
+                     [c[:limit_n] for c in res.columns],
+                     [m[:limit_n] if m is not None else None
+                      for m in res.nulls], res.dtypes)
+    return res
+
+
+def _agg_index(items, it) -> int:
+    k = 0
+    for other in items:
+        if other.kind == "agg":
+            if other is it:
+                return k
+            k += 1
+    raise AssertionError
+
+
+def _error_value(kind: str, est_v, var_v, rec, z: float):
+    """absolute/relative error and bounds for one cell. Base-table rows
+    answer 0 / 0 / NULL / NULL per the contract."""
+    if rec.get("from_base"):
+        return 0.0 if kind in ("absolute_error", "relative_error") \
+            else None
+    if est_v is None or var_v is None:
+        return None
+    abs_err = z * math.sqrt(var_v)
+    if kind == "absolute_error":
+        return abs_err
+    if kind == "relative_error":
+        return abs_err / abs(est_v) if est_v != 0 else (
+            0.0 if abs_err == 0 else None)
+    if kind == "lower_bound":
+        return est_v - abs_err
+    return est_v + abs_err
+
+
+def _host_sort(res: Result, orders) -> Result:
+    """ORDER BY over output columns (names or group aliases) — the
+    result is #groups rows, so a host lexsort is exact and cheap."""
+    keys = []
+    lower = [n.lower() for n in res.names]
+    for expr, asc, nulls_first in reversed(list(orders)):
+        if not isinstance(expr, ast.Col):
+            raise AQPUnsupported(
+                "ORDER BY with error estimation supports plain output "
+                "columns")
+        try:
+            ci = lower.index(expr.name.lower())
+        except ValueError:
+            raise AQPUnsupported(
+                f"ORDER BY column {expr.name!r} is not in the output")
+        col = res.columns[ci]
+        mask = res.nulls[ci]
+        if col.dtype == object:
+            ranks = np.argsort(
+                np.argsort([("" if v is None else str(v)) for v in col]))
+            key = ranks.astype(np.float64)
+        else:
+            key = col.astype(np.float64)
+        if mask is not None:
+            nf = nulls_first if nulls_first is not None else asc
+            key = key.copy()
+            key[mask] = -np.inf if nf else np.inf
+        keys.append(key if asc else -key)
+    order = np.lexsort(keys) if keys else np.arange(res.num_rows)
+    return Result(res.names,
+                  [c[order] for c in res.columns],
+                  [m[order] if m is not None else None
+                   for m in res.nulls],
+                  res.dtypes)

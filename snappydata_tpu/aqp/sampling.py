@@ -21,6 +21,10 @@ import numpy as np
 
 
 RESERVOIR_WEIGHT_COLUMN = "snappy_sampler_weight"
+# hidden ("__"-prefixed) per-row stratum id: closed-form error estimation
+# needs within-stratum sample moments, so every materialized sample row
+# carries the integer id of the stratum (QCS combination) it came from
+STRATUM_ID_COLUMN = "__stratum_id"
 
 
 class StratifiedReservoir:
@@ -33,6 +37,8 @@ class StratifiedReservoir:
         self._lock = threading.Lock()
         # stratum key -> (list of row tuples (len == cap max), seen count)
         self._strata: Dict[tuple, Tuple[List[tuple], int]] = {}
+        # stable stratum → integer id (materialization order)
+        self._stratum_ids: Dict[tuple, int] = {}
         self.version = 0
 
     def observe(self, arrays: Sequence[np.ndarray]) -> None:
@@ -61,16 +67,22 @@ class StratifiedReservoir:
             return {k: (len(rows), seen)
                     for k, (rows, seen) in self._strata.items()}
 
-    def to_arrays(self, dtypes) -> Tuple[List[np.ndarray], np.ndarray]:
-        """Materialize the sample: per-column arrays + weight column."""
+    def to_arrays(self, dtypes) -> Tuple[List[np.ndarray], np.ndarray,
+                                         np.ndarray]:
+        """Materialize the sample: per-column arrays + weight column +
+        stratum-id column (stable insertion-order ids)."""
         with self._lock:
             all_rows: List[tuple] = []
             weights: List[float] = []
-            for rows, seen in self._strata.values():
+            stratum_ids: List[int] = []
+            for key, (rows, seen) in self._strata.items():
+                sid = self._stratum_ids.setdefault(key,
+                                                   len(self._stratum_ids))
                 w = seen / max(1, len(rows))
                 for r in rows:
                     all_rows.append(r)
                     weights.append(w)
+                    stratum_ids.append(sid)
         cols: List[np.ndarray] = []
         for ci in range(self.num_columns):
             vals = [r[ci] for r in all_rows]
@@ -81,7 +93,8 @@ class StratifiedReservoir:
                 cols.append(np.array(
                     [0 if v is None else v for v in vals],
                     dtype=dt.np_dtype))
-        return cols, np.array(weights, dtype=np.float64)
+        return (cols, np.array(weights, dtype=np.float64),
+                np.array(stratum_ids, dtype=np.int64))
 
 
 class SampleTableMaintainer:
@@ -103,8 +116,9 @@ class SampleTableMaintainer:
         if self._materialized_version == self.reservoir.version:
             return
         dtypes = [f.dtype for f in self.base_info.schema.fields]
-        cols, weights = self.reservoir.to_arrays(dtypes)
+        cols, weights, sids = self.reservoir.to_arrays(dtypes)
         self.sample_info.data.truncate()
         if len(weights):
-            self.sample_info.data.insert_arrays(list(cols) + [weights])
+            self.sample_info.data.insert_arrays(
+                list(cols) + [weights, sids])
         self._materialized_version = self.reservoir.version

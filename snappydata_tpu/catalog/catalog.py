@@ -91,6 +91,7 @@ class Catalog:
                     # EVICTION BY in the reference's DDL; memory docs
                     # :86-103) — this table spills above its own budget
                     data.eviction_bytes = int(opts["eviction_bytes"])
+            base_table = opts.get("basetable") or opts.get("base_table")
             info = TableInfo(
                 name=key, schema=schema, provider=provider, options=opts,
                 data=data, key_columns=key_columns, partition_by=partition_by,
@@ -98,8 +99,7 @@ class Catalog:
                 colocate_with=_norm(opts["colocate_with"])
                 if "colocate_with" in opts else None,
                 redundancy=int(opts.get("redundancy", 0)),
-                base_table=_norm(opts["basetable"])
-                if "basetable" in opts else None)
+                base_table=_norm(base_table) if base_table else None)
             self._tables[key] = info
             self.generation += 1
             return info

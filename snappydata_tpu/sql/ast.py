@@ -512,9 +512,23 @@ class Statement:
 
 
 @dataclasses.dataclass(frozen=True)
+class ErrorClause:
+    """WITH ERROR <frac> [CONFIDENCE <frac>] [BEHAVIOR <b>] — the HAC
+    accuracy contract (ref docs/sde/hac_contracts.md:38-74): `error` is
+    the maximum tolerated relative error, `confidence` the interval
+    probability, `behavior` what to do when a group misses the contract
+    (do_nothing | local_omit | strict | run_on_full_table |
+    partial_run_on_base_table)."""
+    error: float
+    confidence: float = 0.95
+    behavior: str = "do_nothing"
+
+
+@dataclasses.dataclass(frozen=True)
 class Query(Statement):
     plan: Plan
     params: Tuple[Any, ...] = ()  # tokenized literal values, by position
+    with_error: Optional["ErrorClause"] = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -99,12 +99,14 @@ class Parser:
         low = t.value.lower() if t.kind == "KW" else ""
         if low == "select" or self.at_op("("):
             plan = self.query_expr()
+            err = self._with_error_clause()
             self._finish()
-            return ast.Query(plan)
+            return ast.Query(plan, with_error=err)
         if low == "with":
             plan = self.with_query()
+            err = self._with_error_clause()
             self._finish()
-            return ast.Query(plan)
+            return ast.Query(plan, with_error=err)
         if low == "create":
             return self._finishing(self.create_stmt())
         if low == "drop":
@@ -387,6 +389,56 @@ class Parser:
         # ORDER BY / LIMIT are applied by query_expr AFTER any set-op
         # chain: `a UNION b ORDER BY k` sorts the union, not b
         return plan
+
+    def _with_error_clause(self):
+        """Trailing HAC clause: WITH ERROR <frac> [CONFIDENCE <frac>]
+        [BEHAVIOR <behavior>] (ref grammar: the reference parser's
+        `withErrorClause`; semantics docs/sde/hac_contracts.md:38-74).
+        The behavior may be a quoted string or a bare identifier."""
+        if not self.at_kw("with"):
+            return None
+        nxt = self.peek(1)
+        if not (nxt.kind in ("IDENT", "KW")
+                and nxt.value.lower() == "error"):
+            return None
+        self.next()  # WITH
+        self.next()  # ERROR
+        t = self.next()
+        if t.kind != "NUM":
+            raise SQLSyntaxError(
+                f"WITH ERROR expects a fraction at {t.pos}")
+        error = float(t.value)
+        confidence, behavior = 0.95, "do_nothing"
+        while True:
+            t = self.peek()
+            word = t.value.lower() if t.kind in ("IDENT", "KW") else ""
+            if word == "confidence":
+                self.next()
+                ct = self.next()
+                if ct.kind != "NUM":
+                    raise SQLSyntaxError(
+                        f"CONFIDENCE expects a fraction at {ct.pos}")
+                confidence = float(ct.value)
+            elif word == "behavior":
+                self.next()
+                bt = self.next()
+                if bt.kind not in ("STR", "IDENT", "KW"):
+                    raise SQLSyntaxError(
+                        f"BEHAVIOR expects a name at {bt.pos}")
+                behavior = bt.value.lower().strip("<>")
+            else:
+                break
+        valid = {"do_nothing", "local_omit", "strict",
+                 "run_on_full_table", "partial_run_on_base_table"}
+        if behavior not in valid:
+            raise SQLSyntaxError(
+                f"unknown BEHAVIOR {behavior!r}; expected one of "
+                f"{sorted(valid)}")
+        if not (0.0 < error < 1.0):
+            raise SQLSyntaxError("WITH ERROR fraction must be in (0, 1)")
+        if not (0.0 < confidence < 1.0):
+            raise SQLSyntaxError("CONFIDENCE must be in (0, 1)")
+        return ast.ErrorClause(error, confidence, behavior)
 
     def _order_limit(self, plan: ast.Plan) -> ast.Plan:
         if self.at_kw("order"):
