@@ -61,6 +61,12 @@ class Properties:
 
     # Execution
     decimal_as_float64: Optional[bool] = None  # None → auto (x64 iff CPU backend)
+    # Cold binds of RLE / boolean-bitset batches ship the ENCODED form
+    # over the host→device link and decode in-trace (jnp.repeat-style
+    # searchsorted expansion / bit unpack) instead of uploading decoded
+    # capacity-row plates (ref: decode-at-scan generated code,
+    # ColumnTableScan.scala:684 genCodeColumnBuffer)
+    device_decode: bool = True
     max_groups: int = 1 << 16                 # static upper bound for generic group-by output
     batches_pow2_bucketing: bool = True       # pad #batches to pow2 → fewer recompiles
 

@@ -187,7 +187,9 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
             dicts[ci] = data.dictionary(ci)
         key = ("col", ci)
         if key not in cache:
-            from snappydata_tpu.storage.encoding import decode_validity
+            from snappydata_tpu import config
+            from snappydata_tpu.storage.encoding import (Encoding,
+                                                         decode_validity)
 
             dt = f.dtype.device_dtype()
             stacked = np.zeros((b, cap), dtype=dt)
@@ -195,18 +197,52 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
             any_null = False
             smin = np.full(b, np.nan)
             smax = np.full(b, np.nan)
+            # in-trace decode: RLE / bitset batches without deltas ship
+            # their ENCODED arrays to the device and expand there (ref
+            # decode-at-scan: ColumnTableScan.scala:684). Mesh binds keep
+            # host decode — the shard placement happens on host arrays.
+            use_dd = (ctx is None and not is_str
+                      and config.global_properties().device_decode)
+            dd_rle: list = []      # (batch row, EncodedColumn)
+            dd_bits: list = []
             for i, v in enumerate(views):
-                decoded = v.decoded_column(ci)
-                stacked[i] = decoded
+                col = v.batch.columns[ci]
+                device_decodable = (
+                    use_dd and not v.deltas
+                    and col.encoding in (Encoding.RUN_LENGTH,
+                                         Encoding.BOOLEAN_BITSET))
                 nm = v.null_mask(ci)  # delta-aware (updates can set/clear)
                 if nm is not None:
                     null_mask[i] = nm
                     any_null = True
-                st = v.batch.columns[ci].stats
+                st = col.stats
                 if st is not None and not v.deltas and not is_str \
                         and st.min is not None:
                     smin[i], smax[i] = float(st.min), float(st.max)
-                elif not is_str and v.batch.num_rows:
+                elif device_decodable:
+                    # stats over the compact encoded form: a SUPERSET of
+                    # the live range (deletes ignored), so predicate
+                    # batch-skipping stays conservative-correct
+                    if col.encoding == Encoding.RUN_LENGTH and \
+                            len(col.data):
+                        smin[i] = float(np.min(col.data))
+                        smax[i] = float(np.max(col.data))
+                    elif col.encoding == Encoding.BOOLEAN_BITSET and \
+                            col.num_rows:
+                        from snappydata_tpu.storage import bitmask
+
+                        bits = bitmask.unpack(col.data, col.num_rows)
+                        smin[i] = float(bits.min())
+                        smax[i] = float(bits.max())
+                if device_decodable:
+                    (dd_rle if col.encoding == Encoding.RUN_LENGTH
+                     else dd_bits).append((i, col))
+                    continue
+                decoded = v.decoded_column(ci)
+                stacked[i] = decoded
+                if not (st is not None and not v.deltas and not is_str
+                        and st.min is not None) \
+                        and not is_str and v.batch.num_rows:
                     live = decoded[v.live_mask()]
                     if live.size:
                         smin[i], smax[i] = float(live.min()), float(live.max())
@@ -235,7 +271,37 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                 if not is_str and take:
                     smin[len(views) + j] = float(vals.min())
                     smax[len(views) + j] = float(vals.max())
-            cache[key] = (_place(stacked), smin, smax,
+            if dd_rle or dd_bits:
+                # only the NON-device-decoded rows cross the link as
+                # decoded plates: upload them compactly and assemble the
+                # full [b, cap] plate on device (HBM-side scatter copies,
+                # not PCIe transfer)
+                dd_set = {i for i, _ in dd_rle} | {i for i, _ in dd_bits}
+                keep = [i for i in range(b) if i not in dd_set]
+                placed = jnp.zeros((b, cap), dtype=dt)
+                nonzero_keep = [i for i in keep if i < b_actual]
+                if nonzero_keep:
+                    placed = placed.at[np.array(nonzero_keep)].set(
+                        jnp.asarray(stacked[np.array(nonzero_keep)]))
+                if dd_rle:
+                    from snappydata_tpu.storage.device_decode import \
+                        rle_views_to_plate
+
+                    idxs = np.array([i for i, _ in dd_rle])
+                    dec = rle_views_to_plate([c for _, c in dd_rle],
+                                             cap, dt)
+                    placed = placed.at[idxs].set(dec.astype(dt))
+                if dd_bits:
+                    from snappydata_tpu.storage.device_decode import \
+                        bitset_views_to_plate
+
+                    idxs = np.array([i for i, _ in dd_bits])
+                    dec = bitset_views_to_plate([c for _, c in dd_bits],
+                                                cap)
+                    placed = placed.at[idxs].set(dec.astype(dt))
+            else:
+                placed = _place(stacked)
+            cache[key] = (placed, smin, smax,
                           _place(null_mask) if any_null else None)
         columns[ci], stats_min[ci], stats_max[ci], nulls[ci] = cache[key]
 

@@ -8,9 +8,12 @@ predicate batch-skipping in ColumnTableScan filter codegen).
 
 TPU-first physical design: the encoded form lives on host as numpy; decode
 targets a fixed `capacity`-row device plate so XLA compiles one kernel per
-table shape. Decode here runs host-side (`decode_to_numpy`) — the
-encodings save disk and host RAM. Strings never reach the device: they
-stay dictionary codes (int32) with the dictionary host-side —
+table shape. `decode_to_numpy` here is the host decode path (mutation
+predicates, mesh binds, delta-bearing batches); cold single-device binds
+of RLE/bitset batches instead ship the encoded arrays and expand in-trace
+(`storage/device_decode.py`), so compressed bytes — not decoded plates —
+cross the host→device link. Strings never reach the device: they stay
+dictionary codes (int32) with the dictionary host-side —
 group-by/join on strings runs on codes, mirroring the reference's
 dictionary fast path (DictionaryOptimizedMapAccessor).
 """

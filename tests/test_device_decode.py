@@ -1,0 +1,90 @@
+"""Device decode (round-3 verdict Missing #5 / task 8): RLE and
+boolean-bitset batches bind by shipping the ENCODED arrays to the device
+and expanding in-trace, with results identical to the host-decode path
+and a measured transfer reduction (ref: decode-at-scan generated code,
+ColumnTableScan.scala:684 genCodeColumnBuffer)."""
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession, config
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.storage import device_decode
+from snappydata_tpu.storage.encoding import Encoding
+
+
+def _rle_session():
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE rle_t (k BIGINT, grp BIGINT, flag BOOLEAN, "
+          "v DOUBLE) USING column")
+    n = 60_000
+    rng = np.random.default_rng(3)
+    k = np.arange(n, dtype=np.int64)
+    grp = np.sort(rng.integers(0, 5, n)).astype(np.int64)   # RLE-friendly
+    flag = (k % 3 == 0)
+    v = np.round(rng.random(n) * 100, 2)
+    s.insert_arrays("rle_t", [k, grp, flag, v])
+    data = s.catalog.describe("rle_t").data
+    data.force_rollover()            # cut the batch so encodings apply
+    return s, k, grp, flag, v, data
+
+
+def test_rle_batches_decode_on_device_and_match():
+    s, k, grp, flag, v, data = _rle_session()
+    m = data.snapshot()
+    encs = {m.views[0].batch.columns[i].encoding for i in (1, 2)}
+    assert Encoding.RUN_LENGTH in encs, "grp should be RLE at rest"
+    assert Encoding.BOOLEAN_BITSET in encs, "flag should be bitset at rest"
+
+    device_decode.reset_counters()
+    r = s.sql("SELECT grp, count(*), sum(v) FROM rle_t GROUP BY grp "
+              "ORDER BY grp")
+    c = device_decode.counters()
+    assert c["batches_device_decoded"] >= 1
+    assert c["bytes_encoded"] < c["bytes_decoded_equiv"] / 4, \
+        "encoded transfer should be far below the decoded plate size"
+    for gi, cnt, sv in r.rows():
+        mm = grp == gi
+        assert cnt == int(mm.sum())
+        assert sv == pytest.approx(float(v[mm].sum()))
+
+    r2 = s.sql("SELECT count(*) FROM rle_t WHERE flag")
+    assert r2.rows()[0][0] == int(flag.sum())
+    s.stop()
+
+
+def test_rle_predicate_pushdown_still_correct():
+    s, k, grp, flag, v, _ = _rle_session()
+    r = s.sql("SELECT count(*), sum(v) FROM rle_t WHERE grp = 2")
+    mm = grp == 2
+    assert r.rows()[0][0] == int(mm.sum())
+    assert r.rows()[0][1] == pytest.approx(float(v[mm].sum()))
+    s.stop()
+
+
+def test_deltas_fall_back_to_host_decode():
+    s, k, grp, flag, v, data = _rle_session()
+    s.sql("UPDATE rle_t SET v = 0.0 WHERE k < 100")
+    r = s.sql("SELECT sum(v) FROM rle_t")
+    expect = float(v[k >= 100].sum())
+    assert r.rows()[0][0] == pytest.approx(expect)
+    # grouping column updates create deltas on grp itself
+    s.sql("UPDATE rle_t SET grp = 99 WHERE k < 50")
+    r2 = s.sql("SELECT count(*) FROM rle_t WHERE grp = 99")
+    assert r2.rows()[0][0] == 50
+    s.stop()
+
+
+def test_disabled_flag_matches():
+    old = config.global_properties().device_decode
+    try:
+        config.global_properties().device_decode = False
+        s, k, grp, flag, v, _ = _rle_session()
+        device_decode.reset_counters()
+        r = s.sql("SELECT grp, sum(v) FROM rle_t GROUP BY grp ORDER BY grp")
+        assert device_decode.counters()["batches_device_decoded"] == 0
+        for gi, sv in r.rows():
+            assert sv == pytest.approx(float(v[grp == gi].sum()))
+        s.stop()
+    finally:
+        config.global_properties().device_decode = old
