@@ -543,7 +543,15 @@ class DiskStore:
 
             session = SnappySession(catalog=catalog)
         else:
+            # the caller's analyzer/executor bound the pre-recovery
+            # catalog at construction — rebind BEFORE replay executes any
+            # statement against the recovered one
+            from snappydata_tpu.engine.executor import Executor
+            from snappydata_tpu.sql.analyzer import Analyzer
+
             session.catalog = catalog
+            session.analyzer = Analyzer(catalog)
+            session.executor = Executor(catalog, session.conf)
         # Views must exist BEFORE WAL replay: a journaled statement may read
         # one (INSERT INTO t SELECT ... FROM some_view) and replay swallows
         # statement errors, silently dropping committed rows otherwise. A

@@ -3,9 +3,10 @@
 The reference runs Spark structured streaming (micro-batches from Kafka/
 file/socket sources) into the snappy sink (SURVEY.md §3.5) plus a legacy
 DStream layer (SchemaDStream). Here: a thread-driven micro-batch loop with
-the same progress/exactly-once contract, and sources for in-memory queues
-and growing files. A Kafka consumer slots in behind the same Source
-interface when a client library is present (none in this image)."""
+the same progress/exactly-once contract, and sources for in-memory queues,
+growing files, and Kafka (streaming/kafka.py — durable per-partition
+offset ranges behind the same Source interface; network brokers need a
+client library, in-process brokers work out of the box)."""
 
 from __future__ import annotations
 
@@ -141,6 +142,7 @@ class StreamingQuery:
             try:
                 applied = self.sink.process_batch(offset, columns)
                 self._note_batch(columns if applied else None)
+                self._prune_source_log(offset)
                 offset = new_offset
             except Exception as e:
                 self.last_error = e
@@ -173,10 +175,22 @@ class StreamingQuery:
                 self.sink.process_batch(offset, columns)
             if did_apply:
                 applied += 1
+                self._prune_source_log(offset)
             # rows count only when APPLIED: a replayed batch the exactly-
             # once sink deduplicated must not inflate progress metrics
             self._note_batch(columns if did_apply else None)
             offset = new_offset
+
+    def _prune_source_log(self, applied_batch_id: int) -> None:
+        """Sources with a durable offset log (Kafka) drop entries the
+        sink has durably recorded — everything strictly below the
+        applied batch stays replayable until then."""
+        prune = getattr(self.source, "prune_log", None)
+        if prune is not None:
+            try:
+                prune(applied_batch_id)
+            except Exception:
+                pass  # pruning is advisory; replay handles leftovers
 
     def _note_batch(self, columns) -> None:
         """columns=None → the batch was seen but deduplicated (replay)."""
@@ -211,4 +225,5 @@ class StreamingQuery:
             "last_batch_ts": self.last_batch_ts,
             "interval_s": self.interval_s,
             "last_error": str(self.last_error) if self.last_error else None,
-        }
+        } | (self.source.extra_progress()
+             if hasattr(self.source, "extra_progress") else {})
