@@ -152,6 +152,30 @@ def _cmd_status(args) -> int:
     return 0
 
 
+def _cmd_rebalance(args) -> int:
+    """Operator action: POST /rebalance on the primary lead (ref:
+    CALL SYS.REBALANCE_ALL_BUCKETS())."""
+    import json as _json
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(f"http://{args.lead}/rebalance",
+                                 data=b"{}", method="POST")
+    if args.token:
+        req.add_header("Authorization", f"Bearer {args.token}")
+    try:
+        with urllib.request.urlopen(req) as resp:
+            out = _json.loads(resp.read().decode("utf-8"))
+    except urllib.error.HTTPError as e:
+        # non-2xx still carries the JSON error payload
+        try:
+            out = _json.loads(e.read().decode("utf-8"))
+        except Exception:
+            out = {"error": str(e)}
+    print(_json.dumps(out, indent=2))
+    return 0 if "error" not in out else 1
+
+
 def _wait_forever() -> None:
     try:
         while True:
@@ -198,6 +222,12 @@ def main(argv=None) -> int:
     st = sub.add_parser("status")
     st.add_argument("--locator", required=True)
     st.set_defaults(fn=_cmd_status)
+
+    rb = sub.add_parser("rebalance")
+    rb.add_argument("--lead", required=True,
+                    help="host:port of the primary lead's REST endpoint")
+    rb.add_argument("--token", default=None)
+    rb.set_defaults(fn=_cmd_rebalance)
 
     args = p.parse_args(argv)
     return args.fn(args)

@@ -100,9 +100,26 @@ class _MemberNode:
 
 
 class ServerNode(_MemberNode):
-    """Data server: storage + Flight query/ingest endpoint."""
+    """Data server: storage + Flight query/ingest endpoint.
+
+    `mesh_devices`: indices of the LOCAL accelerator devices this server
+    owns — its session then runs every query GSPMD-sharded over that
+    submesh, composing the cluster plane (scatter over servers) with the
+    mesh plane (SPMD inside each server). Ref: one long-lived embedded
+    executor per store JVM, ExecutorInitiator.scala:45-105."""
 
     role = "server"
+
+    def __init__(self, locator_address: str, session,
+                 host: str = "127.0.0.1", flight_port: int = 0,
+                 member_id: Optional[str] = None,
+                 mesh_devices: Optional[list] = None):
+        super().__init__(locator_address, session, host, flight_port,
+                         member_id)
+        if mesh_devices:
+            from snappydata_tpu.parallel.mesh import submesh
+
+            session.default_mesh = submesh(mesh_devices)
 
     def start(self) -> "ServerNode":
         port = self._start_flight()
@@ -174,6 +191,20 @@ class LeadNode(_MemberNode):
                                     "auth_tokens") or None,
                                 auth_provider=make_provider(
                                     self.session.conf)).start()
+        # cluster view for operator actions (POST /rebalance): a
+        # DistributedSession over the data servers the locator knows
+        try:
+            servers = sorted(f"{m.host}:{m.port}"
+                             for m in self.membership.members()
+                             if m.role == "server")
+            if servers:
+                from snappydata_tpu.cluster.distributed import \
+                    DistributedSession
+
+                self.rest.distributed = DistributedSession(
+                    server_addresses=servers)
+        except Exception:
+            pass  # no servers yet: /rebalance reports 409 until retried
         self.is_primary = True
 
     def _step_down(self) -> None:

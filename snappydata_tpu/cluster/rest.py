@@ -139,6 +139,9 @@ class RestService:
         self.session = session
         self.stats_service = stats_service
         self.membership = membership
+        # optional DistributedSession (the lead's cluster view) — powers
+        # operator actions like POST /rebalance
+        self.distributed = None
         self.auth_tokens = auth_tokens or {}
         self.auth_provider = auth_provider
         self._basic_cache = {}   # sha256(user:password) -> (user, expiry)
@@ -286,6 +289,27 @@ class RestService:
                         body["sql"], tuple(body.get("params", ())),
                         session=sess)
                     self._send({"jobId": job_id, "status": "STARTED"})
+                elif path == "/rebalance":
+                    # SYS.REBALANCE_ALL_BUCKETS analogue (operator
+                    # action; admin only when auth is on)
+                    sess = self._principal_session()
+                    if sess is None:
+                        return
+                    if (svc.auth_tokens or svc.auth_provider) and \
+                            sess.user != "admin":
+                        self._send({"error": "rebalance requires admin"},
+                                   403)
+                        return
+                    if svc.distributed is None:
+                        self._send({"error": "no cluster session on "
+                                             "this lead"}, 409)
+                        return
+                    try:
+                        self._send(svc.distributed.rebalance())
+                    except Exception as e:
+                        # rebalance is restartable: report how it failed
+                        # rather than aborting the connection
+                        self._send({"error": str(e)}, 500)
                 else:
                     self._send({"error": "not found"}, 404)
 

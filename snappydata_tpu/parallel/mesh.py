@@ -78,6 +78,15 @@ def data_mesh(num_devices: Optional[int] = None) -> Mesh:
     return Mesh(np.array(devices[:n]), ("data",))
 
 
+def submesh(device_indices) -> Mesh:
+    """Mesh over an explicit device subset — the composed topology's
+    per-server plane (each ServerNode owns a disjoint slice of the
+    host's chips; ref: one embedded executor per store JVM,
+    ExecutorInitiator.scala:45-105)."""
+    devices = jax.devices()
+    return Mesh(np.array([devices[i] for i in device_indices]), ("data",))
+
+
 def shard_batches(array, ctx: Optional[MeshContext]):
     """Place a stacked [B, C] array: batch-sharded under a mesh, default
     placement otherwise. B is padded to a multiple of the mesh size by the

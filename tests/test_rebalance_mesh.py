@@ -1,0 +1,120 @@
+"""Round-4 task 9: mesh × cluster composition and bucket rebalance.
+
+* Composition: each ServerNode owns a submesh of the host's devices, so
+  a distributed query is scatter (over servers) → per-server GSPMD (over
+  the submesh) → merge (ref: one embedded executor per store JVM,
+  ExecutorInitiator.scala:45-105).
+* Rebalance: after kill → rejoin → rebalance, bucket primaries are even
+  across members again and data placement follows (ref:
+  SYS.REBALANCE_ALL_BUCKETS, rebalance-all-buckets.md).
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.cluster import LocatorNode, ServerNode
+from snappydata_tpu.cluster.distributed import DistributedSession
+
+
+def test_mesh_cluster_composed_topology():
+    """2 servers × 4-device submeshes on the 8-device CPU rig: results
+    equal the single-node answer while each server's executor runs
+    GSPMD-sharded over its own device slice."""
+    locator = LocatorNode().start()
+    servers = [
+        ServerNode(locator.address, SnappySession(catalog=Catalog()),
+                   mesh_devices=list(range(si * 4, si * 4 + 4))).start()
+        for si in range(2)]
+    ds = DistributedSession(
+        server_addresses=[s.flight_address for s in servers])
+    try:
+        assert servers[0].session.default_mesh is not None
+        assert servers[0].session.default_mesh.devices.ravel()[0] != \
+            servers[1].session.default_mesh.devices.ravel()[0]
+        ds.sql("CREATE TABLE mc (k BIGINT, g BIGINT, v DOUBLE) "
+               "USING column OPTIONS (partition_by 'k')")
+        rng = np.random.default_rng(9)
+        n = 40_000
+        k = rng.integers(0, 10_000, n).astype(np.int64)
+        g = (k % 7).astype(np.int64)
+        v = np.round(rng.random(n) * 10, 3)
+        ds.insert_arrays("mc", [k, g, v])
+        r = ds.sql("SELECT g, count(*), sum(v) FROM mc GROUP BY g "
+                   "ORDER BY g")
+        for gi, cnt, sv in r.rows():
+            m = g == gi
+            assert cnt == int(m.sum())
+            assert sv == pytest.approx(float(v[m].sum()))
+    finally:
+        ds.close()
+        for s in servers:
+            s.stop()
+        locator.stop()
+
+
+def test_kill_rejoin_rebalance():
+    locator = LocatorNode().start()
+    servers = [ServerNode(locator.address, SnappySession(catalog=Catalog()))
+               .start() for _ in range(3)]
+    ds = DistributedSession(
+        server_addresses=[s.flight_address for s in servers])
+    try:
+        ds.sql("CREATE TABLE rb (k BIGINT, v DOUBLE) USING column "
+               "OPTIONS (partition_by 'k', redundancy '1')")
+        rng = np.random.default_rng(13)
+        n = 30_000
+        k = rng.integers(0, 50_000, n).astype(np.int64)
+        ds.insert_arrays("rb", [k, np.ones(n)])
+        exact = (n, float(n))
+
+        # kill member 2 → its buckets re-host onto survivors
+        servers[2].stop()
+        ds.mark_server_failed(2)
+        assert ds.sql("SELECT count(*), sum(v) FROM rb").rows()[0] == exact
+        owners = set(ds.bucket_map)
+        assert 2 not in owners
+
+        # rejoin (empty) then rebalance: primaries even out again
+        servers[2] = ServerNode(locator.address,
+                                SnappySession(catalog=Catalog())).start()
+        ds.replace_server(2, servers[2].flight_address)
+        out = ds.rebalance()
+        assert out["moved_buckets"] > 0
+        per = [sum(1 for b in range(ds.num_buckets)
+                   if ds.bucket_map[b] == m) for m in range(3)]
+        assert max(per) - min(per) <= 1, per
+
+        # data followed the buckets: the rejoined member actually holds
+        # its share of rows, and the global answer is unchanged
+        c2 = servers[2].session.sql("SELECT count(*) FROM rb").rows()[0][0]
+        assert c2 > 0
+        assert ds.sql("SELECT count(*), sum(v) FROM rb").rows()[0] == exact
+
+        # mid-rebalance exactness: run a second rebalance (no-op moves)
+        # interleaved with queries
+        out2 = ds.rebalance()
+        assert ds.sql("SELECT count(*) FROM rb").rows()[0][0] == n
+
+        # writes after rebalance route by the NEW map and stay exact
+        ds.insert_arrays("rb", [np.arange(50_000, 50_500,
+                                          dtype=np.int64),
+                                np.ones(500)])
+        assert ds.sql("SELECT count(*) FROM rb").rows()[0][0] == n + 500
+
+        # survivor death AFTER rebalance: redundancy was rebuilt for the
+        # moved buckets, so answers stay complete
+        servers[1].stop()
+        ds.mark_server_failed(1)
+        assert ds.sql("SELECT count(*) FROM rb").rows()[0][0] == n + 500
+    finally:
+        ds.close()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        locator.stop()
