@@ -174,6 +174,19 @@ class SnappyClient:
         shuffle-exchange fan-out)."""
         return self._action("repartition", body, retry=False)
 
+    def plan(self, plan_payload, params: Sequence = ()):
+        """Execute a serialized logical plan fragment on this server and
+        return the Arrow result (the plan-shipping twin of sql() —
+        idempotent read, so failover/re-login retry applies the same)."""
+        def once():
+            conn = self._client()
+            body = self._with_token({"plan": plan_payload,
+                                     "params": list(params)})
+            return conn.do_get(flight.Ticket(
+                json.dumps(body).encode("utf-8"))).read_all()
+
+        return self._request(once, retry=True)
+
     def move_buckets(self, body: dict) -> dict:
         """Rebalance: this server copies its primary rows of
         body['buckets'] (table body['table']) to body['target'] and

@@ -437,10 +437,11 @@ class DistributedSession:
             return empty_result(["status"], [T.STRING])
         if isinstance(stmt, (ast.CreateView, ast.DropView, ast.CreateIndex,
                              ast.DropIndex, ast.CreatePolicy,
-                             ast.DropPolicy, ast.AlterTable)):
+                             ast.DropPolicy, ast.AlterTable,
+                             ast.CreateFunction, ast.DropFunction)):
             # schema-surface DDL applies on the lead's planning catalog
             # AND on every server (scattered SQL references views/
-            # policies by name; servers resolve them locally)
+            # policies/functions by name; servers resolve them locally)
             result = self.planner.execute_statement(stmt)
             self._fan(lambda srv: srv.execute(sql_text))
             if isinstance(stmt, ast.AlterTable):
@@ -778,12 +779,12 @@ class DistributedSession:
         # every server: answer from ONE (scatter-merge would double-count
         # — and the reference's replicated-region reads are single-member)
         if not self._touches_partitioned(plan):
-            sql_text = render_plan(plan)
+            exec_fn = self._partial_exec(plan)
             for si, srv in self._alive():
                 try:
                     import pyarrow as pa
 
-                    return _arrow_to_result(srv.sql(sql_text), self.planner)
+                    return _arrow_to_result(exec_fn(srv), self.planner)
                 except Exception:
                     if self._probe(si):
                         raise
@@ -1308,11 +1309,43 @@ class DistributedSession:
                         f"keys ({a} = {b}) for shard-local joins to be "
                         f"complete; rewrite the join or replicate one side")
 
+    def _partial_exec(self, node: ast.Plan):
+        """Per-server execution of a partial plan: rendered single-block
+        SQL when the renderer covers the shape, otherwise the serialized
+        logical plan ships directly (plan-fragment shipping, ref
+        SparkSQLExecuteImpl.scala:75-109) — GROUPING SETS, window
+        partials and decorrelated semi/anti FROM trees run distributed
+        instead of falling to the bounded gather path."""
+        try:
+            sql_text = render_plan(node)
+            return lambda srv: srv.sql(sql_text)
+        except RenderError:
+            from snappydata_tpu.sql.plan_json import (PlanCodecError,
+                                                      to_json)
+
+            try:
+                payload = to_json(node)
+            except PlanCodecError as e:
+                # neither renderable nor serializable: surface as a
+                # RenderError so callers keep the bounded-gather fallback
+                raise RenderError(str(e))
+
+            def run(srv):
+                try:
+                    return srv.plan(payload)
+                except Exception as ex:
+                    # app-level failure of a shipped fragment degrades to
+                    # gather (member death still fails the probe in _fan
+                    # and triggers failover as usual)
+                    raise DistributedError(
+                        f"shipped plan fragment failed: {ex}")
+
+            return run
+
     def _scatter_concat(self, node: ast.Plan, outer: List):
-        partial_sql = render_plan(node)
         import pyarrow as pa
 
-        pieces = self._fan(lambda srv: srv.sql(partial_sql))
+        pieces = self._fan(self._partial_exec(node))
         merged = pa.concat_tables(pieces)
         result = _arrow_to_result(merged, self.planner)
         return _apply_outer(result, outer, self.planner)
@@ -1327,11 +1360,10 @@ class DistributedSession:
         groups = list(agg.group_exprs)
         partial_plan, merged_select, n_slots, merge_having = \
             decompose_aggregate(agg, having, distinct_ok_cols=distinct_ok)
-        partial_sql = render_plan(partial_plan)
 
         import pyarrow as pa
 
-        pieces = self._fan(lambda srv: srv.sql(partial_sql))
+        pieces = self._fan(self._partial_exec(partial_plan))
         merged = pa.concat_tables(pieces)
 
         scratch = self._load_partials(merged, len(groups), n_slots)
@@ -1385,8 +1417,7 @@ class DistributedSession:
         plain = _dc.replace(agg, grouping_sets=None)
         partial_plan, merged_select, n_slots, merge_having = \
             decompose_aggregate(plain, having)
-        partial_sql = render_plan(partial_plan)
-        pieces = self._fan(lambda srv: srv.sql(partial_sql))
+        pieces = self._fan(self._partial_exec(partial_plan))
         merged = pa.concat_tables(pieces)
         scratch = self._load_partials(merged, len(agg.group_exprs), n_slots)
         merge_plan: ast.Plan = ast.Aggregate(

