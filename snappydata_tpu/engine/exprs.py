@@ -1047,6 +1047,45 @@ class ExprBuilder:
                                                   "ascii", "to_date"):
             return self._emit_string_func(e)
 
+        # SQL-registered functions (CREATE FUNCTION): the python body
+        # runs on the TRACED values, so a jnp-compatible UDF fuses into
+        # the same XLA program as the rest of the plan (ref:
+        # SnappyDDLParser.scala:765 createFunction — codegen'd JVM UDFs
+        # there). String args stay on the host path (device values are
+        # dictionary codes the body must not see).
+        from snappydata_tpu.sql import udf as _udf
+
+        u = _udf.lookup(name)
+        if u is not None:
+            from snappydata_tpu.sql.analyzer import expr_type
+
+            for a in e.args:
+                try:
+                    at = expr_type(a)
+                except Exception:
+                    at = None
+                if at is not None and at.name == "string":
+                    raise CompileError(
+                        f"UDF {name} over string arguments runs on host")
+            ret = u.returns or T.DOUBLE
+            fn = u.fn
+
+            def run_udf(rt: Runtime) -> DVal:
+                dvs = [a(rt) for a in args]
+                try:
+                    v = jnp.asarray(fn(*[d.value for d in dvs]))
+                except Exception as ex:
+                    raise CompileError(
+                        f"UDF {name} failed under tracing: {ex}")
+                out_null = None
+                for d in dvs:
+                    if d.null is not None:
+                        out_null = d.null if out_null is None \
+                            else (out_null | d.null)
+                return DVal(v, out_null, ret)
+
+            return run_udf
+
         raise CompileError(f"unsupported function on device: {name}")
 
     def _unary_math(self, arg, fn, keep_type=False):

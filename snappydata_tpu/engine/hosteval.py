@@ -589,6 +589,22 @@ def _eval_func(e: ast.Func, cols, nulls, params, n):
             nl = _or_null(nl, a[1])
         return np.array(["".join(str(x) for x in row)
                          for row in zip(*vs)], dtype=object), nl
+    from snappydata_tpu.sql import udf as _udf
+
+    u = _udf.lookup(name)
+    if u is not None:
+        vals = [np.broadcast_to(v, (n,)) for v, _ in args]
+        try:
+            out = np.asarray(u.fn(*vals))
+        except Exception as ex:
+            raise HostEvalError(f"UDF {name} failed: {ex}")
+        if out.shape != (n,):
+            out = np.broadcast_to(out, (n,))
+        nl = None
+        for _, a_nl in args:
+            nl = _or_null(nl, a_nl)
+        return out, nl
+
     raise HostEvalError(f"unsupported host function {name}")
 
 

@@ -271,6 +271,16 @@ class SnappySession:
                 getattr(self.catalog, "_aux_ddl", {}).pop(
                     f"{kind}:{stmt.name.lower()}", None)
                 ds.save_catalog(self.catalog)
+            elif isinstance(stmt, ast.CreateFunction):
+                if not hasattr(self.catalog, "_aux_ddl"):
+                    self.catalog._aux_ddl = {}
+                self.catalog._aux_ddl[
+                    f"function:{stmt.name.lower()}"] = sql_text
+                ds.save_catalog(self.catalog)
+            elif isinstance(stmt, ast.DropFunction):
+                getattr(self.catalog, "_aux_ddl", {}).pop(
+                    f"function:{stmt.name.lower()}", None)
+                ds.save_catalog(self.catalog)
             elif isinstance(stmt, (ast.GrantStmt, ast.RevokeStmt)):
                 ds.save_catalog(self.catalog)  # grants persist like DDL
             elif isinstance(stmt, ast.DeployStmt):
@@ -372,6 +382,22 @@ class SnappySession:
             return _status()
         if isinstance(stmt, ast.TruncateTable):
             self.catalog.describe(stmt.name).data.truncate()
+            return _status()
+        if isinstance(stmt, ast.CreateFunction):
+            # UDF bodies are python code: same gate as EXEC PYTHON
+            self._gate_code_surface("CREATE FUNCTION")
+            from snappydata_tpu.sql import udf as _udf
+
+            if not stmt.or_replace and stmt.name.lower() in \
+                    getattr(self.catalog, "_functions", {}):
+                raise ValueError(f"function already exists: {stmt.name}")
+            _udf.register(self.catalog, stmt.name, stmt.body,
+                          stmt.returns)
+            return _status()
+        if isinstance(stmt, ast.DropFunction):
+            from snappydata_tpu.sql import udf as _udf
+
+            _udf.unregister(self.catalog, stmt.name, stmt.if_exists)
             return _status()
         if isinstance(stmt, ast.AlterTable):
             return self._alter_table(stmt)
@@ -895,6 +921,16 @@ class SnappySession:
                       [T.STRING])
 
     def _run_query(self, plan: ast.Plan, user_params=()) -> Result:
+        if getattr(self.catalog, "_functions", None):
+            # expose this catalog's SQL-registered functions to the
+            # analyzer / compilers / host evaluator for this execution
+            from snappydata_tpu.sql import udf as _udf
+
+            with _udf.using(self.catalog):
+                return self._run_query_inner(plan, user_params)
+        return self._run_query_inner(plan, user_params)
+
+    def _run_query_inner(self, plan: ast.Plan, user_params=()) -> Result:
         if getattr(self.catalog, "_sample_maintainers", None):
             self._refresh_samples()
         plan = self._rewrite_stream_windows(plan)
@@ -1220,6 +1256,7 @@ class SnappySession:
                              ast.DropPolicy, ast.CreateIndex,
                              ast.DropIndex, ast.ExecCode, ast.SetConf,
                              ast.CreateView, ast.DropView,
+                             ast.CreateFunction, ast.DropFunction,
                              ast.DeployStmt, ast.UndeployStmt)):
             raise PermissionError(
                 f"user {self.user!r} may not run "

@@ -558,6 +558,24 @@ class DropTable(Statement):
 
 
 @dataclasses.dataclass(frozen=True)
+class CreateFunction(Statement):
+    """CREATE [OR REPLACE] FUNCTION name AS '<python lambda>'
+    [RETURNS type] (ref: SnappyDDLParser.scala:765 createFunction — a
+    jar'd JVM class there, a traceable Python expression here)."""
+
+    name: str
+    body: str
+    returns: Optional[T.DataType] = None
+    or_replace: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropFunction(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
 class AlterTable(Statement):
     """ALTER TABLE t ADD [COLUMN] c type | DROP [COLUMN] c
     (ref SnappyDDLParser.scala:697-713, AlterTableAddColumnCommand)."""

@@ -922,6 +922,20 @@ class Parser:
             name = self.qualified_name()
             self.expect_kw("as")
             return ast.CreateView(name, self.query_expr(), or_replace=or_replace)
+        if self.accept_kw("function"):
+            name = self.qualified_name()
+            self.expect_kw("as")
+            t = self.next()
+            if t.kind != "STR":
+                raise SQLSyntaxError(
+                    "CREATE FUNCTION expects a quoted Python lambda "
+                    "after AS")
+            body = t.value
+            ret = None
+            if self.accept_kw("returns"):
+                ret = self.type_name()
+            return ast.CreateFunction(name, body, ret,
+                                      or_replace=or_replace)
         if self.accept_kw("policy"):
             name = self.qualified_name()
             self.expect_kw("on")
@@ -1062,7 +1076,7 @@ class Parser:
     def drop_stmt(self) -> ast.Statement:
         self.expect_kw("drop")
         kind = "table"
-        for k in ("view", "policy", "index"):
+        for k in ("view", "policy", "index", "function"):
             if self.accept_kw(k):
                 kind = k
                 break
@@ -1079,6 +1093,8 @@ class Parser:
             return ast.DropPolicy(name, if_exists)
         if kind == "index":
             return ast.DropIndex(name, if_exists)
+        if kind == "function":
+            return ast.DropFunction(name, if_exists)
         return ast.DropTable(name, if_exists)
 
     def insert_stmt(self) -> ast.Statement:

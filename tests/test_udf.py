@@ -1,0 +1,115 @@
+"""CREATE FUNCTION / UDFs (round-3 verdict Missing #6; ref:
+SnappyDDLParser.scala:765 createFunction, dispatch :1056): SQL-registered
+scalar functions callable in queries. TPU-first: the python body runs on
+the TRACED values, fusing into the compiled XLA program; the host path
+evaluates the identical body on numpy arrays."""
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+
+
+@pytest.fixture()
+def sess():
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE tf (k BIGINT, price DOUBLE, rate DOUBLE, "
+          "name STRING) USING column")
+    rng = np.random.default_rng(4)
+    n = 5000
+    s.insert_arrays("tf", [
+        np.arange(n, dtype=np.int64),
+        np.round(rng.random(n) * 100, 2),
+        np.round(rng.random(n) * 0.2, 3),
+        np.array([f"s{i % 5}" for i in range(n)], dtype=object)])
+    yield s
+    s.stop()
+
+
+def test_udf_in_projection_and_where(sess):
+    sess.sql("CREATE FUNCTION taxed AS "
+             "'lambda price, rate: price * (1 + rate)' RETURNS DOUBLE")
+    r = sess.sql("SELECT sum(taxed(price, rate)) FROM tf")
+    # oracle
+    pr = sess.sql("SELECT sum(price * (1 + rate)) FROM tf").rows()[0][0]
+    assert r.rows()[0][0] == pytest.approx(pr)
+    r2 = sess.sql("SELECT count(*) FROM tf WHERE taxed(price, rate) > 60")
+    e2 = sess.sql("SELECT count(*) FROM tf "
+                  "WHERE price * (1 + rate) > 60").rows()[0][0]
+    assert r2.rows()[0][0] == e2
+
+
+def test_udf_with_jnp_ops(sess):
+    sess.sql("CREATE FUNCTION clipped AS "
+             "'lambda x: jnp.clip(x, 10, 90)' RETURNS DOUBLE")
+    r = sess.sql("SELECT avg(clipped(price)) FROM tf").rows()[0][0]
+    prices = sess.sql("SELECT price FROM tf")
+    exact = float(np.clip(np.asarray(prices.columns[0]), 10, 90).mean())
+    assert r == pytest.approx(exact, rel=1e-9)
+
+
+def test_udf_nulls_propagate(sess):
+    sess.sql("CREATE FUNCTION dbl AS 'lambda x: x * 2' RETURNS DOUBLE")
+    sess.sql("CREATE TABLE tn (v DOUBLE) USING column")
+    sess.sql("INSERT INTO tn VALUES (1.0), (NULL), (3.0)")
+    r = sess.sql("SELECT dbl(v) FROM tn ORDER BY v NULLS FIRST")
+    vals = [row[0] for row in r.rows()]
+    assert None in vals
+    assert sorted(v for v in vals if v is not None) == [2.0, 6.0]
+
+
+def test_udf_group_by_key(sess):
+    sess.sql("CREATE FUNCTION bucket2 AS 'lambda k: k % 3' "
+             "RETURNS LONG")
+    r = sess.sql("SELECT bucket2(k) AS b, count(*) FROM tf "
+                 "GROUP BY bucket2(k) ORDER BY b")
+    assert [row[0] for row in r.rows()] == [0, 1, 2]
+    assert sum(row[1] for row in r.rows()) == 5000
+
+
+def test_or_replace_and_drop(sess):
+    sess.sql("CREATE FUNCTION f1 AS 'lambda x: x + 1' RETURNS DOUBLE")
+    assert sess.sql("SELECT f1(price) FROM tf LIMIT 1").num_rows == 1
+    with pytest.raises(Exception, match="already exists"):
+        sess.sql("CREATE FUNCTION f1 AS 'lambda x: x + 2'")
+    sess.sql("CREATE OR REPLACE FUNCTION f1 AS 'lambda x: x + 100' "
+             "RETURNS DOUBLE")
+    one = sess.sql("SELECT f1(price) - price FROM tf LIMIT 1").rows()[0][0]
+    assert one == pytest.approx(100.0)
+    sess.sql("DROP FUNCTION f1")
+    with pytest.raises(Exception, match="unknown function|unsupported"):
+        sess.sql("SELECT f1(price) FROM tf")
+    sess.sql("DROP FUNCTION IF EXISTS f1")   # no error
+
+
+def test_udf_rejected_on_unauthenticated_network_principal(sess):
+    remote = sess.for_user("bob", remote=True, authenticated=False)
+    # refused either by the DDL-is-admin gate or the code-surface gate
+    with pytest.raises(PermissionError,
+                       match="CREATE FUNCTION|admin-only"):
+        remote.execute_statement(
+            __import__("snappydata_tpu.sql.parser",
+                       fromlist=["parse"]).parse(
+                "CREATE FUNCTION evil AS 'lambda x: x'"))
+
+
+def test_udf_invalid_body_rejected(sess):
+    with pytest.raises(Exception, match="does not evaluate|callable"):
+        sess.sql("CREATE FUNCTION bad AS 'this is not python'")
+    with pytest.raises(Exception, match="callable"):
+        sess.sql("CREATE FUNCTION bad2 AS '42'")
+
+
+def test_udf_survives_recovery(tmp_path):
+    d = str(tmp_path / "store")
+    s = SnappySession(data_dir=d)
+    s.sql("CREATE TABLE rt (v DOUBLE) USING column")
+    s.sql("INSERT INTO rt VALUES (2.0), (4.0)")
+    s.sql("CREATE FUNCTION trip AS 'lambda x: x * 3' RETURNS DOUBLE")
+    s.checkpoint()
+    s.disk_store.close()
+    s2 = SnappySession(data_dir=d)
+    r = s2.sql("SELECT sum(trip(v)) FROM rt").rows()[0][0]
+    assert r == pytest.approx(18.0)
+    s2.disk_store.close()
