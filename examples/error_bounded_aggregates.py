@@ -1,0 +1,59 @@
+"""Error-bounded approximate aggregates — the HAC surface (ref example:
+the airline WITH ERROR queries in docs/sde/hac_contracts.md and
+docs/aqp.md; job analogue AirlineDataJob.scala).
+
+Run: PYTHONPATH=. python examples/error_bounded_aggregates.py
+"""
+
+import time
+
+import numpy as np
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+
+
+def main():
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE airline (carrier STRING, arr_delay DOUBLE, "
+          "month_ INT) USING column")
+    rng = np.random.default_rng(7)
+    n = 2_000_000
+    s.insert_arrays("airline", [
+        np.array(["AA", "UA", "DL", "WN", "B6"],
+                 dtype=object)[rng.integers(0, 5, n)],
+        rng.normal(9.0, 25.0, n),
+        rng.integers(1, 13, n).astype(np.int32)])
+    s.sql("CREATE SAMPLE TABLE airline_sample ON airline OPTIONS "
+          "(baseTable 'airline', qcs 'carrier', reservoir_size '400')")
+
+    q = ("SELECT carrier, avg(arr_delay) AS ad, absolute_error(ad) AS ae, "
+         "relative_error(ad) AS re, lower_bound(ad) AS lb, "
+         "upper_bound(ad) AS ub FROM airline GROUP BY carrier "
+         "ORDER BY carrier WITH ERROR 0.1 CONFIDENCE 0.95")
+    t0 = time.time()
+    approx = s.sql(q)
+    t_approx = time.time() - t0
+    t0 = time.time()
+    exact = s.sql("SELECT carrier, avg(arr_delay) FROM airline "
+                  "GROUP BY carrier ORDER BY carrier")
+    t_exact = time.time() - t0
+
+    exact_by = dict(exact.rows())
+    print(f"approx ({t_approx * 1e3:.1f} ms) vs exact "
+          f"({t_exact * 1e3:.1f} ms):")
+    for carrier, ad, ae, re, lb, ub in approx.rows():
+        inside = "ok" if lb <= exact_by[carrier] <= ub else "MISS"
+        print(f"  {carrier}: {ad:8.3f} ± {ae:.3f}  "
+              f"[{lb:.3f}, {ub:.3f}]  exact {exact_by[carrier]:8.3f}  "
+              f"{inside}")
+
+    # behaviors: strict raises when a group misses the contract
+    s.sql("SELECT carrier, avg(arr_delay) AS ad FROM airline "
+          "GROUP BY carrier WITH ERROR 0.5 BEHAVIOR 'run_on_full_table'")
+    print("run_on_full_table behavior: exact values substituted on "
+          "violation")
+
+
+if __name__ == "__main__":
+    main()

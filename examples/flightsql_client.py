@@ -1,0 +1,41 @@
+"""Connect with the open Flight SQL protocol — what a stock ADBC/JDBC
+FlightSQL driver speaks (ref: the any-client thrift/DRDA surface,
+cluster/README-thrift.md; app analogue AirlineDataSparkApp.scala's JDBC
+path).
+
+Run: PYTHONPATH=. python examples/flightsql_client.py
+"""
+
+import threading
+
+import numpy as np
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.cluster.flight_server import SnappyFlightServer
+from snappydata_tpu.cluster.flightsql import FlightSqlClient
+
+
+def main():
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE trips (id BIGINT, dist DOUBLE) USING column")
+    s.insert_arrays("trips", [np.arange(10_000, dtype=np.int64),
+                              np.random.default_rng(0).random(10_000) * 30])
+    srv = SnappyFlightServer(s)
+    threading.Thread(target=srv.serve, daemon=True).start()
+    srv.wait_ready()
+
+    c = FlightSqlClient(f"127.0.0.1:{srv.actual_port}")
+    print("tables:", c.get_tables().column("table_name").to_pylist())
+    t = c.execute("SELECT count(*) AS n, avg(dist) AS ad FROM trips")
+    print("query:", t.to_pydict())
+    ps = c.prepare("SELECT count(*) AS n FROM trips WHERE dist < ?")
+    for lim in (5.0, 15.0):
+        print(f"dist < {lim}:", ps.execute([lim]).column("n")[0].as_py())
+    ps.close()
+    c.close()
+    srv.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,42 @@
+"""Kafka → table exactly-once ingest (ref example: the streaming jobs
+TwitterPopularTagsJob.scala / StreamingUtils.scala, re-shaped onto the
+kafka_stream provider).
+
+Run: PYTHONPATH=. python examples/kafka_ingest.py
+"""
+
+import time
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.streaming.kafka import InProcessBroker, register_broker
+
+
+def main():
+    s = SnappySession(catalog=Catalog())
+    broker = InProcessBroker(num_partitions=4)
+    register_broker("demo", broker)
+    s.sql("CREATE STREAM TABLE clicks (id BIGINT, page STRING) "
+          "USING kafka_stream OPTIONS (topic 'clicks', "
+          "brokers 'inproc://demo', key_columns 'id', interval '0.02')")
+
+    n = 100_000
+    broker.produce("clicks", [{"id": i, "page": f"p{i % 9}"}
+                              for i in range(n)])
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if s.sql("SELECT count(*) FROM clicks").rows()[0][0] == n:
+            break
+        time.sleep(0.1)
+    prog = [p for p in s.streaming_queries()
+            if p["name"] == "stream_clicks"][0]
+    print(f"landed {prog['rows_processed']} rows at "
+          f"{prog['rows_per_s']:.0f}/s, consumer lag "
+          f"{prog['consumer_lag']}")
+    top = s.sql("SELECT page, count(*) c FROM clicks GROUP BY page "
+                "ORDER BY c DESC LIMIT 3")
+    print("top pages:", top.rows())
+
+
+if __name__ == "__main__":
+    main()

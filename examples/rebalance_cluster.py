@@ -1,0 +1,57 @@
+"""Kill → rejoin → rebalance on a 3-server cluster (ref:
+CALL SYS.REBALANCE_ALL_BUCKETS(), rebalance-all-buckets.md; HA walkthrough
+docs/architecture/cluster_architecture.md).
+
+Run: PYTHONPATH=. JAX_PLATFORMS=cpu python examples/rebalance_cluster.py
+"""
+
+import numpy as np
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.cluster import LocatorNode, ServerNode
+from snappydata_tpu.cluster.distributed import DistributedSession
+
+
+def main():
+    locator = LocatorNode().start()
+    servers = [ServerNode(locator.address,
+                          SnappySession(catalog=Catalog())).start()
+               for _ in range(3)]
+    ds = DistributedSession(
+        server_addresses=[sv.flight_address for sv in servers])
+    try:
+        ds.sql("CREATE TABLE t (k BIGINT, v DOUBLE) USING column "
+               "OPTIONS (partition_by 'k', redundancy '1')")
+        rng = np.random.default_rng(2)
+        ds.insert_arrays("t", [rng.integers(0, 90_000, 60_000)
+                               .astype(np.int64), np.ones(60_000)])
+
+        def counts():
+            return [sum(1 for b in range(ds.num_buckets)
+                        if ds.bucket_map[b] == m) for m in range(3)]
+
+        print("buckets per member:", counts())
+        servers[2].stop()
+        ds.mark_server_failed(2)
+        print("after member death:", counts(),
+              "count:", ds.sql("SELECT count(*) FROM t").rows()[0][0])
+        servers[2] = ServerNode(locator.address,
+                                SnappySession(catalog=Catalog())).start()
+        ds.replace_server(2, servers[2].flight_address)
+        out = ds.rebalance()
+        print("rebalanced:", out)
+        print("count unchanged:",
+              ds.sql("SELECT count(*) FROM t").rows()[0][0])
+    finally:
+        ds.close()
+        for sv in servers:
+            try:
+                sv.stop()
+            except Exception:
+                pass
+        locator.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,29 @@
+"""SQL-registered functions (CREATE FUNCTION): the python body runs on
+the traced values and fuses into the compiled query program (ref:
+CreateAndLoadAirlineDataJob.scala registers UDFs the JVM way).
+
+Run: PYTHONPATH=. python examples/sql_functions.py
+"""
+
+import numpy as np
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+
+
+def main():
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE fares (base DOUBLE, surge DOUBLE) USING column")
+    rng = np.random.default_rng(1)
+    s.insert_arrays("fares", [rng.random(100_000) * 40,
+                              1 + rng.random(100_000)])
+    s.sql("CREATE FUNCTION total_fare AS "
+          "'lambda base, surge: jnp.round(base * surge + 2.5, 2)' "
+          "RETURNS DOUBLE")
+    r = s.sql("SELECT count(*), avg(total_fare(base, surge)) FROM fares "
+              "WHERE total_fare(base, surge) > 30")
+    print("rows over $30 and their avg:", r.rows()[0])
+
+
+if __name__ == "__main__":
+    main()
