@@ -1673,7 +1673,8 @@ class SnappySession:
                          if c.strip())
         provider = stmt.provider if stmt.provider in ("file_stream",
                                                       "memory_stream",
-                                                      "kafka_stream") \
+                                                      "kafka_stream",
+                                                      "socket_stream") \
             else opts.get("provider", "memory_stream")
         if not hasattr(self.catalog, "_streams"):
             self.catalog._streams = {}
@@ -1691,6 +1692,17 @@ class SnappySession:
                 raise ValueError(
                     "file_stream requires OPTIONS (directory '...')")
             source = FileSource(directory, schema.names())
+        elif provider == "socket_stream":
+            from snappydata_tpu.streaming.query import SocketSource
+
+            host = opts.get("hostname") or opts.get("host")
+            port = opts.get("port")
+            if not host or not port:
+                raise ValueError("socket_stream requires OPTIONS "
+                                 "(hostname '...', port '...')")
+            source = SocketSource(
+                host, int(port),
+                [n for n in schema.names() if not n.startswith("__")])
         elif provider == "kafka_stream":
             from snappydata_tpu.streaming.kafka import (KafkaSource,
                                                         resolve_broker)

@@ -86,6 +86,62 @@ class FileSource(Source):
         return cols, offset + 1
 
 
+class SocketSource(Source):
+    """TCP line source (ref: socketTextStream demos — the reference's
+    socket stream source is likewise at-most-once: a socket has no
+    offsets to replay, so unconsumed lines buffered at crash time are
+    lost; durable pipelines use kafka_stream)."""
+
+    def __init__(self, host: str, port: int, schema_names):
+        import socket
+        import threading as _t
+
+        self.names = list(schema_names)
+        self._buf: List[dict] = []
+        self._lock = _t.Lock()
+        self._sock = socket.create_connection((host, port), timeout=10)
+        # the 10s timeout covers CONNECT only: a blocking read timeout
+        # would poison the pump on any >10s producer idle gap
+        self._sock.settimeout(None)
+        self._closed = False
+        _t.Thread(target=self._pump, daemon=True).start()
+
+    def _pump(self) -> None:
+        import json as _json
+
+        fh = self._sock.makefile("r")
+        try:
+            for line in fh:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = _json.loads(line)
+                except ValueError:
+                    continue  # poison line: skip, like FileSource
+                with self._lock:
+                    self._buf.append(rec)
+        except OSError:
+            pass
+        finally:
+            self._closed = True
+
+    def next_batch(self, offset):
+        with self._lock:
+            if not self._buf:
+                return None
+            rows, self._buf = self._buf, []
+        cols = {n: np.array([r.get(n) for r in rows])
+                for n in self.names}
+        return cols, offset + 1
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
 def _batch_empty(columns) -> bool:
     return not columns or all(len(np.asarray(v)) == 0
                               for v in columns.values())
@@ -204,6 +260,12 @@ class StreamingQuery:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        close = getattr(self.source, "close", None)
+        if close is not None:   # socket sources hold a live connection
+            try:
+                close()
+            except Exception:
+                pass
 
     @property
     def is_active(self) -> bool:

@@ -1,0 +1,128 @@
+"""Round-4 parity gaps: ENOSPC during WAL append (the hydra
+diskFullTests tier had no analogue here — round-3 verdict Weak #6) and
+the socket stream source (ref: socketTextStream demos)."""
+
+import errno
+import json
+import socket
+import socketserver
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.storage import persistence
+
+
+class TestDiskFull:
+    def test_enospc_mid_ingest_fails_clean_and_recovers(self, tmp_path,
+                                                        monkeypatch):
+        """ENOSPC during a WAL append: the INSERT fails with the OS
+        error, previously-committed data stays intact and readable, and
+        once space frees up the store accepts writes again — exactly the
+        WAL-then-apply contract under the hydra disk-full battery."""
+        d = str(tmp_path / "store")
+        s = SnappySession(data_dir=d)
+        s.sql("CREATE TABLE ev (k BIGINT, v DOUBLE) USING column")
+        for i in range(5):
+            s.insert_arrays("ev", [
+                np.arange(i * 100, (i + 1) * 100, dtype=np.int64),
+                np.ones(100)])
+
+        real_write = persistence.write_record
+        state = {"full": True}
+
+        def failing_write(fh, header, arrays):
+            if state["full"]:
+                raise OSError(errno.ENOSPC, "No space left on device")
+            return real_write(fh, header, arrays)
+
+        monkeypatch.setattr(persistence, "write_record", failing_write)
+        with pytest.raises(OSError, match="No space left"):
+            s.insert_arrays("ev", [np.arange(500, 600, dtype=np.int64),
+                                   np.ones(100)])
+        # WAL-first: the failed chunk must not be half-applied
+        assert s.sql("SELECT count(*) FROM ev").rows()[0][0] == 500
+
+        # space freed: ingest resumes on the SAME store
+        state["full"] = False
+        s.insert_arrays("ev", [np.arange(500, 600, dtype=np.int64),
+                               np.ones(100)])
+        assert s.sql("SELECT count(*) FROM ev").rows()[0][0] == 600
+        s.checkpoint()
+        s.disk_store.close()
+
+        # recovery sees a consistent store: the acknowledged 600 rows
+        s2 = SnappySession(data_dir=d)
+        assert s2.sql("SELECT count(*) FROM ev").rows()[0][0] == 600
+        assert s2.sql("SELECT count(DISTINCT k) FROM ev").rows()[0][0] \
+            == 600
+        s2.disk_store.close()
+
+    def test_enospc_during_checkpoint_keeps_store_consistent(
+            self, tmp_path, monkeypatch):
+        d = str(tmp_path / "store")
+        s = SnappySession(data_dir=d)
+        s.sql("CREATE TABLE cv (k BIGINT) USING column")
+        s.insert_arrays("cv", [np.arange(1000, dtype=np.int64)])
+        # cut a real batch so the checkpoint writes batch files
+        s.catalog.describe("cv").data.force_rollover()
+
+        real_write = persistence.write_record
+
+        def failing_write(fh, header, arrays):
+            raise OSError(errno.ENOSPC, "No space left on device")
+
+        monkeypatch.setattr(persistence, "write_record", failing_write)
+        with pytest.raises(OSError):
+            s.checkpoint()
+        monkeypatch.setattr(persistence, "write_record", real_write)
+        # the half-written checkpoint must not poison recovery: WAL
+        # replay still reconstructs every acknowledged row
+        s.disk_store.close()
+        s2 = SnappySession(data_dir=d)
+        assert s2.sql("SELECT count(*) FROM cv").rows()[0][0] == 1000
+        s2.disk_store.close()
+
+
+class _LineServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True   # sleeping handlers must not delay exit
+
+
+def test_socket_stream_source():
+    rows = [{"id": i, "tag": f"t{i % 3}"} for i in range(500)]
+    conns = []
+
+    class H(socketserver.StreamRequestHandler):
+        def handle(self):
+            conns.append(True)
+            for r in rows:
+                self.wfile.write((json.dumps(r) + "\n").encode())
+            self.wfile.flush()
+            time.sleep(30)   # hold the connection open
+
+    srv = _LineServer(("127.0.0.1", 0), H)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    port = srv.server_address[1]
+    from snappydata_tpu.catalog import Catalog
+
+    s = SnappySession(catalog=Catalog())
+    try:
+        s.sql(f"CREATE STREAM TABLE sk (id BIGINT, tag STRING) "
+              f"USING socket_stream OPTIONS (hostname '127.0.0.1', "
+              f"port '{port}', key_columns 'id', interval '0.02')")
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if s.sql("SELECT count(*) FROM sk").rows()[0][0] == 500:
+                break
+            time.sleep(0.05)
+        assert s.sql("SELECT count(*) FROM sk").rows()[0][0] == 500
+        r = s.sql("SELECT tag, count(*) FROM sk GROUP BY tag "
+                  "ORDER BY tag")
+        assert [row[1] for row in r.rows()] == [167, 167, 166]
+    finally:
+        s.stop()
+        srv.shutdown()

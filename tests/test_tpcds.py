@@ -1,0 +1,93 @@
+"""TPC-DS reporting-family harness (ref: TPCDSQuerySnappyBenchmark) —
+canonical query text over the synthetic star schema, value-asserted
+against pandas oracles, single-node and distributed."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.utils import tpcds
+
+
+@pytest.fixture(scope="module")
+def sess():
+    s = SnappySession(catalog=Catalog())
+    tpcds.load_tpcds(s, sf=0.003, seed=11)
+    yield s
+    s.stop()
+
+
+def _frames(seed=11, sf=0.003):
+    sz = tpcds.table_sizes(sf)   # shared sizing: oracle == loaded data
+    dd = tpcds.gen_date_dim(seed=seed)
+    return {
+        "date_dim": pd.DataFrame(dd),
+        "item": pd.DataFrame(tpcds.gen_item(sz["item"], seed + 1)),
+        "store_sales": pd.DataFrame(tpcds.gen_store_sales(
+            sz["store_sales"], len(dd["d_date_sk"]), sz["item"],
+            sz["customer"], sz["store"], seed + 5)),
+    }
+
+
+def test_q3_matches_pandas(sess):
+    f = _frames()
+    j = (f["store_sales"]
+         .merge(f["date_dim"], left_on="ss_sold_date_sk",
+                right_on="d_date_sk")
+         .merge(f["item"], left_on="ss_item_sk", right_on="i_item_sk"))
+    j = j[(j.i_manufact_id == 100) & (j.d_moy == 11)]
+    exp = (j.groupby(["d_year", "i_brand_id", "i_brand"])
+           .ss_ext_sales_price.sum().reset_index())
+    got = sess.sql(tpcds.Q3).rows()
+    assert len(got) == min(100, len(exp))
+    by_key = {(r.d_year, r.i_brand_id): r.ss_ext_sales_price
+              for r in exp.itertuples()}
+    for year, brand_id, brand, total in got:
+        assert total == pytest.approx(by_key[(year, brand_id)])
+    # ordering: per year, totals descend
+    for a, b in zip(got, got[1:]):
+        if a[0] == b[0]:
+            assert a[3] >= b[3] - 1e-9
+
+
+@pytest.mark.parametrize("qname", ["q42", "q52", "q55", "q19"])
+def test_queries_run_and_are_consistent(sess, qname):
+    r = sess.sql(tpcds.QUERIES[qname])
+    rows = r.rows()
+    # every query aggregates a positive price column over a non-empty
+    # join at this scale
+    assert rows, qname
+    totals = [row[-1] for row in rows]
+    assert all(t is None or t > 0 for t in totals)
+    assert totals == sorted([t for t in totals], reverse=True)
+
+
+@pytest.mark.slow
+def test_tpcds_distributed_equals_single_node():
+    from snappydata_tpu.cluster import LocatorNode, ServerNode
+    from snappydata_tpu.cluster.distributed import DistributedSession
+
+    locator = LocatorNode().start()
+    servers = [ServerNode(locator.address, SnappySession(catalog=Catalog()))
+               .start() for _ in range(3)]
+    ds = DistributedSession(
+        server_addresses=[s.flight_address for s in servers])
+    single = SnappySession(catalog=Catalog())
+    try:
+        tpcds.load_tpcds(ds, sf=0.002, seed=7, partition_sales=True)
+        tpcds.load_tpcds(single, sf=0.002, seed=7)
+        for qname, q in tpcds.QUERIES.items():
+            got = ds.sql(q).rows()
+            exp = single.sql(q).rows()
+            assert len(got) == len(exp), qname
+            for a, b in zip(got, exp):
+                assert a[:-1] == b[:-1], qname
+                assert a[-1] == pytest.approx(b[-1]), qname
+    finally:
+        ds.close()
+        single.stop()
+        for s in servers:
+            s.stop()
+        locator.stop()
