@@ -33,15 +33,34 @@ def _cmd_locator(args) -> int:
 
 
 def _cmd_server(args) -> int:
+    # multi-host slice: initialize jax.distributed BEFORE any jax API
+    # (flags override SNAPPY_COORDINATOR / _NUM_PROCESSES / _PROCESS_ID)
+    from snappydata_tpu.parallel.multihost import (initialize_multihost,
+                                                   local_device_indices)
+
+    multihost = initialize_multihost(
+        coordinator=getattr(args, "coordinator", None),
+        num_processes=getattr(args, "num_processes", None),
+        process_id=getattr(args, "process_id", None))
+
     from snappydata_tpu import SnappySession
     from snappydata_tpu.catalog import Catalog
     from snappydata_tpu.cluster import ServerNode
 
+    mesh_devices = None
+    if args.mesh_devices:
+        mesh_devices = [int(x) for x in args.mesh_devices.split(",")]
+    elif multihost:
+        # per-host server owns exactly its local chips of the slice
+        mesh_devices = local_device_indices()
     session = SnappySession(catalog=None if args.data_dir else Catalog(),
                             data_dir=args.data_dir)
     node = ServerNode(args.locator, session, host=args.host,
-                      flight_port=args.port).start()
-    print(f"server {node.member_id} flight at {node.flight_address}")
+                      flight_port=args.port,
+                      mesh_devices=mesh_devices).start()
+    extra = f", submesh {mesh_devices}" if mesh_devices else ""
+    print(f"server {node.member_id} flight at {node.flight_address}"
+          + extra)
     _wait_forever()
     return 0
 
@@ -201,6 +220,15 @@ def main(argv=None) -> int:
         rp.add_argument("--data-dir", default=None)
         if role == "lead":
             rp.add_argument("--rest-port", type=int, default=5050)
+        if role == "server":
+            rp.add_argument("--mesh-devices", default=None,
+                            help="comma-separated GLOBAL device indices "
+                                 "this server's submesh owns")
+            rp.add_argument("--coordinator", default=None,
+                            help="jax.distributed coordinator host:port "
+                                 "(multi-host slice)")
+            rp.add_argument("--num-processes", type=int, default=None)
+            rp.add_argument("--process-id", type=int, default=None)
         rp.set_defaults(fn=fn)
 
     sp = sub.add_parser("sql")

@@ -1,0 +1,80 @@
+"""Multi-host initialization: the jax.distributed path (SURVEY §7 step 4).
+
+The reference scales past one machine through its store's P2P membership
+plus NCCL-style transports; the TPU-native equivalent is
+`jax.distributed.initialize` — after it, `jax.devices()` spans every
+host in the slice and GSPMD collectives ride ICI within a pod (DCN
+across pods), so the SAME `Mesh`/`pjit` code the single-host path uses
+scales to multi-host with no query-engine changes (the "pick a mesh,
+annotate shardings, let XLA insert collectives" recipe).
+
+Topology composition with the cluster plane:
+- one snappydata server process per HOST, each joining the locator;
+- each process calls `initialize_multihost()` at boot (before any jax
+  API) with the shared coordinator address;
+- the server's submesh (`ServerNode(mesh_devices=...)`) then selects
+  its LOCAL devices out of the global device list (`local_devices()`),
+  while cross-server exchanges keep riding Arrow Flight.
+
+Configuration (flags or environment):
+  SNAPPY_COORDINATOR=host:port   the process-0 coordinator endpoint
+  SNAPPY_NUM_PROCESSES=N         world size
+  SNAPPY_PROCESS_ID=i            this process's rank
+
+No real multi-host fabric exists in CI; tests cover the argument
+plumbing and the local_devices selection (jax.distributed.initialize is
+a no-op pass-through that unit tests monkeypatch).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+_initialized = False
+
+
+def initialize_multihost(coordinator: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> bool:
+    """Initialize the jax multi-host runtime from args or SNAPPY_* env.
+    Returns False (no-op) when no coordinator is configured — single-host
+    deployments need nothing. Must run before the first jax API call.
+    Safe to call twice (second call is a no-op)."""
+    global _initialized
+    if _initialized:
+        return True
+    coordinator = coordinator or os.environ.get("SNAPPY_COORDINATOR")
+    if not coordinator:
+        return False
+    num_processes = num_processes if num_processes is not None else \
+        int(os.environ.get("SNAPPY_NUM_PROCESSES", "1"))
+    process_id = process_id if process_id is not None else \
+        int(os.environ.get("SNAPPY_PROCESS_ID", "0"))
+    import jax
+
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    _initialized = True
+    return True
+
+
+def local_device_indices() -> list:
+    """Indices (into the GLOBAL jax.devices() list) of THIS process's
+    devices — what a per-host ServerNode passes as `mesh_devices` so its
+    submesh covers exactly the chips it hosts."""
+    import jax
+
+    all_devices = jax.devices()
+    local = set(id(d) for d in jax.local_devices())
+    return [i for i, d in enumerate(all_devices) if id(d) in local]
+
+
+def global_data_mesh():
+    """A 1-D data mesh over EVERY device in the multi-host slice —
+    collectives ride ICI inside a pod, DCN across pods, inserted by XLA
+    from the sharding annotations (no NCCL/MPI calls to port)."""
+    from snappydata_tpu.parallel.mesh import data_mesh
+
+    return data_mesh()

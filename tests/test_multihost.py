@@ -1,0 +1,68 @@
+"""Multi-host wiring (SURVEY §7 step 4): jax.distributed argument
+plumbing and local-device submesh selection. No real multi-host fabric
+exists in CI — initialize is monkeypatched; the single-host no-op path
+and the env/flag precedence are what these tests pin down."""
+
+import importlib
+
+import pytest
+
+from snappydata_tpu.parallel import multihost
+
+
+@pytest.fixture(autouse=True)
+def fresh(monkeypatch):
+    importlib.reload(multihost)
+    yield
+
+
+def test_noop_without_coordinator(monkeypatch):
+    monkeypatch.delenv("SNAPPY_COORDINATOR", raising=False)
+    assert multihost.initialize_multihost() is False
+
+
+def test_env_configuration(monkeypatch):
+    calls = {}
+
+    def fake_init(coordinator_address, num_processes, process_id):
+        calls.update(coordinator=coordinator_address,
+                     n=num_processes, pid=process_id)
+
+    import jax
+
+    monkeypatch.setattr(jax.distributed, "initialize", fake_init)
+    monkeypatch.setenv("SNAPPY_COORDINATOR", "10.0.0.1:8476")
+    monkeypatch.setenv("SNAPPY_NUM_PROCESSES", "4")
+    monkeypatch.setenv("SNAPPY_PROCESS_ID", "2")
+    assert multihost.initialize_multihost() is True
+    assert calls == {"coordinator": "10.0.0.1:8476", "n": 4, "pid": 2}
+    # second call: no-op, no re-init
+    calls.clear()
+    assert multihost.initialize_multihost() is True
+    assert calls == {}
+
+
+def test_flag_overrides_env(monkeypatch):
+    calls = {}
+    import jax
+
+    monkeypatch.setattr(
+        jax.distributed, "initialize",
+        lambda coordinator_address, num_processes, process_id:
+        calls.update(c=coordinator_address, n=num_processes,
+                     p=process_id))
+    monkeypatch.setenv("SNAPPY_COORDINATOR", "env:1")
+    assert multihost.initialize_multihost("flag:2", 8, 3) is True
+    assert calls == {"c": "flag:2", "n": 8, "p": 3}
+
+
+def test_local_device_indices_single_host():
+    # on one host, local == global (the 8 virtual CPU devices)
+    idx = multihost.local_device_indices()
+    import jax
+
+    assert idx == list(range(len(jax.devices())))
+    from snappydata_tpu.parallel.mesh import submesh
+
+    m = submesh(idx[:4])
+    assert m.devices.size == 4
