@@ -138,6 +138,27 @@ def main() -> None:
                   file=sys.stderr, flush=True)
             device[name] = None
 
+    # Pallas Kahan-reduction side-by-side (TPU only; default-off path —
+    # measured here so next round can flip it on with evidence)
+    q6_pallas_s = None
+    if platform == "tpu":
+        try:
+            config.global_properties().pallas_reduce = True
+            s.executor.clear_cache()
+            s.sql(tpch.Q6)
+            best = float("inf")
+            for _ in range(repeats):
+                t0 = time.time()
+                s.sql(tpch.Q6)
+                best = min(best, time.time() - t0)
+            q6_pallas_s = round(best, 4)
+        except Exception as e:
+            print(f"bench: pallas Q6 timing failed: {e}",
+                  file=sys.stderr, flush=True)
+        finally:
+            config.global_properties().pallas_reduce = False
+            s.executor.clear_cache()
+
     ingest_rows_per_s = sink_events_per_s = None
     try:   # secondary benches must not kill the headline numbers
         ingest_rows_per_s = _ingest_bench()
@@ -174,6 +195,7 @@ def main() -> None:
             "q6_device_rows_per_s": None if device.get("q6") is None
             else round(n_rows / device["q6"], 1),
             "q1_max_rel_err": q1_max_rel_err,
+            "q6_pallas_s": q6_pallas_s,
             "ingest_rows_per_s": ingest_rows_per_s,
             "sink_events_per_s": sink_events_per_s,
         },

@@ -67,6 +67,11 @@ class Properties:
     # capacity-row plates (ref: decode-at-scan generated code,
     # ColumnTableScan.scala:684 genCodeColumnBuffer)
     device_decode: bool = True
+    # Pallas compensated-f32 kernel for global float SUM/AVG instead of
+    # the emulated-f64 segment reduction on TPU (ops/pallas_reduce.py).
+    # Default OFF until measured on hardware; bench.py reports the
+    # side-by-side timing when a TPU is reachable.
+    pallas_reduce: bool = False
     max_groups: int = 1 << 16                 # static upper bound for generic group-by output
     batches_pow2_bucketing: bool = True       # pad #batches to pow2 → fewer recompiles
 

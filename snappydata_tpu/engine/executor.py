@@ -1212,8 +1212,26 @@ class Compiler:
                         new.astype(jnp.int64), g_s,
                         num_segments=num_groups + 1))
                 elif kind == "sum":
-                    acc = v.astype(_acc_dtype(dv.dtype))
-                    slot_arrays.append(seg("sum", jnp.where(w, acc, 0)))
+                    if (not groups and v.dtype == jnp.float32
+                            and config.global_properties().pallas_reduce):
+                        # global f32 sum via the Pallas Kahan kernel:
+                        # one compensated-f32 pass instead of the
+                        # emulated-f64 reduction. f32 inputs ONLY — the
+                        # TPU storage contract already keeps DOUBLE as
+                        # f32 plates, so nothing extra is truncated;
+                        # f64 plates (CPU policy) keep the exact path
+                        # (ops/pallas_reduce.py, incl. the cancellation
+                        # caveat)
+                        from snappydata_tpu.ops.pallas_reduce import \
+                            masked_kahan_sum
+
+                        total = masked_kahan_sum(v, w)
+                        slot_arrays.append(jnp.stack(
+                            [total, jnp.zeros((), total.dtype)]))
+                    else:
+                        acc = v.astype(_acc_dtype(dv.dtype))
+                        slot_arrays.append(
+                            seg("sum", jnp.where(w, acc, 0)))
                 elif kind == "sumsq":
                     acc = v.astype(_acc_dtype(T.DOUBLE))
                     slot_arrays.append(seg("sum", jnp.where(w, acc * acc, 0)))

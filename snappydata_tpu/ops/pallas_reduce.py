@@ -1,0 +1,142 @@
+"""Pallas kernel: masked compensated (Kahan) reduction.
+
+Motivation (the numerics/bandwidth trade the aggregate accumulators
+face): DOUBLE aggregates need ~1e-6-grade accuracy, so the XLA path
+widens the accumulator to float64 — which TPUs EMULATE in software at a
+large per-op cost. This kernel instead runs ONE pass over the f32
+plates keeping a per-lane Kahan compensation term in VMEM: each of the
+8x128 vector lanes owns an independent compensated chain over its
+~rows/8 elements (error ~eps, not ~n*eps), and the tiny [8,128]
+(sum, compensation) partials combine in exact-enough float64 OUTSIDE
+the kernel. Accuracy matches the f64 path to <=1e-6 relative while the
+hot loop stays entirely in native f32 vector ops.
+
+Used for global (ungrouped) SUM/AVG over float32 plates — the TPC-H
+Q6 shape — behind `properties.pallas_reduce` (**default OFF** until
+measured on hardware; bench.py records the side-by-side timing when a
+TPU is reachable). Scope caveats the gate enforces and the docs own:
+only float32 inputs qualify (an f64 input would be truncated — the TPU
+storage contract already stores DOUBLE as f32 plates, so on TPU this
+loses nothing), and compensated summation bounds error relative to
+Σ|v|, not |Σv| — under heavy cancellation (Σ|v| >> |Σv|) the emulated-
+f64 segment path remains the accurate choice. CPU runs use the
+interpreter (no Mosaic lowering) and exist for correctness tests only.
+
+Ref parity note: the reference leans on JVM codegen'd loops with
+double accumulators (SnappyHashAggregateExec); this is the TPU-native
+equivalent of "accumulate wider than the data".
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_LANES = 128
+_SUBLANES = 8
+
+
+# rows per grid step: 2048x128 f32 block = 1MB data + 256KB mask in
+# VMEM — far under the ~16MB budget, so arbitrarily long columns
+# stream block by block instead of requiring the whole array resident
+_BLOCK_ROWS = 2048
+
+
+def _kahan_kernel(x_ref, m_ref, sum_ref, comp_ref):
+    """One grid step = one [_BLOCK_ROWS, LANES] f32 block + bool mask.
+    Per-lane-element Kahan accumulation over the row axis via
+    lax.fori_loop, writing this block's [SUBLANES, LANES] sum +
+    compensation tiles."""
+    steps = _BLOCK_ROWS // _SUBLANES
+
+    def body(i, carry):
+        s, c = carry
+        blk = x_ref[pl.ds(i * _SUBLANES, _SUBLANES), :]
+        msk = m_ref[pl.ds(i * _SUBLANES, _SUBLANES), :]
+        v = jnp.where(msk, blk, 0.0)
+        # Kahan: y = v - c; t = s + y; c = (t - s) - y; s = t
+        y = v - c
+        t = s + y
+        c_new = (t - s) - y
+        return t, c_new
+
+    zero = jnp.zeros((_SUBLANES, _LANES), dtype=jnp.float32)
+    s, c = jax.lax.fori_loop(0, steps, body, (zero, zero))
+    sum_ref[:, :, :] = s[None]
+    comp_ref[:, :, :] = c[None]
+
+
+try:  # pallas import is cheap; actual lowering happens at first call
+    from jax.experimental import pallas as pl
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+    except ImportError:  # pragma: no cover
+        pltpu = None
+    _PALLAS = True
+except ImportError:  # pragma: no cover - pallas always ships with jax
+    _PALLAS = False
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _kahan_call(x2d: jnp.ndarray, mask2d: jnp.ndarray,
+                interpret: bool = False):
+    rows = x2d.shape[0]
+    nblocks = rows // _BLOCK_ROWS
+    sums, comps = pl.pallas_call(
+        _kahan_kernel,
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0)),
+            pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, _SUBLANES, _LANES), lambda i: (i, 0, 0)),
+            pl.BlockSpec((1, _SUBLANES, _LANES), lambda i: (i, 0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((nblocks, _SUBLANES, _LANES),
+                                 jnp.float32),
+            jax.ShapeDtypeStruct((nblocks, _SUBLANES, _LANES),
+                                 jnp.float32),
+        ),
+        interpret=interpret,
+    )(x2d, mask2d)
+    # exact f64 combine of the small per-block partials; adding the
+    # compensation terms recovers what f32 rounding withheld per chain
+    return (jnp.sum(sums.astype(jnp.float64))
+            + jnp.sum(comps.astype(jnp.float64)))
+
+
+def masked_kahan_sum(values: jnp.ndarray, mask: jnp.ndarray,
+                     interpret=None) -> jnp.ndarray:
+    """Compensated sum of values[mask] -> float64 scalar.
+
+    `values`: any-shape f32/f64 array; `mask`: same-shape bool. The
+    flattened data pads to a [rows, 128] layout with rows a multiple of
+    8 (TPU native tiling). `interpret=None` auto-selects: compiled on
+    TPU, interpreter elsewhere (CPU has no Mosaic lowering)."""
+    if not _PALLAS:   # degrade gracefully: plain f64 reduction
+        return jnp.sum(jnp.where(mask, values, 0).astype(jnp.float64))
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    flat = values.reshape(-1).astype(jnp.float32)
+    m = mask.reshape(-1)
+    n = flat.shape[0]
+    tile = _BLOCK_ROWS * _LANES
+    padded = ((n + tile - 1) // tile) * tile
+    if padded != n:
+        flat = jnp.pad(flat, (0, padded - n))
+        m = jnp.pad(m, (0, padded - n))
+    x2d = flat.reshape(-1, _LANES)
+    m2d = m.reshape(-1, _LANES)
+    return _kahan_call(x2d, m2d, interpret=interpret)
+
+
+def pallas_reduce_available() -> bool:
+    """True when the TPU lowering path is usable on this backend."""
+    if not _PALLAS:
+        return False
+    return jax.default_backend() == "tpu"

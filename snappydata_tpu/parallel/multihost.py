@@ -71,10 +71,3 @@ def local_device_indices() -> list:
     return [i for i, d in enumerate(all_devices) if id(d) in local]
 
 
-def global_data_mesh():
-    """A 1-D data mesh over EVERY device in the multi-host slice —
-    collectives ride ICI inside a pod, DCN across pods, inserted by XLA
-    from the sharding annotations (no NCCL/MPI calls to port)."""
-    from snappydata_tpu.parallel.mesh import data_mesh
-
-    return data_mesh()
