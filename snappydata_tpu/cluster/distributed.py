@@ -395,6 +395,13 @@ class DistributedSession:
 
     def sql(self, sql_text: str):
         stmt = parse(sql_text)
+        if isinstance(stmt, ast.Query) and stmt.with_error is not None:
+            # HAC estimation composes per-server stratified moments; the
+            # distributed merge of phase A/B is not wired this round —
+            # refuse explicitly rather than silently dropping the clause
+            raise DistributedUnsupported(
+                "WITH ERROR / error estimation runs on a single-node "
+                "session this round; query the sampled session directly")
         if isinstance(stmt, (ast.CreateTable, ast.DropTable,
                              ast.TruncateTable)):
             self.planner.execute_statement(stmt)

@@ -71,10 +71,6 @@ def _kahan_kernel(x_ref, m_ref, sum_ref, comp_ref):
 
 try:  # pallas import is cheap; actual lowering happens at first call
     from jax.experimental import pallas as pl
-    try:
-        from jax.experimental.pallas import tpu as pltpu
-    except ImportError:  # pragma: no cover
-        pltpu = None
     _PALLAS = True
 except ImportError:  # pragma: no cover - pallas always ships with jax
     _PALLAS = False
@@ -104,10 +100,12 @@ def _kahan_call(x2d: jnp.ndarray, mask2d: jnp.ndarray,
         ),
         interpret=interpret,
     )(x2d, mask2d)
-    # exact f64 combine of the small per-block partials; adding the
-    # compensation terms recovers what f32 rounding withheld per chain
+    # exact f64 combine of the small per-block partials. Kahan's
+    # c = (t - s) - y holds the EXCESS already folded into s, so the
+    # true chain total is s - c (review finding: + doubled the residual
+    # instead of cancelling it)
     return (jnp.sum(sums.astype(jnp.float64))
-            + jnp.sum(comps.astype(jnp.float64)))
+            - jnp.sum(comps.astype(jnp.float64)))
 
 
 def masked_kahan_sum(values: jnp.ndarray, mask: jnp.ndarray,

@@ -20,7 +20,9 @@ def test_kernel_accuracy_same_sign_large():
     m = np.ones(v.shape, dtype=bool)
     got = float(masked_kahan_sum(v, m))
     exact = float(v.astype(np.float64).sum())
-    assert abs(got - exact) / exact <= 1e-6
+    # the s - c combine leaves ~eps-level error; 1e-7 would regress to
+    # ~1e-6+ if the compensation sign ever flips back (review finding)
+    assert abs(got - exact) / exact <= 1e-7
     plain = float(v.sum(dtype=np.float32))
     assert abs(got - exact) <= abs(plain - exact) / 100
 

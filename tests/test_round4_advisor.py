@@ -216,3 +216,29 @@ def test_mutation_params_bind_positionally():
            s.sql("SELECT a, b, c FROM pt").rows()}
     assert got[("x", 2)] == 7.5 and got[("y", 3)] == 0.0
     s.stop()
+
+
+@pytest.mark.slow
+def test_with_error_distributed_is_explicit():
+    """WITH ERROR on a cluster refuses explicitly (the distributed
+    phase merge isn't wired this round) instead of silently dropping
+    the clause or failing with a confusing analyzer error."""
+    from snappydata_tpu.cluster import LocatorNode, ServerNode
+    from snappydata_tpu.cluster.distributed import (DistributedSession,
+                                                    DistributedUnsupported)
+
+    locator = LocatorNode().start()
+    servers = [ServerNode(locator.address, SnappySession(catalog=Catalog()))
+               .start() for _ in range(2)]
+    ds = DistributedSession(
+        server_addresses=[s.flight_address for s in servers])
+    try:
+        ds.sql("CREATE TABLE we_t (k BIGINT, v DOUBLE) USING column "
+               "OPTIONS (partition_by 'k')")
+        with pytest.raises(DistributedUnsupported, match="WITH ERROR"):
+            ds.sql("SELECT sum(v) AS s FROM we_t WITH ERROR 0.1")
+    finally:
+        ds.close()
+        for s in servers:
+            s.stop()
+        locator.stop()
