@@ -51,6 +51,22 @@ ERROR_FUNCS = ("absolute_error", "relative_error", "lower_bound",
 _ESTIMABLE = ("sum", "avg", "count")
 
 
+@dataclasses.dataclass
+class _ExecCtx:
+    """How the estimation phases execute. Single-node: one session, one
+    piece per phase. Distributed: each phase fans to every data server
+    and returns one piece per shard — per-server reservoirs are valid
+    strata of the GLOBAL population, so the combine simply namespaces
+    stratum ids by shard index (the HT/variance algebra is unchanged).
+    """
+    catalog: object
+    run_phases: object    # [plans] -> List[List[Result]] per shard —
+                          # ALL phases per shard execute in one call so
+                          # shard indices stay aligned across failover
+    run_exact: object     # plan -> Result (exact, full data)
+    refresh: object       # () -> None
+
+
 class AQPUnsupported(ValueError):
     """Query shape outside the AQP error-estimation scope (the reference
     limits error functions to SUM/AVG/COUNT over a sampled FROM — the
@@ -94,14 +110,12 @@ class _Item:
     group_idx: int = -1          # (kind=group)
 
 
-def execute_error_query(session, stmt: ast.Query, user_params=()):
-    """Entry: run `stmt` with error estimation / HAC enforcement."""
-    clause = stmt.with_error
-    plan = stmt.plan
-
+def _unwrap_aggregate(stmt: ast.Query):
+    """Peel Sort/Limit and validate the supported shape → (aggregate,
+    outer_orders, limit_n)."""
     outer_orders = None
     limit_n = None
-    node = plan
+    node = stmt.plan
     while isinstance(node, (ast.Sort, ast.Limit)):
         if isinstance(node, ast.Sort):
             outer_orders = node.orders
@@ -117,10 +131,54 @@ def execute_error_query(session, stmt: ast.Query, user_params=()):
         raise AQPUnsupported(
             "error estimation applies to plain aggregate queries "
             "(SUM/AVG/COUNT [GROUP BY ...]) over a sampled table")
-    agg = node
+    return node, outer_orders, limit_n
 
+
+def execute_error_query(session, stmt: ast.Query, user_params=()):
+    """Entry: run `stmt` with error estimation / HAC enforcement."""
+    agg, outer_orders, limit_n = _unwrap_aggregate(stmt)
+    user_params = tuple(user_params)
+
+    ctx = _ExecCtx(
+        catalog=session.catalog,
+        run_phases=lambda ps: [[session._run_query(p, user_params)
+                                for p in ps]],
+        run_exact=lambda p: session._run_query(p, user_params),
+        refresh=session._refresh_samples)
+    return _execute_with_ctx(ctx, stmt, agg, outer_orders, limit_n)
+
+
+def execute_error_query_distributed(ds, stmt: ast.Query):
+    """Cluster entry: the phase aggregates fan to every data server —
+    one piece per shard, BOTH phases in a single per-server call so a
+    mid-estimation failover can't pair one shard's moments with another
+    shard's stratum totals (review finding); exact re-runs go through
+    the normal distributed query path."""
+    from snappydata_tpu.cluster.distributed import _arrow_to_result
+
+    agg, outer_orders, limit_n = _unwrap_aggregate(stmt)
+
+    def run_phases(ps):
+        fns = [ds._partial_exec(p) for p in ps]
+
+        def both(srv):
+            return [fn(srv) for fn in fns]
+
+        return [[_arrow_to_result(t, ds.planner) for t in piece]
+                for piece in ds._fan(both)]
+
+    ctx = _ExecCtx(catalog=ds.planner.catalog,
+                   run_phases=run_phases,
+                   run_exact=lambda p: ds._query(p),
+                   refresh=lambda: None)   # servers refresh in-query
+    return _execute_with_ctx(ctx, stmt, agg, outer_orders, limit_n)
+
+
+def _execute_with_ctx(ctx: _ExecCtx, stmt: ast.Query,
+                      agg: ast.Aggregate, outer_orders, limit_n):
+    clause = stmt.with_error
     samples = {}
-    for info in session.catalog.list_tables():
+    for info in ctx.catalog.list_tables():
         if info.provider == "sample" and info.base_table:
             samples.setdefault(info.base_table.lower(), info.name)
 
@@ -130,22 +188,21 @@ def execute_error_query(session, stmt: ast.Query, user_params=()):
     if sampled_name is None:
         # contract: on the base table the error functions answer 0 and
         # the bounds NULL (docs/sde/hac_contracts.md:62-64)
-        exact = _run_exact(session, agg, user_params)
+        exact = _run_exact(ctx, agg)
         return _finalize(_exact_to_rows(exact, items, agg_items),
                          items, exact, outer_orders, limit_n, z=0.0)
 
-    session._refresh_samples()
+    ctx.refresh()
     sample_rel = samples[sampled_name]
 
     conf = clause.confidence if clause is not None else 0.95
     z = NormalDist().inv_cdf(0.5 + conf / 2.0)
 
-    est = _estimate(session, agg, items, agg_items, sampled_name,
-                    sample_rel, z, user_params)
+    est = _estimate(ctx, agg, items, agg_items, sampled_name,
+                    sample_rel, z)
 
     if clause is not None and clause.error < 1.0:
-        est = _apply_behavior(session, est, clause, agg, items, agg_items,
-                              user_params)
+        est = _apply_behavior(ctx, est, clause, agg, items, agg_items)
 
     return _finalize(est.rows, items, est.proto, outer_orders, limit_n,
                      z=est.z)
@@ -259,8 +316,8 @@ class _Estimate:
     proto: Result           # phase-A result (dtype source for groups)
 
 
-def _estimate(session, agg, items, agg_items, base_name, sample_rel, z,
-              user_params) -> _Estimate:
+def _estimate(ctx: _ExecCtx, agg, items, agg_items, base_name,
+              sample_rel, z) -> _Estimate:
     from snappydata_tpu.aqp.sampling import (RESERVOIR_WEIGHT_COLUMN,
                                              STRATUM_ID_COLUMN)
 
@@ -306,7 +363,6 @@ def _estimate(session, agg, items, agg_items, base_name, sample_rel, z,
     phase_a = ast.Aggregate(
         child, tuple(groups) + (ast.Col(STRATUM_ID_COLUMN),),
         tuple(a_exprs))
-    res_a = session._run_query(phase_a, user_params)
 
     # ---- phase B: UNFILTERED per-stratum totals (n_h, w_h) — the
     # stratum size is a property of the sample, not of the query
@@ -317,20 +373,27 @@ def _estimate(session, agg, items, agg_items, base_name, sample_rel, z,
          ast.Alias(ast.Func("count", ()), "__n"),
          ast.Alias(ast.Func("max", (ast.Col(RESERVOIR_WEIGHT_COLUMN),)),
                    "__w")))
-    res_b = session._run_query(phase_b, user_params)
-    n_of: Dict[int, float] = {}
-    w_of: Dict[int, float] = {}
-    for h, n, w in res_b.rows():
-        n_of[int(h)] = float(n)
-        w_of[int(h)] = float(w)
+    shards = ctx.run_phases([phase_a, phase_b])
+    pieces_a = [pa for pa, _pb in shards]
+    pieces_b = [pb for _pa, pb in shards]
+    # stratum identity is (shard index, local stratum id): per-shard
+    # reservoirs assign ids independently, and the same QCS value on two
+    # shards IS two strata of the global population
+    n_of: Dict[tuple, float] = {}
+    w_of: Dict[tuple, float] = {}
+    for pi, res_b in enumerate(pieces_b):
+        for h, n, w in res_b.rows():
+            n_of[(pi, int(h))] = float(n)
+            w_of[(pi, int(h))] = float(w)
 
     # ---- host combine: strata → per-group estimate + variance
     ng = len(groups)
-    a_rows = res_a.rows()
-    col_idx = {nm.lower(): i for i, nm in enumerate(res_a.names)}
+    col_idx = {nm.lower(): i
+               for i, nm in enumerate(pieces_a[0].names)}
     by_group: Dict[tuple, List[tuple]] = {}
-    for row in a_rows:
-        by_group.setdefault(tuple(row[:ng]), []).append(row)
+    for pi, res_a in enumerate(pieces_a):
+        for row in res_a.rows():
+            by_group.setdefault(tuple(row[:ng]), []).append((pi, row))
 
     out_rows: List[dict] = []
     for gkey, rows in by_group.items():
@@ -339,7 +402,8 @@ def _estimate(session, agg, items, agg_items, base_name, sample_rel, z,
         for it in agg_items:
             si = it._slot
             if it.agg_name in ("min", "max"):
-                vals = [r[col_idx[f"__s{si}_{it.agg_name}"]] for r in rows
+                vals = [r[col_idx[f"__s{si}_{it.agg_name}"]]
+                        for _pi, r in rows
                         if r[col_idx[f"__s{si}_{it.agg_name}"]] is not None]
                 v = (min(vals) if it.agg_name == "min" else max(vals)) \
                     if vals else None
@@ -350,8 +414,8 @@ def _estimate(session, agg, items, agg_items, base_name, sample_rel, z,
             var_s = var_c = cov_sc = 0.0
             true_cnt = 0.0
             true_sum = 0.0
-            for r in rows:
-                h = int(r[col_idx["__h"]])
+            for pi, r in rows:
+                h = (pi, int(r[col_idx["__h"]]))
                 n_h, w_h = n_of[h], w_of[h]
                 fpc = n_h * w_h * (w_h - 1.0)
                 if it.agg_name == "count" and it.arg is None:
@@ -404,7 +468,7 @@ def _estimate(session, agg, items, agg_items, base_name, sample_rel, z,
             rec["var"].append(0.0 if it.agg_name == "count" else None)
         out_rows.append(rec)
 
-    est = _Estimate(out_rows, z, res_a)
+    est = _Estimate(out_rows, z, pieces_a[0])
     return est
 
 
@@ -421,8 +485,8 @@ def _rel_error(est_v, var_v, z) -> Optional[float]:
     return abs_err / abs(est_v)
 
 
-def _apply_behavior(session, est: _Estimate, clause, agg, items,
-                    agg_items, user_params) -> _Estimate:
+def _apply_behavior(ctx: _ExecCtx, est: _Estimate, clause, agg, items,
+                    agg_items) -> _Estimate:
     violating: List[int] = []
     for ri, rec in enumerate(est.rows):
         bad = []
@@ -475,7 +539,7 @@ def _apply_behavior(session, est: _Estimate, clause, agg, items,
             cond = ast.BinOp("or", cond, x)
         exact_agg = dataclasses.replace(
             agg, child=ast.Filter(agg.child, cond))
-    exact = _run_exact(session, exact_agg, user_params)
+    exact = _run_exact(ctx, exact_agg)
     exact_rows = _exact_to_rows(exact, items, agg_items)
 
     ng = len(groups)
@@ -493,15 +557,14 @@ def _apply_behavior(session, est: _Estimate, clause, agg, items,
     return est
 
 
-def _run_exact(session, agg: ast.Aggregate, user_params) -> Result:
+def _run_exact(ctx: _ExecCtx, agg: ast.Aggregate) -> Result:
     """The original aggregate with error functions stripped, on base."""
     keep = tuple(e for e in agg.agg_exprs
                  if not (isinstance(
                      e.child if isinstance(e, ast.Alias) else e, ast.Func)
                      and (e.child if isinstance(e, ast.Alias) else e).name
                      in ERROR_FUNCS))
-    return session._run_query(dataclasses.replace(agg, agg_exprs=keep),
-                              user_params)
+    return ctx.run_exact(dataclasses.replace(agg, agg_exprs=keep))
 
 
 def _exact_to_rows(exact: Result, items, agg_items) -> List[dict]:

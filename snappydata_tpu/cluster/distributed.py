@@ -395,13 +395,16 @@ class DistributedSession:
 
     def sql(self, sql_text: str):
         stmt = parse(sql_text)
-        if isinstance(stmt, ast.Query) and stmt.with_error is not None:
-            # HAC estimation composes per-server stratified moments; the
-            # distributed merge of phase A/B is not wired this round —
-            # refuse explicitly rather than silently dropping the clause
-            raise DistributedUnsupported(
-                "WITH ERROR / error estimation runs on a single-node "
-                "session this round; query the sampled session directly")
+        if isinstance(stmt, ast.Query):
+            from snappydata_tpu.aqp.error_estimation import (
+                execute_error_query_distributed, query_has_error_surface)
+
+            if query_has_error_surface(stmt):
+                # HAC estimation over the cluster: the phase aggregates
+                # fan per server (each reservoir samples its shard — a
+                # valid stratum of the global population) and the lead
+                # merges the moments
+                return execute_error_query_distributed(self, stmt)
         if isinstance(stmt, (ast.CreateTable, ast.DropTable,
                              ast.TruncateTable)):
             self.planner.execute_statement(stmt)

@@ -219,24 +219,57 @@ def test_mutation_params_bind_positionally():
 
 
 @pytest.mark.slow
-def test_with_error_distributed_is_explicit():
-    """WITH ERROR on a cluster refuses explicitly (the distributed
-    phase merge isn't wired this round) instead of silently dropping
-    the clause or failing with a confusing analyzer error."""
+def test_with_error_distributed_estimation():
+    """WITH ERROR over a cluster: phase aggregates fan per server (each
+    reservoir samples its shard — a stratum of the global population)
+    and the lead merges the moments. Bounds must cover the exact answer
+    and behaviors must work distributed."""
     from snappydata_tpu.cluster import LocatorNode, ServerNode
-    from snappydata_tpu.cluster.distributed import (DistributedSession,
-                                                    DistributedUnsupported)
+    from snappydata_tpu.cluster.distributed import DistributedSession
 
     locator = LocatorNode().start()
     servers = [ServerNode(locator.address, SnappySession(catalog=Catalog()))
-               .start() for _ in range(2)]
+               .start() for _ in range(3)]
     ds = DistributedSession(
         server_addresses=[s.flight_address for s in servers])
     try:
-        ds.sql("CREATE TABLE we_t (k BIGINT, v DOUBLE) USING column "
-               "OPTIONS (partition_by 'k')")
-        with pytest.raises(DistributedUnsupported, match="WITH ERROR"):
-            ds.sql("SELECT sum(v) AS s FROM we_t WITH ERROR 0.1")
+        ds.sql("CREATE TABLE we_t (k BIGINT, g STRING, v DOUBLE) "
+               "USING column OPTIONS (partition_by 'k')")
+        rng = np.random.default_rng(31)
+        n = 60_000
+        k = rng.integers(0, 50_000, n).astype(np.int64)
+        g = np.array(["a", "b", "c"], dtype=object)[rng.integers(0, 3, n)]
+        v = rng.normal(50, 8, n)
+        ds.insert_arrays("we_t", [k, g, v])
+        ds.sql("CREATE SAMPLE TABLE we_s ON we_t OPTIONS "
+               "(baseTable 'we_t', qcs 'g', reservoir_size '250')")
+
+        r = ds.sql("SELECT g, avg(v) AS av, absolute_error(av) AS ae, "
+                   "lower_bound(av) AS lb, upper_bound(av) AS ub "
+                   "FROM we_t GROUP BY g ORDER BY g "
+                   "WITH ERROR 0.5 CONFIDENCE 0.95")
+        exact = {row[0]: row[1] for row in
+                 ds.sql("SELECT g, avg(v) FROM we_t GROUP BY g").rows()}
+        assert len(r.rows()) == 3
+        inside = 0
+        for gi, av, ae, lb, ub in r.rows():
+            assert ae > 0 and lb < av < ub
+            if lb <= exact[gi] <= ub:
+                inside += 1
+        assert inside >= 2   # 95% intervals: 3 misses is implausible
+
+        # count(*) with no filter: stratified HT knows every N_h exactly
+        c, cae = ds.sql("SELECT count(*) AS c, absolute_error(c) "
+                        "FROM we_t WITH ERROR 0.5").rows()[0]
+        assert c == n and cae == pytest.approx(0.0)
+
+        # behavior runs the exact query DISTRIBUTED on violation
+        r2 = ds.sql("SELECT g, avg(v) AS av, absolute_error(av) AS ae "
+                    "FROM we_t GROUP BY g "
+                    "WITH ERROR 0.00001 BEHAVIOR 'run_on_full_table'")
+        for gi, av, ae in r2.rows():
+            assert av == pytest.approx(exact[gi])
+            assert ae == 0.0
     finally:
         ds.close()
         for s in servers:
