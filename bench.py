@@ -138,26 +138,30 @@ def main() -> None:
                   file=sys.stderr, flush=True)
             device[name] = None
 
-    # Pallas Kahan-reduction side-by-side (TPU only; default-off path —
-    # measured here so next round can flip it on with evidence)
-    q6_pallas_s = None
+    # Pallas side-by-sides (TPU only; default-off paths — measured here
+    # so next round can flip them on with evidence): the global Kahan
+    # reduction on Q6 and the fused grouped-aggregate kernel on Q1
+    pallas = {"q6_pallas_s": None, "q1_pallas_s": None}
     if platform == "tpu":
-        try:
-            config.global_properties().pallas_reduce = True
-            s.executor.clear_cache()
-            s.sql(tpch.Q6)
-            best = float("inf")
-            for _ in range(repeats):
-                t0 = time.time()
-                s.sql(tpch.Q6)
-                best = min(best, time.time() - t0)
-            q6_pallas_s = round(best, 4)
-        except Exception as e:
-            print(f"bench: pallas Q6 timing failed: {e}",
-                  file=sys.stderr, flush=True)
-        finally:
-            config.global_properties().pallas_reduce = False
-            s.executor.clear_cache()
+        for field, flag, q in (
+                ("q6_pallas_s", "pallas_reduce", tpch.Q6),
+                ("q1_pallas_s", "pallas_group_reduce", tpch.Q1)):
+            try:
+                setattr(config.global_properties(), flag, True)
+                s.executor.clear_cache()
+                s.sql(q)
+                best = float("inf")
+                for _ in range(repeats):
+                    t0 = time.time()
+                    s.sql(q)
+                    best = min(best, time.time() - t0)
+                pallas[field] = round(best, 4)
+            except Exception as e:
+                print(f"bench: pallas {field} timing failed: {e}",
+                      file=sys.stderr, flush=True)
+            finally:
+                setattr(config.global_properties(), flag, False)
+                s.executor.clear_cache()
 
     ingest_rows_per_s = sink_events_per_s = None
     try:   # secondary benches must not kill the headline numbers
@@ -195,11 +199,26 @@ def main() -> None:
             "q6_device_rows_per_s": None if device.get("q6") is None
             else round(n_rows / device["q6"], 1),
             "q1_max_rel_err": q1_max_rel_err,
-            "q6_pallas_s": q6_pallas_s,
+            "q6_pallas_s": pallas["q6_pallas_s"],
+            "q1_pallas_s": pallas["q1_pallas_s"],
             "ingest_rows_per_s": ingest_rows_per_s,
             "sink_events_per_s": sink_events_per_s,
+            # in-trace decode counters: bytes actually shipped over the
+            # host->device link for RLE/bitset binds vs the decoded
+            # plate bytes they replaced (round-4 device_decode feature,
+            # now evidenced in the bench record)
+            "device_decode": _decode_counters(),
         },
     }))
+
+
+def _decode_counters():
+    try:
+        from snappydata_tpu.storage import device_decode
+
+        return device_decode.counters()
+    except Exception:  # pragma: no cover - instrumentation only
+        return None
 
 
 def _device_only_best(s, q: str, repeats: int) -> float:

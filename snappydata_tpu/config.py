@@ -72,6 +72,11 @@ class Properties:
     # Default OFF until measured on hardware; bench.py reports the
     # side-by-side timing when a TPU is reachable.
     pallas_reduce: bool = False
+    # Fused Pallas grouped-aggregate kernel for the dictionary fast path
+    # (the TPC-H Q1 shape): one VMEM pass per slot batch with per-group
+    # per-lane Kahan partials, f64 combine outside (ops/pallas_group.py).
+    # Same default-OFF-until-measured policy as pallas_reduce.
+    pallas_group_reduce: bool = False
     max_groups: int = 1 << 16                 # static upper bound for generic group-by output
     batches_pow2_bucketing: bool = True       # pad #batches to pow2 → fewer recompiles
 

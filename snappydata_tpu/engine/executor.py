@@ -41,6 +41,7 @@ from snappydata_tpu.engine.exprs import (STRING_VALUE_FUNCS, CompileError,
                                          DVal, ExprBuilder, Runtime,
                                          _or_null)
 from snappydata_tpu.engine.result import Result, empty_result
+from snappydata_tpu.ops import pallas_group as _pg
 from snappydata_tpu.sql import ast
 from snappydata_tpu.sql.analyzer import expr_type, _expr_name
 
@@ -1184,9 +1185,39 @@ class Compiler:
                                     num_segments=num_groups + 1)
 
             # --- slots ---
+            # Fused Pallas grouped path (the Q1 shape): dictionary/bool
+            # fast-path group index, G <= 64, f32 value plates. All
+            # eligible slots share ONE streaming VMEM pass with
+            # per-group per-lane Kahan partials (ops/pallas_group.py)
+            # instead of per-slot emulated-f64 segment reductions.
+            # Ineligible slots (int sums, sumsq, count_distinct, f64
+            # plates) keep the _seg_reduce path slot by slot.
+            use_pg = bool(groups) and fast \
+                and num_groups + 1 <= _pg.MAX_GROUPS \
+                and config.global_properties().pallas_group_reduce
+            # VMEM budget: stop fusing before a wide aggregate would
+            # fail the Mosaic compile; unfused slots keep _seg_reduce.
+            # The base reserves the gidx block plus the shared gvalid
+            # count op appended below.
+            pg_bytes = _pg.base_vmem_bytes() \
+                + _pg.op_vmem_bytes("count", num_groups + 1)
+            fused = []  # (slot_idx, kind, values|None, mask)
+
+            def try_fuse(kind, v, w):
+                nonlocal pg_bytes
+                cost = _pg.op_vmem_bytes(kind, num_groups + 1)
+                if pg_bytes + cost > _pg.VMEM_BUDGET:
+                    return False
+                pg_bytes += cost
+                fused.append((len(slot_arrays), kind, v, w))
+                slot_arrays.append(None)
+                return True
+
             slot_arrays = []
             for (kind, arg), run in zip(slots, slot_arg_runs):
                 if run is None:  # count(*)
+                    if use_pg and try_fuse("count", None, valid):
+                        continue
                     slot_arrays.append(seg("count", valid))
                     continue
                 dv = run(rt)
@@ -1194,6 +1225,13 @@ class Compiler:
                 w = valid
                 if dv.null is not None:
                     w = w & ~_broadcast_to_mask(dv.null, out.valid).reshape(-1)
+                if use_pg and (
+                        kind == "count"
+                        or (kind in ("sum", "min", "max")
+                            and v.dtype == jnp.float32)) \
+                        and try_fuse(kind,
+                                     None if kind == "count" else v, w):
+                    continue
                 if kind == "count":
                     slot_arrays.append(seg("count", w))
                 elif kind == "count_distinct":
@@ -1244,7 +1282,17 @@ class Compiler:
                 else:
                     raise CompileError(kind)
 
-            counts = seg("count", valid)
+            if fused:
+                # the gvalid count rides the same streaming pass (its
+                # VMEM share is reserved in pg_bytes' base above)
+                ops = [(k, v, w) for _, k, v, w in fused]
+                ops.append(("count", None, valid))
+                pg_out = _pg.grouped_reduce(ops, gidx, num_groups + 1)
+                for (i, _, _, _), r in zip(fused, pg_out[:-1]):
+                    slot_arrays[i] = r
+                counts = pg_out[-1]
+            else:
+                counts = seg("count", valid)
             if groups:
                 gvalid = counts[:num_groups] > 0
             else:

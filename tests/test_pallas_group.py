@@ -1,0 +1,201 @@
+"""Fused Pallas grouped-aggregate kernel (ops/pallas_group.py):
+kernel-level accuracy vs exact f64 oracles, and the engine's Q1-shape
+integration behind properties.pallas_group_reduce. On CPU the kernel
+runs in interpreter mode — correctness only; the TPU timing story is
+recorded by bench.py (`q1_pallas_s`) when hardware is reachable."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession, config
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.ops.pallas_group import grouped_reduce
+
+
+def test_kernel_all_kinds_vs_oracle():
+    rng = np.random.default_rng(0)
+    n = 300_000
+    G = 7
+    gidx = rng.integers(0, G, n)
+    v1 = (rng.random(n) * 2e4).astype(np.float32)  # same-sign: f32-hostile
+    v2 = (rng.random(n) * 100 - 50).astype(np.float32)
+    m1 = rng.random(n) < 0.9
+    m2 = rng.random(n) < 0.7
+    outs = grouped_reduce(
+        [("sum", jnp.asarray(v1), jnp.asarray(m1)),
+         ("count", None, jnp.asarray(m1)),
+         ("min", jnp.asarray(v2), jnp.asarray(m2)),
+         ("max", jnp.asarray(v2), jnp.asarray(m2)),
+         ("sum", jnp.asarray(v2), jnp.asarray(m2))],
+        jnp.asarray(gidx), G)
+    for g in range(G):
+        s1 = (gidx == g) & m1
+        s2 = (gidx == g) & m2
+        exact = v1.astype(np.float64)[s1].sum()
+        assert float(outs[0][g]) == pytest.approx(exact, rel=1e-7)
+        assert int(outs[1][g]) == int(s1.sum())
+        assert float(outs[2][g]) == v2[s2].min()
+        assert float(outs[3][g]) == v2[s2].max()
+        # mixed-sign sum: compensated error is bounded vs sum(|v|)
+        exact2 = v2.astype(np.float64)[s2].sum()
+        assert abs(float(outs[4][g]) - exact2) \
+            <= 1e-6 * np.abs(v2[s2].astype(np.float64)).sum()
+
+
+def test_kernel_padding_and_empty_groups():
+    rng = np.random.default_rng(1)
+    for n in (1, 7, 1024, 131073):
+        G = 5
+        # group 4 stays empty: min/max must yield the +/-inf fillers
+        # _seg_reduce produces so downstream gvalid handling matches
+        gidx = rng.integers(0, 4, n)
+        v = (rng.random(n) * 10).astype(np.float32)
+        m = np.ones(n, dtype=bool)
+        outs = grouped_reduce(
+            [("sum", jnp.asarray(v), jnp.asarray(m)),
+             ("count", None, jnp.asarray(m)),
+             ("min", jnp.asarray(v), jnp.asarray(m)),
+             ("max", jnp.asarray(v), jnp.asarray(m))],
+            jnp.asarray(gidx), G)
+        assert float(outs[0][4]) == 0.0
+        assert int(outs[1][4]) == 0
+        assert float(outs[2][4]) == np.inf
+        assert float(outs[3][4]) == -np.inf
+        for g in range(4):
+            sel = gidx == g
+            if not sel.any():
+                continue
+            assert float(outs[0][g]) == pytest.approx(
+                v.astype(np.float64)[sel].sum(), rel=1e-6, abs=1e-6)
+            assert int(outs[1][g]) == int(sel.sum())
+
+
+def _q1_sessions():
+    """Two identical sessions over a Q1-shaped table; one runs the
+    fused pallas grouped path, one the _seg_reduce baseline."""
+    rng = np.random.default_rng(2)
+    n = 120_000
+    flag = rng.choice(np.array(["A", "N", "R"], dtype=object), n)
+    status = rng.choice(np.array(["F", "O"], dtype=object), n)
+    qty = np.round(rng.random(n) * 50, 0)
+    price = np.round(rng.random(n) * 2e4, 2)
+    disc = np.round(rng.random(n) * 0.1, 2)
+
+    def mk():
+        s = SnappySession(catalog=Catalog())
+        s.sql("CREATE TABLE li (flag STRING, status STRING, qty DOUBLE,"
+              " price DOUBLE, disc DOUBLE) USING column")
+        s.insert_arrays("li", [flag, status, qty, price, disc])
+        return s
+
+    return mk, (flag, status, qty, price, disc)
+
+
+Q1 = ("SELECT flag, status, sum(qty), sum(price),"
+      " sum(price * (1 - disc)), avg(qty), avg(disc), count(*),"
+      " min(price), max(price)"
+      " FROM li WHERE qty < 45 GROUP BY flag, status"
+      " ORDER BY flag, status")
+
+
+def test_engine_q1_shape_via_pallas():
+    # f32 plates (the TPU storage policy) are required for eligibility —
+    # force them on CPU so the fused path actually engages
+    old = config.global_properties().pallas_group_reduce
+    old_f64 = config.global_properties().decimal_as_float64
+    config.global_properties().decimal_as_float64 = False
+    try:
+        mk, (flag, status, qty, price, disc) = _q1_sessions()
+        s = mk()
+        baseline = s.sql(Q1).rows()
+        config.global_properties().pallas_group_reduce = True
+        s2 = mk()
+        got = s2.sql(Q1).rows()
+        assert len(got) == len(baseline) == 6
+        for rg, rb in zip(got, baseline):
+            assert rg[0] == rb[0] and rg[1] == rb[1]
+            for a, b in zip(rg[2:], rb[2:]):
+                assert a == pytest.approx(b, rel=2e-6)
+        # independent exact oracle for one group
+        sel = (flag == "A") & (status == "F") & (qty < 45)
+        row = [r for r in got if r[0] == "A" and r[1] == "F"][0]
+        assert row[2] == pytest.approx(qty[sel].sum(), rel=1e-7)
+        assert row[7] == int(sel.sum())
+        assert row[8] == pytest.approx(price[sel].min(), rel=1e-6)
+        s.stop()
+        s2.stop()
+    finally:
+        config.global_properties().pallas_group_reduce = old
+        config.global_properties().decimal_as_float64 = old_f64
+
+
+def test_engine_wide_aggregate_respects_vmem_budget():
+    """A wide slot batch must stop fusing at the VMEM budget and route
+    the overflow slots through _seg_reduce — never fail the compile."""
+    old = config.global_properties().pallas_group_reduce
+    old_f64 = config.global_properties().decimal_as_float64
+    config.global_properties().decimal_as_float64 = False
+    try:
+        rng = np.random.default_rng(5)
+        n = 5_000
+        k = rng.choice(np.array(["x", "y", "z"], dtype=object), n)
+        cols = [np.round(rng.random(n) * 100, 2) for _ in range(12)]
+
+        def mk():
+            s = SnappySession(catalog=Catalog())
+            decls = ", ".join(f"c{i} DOUBLE" for i in range(12))
+            s.sql(f"CREATE TABLE w (k STRING, {decls}) USING column")
+            s.insert_arrays("w", [k] + cols)
+            return s
+
+        sums = ", ".join(f"sum(c{i})" for i in range(12))
+        mins = ", ".join(f"min(c{i})" for i in range(6))
+        q = f"SELECT k, {sums}, {mins}, count(*) FROM w GROUP BY k ORDER BY k"
+        s = mk()
+        baseline = s.sql(q).rows()
+        config.global_properties().pallas_group_reduce = True
+        s2 = mk()
+        got = s2.sql(q).rows()
+        for rg, rb in zip(got, baseline):
+            assert rg[0] == rb[0]
+            for a, b in zip(rg[1:], rb[1:]):
+                assert a == pytest.approx(b, rel=2e-6)
+        s.stop()
+        s2.stop()
+    finally:
+        config.global_properties().pallas_group_reduce = old
+        config.global_properties().decimal_as_float64 = old_f64
+
+
+def test_engine_nullable_key_and_empty_group():
+    """Nullable group key (extra code slot) and int sums (ineligible —
+    mixed fused/non-fused slot batch) stay correct under the flag."""
+    old = config.global_properties().pallas_group_reduce
+    old_f64 = config.global_properties().decimal_as_float64
+    config.global_properties().decimal_as_float64 = False
+    try:
+        def mk():
+            s = SnappySession(catalog=Catalog())
+            s.sql("CREATE TABLE t (k STRING, v DOUBLE, i INT) USING column")
+            s.sql("INSERT INTO t VALUES ('a', 1.5, 10), ('a', 2.5, 20),"
+                  " (NULL, 4.0, 40), ('b', 8.0, 80), (NULL, 0.5, 5)")
+            return s
+
+        q = ("SELECT k, sum(v), sum(i), count(v), min(v), max(v) FROM t"
+             " GROUP BY k ORDER BY k")
+        s = mk()
+        baseline = s.sql(q).rows()
+        config.global_properties().pallas_group_reduce = True
+        s2 = mk()
+        got = s2.sql(q).rows()
+        assert got == baseline
+        assert [r[0] for r in got] == [None, "a", "b"]
+        byk = {r[0]: r for r in got}
+        assert byk["a"][1:] == (4.0, 30, 2, 1.5, 2.5)
+        assert byk[None][1:] == (4.5, 45, 2, 0.5, 4.0)
+        s.stop()
+        s2.stop()
+    finally:
+        config.global_properties().pallas_group_reduce = old
+        config.global_properties().decimal_as_float64 = old_f64
