@@ -21,9 +21,12 @@ Configuration (flags or environment):
   SNAPPY_NUM_PROCESSES=N         world size
   SNAPPY_PROCESS_ID=i            this process's rank
 
-No real multi-host fabric exists in CI; tests cover the argument
-plumbing and the local_devices selection (jax.distributed.initialize is
-a no-op pass-through that unit tests monkeypatch).
+Tested two ways: unit tests cover the argument plumbing / env
+precedence (monkeypatched initialize), and tests/test_multihost_real.py
+EXECUTES `jax.distributed.initialize` across two real OS processes on
+the CPU backend — cross-process GSPMD collective value-asserted, plus
+the full `python -m snappydata_tpu server --coordinator ...` composed
+topology with per-process submeshes.
 """
 
 from __future__ import annotations

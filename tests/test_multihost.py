@@ -1,7 +1,8 @@
 """Multi-host wiring (SURVEY §7 step 4): jax.distributed argument
-plumbing and local-device submesh selection. No real multi-host fabric
-exists in CI — initialize is monkeypatched; the single-host no-op path
-and the env/flag precedence are what these tests pin down."""
+plumbing and local-device submesh selection. These unit tests pin the
+single-host no-op path and env/flag precedence with a monkeypatched
+initialize; the REAL two-process `jax.distributed.initialize` bring-up
+(executed, not mocked) lives in tests/test_multihost_real.py."""
 
 import importlib
 
