@@ -783,6 +783,11 @@ class DistributedSession:
             yield from DistributedSession._walk_exprs(k)
 
     def _query_scatter(self, plan: ast.Plan):
+        from snappydata_tpu.engine.result import finalize_decimals
+
+        return finalize_decimals(self._query_scatter_raw(plan))
+
+    def _query_scatter_raw(self, plan: ast.Plan):
         plan = self._plan_exchanges(plan)
         self._check_scatterable(plan)
         # a query touching ONLY replicated tables has the full data on
@@ -1855,6 +1860,8 @@ def _sql_type(field) -> str:
     t = field.type
     if pa.types.is_string(t) or pa.types.is_large_string(t):
         return "STRING"
+    if pa.types.is_decimal(t):
+        return f"DECIMAL({t.precision},{t.scale})"
     if pa.types.is_integer(t):
         return "BIGINT"
     if pa.types.is_floating(t):
@@ -1875,6 +1882,9 @@ def _arrow_to_result(table, planner):
     for f in table.schema:
         if pa.types.is_string(f.type) or pa.types.is_large_string(f.type):
             dtypes.append(T.STRING)
+        elif pa.types.is_decimal(f.type):
+            dtypes.append(T.DecimalType("decimal", f.type.precision,
+                                        f.type.scale))
         elif pa.types.is_integer(f.type):
             dtypes.append(T.LONG)
         elif pa.types.is_boolean(f.type):

@@ -39,7 +39,30 @@ def result_to_arrow(result, sel: Optional[np.ndarray] = None) -> pa.Table:
         if sel is not None:
             col = np.asarray(col)[sel]
             nmask = np.asarray(nmask)[sel] if nmask is not None else None
-        if dtype.name == "string" or col.dtype == object:
+        if dtype.name == "decimal":
+            # real decimal128(p, s) on the wire — the BI/JDBC contract
+            # (ref readDecimal, ColumnEncoding.scala:137-140); values may
+            # be Decimal objects (finalized), scaled int64 (engine
+            # domain) or plain floats (host fallback)
+            import decimal as _d
+
+            pt = pa.decimal128(max(1, dtype.precision), dtype.scale)
+            q = _d.Decimal(1).scaleb(-dtype.scale)
+
+            def cell(i, v):
+                if (nmask is not None and nmask[i]) or v is None:
+                    return None
+                if isinstance(v, _d.Decimal):
+                    return v
+                if isinstance(v, (int, np.integer)) \
+                        and getattr(dtype, "is_exact", False):
+                    return _d.Decimal(int(v)).scaleb(-dtype.scale)
+                return _d.Decimal(repr(float(v))).quantize(
+                    q, rounding=_d.ROUND_HALF_UP)
+
+            arrays.append(pa.array(
+                [cell(i, v) for i, v in enumerate(col)], type=pt))
+        elif dtype.name == "string" or col.dtype == object:
             arrays.append(pa.array(
                 [None if (nmask is not None and nmask[i]) or v is None
                  else str(v) for i, v in enumerate(col)], type=pa.string()))
@@ -108,7 +131,17 @@ def arrow_to_arrays(table: pa.Table):
     nulls = []
     for col in table.columns:
         combined = col.combine_chunks()
-        if pa.types.is_string(combined.type) or \
+        if pa.types.is_decimal(combined.type):
+            # storage host domain for decimals is plain float64 (the
+            # scaled-int64 form is device-bind-time only); f64 holds
+            # partial aggregates exactly through 15 significant digits
+            vals = combined.to_pylist()
+            arrays.append(np.array(
+                [0.0 if v is None else float(v) for v in vals],
+                dtype=np.float64))
+            nulls.append(np.array([v is None for v in vals])
+                         if combined.null_count else None)
+        elif pa.types.is_string(combined.type) or \
                 pa.types.is_large_string(combined.type):
             arrays.append(np.array(combined.to_pylist(), dtype=object))
             nulls.append(np.array([v is None for v in combined.to_pylist()])

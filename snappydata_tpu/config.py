@@ -61,6 +61,11 @@ class Properties:
 
     # Execution
     decimal_as_float64: Optional[bool] = None  # None → auto (x64 iff CPU backend)
+    # Exact DECIMAL(p<=18): scaled-int64 device plates + int aggregation
+    # (types.DecimalType docstring; ref ColumnEncoding.scala:137-140
+    # readDecimal — real fixed-point semantics). OFF reverts decimals to
+    # the float path everywhere.
+    decimal_exact: bool = True
     # Cold binds of RLE / boolean-bitset batches ship the ENCODED form
     # over the host→device link and decode in-trace (jnp.repeat-style
     # searchsorted expansion / bit unpack) instead of uploading decoded

@@ -152,6 +152,12 @@ def _row_table_device(info, used):
             vals = np.fromiter(
                 (lookup.get(v if v is not None else "", 0)
                  for v in arrays[ci]), dtype=np.int32, count=n)
+        elif f.dtype.name == "decimal" \
+                and f.dtype.device_dtype().kind == "i":
+            # exact decimal: host rows -> scaled int64 device plate
+            vals = T.decimal_to_unscaled(f.dtype,
+                                         np.asarray(arrays[ci],
+                                                    dtype=np.float64))
         else:
             vals = np.asarray(arrays[ci]).astype(f.dtype.device_dtype())
         if row_masks[ci] is not None:
@@ -255,7 +261,9 @@ class CompiledPlan:
         outs = jax.device_get(outs)
         if bool(np.asarray(outs[2])):
             raise CompileError(
-                "group-by cardinality exceeded max_groups on device")
+                "device aggregate overflow (group-by cardinality beyond "
+                "max_groups, or an exact-decimal sum at int64 risk): "
+                "host path")
         return self._assemble(outs, tables)
 
     def _assemble(self, outs, tables) -> Result:
@@ -1043,10 +1051,22 @@ class Compiler:
                     kind = {"first": "min", "last": "max"}.get(e.name, e.name)
                     return _SlotRef(slot_of(kind, arg), expr_type(arg))
                 if e.name == "avg":
-                    s = _SlotRef(slot_of("sum", arg), T.DOUBLE)
+                    # the sum slot may be shared with an explicit
+                    # sum(x): for exact decimals it holds scaled int64,
+                    # so the slot ref must carry the decimal type — the
+                    # division then unscales (avg = exact sum / count)
+                    at = expr_type(arg) if arg is not None else T.DOUBLE
+                    st = T.decimal_sum_type(at) if at.name == "decimal" \
+                        else T.DOUBLE
+                    s = _SlotRef(slot_of("sum", arg), st)
                     c = _SlotRef(slot_of("count", arg), T.LONG)
                     return ast.BinOp("/", s, c)
                 if e.name in ("stddev", "variance"):
+                    if arg is not None \
+                            and expr_type(arg).name == "decimal":
+                        # sumsq would square the SCALED representation:
+                        # run these moments in the plain float domain
+                        arg = ast.Cast(arg, T.DOUBLE)
                     s = _SlotRef(slot_of("sum", arg), T.DOUBLE)
                     s2 = _SlotRef(slot_of("sumsq", arg), T.DOUBLE)
                     c = _SlotRef(slot_of("count", arg), T.LONG)
@@ -1067,6 +1087,22 @@ class Compiler:
                             for e in plan.agg_exprs]
         slot_arg_runs = [builder.emit(arg) if arg is not None else None
                          for _, arg in slots]
+
+        def _slot_dtype(kind: str, arg) -> T.DataType:
+            """Static type of a slot's [G] array — the post-agg scope
+            needs it so exact-decimal slot values (scaled int64) are
+            recognized by the decimal-aware expression lowering."""
+            if kind in ("count", "count_distinct"):
+                return T.LONG
+            if kind == "sumsq":
+                return T.DOUBLE
+            at = expr_type(arg) if arg is not None else T.DOUBLE
+            if kind == "sum":
+                return T.decimal_sum_type(at) if at.name == "decimal" \
+                    else at
+            return at  # min / max
+
+        slot_dtypes = [_slot_dtype(k, a) for k, a in slots]
 
         # key cardinalities (static): string keys use padded dict size
         key_infos = []
@@ -1267,7 +1303,22 @@ class Compiler:
                         slot_arrays.append(jnp.stack(
                             [total, jnp.zeros((), total.dtype)]))
                     else:
-                        acc = v.astype(_acc_dtype(dv.dtype))
+                        acc_dt = _acc_dtype(dv.dtype,
+                                            jnp.asarray(v).dtype)
+                        acc = v.astype(acc_dt)
+                        if acc_dt == jnp.int64 and dv.dtype is not None \
+                                and dv.dtype.name == "decimal":
+                            # exact scaled-int decimal sum: a group
+                            # total CAN exceed int64 (p=18, ~1e18 rows'
+                            # headroom notwithstanding) — bound-check
+                            # max|v| * count and reroute to the host
+                            # path instead of wrapping silently
+                            absmax = seg("max",
+                                         jnp.where(w, jnp.abs(acc), 0))
+                            cnt_w = seg("count", w)
+                            overflow = overflow | jnp.any(
+                                absmax.astype(jnp.float64)
+                                * cnt_w.astype(jnp.float64) >= 2.0 ** 62)
                         slot_arrays.append(
                             seg("sum", jnp.where(w, acc, 0)))
                 elif kind == "sumsq":
@@ -1351,7 +1402,7 @@ class Compiler:
             slot_cols: Dict[int, DVal] = {}
             for si, arr in enumerate(slot_arrays):
                 slot_cols[len(groups) + si] = DVal(
-                    arr[:num_groups], None, None)
+                    arr[:num_groups], None, slot_dtypes[si])
             post_rt = Runtime({**post_cols, **slot_cols}, ctx.params,
                               ctx.aux_range(post_aux_off,
                                             len(post_builder.aux_builders)))
@@ -1542,18 +1593,26 @@ def _seg_reduce(kind: str, values, gidx, num_segments: int):
     raise CompileError(kind)
 
 
-def _acc_dtype(dt: Optional[T.DataType]):
-    """Aggregate ACCUMULATOR dtype. Always float64 for DOUBLE/DECIMAL
-    outputs — on TPU the element plates stay float32 (storage and
-    elementwise compute ride the fast path) but the segment reductions
-    widen to f64: summing ~1e8 values of magnitude 1e4 into 1e10 group
-    totals in f32 leaves ~3 trustworthy digits (round-3 verdict), while
-    f32-rounded inputs accumulated in f64 keep relative error ≤1e-6 (the
-    exact-decimal contract the reference meets via real BigDecimal,
-    encoders/.../encoding/ColumnEncoding.scala:137-140 readDecimal). XLA
-    emulates f64 adds on TPU; reductions are bandwidth-bound, so the
-    extra ALU cost does not move the bottleneck."""
-    if dt is not None and dt.name in ("float", "double", "decimal"):
+def _acc_dtype(dt: Optional[T.DataType], value_dtype=None):
+    """Aggregate ACCUMULATOR dtype. float64 for DOUBLE/FLOAT outputs —
+    on TPU the element plates stay float32 (storage and elementwise
+    compute ride the fast path) but the segment reductions widen to
+    f64: summing ~1e8 values of magnitude 1e4 into 1e10 group totals in
+    f32 leaves ~3 trustworthy digits (round-3 verdict), while
+    f32-rounded inputs accumulated in f64 keep relative error ≤1e-6.
+    DECIMAL with scaled-int64 plates (the exact path, p≤18) accumulates
+    in int64 — EXACT, matching the reference's BigDecimal contract
+    (encoders/.../encoding/ColumnEncoding.scala:137-140 readDecimal)
+    with native int ops instead of emulated f64; float-domain decimals
+    (p>18) keep the f64 accumulator. XLA emulates f64 adds on TPU;
+    reductions are bandwidth-bound, so the extra ALU cost does not move
+    the bottleneck."""
+    if dt is not None and dt.name == "decimal":
+        if value_dtype is not None \
+                and jnp.issubdtype(value_dtype, jnp.integer):
+            return jnp.int64
+        return jnp.float64
+    if dt is not None and dt.name in ("float", "double"):
         return jnp.float64
     return jnp.int64
 

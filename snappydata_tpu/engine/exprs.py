@@ -81,6 +81,168 @@ def _or_null(a, b):
     return a | b
 
 
+# ---------------------------------------------------------------------------
+# Exact decimals: a DVal whose dtype is an exact DecimalType carries the
+# SCALED int64 unscaled value (types.DecimalType docstring). The binop /
+# cast emitters below keep +,-,*,%, comparisons and casts in the exact
+# integer domain when the result precision fits int64, and unscale to
+# float64 otherwise. Every other consumer (math funcs, division, IN
+# tables, mixed CASE branches) receives the PLAIN float domain via
+# _dec_unscale — scaled ints must never leak into value-blind float math.
+# ---------------------------------------------------------------------------
+
+def _dec_scale(d: DVal) -> Optional[int]:
+    """Scale when d is an exact scaled-int decimal DVal, else None."""
+    dt = d.dtype
+    if dt is not None and dt.name == "decimal" \
+            and getattr(dt, "is_exact", False) \
+            and jnp.issubdtype(jnp.asarray(d.value).dtype, jnp.integer):
+        return dt.scale
+    return None
+
+
+def _dec_unscale(d: DVal) -> DVal:
+    """Exact decimal -> plain float64 DVal; anything else unchanged."""
+    s = _dec_scale(d)
+    if s is None:
+        return d
+    v = d.value.astype(jnp.float64) / (10 ** s)
+    return DVal(v, d.null, T.DOUBLE, d.dictionary)
+
+
+def _dec_wrap_unscaled(run: Callable[["Runtime"], DVal]
+                       ) -> Callable[["Runtime"], DVal]:
+    """Wrap an emitted closure so consumers see the float domain.
+    Preserves the static_param/static_str markers structural consumers
+    inspect."""
+
+    def wrapped(rt: "Runtime") -> DVal:
+        return _dec_unscale(run(rt))
+
+    for attr in ("static_param", "static_str"):
+        if hasattr(run, attr):
+            setattr(wrapped, attr, getattr(run, attr))
+    return wrapped
+
+
+def _dec_rescale_int(value, from_scale: int, to_scale: int):
+    """Scaled int64 -> scaled int64 at another scale, rounding half away
+    from zero on downscale (Spark/java BigDecimal HALF_UP)."""
+    if to_scale == from_scale:
+        return value
+    if to_scale > from_scale:
+        return value * (10 ** (to_scale - from_scale))
+    f = 10 ** (from_scale - to_scale)
+    av = jnp.abs(value)
+    return jnp.sign(value) * ((av + f // 2) // f)
+
+
+def _as_dec_operand(d: DVal):
+    """(int64 values, DecimalType) for an operand that can join exact
+    integer-domain math — an exact decimal, or an integer typed as
+    decimal(digits, 0). (None, None) for float operands."""
+    s = _dec_scale(d)
+    if s is not None:
+        return d.value.astype(jnp.int64), d.dtype
+    vdt = jnp.asarray(d.value).dtype
+    if not jnp.issubdtype(vdt, jnp.integer):
+        return None, None
+    name = d.dtype.name if d.dtype is not None else "long"
+    digits = T._INT_DIGITS.get(name)
+    if digits is None:
+        return None, None
+    return d.value.astype(jnp.int64), T.DecimalType("decimal", digits, 0)
+
+
+def _dec_cmp_float_scalar(op: str, d: DVal, s: int, lit) -> DVal:
+    """Compare an exact decimal against a float SCALAR (typically a
+    tokenized literal) in the scaled-int domain — unscaling to float
+    instead would mis-bucket boundary values (an f32 literal 24.05 is
+    24.04999...). The threshold math is traced, so tokenized literals
+    rebind without recompiles. Handles literals finer than the column
+    scale (v <= 24.056 at scale 2 means v <= 24.05) via op-aware
+    floor/ceil; literals too large for int64 fall back to the float
+    compare lane, selected in-trace."""
+    f = 10 ** s
+    t = jnp.asarray(lit).astype(jnp.float64) * f
+    r = jnp.round(t)
+    tol = 1e-6 * jnp.maximum(1.0, jnp.abs(t))
+    is_int = jnp.abs(t - r) <= tol
+    fl = jnp.floor(t)
+    safe = jnp.abs(t) <= 2.0 ** 62
+    ts = jnp.where(safe, t, 0.0)
+    r64 = jnp.round(ts).astype(jnp.int64)
+    fl64 = jnp.floor(ts).astype(jnp.int64)
+    del fl
+    v = d.value.astype(jnp.int64)
+    if op == "=":
+        res_i = is_int & (v == r64)
+    elif op == "!=":
+        res_i = ~is_int | (v != r64)
+    elif op == "<":
+        res_i = v < jnp.where(is_int, r64, fl64 + 1)
+    elif op == "<=":
+        res_i = v <= jnp.where(is_int, r64, fl64)
+    elif op == ">":
+        res_i = v > jnp.where(is_int, r64, fl64)
+    else:  # >=
+        res_i = v >= jnp.where(is_int, r64, fl64 + 1)
+    vf = v.astype(jnp.float64) / f
+    lf = jnp.asarray(lit).astype(jnp.float64)
+    res_f = {"=": vf == lf, "!=": vf != lf, "<": vf < lf,
+             "<=": vf <= lf, ">": vf > lf, ">=": vf >= lf}[op]
+    return DVal(jnp.where(safe, res_i, res_f), d.null, T.BOOLEAN)
+
+
+_FLIP_CMP = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+             "=": "=", "!=": "!="}
+
+
+def _dec_binop(op: str, fn, a: DVal, b: DVal, is_cmp: bool
+               ) -> Optional[DVal]:
+    """Exact integer-domain lowering of a binop with >= 1 decimal side.
+    None -> the caller unscales both sides and runs plain float math.
+    Scale/precision rules shared with the analyzer via
+    types.decimal_binop_type, so declared output scale always equals
+    the computed representation's."""
+    av, adt = _as_dec_operand(a)
+    bv, bdt = _as_dec_operand(b)
+    if av is None or bv is None:
+        if is_cmp:
+            # decimal vs float SCALAR (tokenized literal): exact
+            # scaled-int compare instead of a lossy float unscale
+            sa, sb = _dec_scale(a), _dec_scale(b)
+            if sa is not None and bv is None and jnp.ndim(b.value) == 0:
+                out = _dec_cmp_float_scalar(op, a, sa, b.value)
+                return DVal(out.value, _or_null(a.null, b.null),
+                            T.BOOLEAN)
+            if sb is not None and av is None and jnp.ndim(a.value) == 0:
+                out = _dec_cmp_float_scalar(_FLIP_CMP[op], b, sb,
+                                            a.value)
+                return DVal(out.value, _or_null(a.null, b.null),
+                            T.BOOLEAN)
+        return None
+    null = _or_null(a.null, b.null)
+    if is_cmp:
+        s = max(adt.scale, bdt.scale)
+        if max(adt.precision + (s - adt.scale),
+               bdt.precision + (s - bdt.scale)) \
+                > T.DECIMAL_EXACT_MAX_PRECISION:
+            return None  # alignment could overflow int64: f64 compare
+        va = _dec_rescale_int(av, adt.scale, s)
+        vb = _dec_rescale_int(bv, bdt.scale, s)
+        return DVal(fn(va, vb), null, T.BOOLEAN)
+    out_dt = T.decimal_binop_type(op, adt, bdt)
+    if not isinstance(out_dt, T.DecimalType) or not out_dt.is_exact:
+        return None
+    if op == "*":
+        # scales add under int multiply: result is already at out_dt.scale
+        return DVal(av * bv, null, out_dt)
+    va = _dec_rescale_int(av, adt.scale, out_dt.scale)
+    vb = _dec_rescale_int(bv, bdt.scale, out_dt.scale)
+    return DVal(fn(va, vb), null, out_dt)
+
+
 class Runtime:
     """Runtime arrays handed to emitted closures inside the trace."""
 
@@ -253,9 +415,20 @@ class ExprBuilder:
 
             run_str.static_str = value
             return run_str
-        np_dtype = (dtype or (T.DOUBLE if isinstance(value, float)
-                              else T.LONG)).device_dtype()
-        const = np.asarray(value, dtype=np_dtype)
+        eff = dtype or (T.DOUBLE if isinstance(value, float) else T.LONG)
+        if eff.name == "decimal" and getattr(eff, "is_exact", False):
+            # exact-decimal literal (subquery substitution yields
+            # Decimal/float values typed decimal): store the SCALED
+            # unscaled value — a plain int64 cast would truncate 24.05
+            # to 24 and then decode as 0.24 (review finding)
+            import decimal as _d
+
+            q = _d.Decimal(value if isinstance(value, (_d.Decimal, int))
+                           else repr(float(value)))
+            const = np.asarray(int(q.scaleb(eff.scale).to_integral_value(
+                rounding=_d.ROUND_HALF_UP)), dtype=np.int64)
+        else:
+            const = np.asarray(value, dtype=eff.device_dtype())
 
         def run_lit(rt: Runtime) -> DVal:
             return DVal(jnp.asarray(const), None, dtype or T.LONG)
@@ -428,7 +601,11 @@ class ExprBuilder:
         is_cmp = op in ("=", "!=", "<", "<=", ">", ">=")
         if op == "/":
             def run_div(rt: Runtime) -> DVal:
-                a, b = left(rt), right(rt)
+                # exact decimals leave the int domain here: SQL decimal
+                # division result is DOUBLE in this engine (divergence
+                # from the reference's widened-decimal quotient, noted
+                # in types.DecimalType)
+                a, b = _dec_unscale(left(rt)), _dec_unscale(right(rt))
                 av, bv = a.value, b.value
                 if jnp.issubdtype(jnp.asarray(av).dtype, jnp.integer):
                     av = av.astype(_float_dtype())
@@ -445,6 +622,13 @@ class ExprBuilder:
 
         def run_bin(rt: Runtime) -> DVal:
             a, b = left(rt), right(rt)
+            if _dec_scale(a) is not None or _dec_scale(b) is not None:
+                out = _dec_binop(op, fn, a, b, is_cmp)
+                if out is not None:
+                    return out
+                # result leaves the exact domain (float operand, or the
+                # precision outgrew int64): plain float math
+                a, b = _dec_unscale(a), _dec_unscale(b)
             v = fn(a.value, b.value)
             dt = T.BOOLEAN if is_cmp else _promote(a.dtype, b.dtype)
             return DVal(v, _or_null(a.null, b.null), dt)
@@ -553,7 +737,7 @@ class ExprBuilder:
                 return vals
 
             aux_i = self._register_aux(build_sorted)
-            child = self.emit(e.child)
+            child = _dec_wrap_unscaled(self.emit(e.child))
 
             def run_in_sorted(rt: Runtime) -> DVal:
                 c = child(rt)
@@ -577,8 +761,8 @@ class ExprBuilder:
 
             return run_in_sorted
 
-        child = self.emit(e.child)
-        values = [self.emit(v) for v in e.values]
+        child = _dec_wrap_unscaled(self.emit(e.child))
+        values = [_dec_wrap_unscaled(self.emit(v)) for v in e.values]
 
         def run_in(rt: Runtime) -> DVal:
             c = child(rt)
@@ -623,8 +807,13 @@ class ExprBuilder:
         return run_neg
 
     def _emit_case(self, e: ast.Case) -> Callable[[Runtime], DVal]:
-        whens = [(self.emit(c), self.emit(v)) for c, v in e.whens]
-        other = self.emit(e.otherwise) if e.otherwise is not None else None
+        # branch values unscale exact decimals: branches mix with
+        # literals/other types, and scaled ints must not meet plain
+        # values in one jnp.where lattice
+        whens = [(self.emit(c), _dec_wrap_unscaled(self.emit(v)))
+                 for c, v in e.whens]
+        other = _dec_wrap_unscaled(self.emit(e.otherwise)) \
+            if e.otherwise is not None else None
 
         def run_case(rt: Runtime) -> DVal:
             branches = [(c(rt), v(rt)) for c, v in whens]
@@ -667,9 +856,35 @@ class ExprBuilder:
         if to.name == "string":
             raise CompileError("CAST to string not supported on device")
         np_dt = to.device_dtype()
+        to_exact = to.name == "decimal" and getattr(to, "is_exact", False)
 
         def run_cast(rt: Runtime) -> DVal:
             c = child(rt)
+            s_from = _dec_scale(c)
+            if s_from is not None:
+                if to_exact:  # decimal -> decimal: integer rescale
+                    return DVal(_dec_rescale_int(
+                        c.value.astype(jnp.int64), s_from, to.scale),
+                        c.null, to)
+                if T.is_integral(to):
+                    # decimal -> int truncates toward zero (Spark), done
+                    # exactly in the int domain
+                    f = 10 ** s_from
+                    iv = c.value.astype(jnp.int64)
+                    tv = jnp.sign(iv) * (jnp.abs(iv) // f)
+                    return DVal(tv.astype(np_dt), c.null, to)
+                c = _dec_unscale(c)
+            if to_exact:
+                v = c.value
+                if jnp.issubdtype(jnp.asarray(v).dtype, jnp.integer):
+                    return DVal(v.astype(jnp.int64) * (10 ** to.scale),
+                                c.null, to)
+                # HALF_UP (half away from zero), matching
+                # decimal_to_unscaled / _dec_rescale_int — jnp.round
+                # would tie to even
+                vf = v.astype(jnp.float64) * (10 ** to.scale)
+                scaled = jnp.sign(vf) * jnp.floor(jnp.abs(vf) + 0.5)
+                return DVal(scaled.astype(jnp.int64), c.null, to)
             return DVal(c.value.astype(np_dt), c.null, to)
 
         return run_cast
@@ -690,6 +905,11 @@ class ExprBuilder:
             raise CompileError(
                 f"aggregate {name} outside aggregation context")
         args = [self.emit(a) for a in e.args]
+        # scalar functions consume exact decimals in the plain float
+        # domain — their value math (round, sqrt, coalesce-with-
+        # literals, ...) is blind to the scaled-int representation.
+        # Aggregates never reach here (executor handles them exactly).
+        args = [_dec_wrap_unscaled(r) for r in args]
 
         # device lowering for numeric fixed-width arrays: the column binds
         # as (values [.., L], lengths, element_nulls) plates; padding and

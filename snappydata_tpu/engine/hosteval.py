@@ -661,16 +661,35 @@ def _take(result: Result, idx: np.ndarray) -> Result:
                   result.dtypes)
 
 
+def _float_domain_columns(result: Result) -> List[np.ndarray]:
+    """Result columns with exact-decimal scaled-int64 columns (the
+    compiled engine's representation) unscaled to plain float64 — what
+    result-level EXPRESSIONS (sort keys, HAVING predicates, projected
+    arithmetic) must consume. `_take`-style passthroughs keep the
+    original scaled columns, so exactness survives sort/limit/filter."""
+    cols = []
+    for c, dt in zip(result.columns, result.dtypes):
+        if dt is not None and dt.name == "decimal" \
+                and getattr(dt, "is_exact", False) \
+                and np.issubdtype(np.asarray(c).dtype, np.integer):
+            cols.append(np.asarray(c, dtype=np.float64)
+                        / (10 ** dt.scale))
+        else:
+            cols.append(c)
+    return cols
+
+
 def sort(result: Result, orders, params) -> Result:
     n = result.num_rows
     if n == 0:
         return result
+    fcols = _float_domain_columns(result)
     keys = []
     for item in reversed(list(orders)):
         e, asc = item[0], item[1]
         nulls_first = item[2] if len(item) > 2 and item[2] is not None \
             else asc   # Spark default: ASC → NULLS FIRST, DESC → LAST
-        v, nl = eval_expr(e, result.columns, result.nulls, params, n)
+        v, nl = eval_expr(e, fcols, result.nulls, params, n)
         v = np.broadcast_to(v, (n,))
         isnull = np.broadcast_to(nl, (n,)).copy() if nl is not None \
             else np.zeros(n, dtype=bool)
@@ -695,7 +714,8 @@ def sort(result: Result, orders, params) -> Result:
 
 def filter_result(result: Result, cond: ast.Expr, params) -> Result:
     n = result.num_rows
-    v, nl = eval_expr(cond, result.columns, result.nulls, params, n)
+    v, nl = eval_expr(cond, _float_domain_columns(result), result.nulls,
+                      params, n)
     keep = np.broadcast_to(v, (n,)).astype(bool)
     if nl is not None:
         keep = keep & ~nl
@@ -704,9 +724,17 @@ def filter_result(result: Result, cond: ast.Expr, params) -> Result:
 
 def project_result(result: Result, exprs, params) -> Result:
     n = result.num_rows
+    fcols = _float_domain_columns(result)
     names, cols, nulls, dtypes = [], [], [], []
     for e in exprs:
-        v, nl = eval_expr(e, result.columns, result.nulls, params, n)
+        base = e.child if isinstance(e, ast.Alias) else e
+        if isinstance(base, ast.Col) and base.index is not None:
+            # bare column pass-through keeps the ORIGINAL representation
+            # (exact-decimal scaled ints survive a result-level SELECT)
+            v = result.columns[base.index]
+            nl = result.nulls[base.index]
+        else:
+            v, nl = eval_expr(e, fcols, result.nulls, params, n)
         names.append(_expr_name(e))
         cols.append(np.broadcast_to(v, (n,)))
         nulls.append(np.broadcast_to(nl, (n,)) if nl is not None else None)
@@ -714,11 +742,29 @@ def project_result(result: Result, exprs, params) -> Result:
     return Result(names, cols, nulls, dtypes)
 
 
+def _unscale_decimal_col(c: np.ndarray, dt) -> np.ndarray:
+    """One column out of the scaled-int domain (no-op otherwise)."""
+    if dt is not None and dt.name == "decimal" \
+            and getattr(dt, "is_exact", False) \
+            and np.issubdtype(np.asarray(c).dtype, np.integer):
+        return np.asarray(c, dtype=np.float64) / (10 ** dt.scale)
+    return c
+
+
 def union(a: Result, b: Result) -> Result:
     cols = []
     nulls = []
     for i in range(len(a.columns)):
         ca, cb = a.columns[i], b.columns[i]
+        if (a.dtypes[i] is not None and a.dtypes[i].name == "decimal") \
+                or (b.dtypes[i] is not None
+                    and b.dtypes[i].name == "decimal"):
+            # branches may sit in different domains (scaled int vs
+            # float) or at different scales (the analyzer anchors the
+            # union's declared type to the LEFT branch): normalize both
+            # through each branch's OWN dtype before concatenating
+            ca = _unscale_decimal_col(ca, a.dtypes[i])
+            cb = _unscale_decimal_col(cb, b.dtypes[i])
         if ca.dtype != cb.dtype:
             ca = ca.astype(object)
             cb = cb.astype(object)
@@ -735,12 +781,16 @@ def union(a: Result, b: Result) -> Result:
 def set_op(a: Result, b: Result, op: str) -> Result:
     """INTERSECT / EXCEPT with SQL set semantics: DISTINCT output, and
     NULLs compare EQUAL (unlike joins) — row-tuples with None make that
-    free in Python."""
+    free in Python. Exact-decimal columns compare through each branch's
+    own unscaled domain (the same alignment union() applies), so a
+    scaled-int branch can intersect a float branch."""
     def row_tuples(r: Result):
+        rcols = [_unscale_decimal_col(c, dt)
+                 for c, dt in zip(r.columns, r.dtypes)]
         out = []
         for i in range(r.num_rows):
             row = []
-            for c, nm in zip(r.columns, r.nulls):
+            for c, nm in zip(rcols, r.nulls):
                 if (nm is not None and nm[i]) or \
                         (c.dtype == object and c[i] is None):
                     row.append(None)
@@ -1135,7 +1185,11 @@ def _eval_rel(plan: ast.Plan, params, executor):
     if isinstance(plan, (ast.Sort, ast.Limit, ast.Distinct, ast.Union,
                          ast.SetOp, ast.Values, ast.WindowProject)):
         r = executor.execute(plan, params)
-        return r.columns, r.nulls, r.names, r.dtypes, r.num_rows
+        # the compiled engine's exact-decimal columns are scaled int64;
+        # the host interpreter's expressions/joins above this node work
+        # in the plain float domain
+        return (_float_domain_columns(r), r.nulls, r.names, r.dtypes,
+                r.num_rows)
 
     raise HostEvalError(f"host fallback: {type(plan).__name__}")
 

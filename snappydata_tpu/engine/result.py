@@ -56,6 +56,43 @@ class Result:
                 + "\n".join(str(r) for r in head))
 
 
+def finalize_decimals(res: Result) -> Result:
+    """User-boundary decode of DECIMAL columns to decimal.Decimal
+    objects (the JDBC-BigDecimal analogue; ref readDecimal,
+    encoders/.../encoding/ColumnEncoding.scala:137-140). Inside the
+    engine decimals ride as scaled int64 (exact path) or plain floats
+    (host fallback / p>18); both decode here:
+
+    - integer column + exact DecimalType -> Decimal(v) * 10^-s, EXACT;
+    - float column + DecimalType -> Decimal quantized at the column
+      scale (exact whenever the f64 faithfully held the value).
+
+    Applied once, by the session/front-door layers — never
+    mid-pipeline, where numeric host ops still need numpy domains."""
+    changed = False
+    cols = list(res.columns)
+    for i, (c, dt) in enumerate(zip(res.columns, res.dtypes)):
+        if dt is None or dt.name != "decimal":
+            continue
+        arr = np.asarray(c)
+        if arr.dtype == object:
+            continue  # already decoded (or host objects)
+        if np.issubdtype(arr.dtype, np.integer) \
+                and getattr(dt, "is_exact", False):
+            out = np.array([T.unscaled_to_python(dt, v) for v in arr],
+                           dtype=object)
+        elif np.issubdtype(arr.dtype, np.floating):
+            out = np.array([T.float_to_python_decimal(dt, v)
+                            for v in arr], dtype=object)
+        else:
+            continue
+        cols[i] = out
+        changed = True
+    if not changed:
+        return res
+    return Result(res.names, cols, res.nulls, res.dtypes)
+
+
 def empty_result(names, dtypes) -> Result:
     cols = [np.empty(0, dtype=dt.np_dtype if dt.name != "string" else object)
             for dt in dtypes]

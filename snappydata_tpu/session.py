@@ -200,7 +200,9 @@ class SnappySession:
             result = self.execute_statement(stmt, tuple(params))
             self._log_query(sql_text, (_time.time() - t0) * 1000.0,
                             result.num_rows)
-            return result
+            from snappydata_tpu.engine.result import finalize_decimals
+
+            return finalize_decimals(result)
         ds = self.disk_store
         if ds is not None and isinstance(
                 stmt, (ast.InsertInto, ast.UpdateStmt, ast.DeleteStmt,
@@ -1606,10 +1608,14 @@ class SnappySession:
         return fn
 
     def _run_subquery(self, subplan: ast.Plan, user_params) -> Result:
+        from snappydata_tpu.engine.result import finalize_decimals
         from snappydata_tpu.sql.analyzer import AnalysisError as AErr
 
         try:
-            return self._run_query(subplan, user_params)
+            # decode exact decimals BEFORE literal substitution: a raw
+            # scaled-int column value (2405 for 24.05) substituted as a
+            # Lit would be re-scaled by the literal emitter
+            return finalize_decimals(self._run_query(subplan, user_params))
         except AErr as e:
             if "cannot resolve column" in str(e):
                 raise AnalysisError(

@@ -104,8 +104,13 @@ def expr_type(e: ast.Expr) -> T.DataType:
         if e.op in ("and", "or", "=", "!=", "<", "<=", ">", ">="):
             return T.BOOLEAN
         lt, rt = expr_type(e.left), expr_type(e.right)
+        dec = T.decimal_binop_type(e.op, lt, rt)
+        if dec is not None:
+            # shared with the runtime lowering (exprs._dec_binop) so the
+            # declared scale always matches the scaled-int representation
+            return dec
         if e.op == "/":
-            return T.DOUBLE if lt.name not in ("decimal",) else lt
+            return T.DOUBLE
         return T.common_type(lt, rt)
     if isinstance(e, ast.WindowFunc):
         if e.name in ("row_number", "rank", "dense_rank", "ntile", "count"):
@@ -124,9 +129,16 @@ def expr_type(e: ast.Expr) -> T.DataType:
         if low in ("count", "count_distinct", "approx_count_distinct"):
             return T.LONG
         if low in ("avg", "stddev", "variance"):
-            at = expr_type(e.args[0]) if e.args else T.DOUBLE
-            return at if at.name == "decimal" else T.DOUBLE
-        if low in ("sum", "min", "max", "first", "last", "abs", "coalesce"):
+            # avg(decimal) = exact int64 sum / exact count, computed and
+            # declared as DOUBLE (divergence from the reference's
+            # scale+4 decimal quotient, types.DecimalType docstring)
+            return T.DOUBLE
+        if low == "sum":
+            at = expr_type(e.args[0])
+            if at.name == "decimal":
+                return T.decimal_sum_type(at)
+            return at
+        if low in ("min", "max", "first", "last", "abs", "coalesce"):
             return expr_type(e.args[0])
         if low in ("year", "month", "day", "length", "instr", "size",
                    "dayofmonth", "dayofweek", "dayofyear", "weekofyear",

@@ -116,6 +116,12 @@ def _render_lit(e: ast.Lit) -> str:
         return f"DATE '{(_EPOCH + datetime.timedelta(days=int(v))).isoformat()}'"
     if isinstance(v, (int, float)):
         return repr(v)
+    import decimal as _d
+
+    if isinstance(v, _d.Decimal):
+        # numeric literal, NOT a quoted string (subquery substitution
+        # yields Decimal objects since the exact-decimal decode)
+        return format(v, "f")
     escaped = str(v).replace("'", "''")
     return f"'{escaped}'"
 

@@ -192,6 +192,11 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                                                          decode_validity)
 
             dt = f.dtype.device_dtype()
+            # exact decimals: HOST plates are float64 (the SQL value
+            # domain — WAL, deltas, stats, hosteval all ride it); the
+            # DEVICE plate is the scaled int64 unscaled value, converted
+            # here at bind (types.DecimalType docstring)
+            dec_exact = f.dtype.name == "decimal" and dt.kind == "i"
             stacked = np.zeros((b, cap), dtype=dt)
             null_mask = np.zeros((b, cap), dtype=np.bool_)
             any_null = False
@@ -201,7 +206,9 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
             # their ENCODED arrays to the device and expand there (ref
             # decode-at-scan: ColumnTableScan.scala:684). Mesh binds keep
             # host decode — the shard placement happens on host arrays.
-            use_dd = (ctx is None and not is_str
+            # Encoded decimal forms are host-domain floats, so the exact
+            # path keeps host decode + scaled conversion.
+            use_dd = (ctx is None and not is_str and not dec_exact
                       and config.global_properties().device_decode)
             dd_rle: list = []      # (batch row, EncodedColumn)
             dd_bits: list = []
@@ -239,7 +246,8 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                      else dd_bits).append((i, col))
                     continue
                 decoded = v.decoded_column(ci)
-                stacked[i] = decoded
+                stacked[i] = T.decimal_to_unscaled(f.dtype, decoded) \
+                    if dec_exact else decoded
                 if not (st is not None and not v.deltas and not is_str
                         and st.min is not None) \
                         and not is_str and v.batch.num_rows:
@@ -262,6 +270,8 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                                             dtype=np.bool_, count=take)
                     chunk_nulls = none_mask if chunk_nulls is None \
                         else (chunk_nulls | none_mask)
+                elif dec_exact:
+                    vals = T.decimal_to_unscaled(f.dtype, src)
                 else:
                     vals = np.asarray(src).astype(dt)
                 if chunk_nulls is not None and chunk_nulls.any():
@@ -269,8 +279,12 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                     any_null = True
                 stacked[len(views) + j, :take] = vals
                 if not is_str and take:
-                    smin[len(views) + j] = float(vals.min())
-                    smax[len(views) + j] = float(vals.max())
+                    # stats stay in the HOST (unscaled) domain — that's
+                    # what sargable predicate literals compare against
+                    stat_src = np.asarray(src, dtype=np.float64) \
+                        if dec_exact else vals
+                    smin[len(views) + j] = float(stat_src.min())
+                    smax[len(views) + j] = float(stat_src.max())
             if dd_rle or dd_bits:
                 # only the NON-device-decoded rows cross the link as
                 # decoded plates: upload them compactly and assemble the

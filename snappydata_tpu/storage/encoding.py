@@ -106,6 +106,12 @@ class EncodedColumn:
 
 
 def _device_np_dtype(dtype: T.DataType) -> np.dtype:
+    if dtype.name == "decimal":
+        # at-rest decimal bytes stay in the HOST (plain float64) domain:
+        # the exact path's scaled-int64 form is produced at device bind
+        # (types.DecimalType docstring) — encoding at device_dtype here
+        # would TRUNCATE values through the int64 cast
+        return dtype.np_dtype
     return dtype.device_dtype()
 
 

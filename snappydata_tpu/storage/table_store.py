@@ -388,9 +388,12 @@ class ColumnTableData:
             return EncodedColumn(Encoding.BOOLEAN_BITSET, dtype, n,
                                  bitmask.pack(np.zeros(n, dtype=np.bool_)),
                                  validity=validity, stats=stats)
-        # run-length [0]*n: one cell regardless of batch size
+        # run-length [0]*n: one cell regardless of batch size (at-rest
+        # bytes live in the HOST domain: np_dtype for decimals)
         return EncodedColumn(Encoding.RUN_LENGTH, dtype, n,
-                             np.zeros(1, dtype=dtype.device_dtype()),
+                             np.zeros(1, dtype=dtype.np_dtype
+                                      if dtype.name == "decimal"
+                                      else dtype.device_dtype()),
                              runs=np.array([n], dtype=np.int32),
                              validity=validity, stats=stats)
 
@@ -559,9 +562,13 @@ class ColumnTableData:
         None entries for string columns."""
         f = self.schema.fields[col_idx]
         shape = like.shape
+        # deltas live in the HOST storage domain: dictionary CODES for
+        # strings, plain float64 for decimals (the scaled-int64 form is
+        # device-only, produced at bind — types.DecimalType docstring)
         if values is None:
             dt = np.int32 if f.dtype.name == "string" \
-                else f.dtype.device_dtype()
+                else (f.dtype.np_dtype if f.dtype.name == "decimal"
+                      else f.dtype.device_dtype())
             return (np.zeros(shape, dtype=dt),
                     np.ones(shape, dtype=np.bool_))
         values = np.asarray(values)
@@ -577,7 +584,8 @@ class ColumnTableData:
             vnulls = np.fromiter((v is None for v in vals), dtype=np.bool_,
                                  count=len(vals))
             return codes, (vnulls if vnulls.any() else None)
-        dt = f.dtype.device_dtype()
+        dt = f.dtype.np_dtype if f.dtype.name == "decimal" \
+            else f.dtype.device_dtype()
         if values.shape == ():
             return np.full(shape, values, dtype=dt), None
         return values.astype(dt), None

@@ -36,6 +36,8 @@ class DataType:
         if self.name == "string":
             return np.dtype(np.int32)  # dictionary codes
         if self.name == "decimal":
+            if getattr(self, "is_exact", False):
+                return np.dtype(np.int64)  # scaled unscaled-value ints
             return np.dtype(np.float64 if config.use_float64() else np.float32)
         if self.name in ("double", "float") and not config.use_float64():
             return np.dtype(np.float32)
@@ -86,11 +88,38 @@ class StructType(DataType):
 
 @dataclasses.dataclass(frozen=True)
 class DecimalType(DataType):
+    """DECIMAL(p, s). TPU-first physical mapping (ref: exact BigDecimal
+    semantics, encoders/.../encoding/ColumnEncoding.scala:137-140
+    readDecimal):
+
+    - p <= 18 ("exact"): DEVICE representation is the scaled int64
+      unscaled value (v * 10^s) — SUM/MIN/MAX/COUNT/GROUP BY and
+      +,-,*,% / comparisons run as fast native integer ops and stay
+      EXACT; results decode to decimal.Decimal at the client edge. The
+      HOST mirror (plates, WAL, deltas, hosteval fallback) stays
+      float64, which round-trips any <= 15-significant-digit decimal
+      exactly — so end-to-end exactness holds through p=15 and device
+      aggregation exactness through p=18.
+    - p > 18: lowers to the float path (f32 plates on TPU with f64
+      accumulators, <= 1e-6 relative — the pre-round-5 behavior).
+    """
+
     precision: int = 38
     scale: int = 2
 
     def __str__(self) -> str:  # pragma: no cover - trivial
         return f"decimal({self.precision},{self.scale})"
+
+    @property
+    def is_exact(self) -> bool:
+        from snappydata_tpu import config
+
+        return (self.precision <= 18
+                and config.global_properties().decimal_exact)
+
+    @property
+    def scale_factor(self) -> int:
+        return 10 ** self.scale
 
 
 BOOLEAN = DataType("boolean")
@@ -170,7 +199,18 @@ def is_floating(dt: DataType) -> bool:
 def common_type(a: DataType, b: DataType) -> DataType:
     """Numeric type promotion for binary expressions."""
     if a.name == b.name:
+        if a.name == "decimal" and a != b:
+            return _decimal_align_type(a, b)
         return a
+    if "decimal" in (a.name, b.name):
+        dec, other = (a, b) if a.name == "decimal" else (b, a)
+        if other.name in ("float", "double"):
+            return DOUBLE
+        if other.name in _INT_DIGITS:
+            return _decimal_align_type(dec, _int_as_decimal(other))
+        if other.name == "string":
+            return STRING
+        return DOUBLE
     order = ["boolean", "byte", "short", "int", "date", "long", "timestamp",
              "float", "decimal", "double"]
     if a.name in order and b.name in order:
@@ -178,6 +218,102 @@ def common_type(a: DataType, b: DataType) -> DataType:
     if STRING in (a, b):
         return STRING
     raise TypeError(f"incompatible types: {a} vs {b}")
+
+
+# ---------------------------------------------------------------------------
+# Exact-decimal type algebra (shared by the analyzer's expr_type and the
+# runtime's scaled-int lowering so declared scale always matches the
+# computed representation). Result precision/scale follow Spark's
+# DecimalPrecision rules, capped: a result that would exceed precision
+# 18 lowers to DOUBLE instead (int64 can't hold it; the reference holds
+# p <= 38 via BigDecimal — documented divergence).
+# ---------------------------------------------------------------------------
+
+DECIMAL_EXACT_MAX_PRECISION = 18
+
+_INT_DIGITS = {"boolean": 1, "byte": 3, "short": 5, "int": 10, "long": 19}
+
+
+def _int_as_decimal(t: DataType) -> "DecimalType":
+    return DecimalType("decimal", _INT_DIGITS[t.name], 0)
+
+
+def _decimal_align_type(a: "DecimalType", b: "DecimalType") -> DataType:
+    s = max(a.scale, b.scale)
+    p = max(a.precision - a.scale, b.precision - b.scale) + s
+    if p > DECIMAL_EXACT_MAX_PRECISION:
+        return DOUBLE
+    return DecimalType("decimal", p, s)
+
+
+def decimal_binop_type(op: str, a: DataType, b: DataType
+                       ) -> Optional[DataType]:
+    """Result type of a +,-,*,%,/ over operands where at least one side
+    is decimal. None = not a decimal-typed operation (caller falls back
+    to common_type). DOUBLE = the operation leaves the exact domain."""
+    if "decimal" not in (a.name, b.name):
+        return None
+    if op == "/":
+        return DOUBLE
+    for t in (a, b):
+        if t.name in ("float", "double") or (
+                t.name not in _INT_DIGITS and t.name != "decimal"):
+            return DOUBLE
+    da = a if a.name == "decimal" else _int_as_decimal(a)
+    db = b if b.name == "decimal" else _int_as_decimal(b)
+    if op == "*":
+        p = da.precision + db.precision + 1
+        s = da.scale + db.scale
+        if p > DECIMAL_EXACT_MAX_PRECISION or not (
+                isinstance(da, DecimalType) and da.is_exact
+                and isinstance(db, DecimalType) and db.is_exact):
+            return DOUBLE
+        return DecimalType("decimal", p, s)
+    if op in ("+", "-", "%"):
+        s = max(da.scale, db.scale)
+        p = max(da.precision - da.scale, db.precision - db.scale) + s + 1
+        if p > DECIMAL_EXACT_MAX_PRECISION:
+            return DOUBLE
+        return DecimalType("decimal", p, s)
+    return None
+
+
+def decimal_sum_type(dt: DataType) -> DataType:
+    """SUM over a decimal column: widen precision (Spark: p+10), capped
+    at the exact-int64 limit — the in-trace overflow check reroutes to
+    the host path if a group total could actually exceed int64."""
+    if not isinstance(dt, DecimalType) or not dt.is_exact:
+        return DOUBLE
+    return DecimalType("decimal",
+                       min(dt.precision + 10, DECIMAL_EXACT_MAX_PRECISION),
+                       dt.scale)
+
+
+def decimal_to_unscaled(dt: DataType, arr) -> np.ndarray:
+    """Host-domain (float) decimal values -> scaled int64 unscaled
+    values, rounding half away from zero at the column scale (HALF_UP,
+    matching _dec_rescale_int and java BigDecimal — np.round would tie
+    to even and disagree with the device rescale path)."""
+    a = np.asarray(arr, dtype=np.float64) * float(dt.scale_factor)
+    return (np.sign(a) * np.floor(np.abs(a) + 0.5)).astype(np.int64)
+
+
+def unscaled_to_python(dt: DataType, v: int):
+    """Scaled int64 -> decimal.Decimal at the column scale."""
+    import decimal as _d
+
+    return _d.Decimal(int(v)).scaleb(-dt.scale)
+
+
+def float_to_python_decimal(dt: DataType, v: float):
+    """Float-domain decimal value -> decimal.Decimal quantized at the
+    column scale (used on host-fallback paths; exact whenever the f64
+    faithfully represents the decimal, i.e. <= 15 significant digits)."""
+    import decimal as _d
+
+    q = _d.Decimal(1).scaleb(-dt.scale)
+    return _d.Decimal(repr(float(v))).quantize(q,
+                                               rounding=_d.ROUND_HALF_UP)
 
 
 @dataclasses.dataclass(frozen=True)
