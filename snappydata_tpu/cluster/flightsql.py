@@ -64,19 +64,28 @@ def _get_varint(buf: bytes, pos: int) -> Tuple[int, int]:
 
 def encode_fields(fields: List[Tuple[int, object]]) -> bytes:
     """fields: (field_number, value) — str/bytes → length-delimited,
-    int/bool → varint. Nones are skipped."""
+    int/bool → varint. Nones AND proto3 defaults (0, False, empty
+    str/bytes) are skipped, matching the official runtime's canonical
+    serialization byte for byte (asserted against golden fixtures
+    generated with google.protobuf — tests/test_flightsql_golden.py)."""
     out = bytearray()
     for num, val in fields:
         if val is None:
             continue
         if isinstance(val, bool):
+            if not val:
+                continue
             _put_varint(out, (num << 3) | 0)
-            _put_varint(out, int(val))
+            _put_varint(out, 1)
         elif isinstance(val, int):
+            if val == 0:
+                continue
             _put_varint(out, (num << 3) | 0)
             _put_varint(out, val)
         else:
             raw = val.encode("utf-8") if isinstance(val, str) else bytes(val)
+            if not raw:
+                continue
             _put_varint(out, (num << 3) | 2)
             _put_varint(out, len(raw))
             out += raw

@@ -108,6 +108,10 @@ class Properties:
     # the lead IS an engine, so small-table full-surface queries run on
     # it; big ones must be expressible as scatter/merge or error).
     dist_gather_bytes: int = 512 * 1024 * 1024
+    # Ship-first distributed execution: serialize plan fragments to the
+    # servers by default (SparkSQLExecuteImpl.scala:75-109); False
+    # re-renders single-block SQL first (compat with down-rev servers).
+    dist_ship_plans: bool = True
     member_timeout_s: float = 5.0             # ref: ClusterManagerTestBase.scala:72
     stats_interval_s: float = 5.0             # ref: Constant.DEFAULT_CALC_TABLE_SIZE_SERVICE_INTERVAL
 

@@ -629,6 +629,8 @@ def _or_null(a, b):
 # --------------------------------------------------------------------------
 
 from snappydata_tpu.engine.result import Result  # noqa: E402
+from snappydata_tpu.engine.result import \
+    unscale_decimal_col as _unscale_decimal_col  # noqa: E402
 
 
 def limit(result: Result, k: int) -> Result:
@@ -667,16 +669,8 @@ def _float_domain_columns(result: Result) -> List[np.ndarray]:
     result-level EXPRESSIONS (sort keys, HAVING predicates, projected
     arithmetic) must consume. `_take`-style passthroughs keep the
     original scaled columns, so exactness survives sort/limit/filter."""
-    cols = []
-    for c, dt in zip(result.columns, result.dtypes):
-        if dt is not None and dt.name == "decimal" \
-                and getattr(dt, "is_exact", False) \
-                and np.issubdtype(np.asarray(c).dtype, np.integer):
-            cols.append(np.asarray(c, dtype=np.float64)
-                        / (10 ** dt.scale))
-        else:
-            cols.append(c)
-    return cols
+    return [_unscale_decimal_col(c, dt)
+            for c, dt in zip(result.columns, result.dtypes)]
 
 
 def sort(result: Result, orders, params) -> Result:
@@ -742,29 +736,33 @@ def project_result(result: Result, exprs, params) -> Result:
     return Result(names, cols, nulls, dtypes)
 
 
-def _unscale_decimal_col(c: np.ndarray, dt) -> np.ndarray:
-    """One column out of the scaled-int domain (no-op otherwise)."""
-    if dt is not None and dt.name == "decimal" \
-            and getattr(dt, "is_exact", False) \
-            and np.issubdtype(np.asarray(c).dtype, np.integer):
-        return np.asarray(c, dtype=np.float64) / (10 ** dt.scale)
-    return c
+# _unscale_decimal_col binds at module bottom (the established
+# cycle-avoiding import spot) to engine.result.unscale_decimal_col
 
 
 def union(a: Result, b: Result) -> Result:
     cols = []
     nulls = []
+    dtypes = list(a.dtypes)
     for i in range(len(a.columns)):
         ca, cb = a.columns[i], b.columns[i]
         if (a.dtypes[i] is not None and a.dtypes[i].name == "decimal") \
                 or (b.dtypes[i] is not None
                     and b.dtypes[i].name == "decimal"):
             # branches may sit in different domains (scaled int vs
-            # float) or at different scales (the analyzer anchors the
-            # union's declared type to the LEFT branch): normalize both
-            # through each branch's OWN dtype before concatenating
+            # float) or at different scales: normalize both through
+            # each branch's OWN dtype before concatenating, and WIDEN
+            # the declared type over both branches so a finer right-
+            # branch scale survives the decode quantization (Spark
+            # widens union types the same way; review finding)
             ca = _unscale_decimal_col(ca, a.dtypes[i])
             cb = _unscale_decimal_col(cb, b.dtypes[i])
+            if a.dtypes[i] != b.dtypes[i] and b.dtypes[i] is not None \
+                    and a.dtypes[i] is not None:
+                try:
+                    dtypes[i] = T.common_type(a.dtypes[i], b.dtypes[i])
+                except TypeError:
+                    pass
         if ca.dtype != cb.dtype:
             ca = ca.astype(object)
             cb = cb.astype(object)
@@ -775,7 +773,7 @@ def union(a: Result, b: Result) -> Result:
             b.num_rows, dtype=bool)
         merged = np.concatenate([na, nb])
         nulls.append(merged if merged.any() else None)
-    return Result(a.names, cols, nulls, a.dtypes)
+    return Result(a.names, cols, nulls, dtypes)
 
 
 def set_op(a: Result, b: Result, op: str) -> Result:
@@ -810,9 +808,26 @@ def set_op(a: Result, b: Result, op: str) -> Result:
         if (op == "intersect") == (row in right):
             keep_idx.append(i)
     idx = np.asarray(keep_idx, dtype=np.int64)
-    cols = [c[idx] for c in a.columns]
+    # output decimal columns leave in the UNSCALED domain with the
+    # dtype widened over both branches — the analyzer's SetOp scope is
+    # widened the same way, so a left-branch scaled column must not be
+    # decoded at the (possibly finer) widened scale (review finding)
+    cols = []
+    dtypes = list(a.dtypes)
+    for i, c in enumerate(a.columns):
+        if (a.dtypes[i] is not None and a.dtypes[i].name == "decimal") \
+                or (b.dtypes[i] is not None
+                    and b.dtypes[i].name == "decimal"):
+            c = _unscale_decimal_col(c, a.dtypes[i])
+            if a.dtypes[i] != b.dtypes[i] and a.dtypes[i] is not None \
+                    and b.dtypes[i] is not None:
+                try:
+                    dtypes[i] = T.common_type(a.dtypes[i], b.dtypes[i])
+                except TypeError:
+                    pass
+        cols.append(c[idx])
     nulls = [nm[idx] if nm is not None else None for nm in a.nulls]
-    return Result(a.names, cols, nulls, a.dtypes)
+    return Result(a.names, cols, nulls, dtypes)
 
 
 def eval_values(node: ast.Values, params) -> Result:

@@ -56,6 +56,30 @@ class Result:
                 + "\n".join(str(r) for r in head))
 
 
+def unscale_decimal_col(c: np.ndarray, dt) -> np.ndarray:
+    """One column out of the exact-decimal scaled-int64 domain into
+    plain float64 (no-op for anything else) — the SINGLE implementation
+    every host consumer shares."""
+    if dt is not None and dt.name == "decimal" \
+            and getattr(dt, "is_exact", False) \
+            and np.issubdtype(np.asarray(c).dtype, np.integer):
+        return np.asarray(c, dtype=np.float64) / (10 ** dt.scale)
+    return c
+
+
+def to_host_domain(res: Result) -> Result:
+    """Result with exact-decimal scaled-int64 columns unscaled to the
+    plain float64 HOST domain — what ingest consumers (CTAS /
+    INSERT..SELECT coercion into host plates) and host numeric code
+    expect. Without this, a scaled column would be stored verbatim and
+    read back 10^scale too large (review finding)."""
+    cols = [unscale_decimal_col(c, dt)
+            for c, dt in zip(res.columns, res.dtypes)]
+    if all(a is b for a, b in zip(cols, res.columns)):
+        return res
+    return Result(res.names, cols, res.nulls, res.dtypes)
+
+
 def finalize_decimals(res: Result) -> Result:
     """User-boundary decode of DECIMAL columns to decimal.Decimal
     objects (the JDBC-BigDecimal analogue; ref readDecimal,

@@ -1172,7 +1172,11 @@ class SnappySession:
             if stmt.if_not_exists and \
                     self.catalog.lookup_table(stmt.name) is not None:
                 return _status()  # no-op, do NOT re-append (review finding)
-            result = self._run_query(stmt.as_select)
+            from snappydata_tpu.engine.result import to_host_domain
+
+            # CTAS ingests into host plates: exact-decimal columns must
+            # leave the scaled-int domain first (else 24.05 stores 2405)
+            result = to_host_domain(self._run_query(stmt.as_select))
             if not stmt.name.split(".")[-1].startswith("__"):
                 for n in result.names:
                     if n.startswith("__"):
@@ -1888,7 +1892,10 @@ class SnappySession:
             resolved, _ = self.analyzer.analyze_plan(stmt.source)
             src = hosteval.eval_values(resolved, user_params)
         else:
-            src = self._run_query(stmt.source, user_params)
+            from snappydata_tpu.engine.result import to_host_domain
+
+            # INSERT..SELECT: same host-domain requirement as CTAS
+            src = to_host_domain(self._run_query(stmt.source, user_params))
         if stmt.columns:
             name_to_src = {c.lower(): i for i, c in enumerate(stmt.columns)}
             if len(stmt.columns) != len(src.columns):

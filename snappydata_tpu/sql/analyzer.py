@@ -32,6 +32,28 @@ class ScopeEntry:
     hidden: bool = False   # internal base-table column (e.g. __arrival_ts)
 
 
+def _widen_branch_scope(ls: "Scope", rs: "Scope") -> "Scope":
+    """UNION/INTERSECT/EXCEPT output scope: left-anchored names, but
+    DECIMAL columns widen to cover BOTH branches' scales (Spark
+    semantics) — anchoring dtype to the left would quantize away a
+    finer right-branch scale at the decode boundary (review finding)."""
+    out = []
+    for le, re_ in zip(ls.entries, rs.entries):
+        dt = le.dtype
+        if "decimal" in ((le.dtype.name if le.dtype else ""),
+                         (re_.dtype.name if re_.dtype else "")) \
+                and le.dtype != re_.dtype:
+            try:
+                dt = T.common_type(le.dtype, re_.dtype)
+            except TypeError:
+                dt = le.dtype
+        if dt is le.dtype:
+            out.append(le)
+        else:
+            out.append(dataclasses.replace(le, dtype=dt))
+    return Scope(out)
+
+
 class Scope:
     def __init__(self, entries: Sequence[ScopeEntry]):
         self.entries = list(entries)
@@ -416,7 +438,8 @@ class Analyzer:
             right, rs = self.analyze_plan(plan.right)
             if len(ls.entries) != len(rs.entries):
                 raise AnalysisError("UNION children must have equal arity")
-            return ast.Union(left, right, plan.all), ls
+            return ast.Union(left, right, plan.all), \
+                _widen_branch_scope(ls, rs)
 
         if isinstance(plan, ast.SetOp):
             left, ls = self.analyze_plan(plan.left)
@@ -424,7 +447,8 @@ class Analyzer:
             if len(ls.entries) != len(rs.entries):
                 raise AnalysisError(
                     f"{plan.op.upper()} children must have equal arity")
-            return ast.SetOp(left, right, plan.op), ls
+            return ast.SetOp(left, right, plan.op), \
+                _widen_branch_scope(ls, rs)
 
         raise AnalysisError(f"cannot analyze plan node {type(plan).__name__}")
 

@@ -96,10 +96,12 @@ class DecimalType(DataType):
       unscaled value (v * 10^s) — SUM/MIN/MAX/COUNT/GROUP BY and
       +,-,*,% / comparisons run as fast native integer ops and stay
       EXACT; results decode to decimal.Decimal at the client edge. The
-      HOST mirror (plates, WAL, deltas, hosteval fallback) stays
-      float64, which round-trips any <= 15-significant-digit decimal
-      exactly — so end-to-end exactness holds through p=15 and device
-      aggregation exactness through p=18.
+      HOST mirror (plates, WAL, deltas, hosteval fallback, and
+      cross-server partial aggregates re-entering the distributed
+      merge) stays float64, which round-trips any
+      <= 15-significant-digit decimal exactly — so end-to-end
+      exactness holds through p=15 (per-shard partials included) and
+      device aggregation exactness through p=18.
     - p > 18: lowers to the float path (f32 plates on TPU with f64
       accumulators, <= 1e-6 relative — the pre-round-5 behavior).
     """
@@ -305,15 +307,26 @@ def unscaled_to_python(dt: DataType, v: int):
     return _d.Decimal(int(v)).scaleb(-dt.scale)
 
 
-def float_to_python_decimal(dt: DataType, v: float):
-    """Float-domain decimal value -> decimal.Decimal quantized at the
-    column scale (used on host-fallback paths; exact whenever the f64
-    faithfully represents the decimal, i.e. <= 15 significant digits)."""
+def decimal_float_converter(dt: DataType):
+    """Column-level converter: float-domain decimal value ->
+    decimal.Decimal quantized at the column scale, with the quantizer
+    hoisted once (per-cell construction was measurable on streamed
+    exports). Exact whenever the f64 faithfully represents the decimal,
+    i.e. <= 15 significant digits."""
     import decimal as _d
 
     q = _d.Decimal(1).scaleb(-dt.scale)
-    return _d.Decimal(repr(float(v))).quantize(q,
-                                               rounding=_d.ROUND_HALF_UP)
+
+    def conv(v):
+        return _d.Decimal(repr(float(v))).quantize(
+            q, rounding=_d.ROUND_HALF_UP)
+
+    return conv
+
+
+def float_to_python_decimal(dt: DataType, v: float):
+    """One-off variant of decimal_float_converter."""
+    return decimal_float_converter(dt)(v)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -206,8 +206,12 @@ def test_persistence_roundtrip(tmp_path):
 
 @pytest.mark.slow
 def test_distributed_sum_exact():
-    """Decimal exactness across the cluster plane: partial sums travel
-    as scaled values and the merge keeps the Decimal oracle equality."""
+    """Decimal exactness across the cluster plane: per-server partial
+    sums are exact int64, ship as Arrow decimal128, and re-enter the
+    merge through the float64 host domain — so the merged total equals
+    the Decimal oracle while every partial fits 15 significant digits
+    (~9e13 at scale 2; beyond that the merge degrades to f64 like the
+    host fallback, a documented bound in types.DecimalType)."""
     from snappydata_tpu.cluster import LocatorNode, ServerNode
     from snappydata_tpu.cluster.distributed import DistributedSession
 
@@ -305,6 +309,34 @@ def test_union_and_intersect_mixed_scales(session):
     inter = session.sql(
         "SELECT v FROM ua INTERSECT SELECT v FROM ub").rows()
     assert len(inter) == 1 and float(inter[0][0]) == pytest.approx(24.05)
+    # the union type widens over both branches: a finer right-branch
+    # scale must survive decode (review finding — left-anchored dtype
+    # quantized 1.005 to 1.00/1.01)
+    session.sql("INSERT INTO ub VALUES (1.005)")
+    got2 = sorted(str(r[0]) for r in session.sql(
+        "SELECT v FROM ua UNION ALL SELECT v FROM ub").rows())
+    assert "1.005" in got2
+    # set_op output decodes at the widened scale too: a scaled left
+    # branch must not be re-read at the finer right-branch scale
+    # (review finding: 24.05 decoded as 2.405)
+    inter2 = session.sql(
+        "SELECT v FROM ua INTERSECT SELECT v FROM ub").rows()
+    assert [float(r[0]) for r in inter2] == pytest.approx([24.05])
+
+
+def test_ctas_and_insert_select_keep_values(session):
+    """CTAS / INSERT..SELECT from an exact-decimal column must store
+    the VALUE, not the scaled representation (review finding: 24.05
+    stored as 2405.00)."""
+    session.sql("CREATE TABLE src (k BIGINT, v DECIMAL(10,2)) USING column")
+    session.sql("INSERT INTO src VALUES (1, 24.05), (2, 1.10)")
+    session.sql("CREATE TABLE ct AS SELECT k, v FROM src")
+    assert session.sql("SELECT sum(v) FROM ct").rows()[0][0] \
+        == Decimal("25.15")
+    session.sql("CREATE TABLE tgt (k BIGINT, v DECIMAL(10,2)) USING column")
+    session.sql("INSERT INTO tgt SELECT k, v FROM src")
+    assert session.sql("SELECT v FROM tgt WHERE k = 1").rows() \
+        == [(Decimal("24.05"),)]
 
 
 def test_half_up_rounding_ties(session):

@@ -144,6 +144,12 @@ def test_windowed_sql_over_stream(session):
     src.add_batch({"k": np.array([1, 2], dtype=np.int32),
                    "v": np.array([1.0, 2.0])})
     q.process_available()
+    # warm the plan shapes NOW: the wall-clock window below must not
+    # race first-compile latency (flaked whenever module import/trace
+    # cost pushed the batch past the window before the query ran)
+    session.sql("SELECT count(*) FROM ws")
+    session.sql("SELECT k, v FROM ws WINDOW (DURATION 0.3 SECONDS) "
+                "ORDER BY k")
     time.sleep(0.35)
     src.add_batch({"k": np.array([3], dtype=np.int32),
                    "v": np.array([30.0])})

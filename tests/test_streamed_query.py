@@ -1,0 +1,119 @@
+"""Streamed scan-shaped query results through the Flight `sql` ticket
+(round-4 verdict Weak #7 / task 5): project/filter queries over a
+column table stream per scan unit — peak host rows bounded by one
+column batch — with LIMIT early-exit, while aggregates/sorts keep the
+materialized path. Ref: CachedDataFrame.executeTake:766,
+SparkSQLExecuteImpl.packRows:109."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.cluster.client import SnappyClient
+from snappydata_tpu.cluster.flight_server import (SnappyFlightServer,
+                                                  try_stream_scan)
+from snappydata_tpu.observability.metrics import global_registry
+
+
+@pytest.fixture()
+def served():
+    s = SnappySession(catalog=Catalog())
+    # small batches -> many scan units, so streaming is observable
+    s.sql("CREATE TABLE big (k BIGINT, tag STRING, v DOUBLE) "
+          "USING column OPTIONS (column_batch_rows '1000', column_max_delta_rows '1000')")
+    n = 12_000
+    rng = np.random.default_rng(9)
+    s.insert_arrays("big", [
+        np.arange(n, dtype=np.int64),
+        np.array(["t%d" % (i % 3) for i in range(n)], dtype=object),
+        np.round(rng.random(n) * 100, 3)])
+    srv = SnappyFlightServer(s)
+    threading.Thread(target=srv.serve, daemon=True).start()
+    srv.wait_ready()
+    client = SnappyClient(address=f"127.0.0.1:{srv.actual_port}")
+    yield s, client, n
+    client.close()
+    srv.shutdown()
+    s.stop()
+
+
+def _metric(name):
+    return global_registry().counter(name)
+
+
+def test_select_star_streams_per_scan_unit(served):
+    s, client, n = served
+    before = _metric("stream_scan_chunks")
+    t = client.sql("SELECT k, tag, v FROM big")
+    assert t.num_rows == n
+    assert sorted(t.column("k").to_pylist()) == list(range(n))
+    chunks = _metric("stream_scan_chunks") - before
+    # 12k rows / 1k-row batches: the server must have produced MANY
+    # bounded chunks, never one materialized result
+    assert chunks >= 10, chunks
+
+
+def test_filter_and_projection_stream(served):
+    s, client, n = served
+    before = _metric("stream_scan_chunks")
+    t = client.sql("SELECT k, v * 2 AS v2 FROM big "
+                   "WHERE tag = 't1' AND k < 6000")
+    exact = [k for k in range(6000) if k % 3 == 1]
+    assert sorted(t.column("k").to_pylist()) == exact
+    local = {r[0]: r[1] for r in s.sql(
+        "SELECT k, v * 2 FROM big WHERE tag = 't1' AND k < 6000").rows()}
+    got = dict(zip(t.column("k").to_pylist(),
+                   t.column("v2").to_pylist()))
+    for k in exact[:50]:
+        assert got[k] == pytest.approx(local[k])
+    assert _metric("stream_scan_chunks") > before
+
+
+def test_limit_early_exit(served):
+    s, client, n = served
+    before_chunks = _metric("stream_scan_chunks")
+    before_stops = _metric("stream_scan_early_stops")
+    t = client.sql("SELECT k FROM big LIMIT 500")
+    assert t.num_rows == 500
+    assert _metric("stream_scan_early_stops") == before_stops + 1
+    # one batch satisfies the limit: remaining units never decoded
+    assert _metric("stream_scan_chunks") - before_chunks <= 2
+
+
+def test_question_mark_params_bind_positionally(served):
+    """'?' placeholders must get positions before streamed eval —
+    unassigned Param(pos=-1) read params[-1] for EVERY placeholder
+    (review finding; the round-4 UPDATE/DELETE bug class)."""
+    s, client, n = served
+    t = client.sql("SELECT k FROM big WHERE k >= ? AND k < ?",
+                   params=[100, 103])
+    assert sorted(t.column("k").to_pylist()) == [100, 101, 102]
+
+
+def test_aggregates_and_sorts_keep_materialized_path(served):
+    s, client, n = served
+    assert try_stream_scan(s, "SELECT count(*) FROM big") is None
+    assert try_stream_scan(s, "SELECT k FROM big ORDER BY k") is None
+    assert try_stream_scan(s, "SELECT DISTINCT tag FROM big") is None
+    assert try_stream_scan(
+        s, "SELECT b1.k FROM big b1 JOIN big b2 ON b1.k = b2.k") is None
+    # and the materialized path still answers them correctly
+    t = client.sql("SELECT tag, count(*) AS c FROM big GROUP BY tag "
+                   "ORDER BY tag")
+    assert t.column("c").to_pylist() == [4000, 4000, 4000]
+
+
+def test_stream_respects_row_level_policy(served):
+    """Policy predicates inject during analyze_plan — the streamed path
+    must enforce them exactly like the materialized path."""
+    s, client, n = served
+    s.sql("CREATE POLICY p_big ON big USING k < 100")
+    try:
+        t = client.sql("SELECT k FROM big")
+        assert t.num_rows == 100  # policy filtered, streamed or not
+    finally:
+        s.sql("DROP POLICY p_big")
+    assert client.sql("SELECT k FROM big").num_rows == n
