@@ -15,6 +15,7 @@ import pytest
 from snappydata_tpu import SnappySession
 from snappydata_tpu.catalog import Catalog
 from snappydata_tpu.aqp.error_estimation import AQPUnsupported, HACViolation
+from snappydata_tpu.sql import ast
 from snappydata_tpu.sql.parser import parse, SQLSyntaxError
 
 
@@ -152,10 +153,35 @@ def test_unsupported_shapes_raise(sess):
     with pytest.raises(AQPUnsupported):
         s.sql("SELECT count(DISTINCT month_) FROM airline WITH ERROR 0.1")
     with pytest.raises(AQPUnsupported):
-        s.sql("SELECT carrier, sum(delay) AS sd FROM airline "
-              "GROUP BY carrier HAVING sum(delay) > 0 WITH ERROR 0.1")
-    with pytest.raises(AQPUnsupported):
         s.sql("SELECT absolute_error(nope) FROM airline WITH ERROR 0.1")
+    with pytest.raises(AQPUnsupported):
+        # HAVING shapes beyond select-list refs/comparisons still raise
+        s.sql("SELECT carrier, sum(delay) AS sd FROM airline "
+              "GROUP BY carrier HAVING length(carrier) > 1 "
+              "WITH ERROR 0.1")
+
+
+def test_having_filters_on_estimates(sess):
+    """HAVING with WITH ERROR filters groups on their ESTIMATED
+    aggregate values post-hoc (round-4 verdict task 7; ref
+    docs/sde/sample_selection.md query-QCS incl. Having columns)."""
+    s, carriers, delays, _ = sess
+    all_rows = s.sql(
+        "SELECT carrier, sum(delay) AS sd FROM airline "
+        "GROUP BY carrier WITH ERROR 0.5").rows()
+    assert len(all_rows) >= 2
+    cutoff = sorted(r[1] for r in all_rows)[len(all_rows) // 2]
+    kept = s.sql(
+        f"SELECT carrier, sum(delay) AS sd FROM airline "
+        f"GROUP BY carrier HAVING sum(delay) > {cutoff} "
+        f"WITH ERROR 0.5").rows()
+    assert {r[0] for r in kept} \
+        == {r[0] for r in all_rows if r[1] > cutoff}
+    # alias references work too
+    kept2 = s.sql(
+        f"SELECT carrier, sum(delay) AS sd FROM airline "
+        f"GROUP BY carrier HAVING sd > {cutoff} WITH ERROR 0.5").rows()
+    assert {r[0] for r in kept2} == {r[0] for r in kept}
 
 
 # ------------------------------------------------------------------
@@ -324,4 +350,83 @@ def test_base_table_underscore_spelling():
               "WITH ERROR 0.9")
     sx, ae = r.rows()[0]
     assert ae is not None and ae > 0   # estimated, not the exact path
+    s.stop()
+
+
+def test_best_qcs_sample_selection():
+    """Multiple samples on one base: the estimator picks the sample
+    whose QCS best matches the query's WHERE/GROUP BY/HAVING columns —
+    exact match > superset > largest-overlap subset, largest sample on
+    ties (round-4 verdict task 7; ref docs/sde/sample_selection.md)."""
+    from snappydata_tpu.aqp.error_estimation import (_ExecCtx,
+                                                     _select_sample)
+    from snappydata_tpu.sql.parser import parse as _parse
+
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE ms (a STRING, b STRING, v DOUBLE) USING column")
+    rng = np.random.default_rng(4)
+    n = 3000
+    s.insert_arrays("ms", [
+        rng.choice(np.array(["x", "y", "z"], dtype=object), n),
+        rng.choice(np.array(["p", "q"], dtype=object), n),
+        rng.random(n)])
+    s.sql("CREATE SAMPLE TABLE ms_a ON ms OPTIONS (baseTable 'ms', "
+          "qcs 'a', reservoir_size '60')")
+    s.sql("CREATE SAMPLE TABLE ms_ab ON ms OPTIONS (baseTable 'ms', "
+          "qcs 'a,b', reservoir_size '60')")
+    s.sql("CREATE SAMPLE TABLE ms_b ON ms OPTIONS (baseTable 'ms', "
+          "qcs 'b', reservoir_size '60')")
+    ctx = _ExecCtx(catalog=s.catalog, run_phases=None, run_exact=None,
+                   refresh=lambda: None)
+    cands = ["ms_a", "ms_ab", "ms_b"]
+
+    def pick(sql_text):
+        stmt = _parse(sql_text)
+        node = stmt.plan
+        while not isinstance(node, ast.Aggregate):
+            node = node.children()[0]
+        return _select_sample(ctx, node, None, cands)
+
+    # exact QCS match
+    assert pick("SELECT a, sum(v) FROM ms GROUP BY a") == "ms_a"
+    assert pick("SELECT b, sum(v) FROM ms GROUP BY b") == "ms_b"
+    assert pick("SELECT a, b, sum(v) FROM ms GROUP BY a, b") == "ms_ab"
+    # superset beats subset: grouping by b with a WHERE on a -> {a,b}
+    assert pick("SELECT b, sum(v) FROM ms WHERE a = 'x' GROUP BY b") \
+        == "ms_ab"
+    # and the full estimation path still runs with several samples
+    r = s.sql("SELECT a, sum(v) AS sv, absolute_error(sv) FROM ms "
+              "GROUP BY a WITH ERROR 0.9").rows()
+    assert len(r) == 3
+    s.stop()
+
+
+@pytest.mark.slow
+def test_100k_group_with_error_completes_fast():
+    """The vectorized strata combine at scale: a 100k-group WITH ERROR
+    query must complete in seconds (the per-group Python loop was
+    pathological here — round-4 verdict task 7)."""
+    import time as _t
+
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE wide (g BIGINT, v DOUBLE) USING column")
+    n = 200_000
+    rng = np.random.default_rng(5)
+    g = np.arange(n, dtype=np.int64) % 100_000
+    v = rng.random(n)
+    s.insert_arrays("wide", [g, v])
+    s.sql("CREATE SAMPLE TABLE wide_s ON wide OPTIONS (baseTable "
+          "'wide', qcs 'g', reservoir_size '2')")
+    t0 = _t.time()
+    rows = s.sql("SELECT g, sum(v) AS sv, absolute_error(sv) "
+                 "FROM wide GROUP BY g WITH ERROR 0.99").rows()
+    combine_s = _t.time() - t0
+    assert len(rows) == 100_000
+    assert combine_s < 60, combine_s   # loop impl took many minutes
+    # spot-check: estimates are the per-stratum exact sums (reservoir
+    # holds every row of a 2-row stratum -> weight 1, variance 0)
+    got = {int(r[0]): r[1] for r in rows[:1000]}
+    for gi, sv in list(got.items())[:20]:
+        exact = float(v[g == gi].sum())
+        assert sv == pytest.approx(exact, rel=1e-9), gi
     s.stop()
