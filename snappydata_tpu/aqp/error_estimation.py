@@ -223,10 +223,17 @@ def _execute_with_ctx(ctx: _ExecCtx, stmt: ast.Query,
 
     rows = est.rows
     if having is not None:
-        # re-filter after behavior: a run_on_full_table rerun rebuilt
-        # the rows from the EXACT answer (unfiltered), and exact values
-        # may move a group across the HAVING boundary
-        rows = _filter_having(rows, having, items, agg_items)
+        # re-filter ONLY rows rebuilt from the exact answer
+        # (run_on_full_table repopulates unfiltered, and exact values
+        # may move a group across the HAVING boundary); estimate rows
+        # already passed the pre-behavior filter — local_omit may have
+        # NULLed their aggregates since, and an omitted row must stay
+        # in the output with NULLs, not vanish (review finding)
+        exact_rows = [r for r in rows if r.get("from_base")]
+        kept_exact = _filter_having(exact_rows, having, items, agg_items)
+        dropped = {id(r) for r in exact_rows} - {id(r)
+                                                 for r in kept_exact}
+        rows = [r for r in rows if id(r) not in dropped]
     return _finalize(rows, items, est.proto, outer_orders, limit_n,
                      z=est.z)
 
@@ -239,14 +246,27 @@ def _filter_having(rows: List[dict], having: ast.Expr, items,
     references / and-or-not / comparisons / + - * / raise
     AQPUnsupported with a clear message."""
 
+    def norm(e):
+        """Case-normalized copy: identifier resolution is
+        case-insensitive engine-wide, so HAVING sum(DELAY) must match
+        select-list sum(delay) (review finding)."""
+        if isinstance(e, ast.Col) and e.name:
+            return dataclasses.replace(e, name=e.name.lower())
+        return e.map_children(norm)
+
+    agg_norm = [norm(a.expr) for a in agg_items]
+    grp_norm = [(it, norm(it.expr)) for it in items
+                if it.kind == "group"]
+
     def value(e, rec):
         if isinstance(e, ast.Alias):
             return value(e.child, rec)
-        for j, a in enumerate(agg_items):
-            if e == a.expr:
+        en = norm(e)
+        for j, an in enumerate(agg_norm):
+            if en == an:
                 return rec["est"][j]
-        for it in items:
-            if it.kind == "group" and e == it.expr:
+        for it, gn in grp_norm:
+            if en == gn:
                 return rec["groups"][it.group_idx]
         if isinstance(e, ast.Col):
             want = (e.name or "").lower()
@@ -547,8 +567,6 @@ def _combine_strata(pieces_a, agg_items, n_of, w_of, ng: int
     fine at 4 groups, pathological at 100k (round-4 verdict task 7).
     The math is identical: stratified Horvitz-Thompson totals with
     per-stratum sample variances, avg as a self-normalized ratio."""
-    import numpy as np
-
     nrows = sum(r.num_rows for r in pieces_a)
     if nrows == 0:
         out_rows: List[dict] = []

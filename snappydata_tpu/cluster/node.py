@@ -55,6 +55,12 @@ class _MemberNode:
         self.flight = None
         self.membership: Optional[LocatorClient] = None
 
+    @property
+    def flight_address(self) -> str:
+        """Every member answers queries over Flight (the lead IS an
+        engine too) — failover clients pin to a tier via this."""
+        return f"{self.host}:{self.flight.port}"
+
     def _start_flight(self) -> int:
         from snappydata_tpu.cluster.flight_server import SnappyFlightServer
 
@@ -125,10 +131,6 @@ class ServerNode(_MemberNode):
         port = self._start_flight()
         self._join(port)
         return self
-
-    @property
-    def flight_address(self) -> str:
-        return f"{self.host}:{self.flight.port}"
 
 
 class LeadNode(_MemberNode):

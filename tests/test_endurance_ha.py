@@ -1,0 +1,254 @@
+"""Sustained-load HA + client-scale endurance tier (round-4 verdict
+task 8; ref: hydra HA batteries northWindHA.bt + the "thousands of
+concurrent clients" envelope, docs/architecture/
+cluster_architecture.md:30-33):
+
+- lead HA: a stream of client queries pinned to the LEAD tier while
+  the primary lead dies mid-stream — the standby must take the
+  __PRIMARY_LEADER_LS lock and ZERO client requests may fail after
+  their failover retry;
+- eviction under pressure: sustained ingest far beyond the host
+  budget with concurrent exact-value queries — evicted batches reload
+  transparently and every answer stays exact;
+- client scale: >= 64 concurrent Flight clients hammering one server
+  with latency sanity asserted.
+
+Each battery runs a SHORT profile in the slow tier and the LONG
+profile under `-m endurance`.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession, config
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.cluster import LeadNode, LocatorNode, ServerNode
+from snappydata_tpu.cluster.client import SnappyClient
+
+
+# ---------------------------------------------------------------------
+# 1) sustained-load lead HA
+# ---------------------------------------------------------------------
+
+def _lead_ha_battery(duration_s: float, n_clients: int):
+    catalog = Catalog()
+    locator = LocatorNode().start()
+    data_sess = SnappySession(catalog=catalog)
+    server = ServerNode(locator.address, data_sess).start()
+    lead1 = LeadNode(locator.address, SnappySession(catalog=catalog),
+                     lease_s=0.5).start(wait_for_primary=True)
+    lead2 = LeadNode(locator.address, SnappySession(catalog=catalog),
+                     lease_s=0.5).start()
+    assert lead1.is_primary and not lead2.is_primary
+
+    n = 30_000
+    rng = np.random.default_rng(13)
+    v = np.round(rng.random(n) * 100, 3)
+    data_sess.sql("CREATE TABLE ha_t (k BIGINT, v DOUBLE) USING column")
+    data_sess.insert_arrays("ha_t", [np.arange(n, dtype=np.int64), v])
+    exact = (n, float(v.sum()))
+
+    lead_addrs = [lead1.flight_address, lead2.flight_address]
+    stop = threading.Event()
+    lock = threading.Lock()
+    stats = {"ok": 0, "retries": 0, "failures": []}
+
+    def worker(wid: int):
+        client = SnappyClient(address=lead_addrs[0])
+        while not stop.is_set():
+            # a client request may retry across the lead tier, but must
+            # never ultimately fail
+            done = False
+            for attempt in range(6):
+                try:
+                    t = client.sql("SELECT count(*), sum(v) FROM ha_t")
+                    got = (t.column(0)[0].as_py(), t.column(1)[0].as_py())
+                    assert got[0] == exact[0], got
+                    assert abs(got[1] - exact[1]) <= 1e-6 * exact[1]
+                    done = True
+                    break
+                except AssertionError:
+                    raise
+                except Exception:
+                    with lock:
+                        stats["retries"] += 1
+                    try:
+                        client.close()
+                    except Exception:
+                        pass
+                    # failover: next lead in the list
+                    client = SnappyClient(
+                        address=lead_addrs[(attempt + 1) % 2])
+                    time.sleep(0.05)
+            with lock:
+                if done:
+                    stats["ok"] += 1
+                else:
+                    stats["failures"].append(wid)
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n_clients)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(duration_s / 3)
+        # kill the PRIMARY lead mid-stream
+        lead1.stop()
+        deadline = time.time() + 15
+        while not lead2.is_primary and time.time() < deadline:
+            time.sleep(0.05)
+        assert lead2.is_primary, "standby never took the primary lock"
+        time.sleep(2 * duration_s / 3)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        lead2.stop()
+        server.stop()
+        locator.stop()
+
+    assert not stats["failures"], stats
+    assert stats["ok"] > n_clients * 2, stats
+    # the kill must actually have been observed by the stream
+    assert stats["retries"] > 0, stats
+    return stats
+
+
+@pytest.mark.slow
+def test_lead_ha_under_load_short():
+    _lead_ha_battery(duration_s=6.0, n_clients=4)
+
+
+@pytest.mark.endurance
+def test_lead_ha_under_load_long():
+    stats = _lead_ha_battery(duration_s=45.0, n_clients=8)
+    assert stats["ok"] > 100
+
+
+# ---------------------------------------------------------------------
+# 2) eviction under sustained pressure
+# ---------------------------------------------------------------------
+
+def _eviction_pressure_battery(waves: int, rows_per_wave: int):
+    from snappydata_tpu.observability.metrics import global_registry
+
+    old = config.global_properties().host_store_bytes
+    # budget far below the data volume: cold batches must spill to
+    # memmaps and reload on every full-scan query
+    config.global_properties().host_store_bytes = 512 * 1024
+    s = SnappySession(catalog=Catalog())
+    try:
+        s.sql("CREATE TABLE ev_t (k BIGINT, v DOUBLE) USING column "
+              "OPTIONS (column_batch_rows '4096', "
+              "column_max_delta_rows '4096')")
+        total = 0
+        checksum = 0.0
+        for w in range(waves):
+            k = np.arange(total, total + rows_per_wave, dtype=np.int64)
+            v = np.full(rows_per_wave, float(w + 1))
+            s.insert_arrays("ev_t", [k, v])
+            total += rows_per_wave
+            checksum += float(v.sum())
+            if w % 3 == 1:
+                s.sql("UPDATE ev_t SET v = v + 0.0 WHERE k % 97 = 3")
+            got = s.sql("SELECT count(*), sum(v) FROM ev_t").rows()[0]
+            assert got[0] == total, (w, got)
+            assert got[1] == pytest.approx(checksum, rel=1e-9), w
+        # pressure must actually have evicted something
+        evictions = global_registry().counter("host_batches_spilled")
+        assert evictions > 0, "budget never forced a spill"
+        data_bytes = total * 16
+        assert data_bytes > 4 * config.global_properties().host_store_bytes
+        return evictions, total
+    finally:
+        config.global_properties().host_store_bytes = old
+        s.stop()
+
+
+@pytest.mark.slow
+def test_eviction_pressure_short():
+    _eviction_pressure_battery(waves=8, rows_per_wave=20_000)
+
+
+@pytest.mark.endurance
+def test_eviction_pressure_long():
+    _eviction_pressure_battery(waves=30, rows_per_wave=40_000)
+
+
+# ---------------------------------------------------------------------
+# 3) concurrent Flight client scale
+# ---------------------------------------------------------------------
+
+def _client_scale_battery(n_clients: int, duration_s: float,
+                          p95_limit_s: float):
+    catalog = Catalog()
+    locator = LocatorNode().start()
+    sess = SnappySession(catalog=catalog)
+    server = ServerNode(locator.address, sess).start()
+    n = 50_000
+    rng = np.random.default_rng(7)
+    v = rng.random(n)
+    sess.sql("CREATE TABLE cs_t (k BIGINT, v DOUBLE) USING column")
+    sess.insert_arrays("cs_t", [np.arange(n, dtype=np.int64), v])
+    exact_n = n
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    lat: list = []
+    failures: list = []
+
+    def worker(wid):
+        try:
+            client = SnappyClient(address=server.flight_address)
+            # vary the predicate so plans rebind, not just replay
+            while not stop.is_set():
+                t0 = time.time()
+                t = client.sql(
+                    "SELECT count(*) FROM cs_t WHERE k >= ?",
+                    params=[wid % 100])
+                dt = time.time() - t0
+                got = t.column(0)[0].as_py()
+                assert got == exact_n - (wid % 100), (wid, got)
+                with lock:
+                    lat.append(dt)
+            client.close()
+        except Exception as e:  # pragma: no cover - failure reporting
+            with lock:
+                failures.append((wid, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n_clients)]
+    try:
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+        server.stop()
+        locator.stop()
+        sess.stop()
+
+    assert not failures, failures[:5]
+    assert len(lat) >= n_clients, len(lat)
+    lat.sort()
+    p95 = lat[int(len(lat) * 0.95)]
+    assert p95 < p95_limit_s, (p95, len(lat))
+    return len(lat), p95
+
+
+@pytest.mark.slow
+def test_client_scale_short():
+    # 16 concurrent clients in the slow tier keeps the suite fast
+    _client_scale_battery(n_clients=16, duration_s=6.0, p95_limit_s=10.0)
+
+
+@pytest.mark.endurance
+def test_client_scale_64():
+    done, p95 = _client_scale_battery(n_clients=64, duration_s=30.0,
+                                      p95_limit_s=15.0)
+    assert done > 200
