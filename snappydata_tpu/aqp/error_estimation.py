@@ -129,7 +129,7 @@ def _unwrap_aggregate(stmt: ast.Query):
                                                    ast.Aggregate):
         having = node.condition
         node = node.child
-    if not isinstance(node, ast.Aggregate) or node.grouping_sets:
+    if not isinstance(node, ast.Aggregate):
         raise AQPUnsupported(
             "error estimation applies to plain aggregate queries "
             "(SUM/AVG/COUNT [GROUP BY ...]) over a sampled table")
@@ -181,6 +181,9 @@ def execute_error_query_distributed(ds, stmt: ast.Query):
 def _execute_with_ctx(ctx: _ExecCtx, stmt: ast.Query,
                       agg: ast.Aggregate, outer_orders, limit_n,
                       having=None):
+    if agg.grouping_sets:
+        return _execute_grouping_sets(ctx, stmt, agg, outer_orders,
+                                      limit_n, having)
     clause = stmt.with_error
     samples: Dict[str, List[str]] = {}
     for info in ctx.catalog.list_tables():
@@ -236,6 +239,97 @@ def _execute_with_ctx(ctx: _ExecCtx, stmt: ast.Query,
         rows = [r for r in rows if id(r) not in dropped]
     return _finalize(rows, items, est.proto, outer_orders, limit_n,
                      z=est.z)
+
+
+def _execute_grouping_sets(ctx: _ExecCtx, stmt: ast.Query,
+                           agg: ast.Aggregate, outer_orders, limit_n,
+                           having):
+    """WITH ERROR over ROLLUP / CUBE / GROUPING SETS: one estimation
+    per grouping set — the same per-set expansion the exact engine's
+    analyzer performs (_expand_grouping_sets) — with absent keys
+    NULL-padded, then the union sorted/limited once. Error bounds are
+    per-variant, exactly as if each set ran as its own query."""
+    from snappydata_tpu.sql.analyzer import _expr_name
+
+    pieces: List[Tuple[Result, List[int]]] = []
+    dtypes_of: Dict[int, T.DataType] = {}
+    for sset in agg.grouping_sets:
+        keep = set(sset)
+
+        def absent_idx(e):
+            b = e.child if isinstance(e, ast.Alias) else e
+            for gi, g in enumerate(agg.group_exprs):
+                if b == g and gi not in keep:
+                    return gi
+            return None
+
+        kept_pos = [i for i, e in enumerate(agg.agg_exprs)
+                    if absent_idx(e) is None]
+
+        def repl(e):
+            """Absent group refs read NULL — including INSIDE kept
+            aggregates: count(carrier) in the () variant must count
+            NULLs (i.e. zero), exactly like the exact analyzer's
+            expansion (review finding). This runs PRE-analysis on raw
+            exprs (the exact path's _expand_grouping runs on resolved
+            plans with typed Cast(NULL) — here the engine's untyped
+            NULL literal lowers fine and _filter_having evaluates it),
+            which is why the two expansions can't share code."""
+            for gi, g in enumerate(agg.group_exprs):
+                if e == g and gi not in keep:
+                    return ast.Lit(None)
+            return e.map_children(repl)
+
+        v_agg = dataclasses.replace(
+            agg, grouping_sets=None,
+            group_exprs=tuple(agg.group_exprs[i] for i in sset),
+            agg_exprs=tuple(repl(agg.agg_exprs[i]) for i in kept_pos))
+        v_having = repl(having) if having is not None else None
+        res = _execute_with_ctx(ctx, stmt, v_agg, None, None, v_having)
+        pieces.append((res, kept_pos))
+        for ci, p in enumerate(kept_pos):
+            dtypes_of.setdefault(p, res.dtypes[ci])
+
+    arity = len(agg.agg_exprs)
+    names = [_expr_name(e) for e in agg.agg_exprs]
+    dtypes = [dtypes_of.get(i, T.STRING) for i in range(arity)]
+    cols: List[List[np.ndarray]] = [[] for _ in range(arity)]
+    nulls: List[List[np.ndarray]] = [[] for _ in range(arity)]
+    for res, kept_pos in pieces:
+        nrows = res.num_rows
+        kept = dict(zip(kept_pos, range(len(kept_pos))))
+        for i in range(arity):
+            ci = kept.get(i)
+            if ci is None:  # absent key: all-NULL pad
+                dt = dtypes[i]
+                fill = np.array([""] * nrows, dtype=object) \
+                    if dt.name == "string" \
+                    else np.zeros(nrows, dtype=dt.np_dtype)
+                cols[i].append(fill)
+                nulls[i].append(np.ones(nrows, dtype=bool))
+            else:
+                cols[i].append(np.asarray(res.columns[ci]))
+                nulls[i].append(np.asarray(res.nulls[ci])
+                                if res.nulls[ci] is not None
+                                else np.zeros(nrows, dtype=bool))
+    out_cols, out_nulls = [], []
+    for i in range(arity):
+        parts = cols[i]
+        if len({p.dtype for p in parts}) > 1:
+            parts = [p.astype(object) for p in parts]
+        out_cols.append(np.concatenate(parts) if parts
+                        else np.zeros(0, dtype=dtypes[i].np_dtype))
+        nm = np.concatenate(nulls[i]) if nulls[i] \
+            else np.zeros(0, dtype=bool)
+        out_nulls.append(nm if nm.any() else None)
+    res = Result(names, out_cols, out_nulls, dtypes)
+    if outer_orders:
+        res = _host_sort(res, outer_orders)
+    if limit_n is not None:
+        res = Result(res.names, [c[:limit_n] for c in res.columns],
+                     [m[:limit_n] if m is not None else None
+                      for m in res.nulls], res.dtypes)
+    return res
 
 
 def _filter_having(rows: List[dict], having: ast.Expr, items,

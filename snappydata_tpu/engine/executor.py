@@ -1237,14 +1237,31 @@ class Compiler:
             # count op appended below.
             pg_bytes = _pg.base_vmem_bytes() \
                 + _pg.op_vmem_bytes("count", num_groups + 1)
+            pg_masks = {id(valid)}  # the gvalid count op's mask
+            pg_vals: set = set()
+            # slots over the SAME argument expression (sum(x)+min(x),
+            # or avg's sum+count beside an explicit sum) must hand
+            # grouped_reduce the same array OBJECTS — its dedup is
+            # id()-keyed, and each slot's emit produces fresh traced
+            # arrays (review finding: the value dedup never fired)
+            pg_vw: Dict[object, tuple] = {}
             fused = []  # (slot_idx, kind, values|None, mask)
 
             def try_fuse(kind, v, w):
                 nonlocal pg_bytes
-                cost = _pg.op_vmem_bytes(kind, num_groups + 1)
+                # grouped_reduce dedups inputs by identity: shared
+                # mask/value blocks (Q1: every slot shares the mask)
+                # cost their VMEM once
+                cost = _pg.op_vmem_bytes(
+                    kind, num_groups + 1,
+                    shared_mask=id(w) in pg_masks,
+                    shared_value=v is not None and id(v) in pg_vals)
                 if pg_bytes + cost > _pg.VMEM_BUDGET:
                     return False
                 pg_bytes += cost
+                pg_masks.add(id(w))
+                if v is not None:
+                    pg_vals.add(id(v))
                 fused.append((len(slot_arrays), kind, v, w))
                 slot_arrays.append(None)
                 return True
@@ -1264,10 +1281,15 @@ class Compiler:
                 if use_pg and (
                         kind == "count"
                         or (kind in ("sum", "min", "max")
-                            and v.dtype == jnp.float32)) \
-                        and try_fuse(kind,
-                                     None if kind == "count" else v, w):
-                    continue
+                            and v.dtype == jnp.float32)):
+                    hit = pg_vw.get(arg)
+                    if hit is not None:
+                        v, w = hit
+                    else:
+                        pg_vw[arg] = (v, w)
+                    if try_fuse(kind,
+                                None if kind == "count" else v, w):
+                        continue
                 if kind == "count":
                     slot_arrays.append(seg("count", w))
                 elif kind == "count_distinct":

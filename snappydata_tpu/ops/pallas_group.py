@@ -43,7 +43,7 @@ JVM double accumulators.
 from __future__ import annotations
 
 import functools
-from typing import List, Optional, Sequence, Tuple
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -78,13 +78,17 @@ def base_vmem_bytes() -> int:
     return _BLOCK_ROWS * _LANES * 4 * 2
 
 
-def op_vmem_bytes(kind: str, num_segments: int) -> int:
+def op_vmem_bytes(kind: str, num_segments: int,
+                  shared_mask: bool = False,
+                  shared_value: bool = False) -> int:
     """Estimated VMEM this op adds: its input blocks (value f32 + mask
     bool, double-buffered) and its [G, 8, 128] f32 carries (two for
-    Kahan sums)."""
+    Kahan sums). `shared_mask`/`shared_value`: the op reuses an
+    already-counted input array — grouped_reduce deduplicates inputs
+    by identity, so the block costs nothing extra."""
     blk = _BLOCK_ROWS * _LANES
-    mask = blk * 1 * 2
-    val = 0 if kind == "count" else blk * 4 * 2
+    mask = 0 if shared_mask else blk * 1 * 2
+    val = 0 if (kind == "count" or shared_value) else blk * 4 * 2
     carry = (num_segments * _SUBLANES * _LANES * 4
              * (2 if kind == "sum" else 1))
     return mask + val + carry
@@ -95,9 +99,13 @@ def _outs_of(kind: str) -> int:
 
 
 @functools.lru_cache(maxsize=64)
-def _make_kernel(kinds: Tuple[str, ...], G: int):
+def _make_kernel(spec: Tuple[Tuple[str, Optional[int], int], ...],
+                 n_in: int, G: int):
+    """spec: one (kind, value_input_index, mask_input_index) per op —
+    indices point into the DEDUPLICATED input list, so ops sharing a
+    value or mask array (all of Q1's slots share one validity mask)
+    read it from HBM once per block instead of once per op."""
     steps = _BLOCK_ROWS // _SUBLANES
-    n_in = sum(1 if k == "count" else 2 for k in kinds)
 
     def kernel(gidx_ref, *refs):
         in_refs = refs[:n_in]
@@ -108,7 +116,7 @@ def _make_kernel(kinds: Tuple[str, ...], G: int):
         @pl.when(pid == 0)
         def _init():
             oi = 0
-            for k in kinds:
+            for k, _vi, _mi in spec:
                 if k == "sum":
                     out_refs[oi][...] = jnp.zeros(shape, jnp.float32)
                     out_refs[oi + 1][...] = jnp.zeros(shape, jnp.float32)
@@ -133,22 +141,30 @@ def _make_kernel(kinds: Tuple[str, ...], G: int):
             sl = pl.ds(i * _SUBLANES, _SUBLANES)
             gblk = gidx_ref[sl, :]
             gm = gblk[None].astype(jnp.int32) == garange  # [G, 8, 128]
+            # one VMEM load + one group-select per UNIQUE input block
+            loaded = {}
+            sels = {}
+
+            def sel_of(mi):
+                if mi not in sels:
+                    sels[mi] = gm & in_refs[mi][sl, :][None]
+                return sels[mi]
+
+            def val_of(vi):
+                if vi not in loaded:
+                    loaded[vi] = in_refs[vi][sl, :]
+                return loaded[vi]
+
             new = []
-            ii = 0
             oi = 0
-            for k in kinds:
+            for k, vi, mi in spec:
+                sel = sel_of(mi)
                 if k == "count":
-                    m = in_refs[ii][sl, :]
-                    ii += 1
-                    sel = gm & m[None]
                     new.append(carry[oi]
                                + jnp.where(sel, 1.0, 0.0).astype(jnp.float32))
                     oi += 1
                     continue
-                v = in_refs[ii][sl, :]
-                m = in_refs[ii + 1][sl, :]
-                ii += 2
-                sel = gm & m[None]
+                v = val_of(vi)
                 if k == "sum":
                     s, c = carry[oi], carry[oi + 1]
                     vv = jnp.where(sel, v[None], 0.0)
@@ -177,16 +193,18 @@ def _make_kernel(kinds: Tuple[str, ...], G: int):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("kinds", "G", "interpret"))
-def _grouped_call(gidx2d, ins, kinds: Tuple[str, ...], G: int,
-                  interpret: bool):
+                   static_argnames=("spec", "G", "interpret"))
+def _grouped_call(gidx2d, ins,
+                  spec: Tuple[Tuple[str, Optional[int], int], ...],
+                  G: int, interpret: bool):
     rows = gidx2d.shape[0]
     nblocks = rows // _BLOCK_ROWS
     blk = pl.BlockSpec((_BLOCK_ROWS, _LANES), lambda i: (i, 0))
     out_blk = pl.BlockSpec((G, _SUBLANES, _LANES), lambda i: (0, 0, 0))
+    kinds = tuple(k for k, _, _ in spec)
     n_out = sum(_outs_of(k) for k in kinds)
     outs = pl.pallas_call(
-        _make_kernel(kinds, G),
+        _make_kernel(spec, len(ins), G),
         grid=(nblocks,),
         in_specs=[blk] * (1 + len(ins)),
         out_specs=(out_blk,) * n_out,
@@ -250,13 +268,28 @@ def grouped_reduce(ops: Sequence[Tuple[str, Optional[jnp.ndarray],
 
     # padded rows carry mask=False, so their gidx value is irrelevant
     gidx2d = prep(gidx, jnp.int32)
-    ins = []
-    for k, v, m in ops:
-        if k != "count":
-            ins.append(prep(v, jnp.float32))
-        ins.append(prep(m, jnp.bool_))
+    # deduplicate inputs by source-array identity: slots that share a
+    # validity mask (Q1: all of them) or a value column (sum(x)+min(x))
+    # cross HBM once per block, not once per op
+    ins: List[jnp.ndarray] = []
+    index_of: Dict[Tuple[int, str], int] = {}
 
-    outs = _grouped_call(gidx2d, tuple(ins), kinds, num_segments,
+    def intern(arr, role: str, dtype) -> int:
+        key = (id(arr), role)
+        got = index_of.get(key)
+        if got is None:
+            got = len(ins)
+            ins.append(prep(arr, dtype))
+            index_of[key] = got
+        return got
+
+    spec = []
+    for k, v, m in ops:
+        vi = None if k == "count" else intern(v, "v", jnp.float32)
+        mi = intern(m, "m", jnp.bool_)
+        spec.append((k, vi, mi))
+
+    outs = _grouped_call(gidx2d, tuple(ins), tuple(spec), num_segments,
                          interpret)
     return list(outs)
 

@@ -430,3 +430,24 @@ def test_100k_group_with_error_completes_fast():
         exact = float(v[g == gi].sum())
         assert sv == pytest.approx(exact, rel=1e-9), gi
     s.stop()
+
+
+def test_rollup_with_error(sess):
+    """WITH ERROR over ROLLUP: one estimation per grouping set, absent
+    keys NULL, bounds per variant (round-5 scope widening; the exact
+    engine expands grouping sets the same way)."""
+    s, carriers, delay, _ = sess
+    rows = s.sql(
+        "SELECT carrier, sum(delay) AS sd, absolute_error(sd) AS ae "
+        "FROM airline GROUP BY ROLLUP(carrier) WITH ERROR 0.5").rows()
+    per_carrier = [r for r in rows if r[0] is not None]
+    grand = [r for r in rows if r[0] is None]
+    assert len(per_carrier) == 4 and len(grand) == 1
+    assert all(r[2] is not None and r[2] >= 0 for r in rows)
+    # the grand total estimate is consistent with the per-group ones
+    assert grand[0][1] == pytest.approx(
+        sum(r[1] for r in per_carrier), rel=0.2)
+    # plain rollup over the base (no sample registered) path also works
+    plain = s.sql("SELECT month_, count(*) FROM airline "
+                  "GROUP BY ROLLUP(month_)").rows()
+    assert len(plain) == 13

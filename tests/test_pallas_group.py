@@ -71,6 +71,86 @@ def test_kernel_padding_and_empty_groups():
             assert int(outs[1][g]) == int(sel.sum())
 
 
+def test_input_dedup_shares_masks_and_values(monkeypatch):
+    """Ops sharing a mask (Q1: every slot) or a value array cross the
+    host->VMEM boundary ONCE: the kernel spec must reference one
+    deduplicated input, not per-op copies."""
+    from snappydata_tpu.ops import pallas_group as pg
+
+    captured = {}
+    orig = pg._grouped_call
+
+    def spy(gidx2d, ins, spec, G, interpret):
+        captured["n_ins"] = len(ins)
+        captured["spec"] = spec
+        return orig(gidx2d, ins, spec, G, interpret)
+
+    monkeypatch.setattr(pg, "_grouped_call", spy)
+    rng = np.random.default_rng(3)
+    n = 4096
+    v = jnp.asarray((rng.random(n) * 10).astype(np.float32))
+    m = jnp.asarray(np.ones(n, dtype=bool))
+    gidx = jnp.asarray(rng.integers(0, 3, n))
+    outs = pg.grouped_reduce(
+        [("sum", v, m), ("count", None, m), ("min", v, m),
+         ("max", v, m)], gidx, 3)
+    # one value array + one mask array — not 3 values + 4 masks
+    assert captured["n_ins"] == 2, captured
+    kinds = [s[0] for s in captured["spec"]]
+    assert kinds == ["sum", "count", "min", "max"]
+    assert len({s[2] for s in captured["spec"]}) == 1   # shared mask
+    vis = {s[1] for s in captured["spec"] if s[1] is not None}
+    assert len(vis) == 1                                # shared values
+    exact = np.asarray(v, dtype=np.float64)
+    g = np.asarray(gidx)
+    for gi in range(3):
+        assert float(outs[0][gi]) == pytest.approx(
+            exact[g == gi].sum(), rel=1e-7)
+        assert int(outs[1][gi]) == int((g == gi).sum())
+
+
+def test_executor_interns_shared_arg_arrays(monkeypatch):
+    """Through the ENGINE, slots over the same argument (sum/min/max/
+    avg of one column + count(*)) must reach grouped_reduce as shared
+    array objects so the id()-keyed dedup fires (review finding: each
+    slot's emit produced fresh arrays and the dedup never triggered)."""
+    from snappydata_tpu.ops import pallas_group as pg
+
+    captured = {}
+    orig = pg._grouped_call
+
+    def spy(gidx2d, ins, spec, G, interpret):
+        captured["n_ins"] = len(ins)
+        captured["spec"] = spec
+        return orig(gidx2d, ins, spec, G, interpret)
+
+    monkeypatch.setattr(pg, "_grouped_call", spy)
+    old = config.global_properties().pallas_group_reduce
+    old_f64 = config.global_properties().decimal_as_float64
+    config.global_properties().decimal_as_float64 = False
+    config.global_properties().pallas_group_reduce = True
+    try:
+        s = SnappySession(catalog=Catalog())
+        s.sql("CREATE TABLE sh (k STRING, x DOUBLE) USING column")
+        rng = np.random.default_rng(8)
+        n = 20_000
+        s.insert_arrays("sh", [
+            rng.choice(np.array(["a", "b"], dtype=object), n),
+            np.round(rng.random(n) * 100, 2)])
+        rows = s.sql("SELECT k, sum(x), min(x), max(x), avg(x), "
+                     "count(*) FROM sh GROUP BY k ORDER BY k").rows()
+        assert len(rows) == 2
+        # one value block (x) + one mask block — not one pair per slot
+        assert captured["n_ins"] == 2, captured
+        assert len({sp[2] for sp in captured["spec"]}) == 1
+        assert len({sp[1] for sp in captured["spec"]
+                    if sp[1] is not None}) == 1
+        s.stop()
+    finally:
+        config.global_properties().pallas_group_reduce = old
+        config.global_properties().decimal_as_float64 = old_f64
+
+
 def _q1_sessions():
     """Two identical sessions over a Q1-shaped table; one runs the
     fused pallas grouped path, one the _seg_reduce baseline."""
