@@ -763,11 +763,19 @@ class Compiler:
             self._prune_cursor += 1
             used = sorted(pruned) if pruned is not None \
                 else list(range(len(info.schema)))
+            from snappydata_tpu.storage.table_store import RowTableData
+
             for uci in used:
                 fdt = info.schema.fields[uci].dtype
                 if fdt.name in ("map", "struct") or (
                         fdt.name == "array"
-                        and not T.is_numeric(fdt.element)):
+                        and not T.is_numeric(fdt.element)
+                        and not (fdt.element.name == "string"
+                                 and not isinstance(info.data,
+                                                    RowTableData))):
+                    # numeric AND string-element arrays have device
+                    # plates (string elements ride as dictionary
+                    # codes); everything else stays host
                     raise CompileError(
                         "complex-typed columns evaluate on the host path")
             rel_idx = len(self.relations)
@@ -1546,10 +1554,18 @@ class _AuxView:
 
 def _dict_provider(info, ci):
     f = info.schema.fields[ci]
-    if f.dtype.name != "string":
-        return None
     from snappydata_tpu.storage.table_store import RowTableData
 
+    if isinstance(f.dtype, T.ArrayType) and f.dtype.element.name == \
+            "string" and not isinstance(info.data, RowTableData):
+        # ARRAY<STRING> plates carry element CODES: the provider is the
+        # element dictionary (element_at decodes through it; contains
+        # literals resolve to codes against it)
+        from snappydata_tpu.storage.device import array_element_dictionary
+
+        return lambda: array_element_dictionary(info.data, ci)
+    if f.dtype.name != "string":
+        return None
     if isinstance(info.data, RowTableData):
         return lambda: info.data.string_dict(ci)
     return lambda: info.data.dictionary(ci)

@@ -889,6 +889,18 @@ class ExprBuilder:
 
         return run_cast
 
+    def _arg_array_col(self, e: ast.Expr):
+        """(ArrayType, column ordinal) of an argument expression that is
+        (an alias of) a raw array column, else (None, None)."""
+        if isinstance(e, ast.Alias):
+            return self._arg_array_col(e.child)
+        if isinstance(e, ast.Col):
+            dt = e.dtype if e.dtype is not None else \
+                self.col_types.get(e.index)
+            if isinstance(dt, T.ArrayType):
+                return dt, e.index
+        return None, None
+
     def _arg_array_type(self, e: ast.Expr):
         """Static ArrayType of an argument expression, else None."""
         if isinstance(e, ast.Col):
@@ -918,8 +930,15 @@ class ExprBuilder:
         if name in ARRAY_DEVICE_FUNCS and e.args:
             t0 = self._arg_array_type(e.args[0])
             if t0 is not None:
-                if not T.is_numeric(t0.element):
-                    raise CompileError("non-numeric array op: host path")
+                is_str_elem = t0.element.name == "string"
+                _adt, a_ci = self._arg_array_col(e.args[0])
+                elem_dict = self.dict_getters.get(a_ci) \
+                    if a_ci is not None else None
+                if not T.is_numeric(t0.element) and not (
+                        is_str_elem and elem_dict is not None):
+                    raise CompileError(
+                        "array element type has no device plates: "
+                        "host path")
                 arr_run = args[0]
                 if name == "size":
                     def run_size(rt: Runtime) -> DVal:
@@ -944,9 +963,58 @@ class ExprBuilder:
                             enul, safe[..., None], axis=-1)[..., 0]
                         bad = (pos_b < 0) | (pos_b >= lengths) | el_null
                         nl = _or_null(_or_null(d.null, iv.null), bad)
-                        return DVal(out, nl, t0.element)
+                        # string elements are CODES: the DVal carries
+                        # the element dictionary so projections decode
+                        # (executor run_project picks dv.dictionary up)
+                        return DVal(out, nl, t0.element,
+                                    dictionary=elem_dict
+                                    if is_str_elem else None)
 
                     return run_elem
+
+                if is_str_elem:
+                    # array_contains(a, 'lit'): resolve the needle to
+                    # its element-dictionary CODE at bind time (absent
+                    # value -> -1, which no code matches)
+                    if not self._is_literalish(e.args[1]):
+                        raise CompileError(
+                            "array_contains over a string array needs "
+                            "a literal needle: host path")
+                    get_lit = (lambda params:
+                               self._param_value(e.args[1], params))
+
+                    def build_code(params, getter=elem_dict):
+                        # [code, needle_is_null]: a NULL needle makes
+                        # the whole result NULL (matching the numeric
+                        # path's null propagation — str(None) would
+                        # have matched the literal string 'None')
+                        lit = get_lit(params)
+                        if lit is None:
+                            return np.array([-1, 1], np.int32)
+                        hit = np.flatnonzero(
+                            np.asarray(getter(), dtype=object)
+                            == str(lit))
+                        return np.array(
+                            [hit[0] if hit.size else -1, 0], np.int32)
+
+                    aux_i = self._register_aux(build_code)
+
+                    def run_contains_str(rt: Runtime) -> DVal:
+                        d = arr_run(rt)
+                        vals, lengths, enul = d.value
+                        L = vals.shape[-1]
+                        code = rt.aux[aux_i][0]
+                        needle_null = rt.aux[aux_i][1] == 1
+                        eq = vals == code
+                        in_range = (jnp.arange(L) < lengths[..., None]) \
+                            & ~enul
+                        out = (eq & in_range).any(axis=-1)
+                        null = _or_null(
+                            d.null, jnp.broadcast_to(needle_null,
+                                                     out.shape))
+                        return DVal(out, null, T.BOOLEAN)
+
+                    return run_contains_str
 
                 def run_contains(rt: Runtime) -> DVal:
                     d = arr_run(rt)

@@ -170,11 +170,15 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
     nulls: Dict[int, Optional[jnp.ndarray]] = {}
     for ci in col_indices:
         f = schema.fields[ci]
-        if isinstance(f.dtype, T.ArrayType) and T.is_numeric(f.dtype.element):
-            # fixed-width device layout for numeric arrays: value plates
-            # [B, C, L] + lengths [B, C] + element-null bits — feeds the
-            # device lowering of size/element_at/array_contains (ref:
-            # SerializedArray fixed-width fast path)
+        if isinstance(f.dtype, T.ArrayType) and (
+                T.is_numeric(f.dtype.element)
+                or f.dtype.element.name == "string"):
+            # fixed-width device layout for numeric AND string arrays:
+            # value plates [B, C, L] (string elements ride as int32
+            # dictionary codes, like scalar string columns) + lengths
+            # [B, C] + element-null bits — feeds the device lowering of
+            # size/element_at/array_contains (ref: SerializedArray
+            # fixed-width fast path)
             key = ("acol", ci)
             if key not in cache:
                 cache[key] = _build_array_column(
@@ -327,11 +331,22 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                        cache.get("nrows", manifest.total_rows()), nulls)
 
 
+def array_element_dictionary(data, ci: int) -> np.ndarray:
+    """Element dictionary of an ARRAY<STRING> column — delegates to the
+    table's APPEND-ONLY intern store (same protocol as scalar string
+    dictionaries: codes never shift, so plates from any pinned manifest
+    version decode correctly against every later dictionary read)."""
+    return data.array_element_dictionary(ci)
+
+
 def _build_array_column(data, manifest, views, row_chunks, ci, f, b, cap,
                         _place):
-    """Numeric ARRAY column → ((values [b,cap,L], lengths [b,cap],
-    element_nulls [b,cap,L]), nan-stats, row-null mask)."""
-    edt = f.dtype.element.device_dtype()
+    """Numeric/string ARRAY column → ((values [b,cap,L], lengths
+    [b,cap], element_nulls [b,cap,L]), nan-stats, row-null mask).
+    String elements encode as int32 dictionary codes interned into the
+    table's append-only element dictionary — size/element_at/
+    array_contains then run on device exactly like their numeric forms."""
+    is_str = f.dtype.element.name == "string"
     sources = []
     for i, v in enumerate(views):
         sources.append((i, v.decoded_column(ci), v.null_mask(ci)))
@@ -342,6 +357,17 @@ def _build_array_column(data, manifest, views, row_chunks, ci, f, b, cap,
         if manifest.row_nulls and manifest.row_nulls[ci] is not None:
             rn = manifest.row_nulls[ci][pos:pos + take]
         sources.append((len(views) + j, src, rn))
+    if is_str:
+        edt = np.dtype(np.int32)
+        # intern THIS pinned manifest's cells (append-only, cheap once
+        # hot) so the bind is self-sufficient across recovery and
+        # concurrent mutation — a review finding killed the previous
+        # sorted-per-version dictionary whose codes shifted under writes
+        lookup: Dict = {}
+        for _bi, dec, _nm in sources:
+            lookup = data.intern_array_elements(ci, dec)
+    else:
+        edt = f.dtype.element.device_dtype()
     maxlen = 1
     for _bi, dec, _nm in sources:
         for x in dec:
@@ -362,6 +388,8 @@ def _build_array_column(data, manifest, views, row_chunks, ci, f, b, cap,
                 for k, el in enumerate(x):
                     if el is None:
                         enul[bi, r, k] = True
+                    elif is_str:
+                        vals[bi, r, k] = lookup[str(el)]
                     else:
                         vals[bi, r, k] = el
             else:

@@ -190,6 +190,17 @@ class ColumnTableData:
         self._dicts: Dict[int, List] = {
             i: [] for i, f in enumerate(schema.fields) if f.dtype.name == "string"}
         self._dict_lookup: Dict[int, Dict] = {i: {} for i in self._dicts}
+        # ARRAY<STRING> columns: append-only ELEMENT dictionaries (same
+        # protocol as scalar strings — codes never shift, so device
+        # plates built under any pinned manifest stay decodable by every
+        # later dictionary read)
+        self._elem_dicts: Dict[int, List] = {
+            i: [] for i, f in enumerate(schema.fields)
+            if f.dtype.name == "array"
+            and getattr(f.dtype, "element", None) is not None
+            and f.dtype.element.name == "string"}
+        self._elem_lookup: Dict[int, Dict] = {i: {}
+                                              for i in self._elem_dicts}
         self._manifest = Manifest(
             0, (), tuple(np.empty(0, dtype=f.dtype.np_dtype)
                          for f in schema.fields), 0,
@@ -232,6 +243,30 @@ class ColumnTableData:
         if col_idx in self._dicts:
             return np.array(self._dicts[col_idx], dtype=object)
         return None
+
+    def intern_array_elements(self, col_idx: int, cells) -> Dict:
+        """Append-only intern of an ARRAY<STRING> column's element
+        values (device binds call this over their PINNED manifest's
+        cells, so a bind is always self-sufficient — recovery included).
+        Returns a point-in-time copy of the lookup for code assignment."""
+        lk = self._elem_lookup[col_idx]
+        d = self._elem_dicts[col_idx]
+        with self._lock:
+            for cell in cells:
+                if isinstance(cell, (list, tuple, np.ndarray)):
+                    for el in cell:
+                        if el is not None:
+                            key = str(el)
+                            if key not in lk:
+                                lk[key] = len(d)
+                                d.append(key)
+            return dict(lk)
+
+    def array_element_dictionary(self, col_idx: int) -> np.ndarray:
+        """Element dictionary of an ARRAY<STRING> column. Append-only:
+        a superset of the values any existing device plates encode."""
+        with self._lock:
+            return np.array(self._elem_dicts[col_idx], dtype=object)
 
     # --- writes ----------------------------------------------------------
 

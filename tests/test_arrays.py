@@ -198,3 +198,87 @@ def test_struct_bulk_insert_large(session):
     r = session.sql("SELECT count(*), sum(element_at(m, 'a')) FROM stl"
                     ).rows()[0]
     assert r[0] == n and r[1] == sum(i % 10 for i in range(n))
+
+
+def test_string_array_device_ops(s):
+    """ARRAY<STRING> columns bind as element-dictionary CODE plates:
+    size / array_contains(lit) / element_at run ON DEVICE (round-5
+    widening of the numeric-only fast path; ref SerializedArray)."""
+    from snappydata_tpu.observability.metrics import global_registry
+
+    s.sql("CREATE TABLE st (id INT, tags ARRAY<STRING>) USING column")
+    s.sql("INSERT INTO st VALUES "
+          "(1, array('red', 'green')), (2, array('blue')), "
+          "(3, array('green', 'green', 'red')), (4, NULL)")
+    before = global_registry().counter("host_fallbacks")
+    rows = s.sql("SELECT id, size(tags), array_contains(tags, 'green'), "
+                 "element_at(tags, 1) FROM st ORDER BY id").rows()
+    assert rows[0] == (1, 2, True, "red")
+    assert rows[1] == (2, 1, False, "blue")
+    assert rows[2] == (3, 3, True, "green")
+    assert rows[3][1] is None and rows[3][3] is None   # NULL array
+    cnt = s.sql("SELECT count(*) FROM st "
+                "WHERE array_contains(tags, 'red')").rows()[0][0]
+    assert cnt == 2
+    # absent needle: matches nothing (code -1)
+    assert s.sql("SELECT count(*) FROM st WHERE "
+                 "array_contains(tags, 'nope')").rows()[0][0] == 0
+    assert global_registry().counter("host_fallbacks") == before
+
+    # growth after bind: new element values re-dictionary cleanly
+    s.sql("INSERT INTO st VALUES (5, array('cyan', 'red'))")
+    rows2 = s.sql("SELECT element_at(tags, 1) FROM st WHERE id = 5").rows()
+    assert rows2 == [("cyan",)]
+    assert s.sql("SELECT count(*) FROM st "
+                 "WHERE array_contains(tags, 'red')").rows()[0][0] == 3
+    # non-literal needle / unsupported shapes still answer via host
+    r = s.sql("SELECT id FROM st WHERE element_at(tags, 1) = 'red' "
+              "ORDER BY id").rows()
+    assert [x[0] for x in r] == [1]
+
+
+def test_string_array_element_nulls_device(s):
+    s.sql("CREATE TABLE sn (id INT, tags ARRAY<STRING>) USING column")
+    s.sql("INSERT INTO sn VALUES (1, array('a', NULL, 'c'))")
+    rows = s.sql("SELECT size(tags), element_at(tags, 2), "
+                 "array_contains(tags, 'c') FROM sn").rows()
+    assert rows[0][0] == 3
+    assert rows[0][1] is None          # NULL element
+    assert rows[0][2] is True
+
+
+def test_string_array_null_needle_and_code_stability(s):
+    from snappydata_tpu.catalog import Catalog as _C
+
+    s.sql("CREATE TABLE nn2 (id INT, tags ARRAY<STRING>) USING column")
+    s.sql("INSERT INTO nn2 VALUES (1, array('None', 'b'))")
+    # NULL needle -> NULL result (NOT a match against the string 'None')
+    r = s.sql("SELECT array_contains(tags, NULL) FROM nn2").rows()
+    assert r == [(None,)]
+    # append-only codes: lexically-earlier values arriving later must
+    # not shift existing codes (the sorted-dictionary design did)
+    s.sql("CREATE TABLE cs2 (id INT, tags ARRAY<STRING>) USING column")
+    s.sql("INSERT INTO cs2 VALUES (1, array('zebra'))")
+    assert s.sql("SELECT count(*) FROM cs2 WHERE "
+                 "array_contains(tags, 'zebra')").rows()[0][0] == 1
+    s.sql("INSERT INTO cs2 VALUES (2, array('apple'))")
+    assert s.sql("SELECT element_at(tags, 1) FROM cs2 "
+                 "ORDER BY id").rows() == [("zebra",), ("apple",)]
+    assert s.sql("SELECT count(*) FROM cs2 WHERE "
+                 "array_contains(tags, 'zebra')").rows()[0][0] == 1
+
+
+def test_string_array_device_ops_survive_recovery(tmp_path):
+    d = str(tmp_path / "store")
+    s = SnappySession(data_dir=d)
+    s.sql("CREATE TABLE ra (id INT, tags ARRAY<STRING>) USING column")
+    s.sql("INSERT INTO ra VALUES (1, array('x', 'y')), (2, array('y'))")
+    s.checkpoint()
+    s.stop()
+    s2 = SnappySession(data_dir=d)
+    rows = s2.sql("SELECT id, size(tags), element_at(tags, 1) FROM ra "
+                  "ORDER BY id").rows()
+    assert rows == [(1, 2, "x"), (2, 1, "y")]
+    assert s2.sql("SELECT count(*) FROM ra WHERE "
+                  "array_contains(tags, 'y')").rows()[0][0] == 2
+    s2.stop()
