@@ -763,19 +763,22 @@ class Compiler:
             self._prune_cursor += 1
             used = sorted(pruned) if pruned is not None \
                 else list(range(len(info.schema)))
+            from snappydata_tpu.storage.device import map_device_eligible
             from snappydata_tpu.storage.table_store import RowTableData
 
+            col_store = not isinstance(info.data, RowTableData)
             for uci in used:
                 fdt = info.schema.fields[uci].dtype
-                if fdt.name in ("map", "struct") or (
-                        fdt.name == "array"
-                        and not T.is_numeric(fdt.element)
-                        and not (fdt.element.name == "string"
-                                 and not isinstance(info.data,
-                                                    RowTableData))):
-                    # numeric AND string-element arrays have device
-                    # plates (string elements ride as dictionary
-                    # codes); everything else stays host
+                ok_complex = col_store and (
+                    (fdt.name == "array"
+                     and (T.is_numeric(fdt.element)
+                          or fdt.element.name == "string"))
+                    or (fdt.name == "map" and map_device_eligible(fdt)))
+                if fdt.name in ("map", "struct", "array") \
+                        and not ok_complex:
+                    # numeric/string-element arrays and MAP<STRING, V>
+                    # have device plates (string parts ride as
+                    # dictionary codes); everything else stays host
                     raise CompileError(
                         "complex-typed columns evaluate on the host path")
             rel_idx = len(self.relations)
@@ -1564,6 +1567,16 @@ def _dict_provider(info, ci):
         from snappydata_tpu.storage.device import array_element_dictionary
 
         return lambda: array_element_dictionary(info.data, ci)
+    if isinstance(f.dtype, T.MapType) \
+            and not isinstance(info.data, RowTableData):
+        from snappydata_tpu.engine.exprs import MapDicts
+        from snappydata_tpu.storage.device import map_device_eligible
+
+        if map_device_eligible(f.dtype):
+            return MapDicts(
+                lambda: info.data.map_key_dictionary(ci),
+                (lambda: info.data.map_value_dictionary(ci))
+                if f.dtype.value.name == "string" else None)
     if f.dtype.name != "string":
         return None
     if isinstance(info.data, RowTableData):
@@ -1773,11 +1786,12 @@ def _validate_array_usage(plan: ast.Plan) -> None:
     of size/element_at/array_contains (their plate layout is opaque to
     every other operator) — anything else reroutes to the host path."""
     def check_expr(e: ast.Expr, allowed: bool) -> None:
-        if isinstance(e, ast.Col) and isinstance(e.dtype, T.ArrayType) \
+        if isinstance(e, ast.Col) \
+                and isinstance(e.dtype, (T.ArrayType, T.MapType)) \
                 and not allowed:
             raise CompileError(
-                "array column outside size/element_at/array_contains: "
-                "host path")
+                "array/map column outside size/element_at/"
+                "array_contains: host path")
         from snappydata_tpu.engine.exprs import ARRAY_DEVICE_FUNCS
 
         for i, c in enumerate(e.children()):

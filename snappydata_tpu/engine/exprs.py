@@ -52,6 +52,15 @@ STRING_VALUE_FUNCS = frozenset(
 
 
 @dataclasses.dataclass
+class MapDicts:
+    """Dictionary providers of a device-plated MAP<STRING, V> column:
+    key codes always, value codes when V is string."""
+
+    key: Callable[[], np.ndarray]
+    value: Optional[Callable[[], np.ndarray]] = None
+
+
+@dataclasses.dataclass
 class DVal:
     """A traced value: device array + optional null mask + static type info."""
 
@@ -890,16 +899,40 @@ class ExprBuilder:
         return run_cast
 
     def _arg_array_col(self, e: ast.Expr):
-        """(ArrayType, column ordinal) of an argument expression that is
-        (an alias of) a raw array column, else (None, None)."""
+        return self._arg_typed_col(e, T.ArrayType)
+
+    def _arg_typed_col(self, e: ast.Expr, type_cls):
+        """(dtype, column ordinal) of an argument that is (an alias of)
+        a raw column of `type_cls`, else (None, None)."""
         if isinstance(e, ast.Alias):
-            return self._arg_array_col(e.child)
+            return self._arg_typed_col(e.child, type_cls)
         if isinstance(e, ast.Col):
             dt = e.dtype if e.dtype is not None else \
                 self.col_types.get(e.index)
-            if isinstance(dt, T.ArrayType):
+            if isinstance(dt, type_cls):
                 return dt, e.index
         return None, None
+
+    def _arg_map_col(self, e: ast.Expr):
+        return self._arg_typed_col(e, T.MapType)
+
+    def _literal_code_aux(self, lit_expr, getter) -> int:
+        """Register an aux array resolving a literal at bind time to
+        [dictionary code, needle_is_null] — -1 = absent (matches no
+        code); a NULL literal flags [1]==1 so runners propagate NULL.
+        Shared by string-array contains and map element_at (review
+        finding: two byte-identical builders)."""
+        get_lit = (lambda params: self._param_value(lit_expr, params))
+
+        def build(params, getter=getter):
+            lit = get_lit(params)
+            if lit is None:
+                return np.array([-1, 1], np.int32)
+            hit = np.flatnonzero(
+                np.asarray(getter(), dtype=object) == str(lit))
+            return np.array([hit[0] if hit.size else -1, 0], np.int32)
+
+        return self._register_aux(build)
 
     def _arg_array_type(self, e: ast.Expr):
         """Static ArrayType of an argument expression, else None."""
@@ -927,6 +960,57 @@ class ExprBuilder:
         # as (values [.., L], lengths, element_nulls) plates; padding and
         # NULL elements are excluded via the length/element-null masks
         # (ref: SerializedArray; round-1 gap: every array op was host)
+        if name in ("size", "element_at") and e.args:
+            m0, m_ci = self._arg_map_col(e.args[0])
+            if m0 is not None:
+                mdicts = self.dict_getters.get(m_ci)
+                if not isinstance(mdicts, MapDicts):
+                    raise CompileError(
+                        "map column without device plates: host path")
+                arr_run = args[0]
+                if name == "size":
+                    def run_msize(rt: Runtime) -> DVal:
+                        d = arr_run(rt)
+                        _k, _v, lengths, _vn = d.value
+                        return DVal(lengths.astype(jnp.int32), d.null,
+                                    T.INT)
+
+                    return run_msize
+                # element_at(map, 'key'): literal key -> key-dictionary
+                # CODE at bind; first matching entry's value (string
+                # values decode through the value dictionary)
+                if not self._is_literalish(e.args[1]):
+                    raise CompileError(
+                        "element_at over a map needs a literal key: "
+                        "host path")
+                aux_i = self._literal_code_aux(e.args[1], mdicts.key)
+                val_t = m0.value
+                val_is_str = val_t.name == "string"
+
+                def run_melem(rt: Runtime) -> DVal:
+                    d = arr_run(rt)
+                    kcodes, vals, lengths, vnul = d.value
+                    L = kcodes.shape[-1]
+                    code = rt.aux[aux_i][0]
+                    key_null = rt.aux[aux_i][1] == 1
+                    in_range = jnp.arange(L) < lengths[..., None]
+                    hit = (kcodes == code) & in_range
+                    found = hit.any(axis=-1)
+                    idx = jnp.argmax(hit, axis=-1)
+                    out = jnp.take_along_axis(
+                        vals, idx[..., None], axis=-1)[..., 0]
+                    vn = jnp.take_along_axis(
+                        vnul, idx[..., None], axis=-1)[..., 0]
+                    null = _or_null(
+                        d.null,
+                        ~found | vn
+                        | jnp.broadcast_to(key_null, found.shape))
+                    return DVal(out, null, val_t,
+                                dictionary=mdicts.value
+                                if val_is_str else None)
+
+                return run_melem
+
         if name in ARRAY_DEVICE_FUNCS and e.args:
             t0 = self._arg_array_type(e.args[0])
             if t0 is not None:
@@ -980,24 +1064,7 @@ class ExprBuilder:
                         raise CompileError(
                             "array_contains over a string array needs "
                             "a literal needle: host path")
-                    get_lit = (lambda params:
-                               self._param_value(e.args[1], params))
-
-                    def build_code(params, getter=elem_dict):
-                        # [code, needle_is_null]: a NULL needle makes
-                        # the whole result NULL (matching the numeric
-                        # path's null propagation — str(None) would
-                        # have matched the literal string 'None')
-                        lit = get_lit(params)
-                        if lit is None:
-                            return np.array([-1, 1], np.int32)
-                        hit = np.flatnonzero(
-                            np.asarray(getter(), dtype=object)
-                            == str(lit))
-                        return np.array(
-                            [hit[0] if hit.size else -1, 0], np.int32)
-
-                    aux_i = self._register_aux(build_code)
+                    aux_i = self._literal_code_aux(e.args[1], elem_dict)
 
                     def run_contains_str(rt: Runtime) -> DVal:
                         d = arr_run(rt)

@@ -170,6 +170,17 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
     nulls: Dict[int, Optional[jnp.ndarray]] = {}
     for ci in col_indices:
         f = schema.fields[ci]
+        if isinstance(f.dtype, T.MapType) and map_device_eligible(f.dtype):
+            # MAP<STRING, V>: key-code plates + value plates (numeric
+            # values as-is, string values as codes) + lengths +
+            # value-null bits — feeds the device element_at lowering
+            key = ("mcol", ci)
+            if key not in cache:
+                cache[key] = _build_map_column(
+                    data, manifest, views, row_chunks, ci, f, b, cap,
+                    _place)
+            columns[ci], stats_min[ci], stats_max[ci], nulls[ci] = cache[key]
+            continue
         if isinstance(f.dtype, T.ArrayType) and (
                 T.is_numeric(f.dtype.element)
                 or f.dtype.element.name == "string"):
@@ -331,6 +342,73 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                        cache.get("nrows", manifest.total_rows()), nulls)
 
 
+def map_device_eligible(dt) -> bool:
+    """MAP<STRING, numeric|string> gets device plates; other key/value
+    types stay host-evaluated."""
+    return (getattr(dt, "key", None) is not None
+            and dt.key.name == "string"
+            and (T.is_numeric(dt.value) or dt.value.name == "string"))
+
+
+def _build_map_column(data, manifest, views, row_chunks, ci, f, b, cap,
+                      _place):
+    """MAP<STRING, V> column → (((kcodes [b,cap,L], vals [b,cap,L],
+    lengths [b,cap], value_nulls [b,cap,L])), nan-stats, row-null mask).
+    Keys (and string values) encode against the table's append-only
+    map dictionaries, so plates from any pinned manifest stay valid."""
+    val_is_str = f.dtype.value.name == "string"
+    vdt = np.dtype(np.int32) if val_is_str \
+        else f.dtype.value.device_dtype()
+    sources = []
+    for i, v in enumerate(views):
+        sources.append((i, v.decoded_column(ci), v.null_mask(ci)))
+    for j, (pos, take) in enumerate(row_chunks):
+        src = np.asarray(manifest.row_arrays[ci][pos:pos + take],
+                         dtype=object)
+        rn = None
+        if manifest.row_nulls and manifest.row_nulls[ci] is not None:
+            rn = manifest.row_nulls[ci][pos:pos + take]
+        sources.append((len(views) + j, src, rn))
+    import itertools
+
+    klookup, vlookup = data.intern_map_entries(
+        ci, itertools.chain.from_iterable(
+            dec for _bi, dec, _nm in sources))
+    maxlen = 1
+    for _bi, dec, _nm in sources:
+        for x in dec:
+            if isinstance(x, dict) and len(x) > maxlen:
+                maxlen = len(x)
+    L = _next_pow2(maxlen)
+    kcodes = np.full((b, cap, L), -1, dtype=np.int32)
+    vals = np.zeros((b, cap, L), dtype=vdt)
+    lens = np.zeros((b, cap), dtype=np.int32)
+    vnul = np.zeros((b, cap, L), dtype=np.bool_)
+    null_mask = np.zeros((b, cap), dtype=np.bool_)
+    any_null = False
+    for bi, dec, nm in sources:
+        for r, x in enumerate(dec):
+            if isinstance(x, dict):
+                lens[bi, r] = len(x)
+                for k, (mk, mv) in enumerate(x.items()):
+                    kcodes[bi, r, k] = klookup[str(mk)]
+                    if mv is None:
+                        vnul[bi, r, k] = True
+                    elif val_is_str:
+                        vals[bi, r, k] = vlookup[str(mv)]
+                    else:
+                        vals[bi, r, k] = mv
+            else:
+                null_mask[bi, r] = True
+                any_null = True
+        if nm is not None:
+            null_mask[bi, :len(nm)] |= np.asarray(nm, dtype=bool)
+            any_null = True
+    return ((_place(kcodes), _place(vals), _place(lens), _place(vnul)),
+            np.full(b, np.nan), np.full(b, np.nan),
+            _place(null_mask) if any_null else None)
+
+
 def array_element_dictionary(data, ci: int) -> np.ndarray:
     """Element dictionary of an ARRAY<STRING> column — delegates to the
     table's APPEND-ONLY intern store (same protocol as scalar string
@@ -358,14 +436,16 @@ def _build_array_column(data, manifest, views, row_chunks, ci, f, b, cap,
             rn = manifest.row_nulls[ci][pos:pos + take]
         sources.append((len(views) + j, src, rn))
     if is_str:
+        import itertools
+
         edt = np.dtype(np.int32)
-        # intern THIS pinned manifest's cells (append-only, cheap once
-        # hot) so the bind is self-sufficient across recovery and
-        # concurrent mutation — a review finding killed the previous
+        # intern THIS pinned manifest's cells in ONE call (append-only,
+        # cheap once hot) so the bind is self-sufficient across recovery
+        # and concurrent mutation — a review finding killed the previous
         # sorted-per-version dictionary whose codes shifted under writes
-        lookup: Dict = {}
-        for _bi, dec, _nm in sources:
-            lookup = data.intern_array_elements(ci, dec)
+        lookup = data.intern_array_elements(
+            ci, itertools.chain.from_iterable(
+                dec for _bi, dec, _nm in sources))
     else:
         edt = f.dtype.element.device_dtype()
     maxlen = 1

@@ -201,6 +201,21 @@ class ColumnTableData:
             and f.dtype.element.name == "string"}
         self._elem_lookup: Dict[int, Dict] = {i: {}
                                               for i in self._elem_dicts}
+        # MAP<STRING, V> columns: append-only KEY dictionaries, plus
+        # VALUE dictionaries when V is also string
+        self._map_key_dicts: Dict[int, List] = {
+            i: [] for i, f in enumerate(schema.fields)
+            if f.dtype.name == "map"
+            and getattr(f.dtype, "key", None) is not None
+            and f.dtype.key.name == "string"}
+        self._map_key_lookup: Dict[int, Dict] = {
+            i: {} for i in self._map_key_dicts}
+        self._map_val_dicts: Dict[int, List] = {
+            i: [] for i, f in enumerate(schema.fields)
+            if i in self._map_key_dicts
+            and f.dtype.value.name == "string"}
+        self._map_val_lookup: Dict[int, Dict] = {
+            i: {} for i in self._map_val_dicts}
         self._manifest = Manifest(
             0, (), tuple(np.empty(0, dtype=f.dtype.np_dtype)
                          for f in schema.fields), 0,
@@ -267,6 +282,40 @@ class ColumnTableData:
         a superset of the values any existing device plates encode."""
         with self._lock:
             return np.array(self._elem_dicts[col_idx], dtype=object)
+
+    def intern_map_entries(self, col_idx: int, cells
+                           ) -> Tuple[Dict, Optional[Dict]]:
+        """Append-only intern of a MAP<STRING, V> column's keys (and
+        values when V is string). Returns point-in-time copies of the
+        (key lookup, value lookup | None) for code assignment."""
+        klk = self._map_key_lookup[col_idx]
+        kd = self._map_key_dicts[col_idx]
+        vlk = self._map_val_lookup.get(col_idx)
+        vd = self._map_val_dicts.get(col_idx)
+        with self._lock:
+            for cell in cells:
+                if isinstance(cell, dict):
+                    for k, v in cell.items():
+                        ks = str(k)
+                        if ks not in klk:
+                            klk[ks] = len(kd)
+                            kd.append(ks)
+                        if vlk is not None and v is not None:
+                            vs = str(v)
+                            if vs not in vlk:
+                                vlk[vs] = len(vd)
+                                vd.append(vs)
+            return dict(klk), (dict(vlk) if vlk is not None else None)
+
+    def map_key_dictionary(self, col_idx: int) -> np.ndarray:
+        with self._lock:
+            return np.array(self._map_key_dicts[col_idx], dtype=object)
+
+    def map_value_dictionary(self, col_idx: int) -> Optional[np.ndarray]:
+        with self._lock:
+            if col_idx not in self._map_val_dicts:
+                return None
+            return np.array(self._map_val_dicts[col_idx], dtype=object)
 
     # --- writes ----------------------------------------------------------
 
@@ -444,6 +493,22 @@ class ColumnTableData:
                 # never zero-sized (codes are masked null anyway)
                 self._dicts[idx] = [""]
                 self._dict_lookup[idx] = {"": 0}
+            # the per-column complex-type dictionary families need
+            # entries too, or the first device bind of an ALTER-added
+            # column dies on a raw KeyError (review finding)
+            if field.dtype.name == "array" \
+                    and getattr(field.dtype, "element", None) is not None \
+                    and field.dtype.element.name == "string":
+                self._elem_dicts[idx] = []
+                self._elem_lookup[idx] = {}
+            if field.dtype.name == "map" \
+                    and getattr(field.dtype, "key", None) is not None \
+                    and field.dtype.key.name == "string":
+                self._map_key_dicts[idx] = []
+                self._map_key_lookup[idx] = {}
+                if field.dtype.value.name == "string":
+                    self._map_val_dicts[idx] = []
+                    self._map_val_lookup[idx] = {}
             self._row_buffer.add_field(field)
             views = []
             for v in self._manifest.views:
@@ -470,6 +535,16 @@ class ColumnTableData:
             self._dict_lookup = {remap(i): d
                                  for i, d in self._dict_lookup.items()
                                  if i != idx}
+            # remap the complex-type dictionary families the same way
+            # (review finding: stale ordinals made a survivor column
+            # intern into its neighbour's dictionary)
+            for attr in ("_elem_dicts", "_elem_lookup", "_map_key_dicts",
+                         "_map_key_lookup", "_map_val_dicts",
+                         "_map_val_lookup"):
+                setattr(self, attr,
+                        {remap(i): d
+                         for i, d in getattr(self, attr).items()
+                         if i != idx})
             self._row_buffer.drop_field(idx)
             views = []
             for v in self._manifest.views:

@@ -187,3 +187,88 @@ def test_null_group_keys_multi_and_agg(s):
         [(None, 3.0), ("a", 1.0)]
     assert s.sql("SELECT g, count(*) c FROM m GROUP BY g "
                  "HAVING count(*) > 1").rows() == [(None, 2)]
+
+
+def test_map_device_element_at():
+    """MAP<STRING, V> binds as key-code + value plates: size and
+    literal-key element_at run ON DEVICE (round-5; previously every
+    map query took the host path)."""
+    from snappydata_tpu.observability.metrics import global_registry
+
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE md (id INT, m MAP<STRING, INT>, "
+          "sm MAP<STRING, STRING>) USING column")
+    s.sql("INSERT INTO md VALUES "
+          "(1, map('a', 10, 'b', 20), map('x', 'hello')), "
+          "(2, map('b', 5), map('x', 'world', 'y', 'z')), "
+          "(3, NULL, NULL)")
+    before = global_registry().counter("host_fallbacks")
+    r = s.sql("SELECT id, element_at(m, 'b'), size(m), "
+              "element_at(sm, 'x') FROM md ORDER BY id").rows()
+    assert r[0] == (1, 20, 2, "hello")
+    assert r[1] == (2, 5, 1, "world")
+    assert r[2][1] is None and r[2][3] is None
+    # filters over element_at run in the same compiled program
+    assert s.sql("SELECT count(*) FROM md WHERE "
+                 "element_at(m, 'a') = 10").rows()[0][0] == 1
+    # missing key -> NULL; NULL key -> NULL
+    assert s.sql("SELECT element_at(m, 'nope') FROM md "
+                 "WHERE id = 1").rows() == [(None,)]
+    assert s.sql("SELECT element_at(m, NULL) FROM md "
+                 "WHERE id = 1").rows() == [(None,)]
+    assert global_registry().counter("host_fallbacks") == before
+    # append-only key codes survive later inserts
+    s.sql("INSERT INTO md VALUES (4, map('aa', 7), map('q', 'r'))")
+    assert s.sql("SELECT element_at(m, 'b') FROM md WHERE id = 1"
+                 ).rows() == [(20,)]
+    assert s.sql("SELECT element_at(m, 'aa') FROM md WHERE id = 4"
+                 ).rows() == [(7,)]
+    # non-literal key and whole-map SELECT keep the host path (correct,
+    # just not device)
+    assert s.sql("SELECT m FROM md WHERE id = 1").rows() \
+        == [({"a": 10, "b": 20},)]
+    s.stop()
+
+
+def test_map_device_persistence(tmp_path):
+    d = str(tmp_path / "store")
+    s = SnappySession(data_dir=d)
+    s.sql("CREATE TABLE mp (id INT, m MAP<STRING, DOUBLE>) USING column")
+    s.sql("INSERT INTO mp VALUES (1, map('k', 1.5)), (2, map('k', 2.5))")
+    s.checkpoint()
+    s.stop()
+    s2 = SnappySession(data_dir=d)
+    assert s2.sql("SELECT sum(element_at(m, 'k')) FROM mp"
+                  ).rows()[0][0] == pytest.approx(4.0)
+    s2.stop()
+
+
+def test_alter_add_drop_complex_columns_keep_device_dicts():
+    """ALTER-added ARRAY<STRING>/MAP columns must have dictionary
+    state, and dropping a preceding column must remap it (review
+    findings: raw KeyError at bind / survivor column decoding through
+    its neighbour's stale dictionary)."""
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE ac (id INT) USING column")
+    s.sql("INSERT INTO ac VALUES (1)")
+    s.sql("ALTER TABLE ac ADD COLUMN tags ARRAY<STRING>")
+    s.sql("ALTER TABLE ac ADD COLUMN m MAP<STRING, INT>")
+    s.sql("INSERT INTO ac VALUES (2, array('p', 'q'), map('k', 9))")
+    r = s.sql("SELECT id, size(tags), element_at(m, 'k') FROM ac "
+              "ORDER BY id").rows()
+    assert r[0] == (1, None, None)
+    assert r[1] == (2, 2, 9)
+
+    s.sql("CREATE TABLE dc (x INT, tags ARRAY<STRING>, "
+          "m MAP<STRING, STRING>) USING column")
+    s.sql("INSERT INTO dc VALUES (1, array('a'), map('u', 'v'))")
+    assert s.sql("SELECT element_at(m, 'u') FROM dc").rows() == [("v",)]
+    s.sql("ALTER TABLE dc DROP COLUMN x")
+    # ordinals shifted: the complex dictionaries must follow
+    s.sql("INSERT INTO dc VALUES (array('b'), map('u', 'w'))")
+    got = sorted(r[0] for r in
+                 s.sql("SELECT element_at(m, 'u') FROM dc").rows())
+    assert got == ["v", "w"]
+    assert s.sql("SELECT count(*) FROM dc "
+                 "WHERE array_contains(tags, 'b')").rows()[0][0] == 1
+    s.stop()
