@@ -763,7 +763,8 @@ class Compiler:
             self._prune_cursor += 1
             used = sorted(pruned) if pruned is not None \
                 else list(range(len(info.schema)))
-            from snappydata_tpu.storage.device import map_device_eligible
+            from snappydata_tpu.storage.device import (
+                map_device_eligible, struct_device_eligible)
             from snappydata_tpu.storage.table_store import RowTableData
 
             col_store = not isinstance(info.data, RowTableData)
@@ -773,12 +774,15 @@ class Compiler:
                     (fdt.name == "array"
                      and (T.is_numeric(fdt.element)
                           or fdt.element.name == "string"))
-                    or (fdt.name == "map" and map_device_eligible(fdt)))
+                    or (fdt.name == "map" and map_device_eligible(fdt))
+                    or (fdt.name == "struct"
+                        and struct_device_eligible(fdt)))
                 if fdt.name in ("map", "struct", "array") \
                         and not ok_complex:
-                    # numeric/string-element arrays and MAP<STRING, V>
-                    # have device plates (string parts ride as
-                    # dictionary codes); everything else stays host
+                    # numeric/string-element arrays, MAP<STRING, V> and
+                    # flat STRUCTs have device plates (string parts
+                    # ride as dictionary codes); nested complex types
+                    # stay host
                     raise CompileError(
                         "complex-typed columns evaluate on the host path")
             rel_idx = len(self.relations)
@@ -1577,6 +1581,16 @@ def _dict_provider(info, ci):
                 lambda: info.data.map_key_dictionary(ci),
                 (lambda: info.data.map_value_dictionary(ci))
                 if f.dtype.value.name == "string" else None)
+    if isinstance(f.dtype, T.StructType) \
+            and not isinstance(info.data, RowTableData):
+        from snappydata_tpu.engine.exprs import StructDicts
+        from snappydata_tpu.storage.device import struct_device_eligible
+
+        if struct_device_eligible(f.dtype):
+            return StructDicts({
+                fn: (lambda fn=fn:
+                     info.data.struct_field_dictionary(ci, fn))
+                for fn, ft in f.dtype.fields if ft.name == "string"})
     if f.dtype.name != "string":
         return None
     if isinstance(info.data, RowTableData):
@@ -1787,10 +1801,11 @@ def _validate_array_usage(plan: ast.Plan) -> None:
     every other operator) — anything else reroutes to the host path."""
     def check_expr(e: ast.Expr, allowed: bool) -> None:
         if isinstance(e, ast.Col) \
-                and isinstance(e.dtype, (T.ArrayType, T.MapType)) \
+                and isinstance(e.dtype, (T.ArrayType, T.MapType,
+                                         T.StructType)) \
                 and not allowed:
             raise CompileError(
-                "array/map column outside size/element_at/"
+                "array/map/struct column outside size/element_at/"
                 "array_contains: host path")
         from snappydata_tpu.engine.exprs import ARRAY_DEVICE_FUNCS
 

@@ -61,6 +61,14 @@ class MapDicts:
 
 
 @dataclasses.dataclass
+class StructDicts:
+    """Per-field value-dictionary providers of a device-plated STRUCT
+    column (string fields only)."""
+
+    fields: Dict[str, Callable[[], np.ndarray]] = None
+
+
+@dataclasses.dataclass
 class DVal:
     """A traced value: device array + optional null mask + static type info."""
 
@@ -960,6 +968,39 @@ class ExprBuilder:
         # as (values [.., L], lengths, element_nulls) plates; padding and
         # NULL elements are excluded via the length/element-null masks
         # (ref: SerializedArray; round-1 gap: every array op was host)
+        if name == "element_at" and len(e.args) == 2:
+            s0, s_ci = self._arg_typed_col(e.args[0], T.StructType)
+            if s0 is not None:
+                # STRUCT field access: the field name is STRUCTURAL
+                # (tokenization keeps it a literal) and selects one
+                # [B, C] plate statically at compile time
+                sdicts = self.dict_getters.get(s_ci)
+                if not isinstance(sdicts, StructDicts):
+                    raise CompileError(
+                        "struct column without device plates: host path")
+                if not isinstance(e.args[1], ast.Lit):
+                    raise CompileError(
+                        "element_at over a struct needs a literal "
+                        "field name: host path")
+                want = str(e.args[1].value).lower()
+                fidx = next((k for k, (fn, _t) in enumerate(s0.fields)
+                             if fn.lower() == want), None)
+                if fidx is None:
+                    raise CompileError(
+                        f"no struct field {want!r}: host path")
+                fname, ftype = s0.fields[fidx]
+                arr_run = args[0]
+
+                def run_sfield(rt: Runtime) -> DVal:
+                    d = arr_run(rt)
+                    fvals, fnuls = d.value
+                    null = _or_null(d.null, fnuls[fidx])
+                    return DVal(fvals[fidx], null, ftype,
+                                dictionary=sdicts.fields.get(fname)
+                                if ftype.name == "string" else None)
+
+                return run_sfield
+
         if name in ("size", "element_at") and e.args:
             m0, m_ci = self._arg_map_col(e.args[0])
             if m0 is not None:
@@ -1088,8 +1129,18 @@ class ExprBuilder:
                     xv = other(rt)
                     vals, lengths, enul = d.value
                     L = vals.shape[-1]
-                    x = jnp.broadcast_to(jnp.asarray(xv.value),
-                                         lengths.shape)
+                    needle = jnp.asarray(xv.value)
+                    if t0.element.name == "decimal" \
+                            and getattr(t0.element, "is_exact", False) \
+                            and jnp.issubdtype(vals.dtype, jnp.integer):
+                        # element plates hold SCALED ints: the needle
+                        # scales the same way (HALF_UP)
+                        nf = needle.astype(jnp.float64) \
+                            * (10 ** t0.element.scale)
+                        needle = (jnp.sign(nf)
+                                  * jnp.floor(jnp.abs(nf) + 0.5)
+                                  ).astype(jnp.int64)
+                    x = jnp.broadcast_to(needle, lengths.shape)
                     # compare under jnp promotion (a fractional needle
                     # must NOT truncate into the int element domain)
                     eq = vals == x[..., None]

@@ -736,6 +736,19 @@ def tokenize_plan(plan: ast.Plan) -> Tuple[ast.Plan, Tuple[Any, ...]]:
 
     def tok_expr(e: ast.Expr) -> ast.Expr:
         def rec(node: ast.Expr) -> ast.Expr:
+            if isinstance(node, ast.Func) and node.name == "element_at" \
+                    and len(node.args) == 2:
+                # a STRUCT field name is STRUCTURAL (it selects a device
+                # plate at compile time) — map keys / array indexes stay
+                # tokenized so they rebind without recompiles
+                try:
+                    structural = isinstance(expr_type(node.args[0]),
+                                            T.StructType)
+                except Exception:
+                    structural = False
+                if structural:
+                    return dataclasses.replace(node, args=(
+                        rec(node.args[0]), node.args[1]))
             if isinstance(node, ast.Func) and \
                     node.name in _STRUCTURAL_LIT_FUNCS:
                 # these functions' literal args are STRUCTURAL (they shape

@@ -170,6 +170,18 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
     nulls: Dict[int, Optional[jnp.ndarray]] = {}
     for ci in col_indices:
         f = schema.fields[ci]
+        if isinstance(f.dtype, T.StructType) \
+                and struct_device_eligible(f.dtype):
+            # STRUCT: one [B, C] plate per field (string fields as
+            # per-field dictionary codes) — element_at field access
+            # becomes a static plate pick in the compiled program
+            key = ("scol", ci)
+            if key not in cache:
+                cache[key] = _build_struct_column(
+                    data, manifest, views, row_chunks, ci, f, b, cap,
+                    _place)
+            columns[ci], stats_min[ci], stats_max[ci], nulls[ci] = cache[key]
+            continue
         if isinstance(f.dtype, T.MapType) and map_device_eligible(f.dtype):
             # MAP<STRING, V>: key-code plates + value plates (numeric
             # values as-is, string values as codes) + lengths +
@@ -350,15 +362,18 @@ def map_device_eligible(dt) -> bool:
             and (T.is_numeric(dt.value) or dt.value.name == "string"))
 
 
-def _build_map_column(data, manifest, views, row_chunks, ci, f, b, cap,
-                      _place):
-    """MAP<STRING, V> column → (((kcodes [b,cap,L], vals [b,cap,L],
-    lengths [b,cap], value_nulls [b,cap,L])), nan-stats, row-null mask).
-    Keys (and string values) encode against the table's append-only
-    map dictionaries, so plates from any pinned manifest stay valid."""
-    val_is_str = f.dtype.value.name == "string"
-    vdt = np.dtype(np.int32) if val_is_str \
-        else f.dtype.value.device_dtype()
+def struct_device_eligible(dt) -> bool:
+    """STRUCT with only numeric/string fields gets per-field plates;
+    nested complex fields keep the host path."""
+    fields = getattr(dt, "fields", ())
+    return bool(fields) and all(
+        T.is_numeric(ft) or ft.name == "string" for _n, ft in fields)
+
+
+def _complex_column_sources(manifest, views, row_chunks, ci):
+    """(batch row, decoded cells, null mask) triples for a complex
+    column — the one assembly all three complex-plate builders share
+    (review finding: three diverging copies)."""
     sources = []
     for i, v in enumerate(views):
         sources.append((i, v.decoded_column(ci), v.null_mask(ci)))
@@ -369,8 +384,92 @@ def _build_map_column(data, manifest, views, row_chunks, ci, f, b, cap,
         if manifest.row_nulls and manifest.row_nulls[ci] is not None:
             rn = manifest.row_nulls[ci][pos:pos + take]
         sources.append((len(views) + j, src, rn))
+    return sources
+
+
+def _value_plate_dtype(vt) -> np.dtype:
+    """Fill dtype for a complex-type VALUE plate: exact decimals fill
+    as plain float64 and convert to scaled int64 afterwards — writing
+    raw values straight into the int64 device dtype TRUNCATED them
+    (review finding, verified: 1.50 decoded as 0.01)."""
+    dt = vt.device_dtype()
+    if vt.name == "decimal" and dt.kind == "i":
+        return np.dtype(np.float64)
+    return dt
+
+
+def _finish_value_plate(vt, plate: np.ndarray) -> np.ndarray:
+    """Host-domain fill plate -> device plate (scale exact decimals)."""
+    dt = vt.device_dtype()
+    if vt.name == "decimal" and dt.kind == "i":
+        return T.decimal_to_unscaled(vt, plate)
+    return plate
+
+
+def _build_struct_column(data, manifest, views, row_chunks, ci, f, b,
+                         cap, _place):
+    """STRUCT column → ((field value plates tuple, field null plates
+    tuple) in the dtype's field order, nan-stats, row-null mask).
+    String fields encode against per-field append-only dictionaries."""
     import itertools
 
+    from snappydata_tpu.storage.table_store import _struct_get
+
+    sources = _complex_column_sources(manifest, views, row_chunks, ci)
+    fnames = [n for n, _t in f.dtype.fields]
+    ftypes = [t for _n, t in f.dtype.fields]
+    str_fields = [fn for fn, ft in zip(fnames, ftypes)
+                  if ft.name == "string"]
+    # all string fields intern in ONE pass over the cells (review
+    # finding: one full scan per field)
+    str_lookups = data.intern_struct_fields(
+        ci, str_fields, itertools.chain.from_iterable(
+            dec for _bi, dec, _nm in sources)) if str_fields else {}
+    lookups = [str_lookups.get(fn) if ft.name == "string" else None
+               for fn, ft in zip(fnames, ftypes)]
+    fvals = [np.zeros((b, cap), dtype=np.int32 if lk is not None
+                      else _value_plate_dtype(ft))
+             for lk, ft in zip(lookups, ftypes)]
+    fnuls = [np.zeros((b, cap), dtype=np.bool_) for _ in fnames]
+    null_mask = np.zeros((b, cap), dtype=np.bool_)
+    any_null = False
+    for bi, dec, nm in sources:
+        for r, x in enumerate(dec):
+            if isinstance(x, dict):
+                for k, (fn, lk) in enumerate(zip(fnames, lookups)):
+                    v = _struct_get(x, fn)
+                    if v is None:
+                        fnuls[k][bi, r] = True
+                    elif lk is not None:
+                        fvals[k][bi, r] = lk[str(v)]
+                    else:
+                        fvals[k][bi, r] = v
+            else:
+                null_mask[bi, r] = True
+                any_null = True
+        if nm is not None:
+            null_mask[bi, :len(nm)] |= np.asarray(nm, dtype=bool)
+            any_null = True
+    fvals = [a if lk is not None else _finish_value_plate(ft, a)
+             for a, lk, ft in zip(fvals, lookups, ftypes)]
+    return ((tuple(_place(a) for a in fvals),
+             tuple(_place(a) for a in fnuls)),
+            np.full(b, np.nan), np.full(b, np.nan),
+            _place(null_mask) if any_null else None)
+
+
+def _build_map_column(data, manifest, views, row_chunks, ci, f, b, cap,
+                      _place):
+    """MAP<STRING, V> column → (((kcodes [b,cap,L], vals [b,cap,L],
+    lengths [b,cap], value_nulls [b,cap,L])), nan-stats, row-null mask).
+    Keys (and string values) encode against the table's append-only
+    map dictionaries, so plates from any pinned manifest stay valid."""
+    import itertools
+
+    val_is_str = f.dtype.value.name == "string"
+    vdt = np.dtype(np.int32) if val_is_str \
+        else _value_plate_dtype(f.dtype.value)
+    sources = _complex_column_sources(manifest, views, row_chunks, ci)
     klookup, vlookup = data.intern_map_entries(
         ci, itertools.chain.from_iterable(
             dec for _bi, dec, _nm in sources))
@@ -404,6 +503,8 @@ def _build_map_column(data, manifest, views, row_chunks, ci, f, b, cap,
         if nm is not None:
             null_mask[bi, :len(nm)] |= np.asarray(nm, dtype=bool)
             any_null = True
+    if not val_is_str:
+        vals = _finish_value_plate(f.dtype.value, vals)
     return ((_place(kcodes), _place(vals), _place(lens), _place(vnul)),
             np.full(b, np.nan), np.full(b, np.nan),
             _place(null_mask) if any_null else None)
@@ -425,16 +526,7 @@ def _build_array_column(data, manifest, views, row_chunks, ci, f, b, cap,
     table's append-only element dictionary — size/element_at/
     array_contains then run on device exactly like their numeric forms."""
     is_str = f.dtype.element.name == "string"
-    sources = []
-    for i, v in enumerate(views):
-        sources.append((i, v.decoded_column(ci), v.null_mask(ci)))
-    for j, (pos, take) in enumerate(row_chunks):
-        src = np.asarray(manifest.row_arrays[ci][pos:pos + take],
-                         dtype=object)
-        rn = None
-        if manifest.row_nulls and manifest.row_nulls[ci] is not None:
-            rn = manifest.row_nulls[ci][pos:pos + take]
-        sources.append((len(views) + j, src, rn))
+    sources = _complex_column_sources(manifest, views, row_chunks, ci)
     if is_str:
         import itertools
 
@@ -447,7 +539,7 @@ def _build_array_column(data, manifest, views, row_chunks, ci, f, b, cap,
             ci, itertools.chain.from_iterable(
                 dec for _bi, dec, _nm in sources))
     else:
-        edt = f.dtype.element.device_dtype()
+        edt = _value_plate_dtype(f.dtype.element)
     maxlen = 1
     for _bi, dec, _nm in sources:
         for x in dec:
@@ -478,6 +570,8 @@ def _build_array_column(data, manifest, views, row_chunks, ci, f, b, cap,
         if nm is not None:
             null_mask[bi, :len(nm)] |= np.asarray(nm, dtype=bool)
             any_null = True
+    if not is_str:
+        vals = _finish_value_plate(f.dtype.element, vals)
     return ((_place(vals), _place(lens), _place(enul)),
             np.full(b, np.nan), np.full(b, np.nan),
             _place(null_mask) if any_null else None)

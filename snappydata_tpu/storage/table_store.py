@@ -38,6 +38,17 @@ from snappydata_tpu.storage.batch import ColumnBatch
 from snappydata_tpu.storage.encoding import decode_to_numpy, decode_validity
 
 
+def _struct_get(cell: dict, fname: str):
+    """Case-insensitive struct field read (analyzer semantics)."""
+    got = cell.get(fname)
+    if got is None:
+        fl = fname.lower()
+        for k, v in cell.items():
+            if isinstance(k, str) and k.lower() == fl:
+                return v
+    return got
+
+
 @dataclasses.dataclass(frozen=True)
 class BatchView:
     """One batch as visible in a particular Manifest version."""
@@ -216,6 +227,10 @@ class ColumnTableData:
             and f.dtype.value.name == "string"}
         self._map_val_lookup: Dict[int, Dict] = {
             i: {} for i in self._map_val_dicts}
+        # STRUCT columns: per-(column, field-name) value dictionaries
+        # for string fields, created lazily at the first intern
+        self._struct_dicts: Dict[int, Dict[str, List]] = {}
+        self._struct_lookup: Dict[int, Dict[str, Dict]] = {}
         self._manifest = Manifest(
             0, (), tuple(np.empty(0, dtype=f.dtype.np_dtype)
                          for f in schema.fields), 0,
@@ -316,6 +331,36 @@ class ColumnTableData:
             if col_idx not in self._map_val_dicts:
                 return None
             return np.array(self._map_val_dicts[col_idx], dtype=object)
+
+    def intern_struct_fields(self, col_idx: int, fnames, cells
+                             ) -> Dict[str, Dict]:
+        """Append-only intern of a STRUCT column's string-field values
+        — ALL fields in one pass over the cells (case-insensitive field
+        resolution like the analyzer). Returns {field: point-in-time
+        lookup copy}."""
+        with self._lock:
+            col_lk = self._struct_lookup.setdefault(col_idx, {})
+            col_d = self._struct_dicts.setdefault(col_idx, {})
+            lks = {fn: col_lk.setdefault(fn, {}) for fn in fnames}
+            ds = {fn: col_d.setdefault(fn, []) for fn in fnames}
+            for cell in cells:
+                if isinstance(cell, dict):
+                    for fn in fnames:
+                        v = _struct_get(cell, fn)
+                        if v is not None:
+                            key = str(v)
+                            lk = lks[fn]
+                            if key not in lk:
+                                d = ds[fn]
+                                lk[key] = len(d)
+                                d.append(key)
+            return {fn: dict(lk) for fn, lk in lks.items()}
+
+    def struct_field_dictionary(self, col_idx: int, fname: str
+                                ) -> np.ndarray:
+        with self._lock:
+            d = self._struct_dicts.get(col_idx, {}).get(fname, [])
+            return np.array(d, dtype=object)
 
     # --- writes ----------------------------------------------------------
 
@@ -540,7 +585,8 @@ class ColumnTableData:
             # intern into its neighbour's dictionary)
             for attr in ("_elem_dicts", "_elem_lookup", "_map_key_dicts",
                          "_map_key_lookup", "_map_val_dicts",
-                         "_map_val_lookup"):
+                         "_map_val_lookup", "_struct_dicts",
+                         "_struct_lookup"):
                 setattr(self, attr,
                         {remap(i): d
                          for i, d in getattr(self, attr).items()

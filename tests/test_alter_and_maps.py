@@ -272,3 +272,89 @@ def test_alter_add_drop_complex_columns_keep_device_dicts():
     assert s.sql("SELECT count(*) FROM dc "
                  "WHERE array_contains(tags, 'b')").rows()[0][0] == 1
     s.stop()
+
+
+def test_struct_device_field_access():
+    """Flat STRUCTs bind as per-field plates (string fields as codes):
+    element_at field access is a static plate pick in the compiled
+    program — filters and aggregates over fields run on device."""
+    from snappydata_tpu.observability.metrics import global_registry
+
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE sd (id INT, "
+          "loc STRUCT<city: STRING, pop: INT>) USING column")
+    s.sql("INSERT INTO sd VALUES "
+          "(1, named_struct('city', 'oslo', 'pop', 700000)), "
+          "(2, named_struct('city', 'bergen', 'pop', 290000)), "
+          "(3, NULL)")
+    before = global_registry().counter("host_fallbacks")
+    r = s.sql("SELECT id, element_at(loc, 'city'), "
+              "element_at(loc, 'pop') FROM sd ORDER BY id").rows()
+    assert r[0] == (1, "oslo", 700000)
+    assert r[1] == (2, "bergen", 290000)
+    assert r[2][1] is None and r[2][2] is None
+    # field names resolve case-insensitively, like the analyzer
+    assert s.sql("SELECT sum(element_at(loc, 'POP')) FROM sd"
+                 ).rows()[0][0] == 990000
+    assert s.sql("SELECT count(*) FROM sd WHERE "
+                 "element_at(loc, 'pop') > 500000").rows()[0][0] == 1
+    assert global_registry().counter("host_fallbacks") == before
+    # appended values keep stable field-dictionary codes
+    s.sql("INSERT INTO sd VALUES "
+          "(4, named_struct('city', 'alta', 'pop', 21000))")
+    got = s.sql("SELECT element_at(loc, 'city') FROM sd WHERE id IN "
+                "(1, 4) ORDER BY id").rows()
+    assert [g[0] for g in got] == ["oslo", "alta"]
+    # whole-struct SELECT keeps the host path (correct, just not device)
+    assert s.sql("SELECT loc FROM sd WHERE id = 1").rows() \
+        == [({"city": "oslo", "pop": 700000},)]
+    s.stop()
+
+
+def test_struct_device_persistence(tmp_path):
+    d = str(tmp_path / "store")
+    s = SnappySession(data_dir=d)
+    s.sql("CREATE TABLE sp (id INT, "
+          "v STRUCT<name: STRING, x: DOUBLE>) USING column")
+    s.sql("INSERT INTO sp VALUES (1, named_struct('name', 'a', 'x', 1.5)),"
+          " (2, named_struct('name', 'b', 'x', 2.5))")
+    s.checkpoint()
+    s.stop()
+    s2 = SnappySession(data_dir=d)
+    assert s2.sql("SELECT sum(element_at(v, 'x')) FROM sp"
+                  ).rows()[0][0] == pytest.approx(4.0)
+    assert s2.sql("SELECT element_at(v, 'name') FROM sp ORDER BY id"
+                  ).rows() == [("a",), ("b",)]
+    s2.stop()
+
+
+def test_decimal_values_in_complex_types_device():
+    """Exact-decimal fields/elements/values inside STRUCT/ARRAY/MAP
+    must scale into their int64 plates (review finding, verified:
+    1.50 decoded as 0.01 when the raw value truncated into int64)."""
+    from decimal import Decimal
+
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE dcx (id INT, "
+          "st STRUCT<price: DECIMAL(10,2), name: STRING>, "
+          "ar ARRAY<DECIMAL(10,2)>, "
+          "mp MAP<STRING, DECIMAL(10,2)>) USING column")
+    s.sql("INSERT INTO dcx VALUES "
+          "(1, named_struct('price', 1.50, 'name', 'a'), "
+          "array(1.25, 2.50), map('k', 10.01)), "
+          "(2, named_struct('price', 2.25, 'name', 'b'), "
+          "array(3.75), map('k', 0.99))")
+    r = s.sql("SELECT element_at(st, 'price'), element_at(ar, 1), "
+              "element_at(mp, 'k') FROM dcx ORDER BY id").rows()
+    assert r[0] == (Decimal("1.50"), Decimal("1.25"), Decimal("10.01"))
+    assert r[1] == (Decimal("2.25"), Decimal("3.75"), Decimal("0.99"))
+    assert s.sql("SELECT sum(element_at(st, 'price')) FROM dcx"
+                 ).rows()[0][0] == Decimal("3.75")
+    assert s.sql("SELECT sum(element_at(mp, 'k')) FROM dcx"
+                 ).rows()[0][0] == Decimal("11.00")
+    # decimal needle in array_contains scales like the elements
+    assert s.sql("SELECT count(*) FROM dcx WHERE "
+                 "array_contains(ar, 2.50)").rows()[0][0] == 1
+    assert s.sql("SELECT count(*) FROM dcx WHERE "
+                 "array_contains(ar, 2.51)").rows()[0][0] == 0
+    s.stop()
