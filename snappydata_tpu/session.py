@@ -1455,9 +1455,16 @@ class SnappySession:
                         "<", "<=", ">", ">=", "=", "<>", "!="):
                     done = False
                     for side in ("left", "right"):
-                        sub = getattr(e, side)
-                        if not isinstance(sub, ast.ScalarSubquery):
+                        side_expr = getattr(e, side)
+                        # the subquery may sit INSIDE arithmetic on the
+                        # comparison side (TPC-DS q6's `price > 1.2 *
+                        # (SELECT avg ...)`) — find exactly one and
+                        # splice the decorrelated value back in place
+                        subs = [x for x in ast.walk(side_expr)
+                                if isinstance(x, ast.ScalarSubquery)]
+                        if len(subs) != 1:
                             continue
+                        sub = subs[0]
                         got = split_scalar_agg(sub.plan)
                         if got is None:
                             continue
@@ -1499,6 +1506,13 @@ class SnappySession:
                             return x.map_children(_externalize)
 
                         sv = _externalize(sel)
+
+                        def _splice(x: ast.Expr) -> ast.Expr:
+                            if x == sub:
+                                return sv
+                            return x.map_children(_splice)
+
+                        sv = _splice(side_expr)
                         aggs = tuple(
                             ast.Alias(ic, f"__ck{j}")
                             for j, (_oc, ic) in enumerate(corr)

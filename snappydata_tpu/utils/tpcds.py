@@ -7,8 +7,9 @@ tables generate synthetically at a scale factor, FK-consistent, with
 the canonical column names so the canonical query text runs verbatim).
 
 Queries included: the brand/category revenue reporting family
-(q3, q42, q52, q55) plus the 6-way join q19 — the set the reference's
-benchmark runs most cited in its docs.
+(q3, q42, q52, q55), the 6-way join q19, the correlated-subquery
+customer report q6, the ROLLUP gross-margin report q36, and the
+windowed revenue-ratio report q98.
 """
 
 from __future__ import annotations
@@ -40,6 +41,12 @@ def gen_item(n: int, seed: int = 1) -> Dict[str, np.ndarray]:
     sk = np.arange(1, n + 1, dtype=np.int64)
     brand_id = rng.integers(1, 1000, n).astype(np.int32)
     cat_id = rng.integers(1, 11, n).astype(np.int32)
+    manufact = rng.integers(1, 200, n).astype(np.int32)
+    manager = rng.integers(1, 100, n).astype(np.int32)
+    price = np.round(rng.uniform(1, 100, n), 2)
+    # drawn AFTER the original columns so their RNG stream (and the
+    # canonical queries' point predicates) is unchanged
+    cls_id = rng.integers(1, 17, n).astype(np.int32)
     return {
         "i_item_sk": sk,
         "i_brand_id": brand_id,
@@ -47,9 +54,11 @@ def gen_item(n: int, seed: int = 1) -> Dict[str, np.ndarray]:
                             dtype=object),
         "i_category_id": cat_id,
         "i_category": np.array([f"cat#{c}" for c in cat_id], dtype=object),
-        "i_manufact_id": rng.integers(1, 200, n).astype(np.int32),
-        "i_manager_id": rng.integers(1, 100, n).astype(np.int32),
-        "i_current_price": np.round(rng.uniform(1, 100, n), 2),
+        "i_class_id": cls_id,
+        "i_class": np.array([f"class#{c}" for c in cls_id], dtype=object),
+        "i_manufact_id": manufact,
+        "i_manager_id": manager,
+        "i_current_price": price,
     }
 
 
@@ -138,6 +147,7 @@ def load_tpcds(session, sf: float = 0.001, seed: int = 0,
                 "d_moy INT, d_qoy INT, d_dow INT) USING column")
     session.sql("CREATE TABLE item (i_item_sk BIGINT, i_brand_id INT, "
                 "i_brand STRING, i_category_id INT, i_category STRING, "
+                "i_class_id INT, i_class STRING, "
                 "i_manufact_id INT, i_manager_id INT, "
                 "i_current_price DOUBLE) USING column")
     session.sql("CREATE TABLE customer (c_customer_sk BIGINT, "
@@ -211,4 +221,45 @@ WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
 GROUP BY i_brand_id, i_brand, i_manufact_id
 ORDER BY ext_price DESC, i_brand_id LIMIT 100"""
 
-QUERIES = {"q3": Q3, "q19": Q19, "q42": Q42, "q52": Q52, "q55": Q55}
+# q6: state-level count of customers buying items priced over 1.2x
+# their category average — CORRELATED scalar-aggregate subquery +
+# HAVING (month predicates widened to return rows at test scale)
+Q6 = """SELECT a.ca_state AS state, count(*) AS cnt
+FROM customer_address a, customer c, store_sales s, date_dim d, item i
+WHERE a.ca_address_sk = c.c_current_addr_sk
+  AND c.c_customer_sk = s.ss_customer_sk
+  AND s.ss_sold_date_sk = d.d_date_sk
+  AND s.ss_item_sk = i.i_item_sk
+  AND d.d_year = 2000
+  AND i.i_current_price > 1.2 *
+      (SELECT avg(j.i_current_price) FROM item j
+       WHERE j.i_category = i.i_category)
+GROUP BY a.ca_state HAVING count(*) >= 10
+ORDER BY cnt, state LIMIT 100"""
+
+# q36: gross-margin reporting over ROLLUP(category, class)
+Q36 = """SELECT sum(ss_net_profit) / sum(ss_ext_sales_price)
+    AS gross_margin, i_category, i_class
+FROM store_sales, date_dim, item, store
+WHERE d_date_sk = ss_sold_date_sk AND ss_item_sk = i_item_sk
+  AND ss_store_sk = s_store_sk AND d_year = 2001
+  AND s_state IN ('CA', 'TX')
+GROUP BY ROLLUP(i_category, i_class)
+ORDER BY gross_margin, i_category, i_class LIMIT 100"""
+
+# q98: per-item revenue as a ratio of its class total — a window
+# aggregate over the grouped result
+Q98 = """SELECT i_item_sk, i_class, itemrevenue,
+    itemrevenue * 100.0 / sum(itemrevenue)
+        OVER (PARTITION BY i_class) AS revenueratio
+FROM (SELECT i_item_sk, i_class,
+             sum(ss_ext_sales_price) AS itemrevenue
+      FROM store_sales, item, date_dim
+      WHERE ss_item_sk = i_item_sk AND ss_sold_date_sk = d_date_sk
+        AND i_category IN ('cat#1', 'cat#2', 'cat#3')
+        AND d_year = 1999 AND d_moy = 2
+      GROUP BY i_item_sk, i_class) t
+ORDER BY i_class, revenueratio LIMIT 100"""
+
+QUERIES = {"q3": Q3, "q6": Q6, "q19": Q19, "q36": Q36, "q42": Q42,
+           "q52": Q52, "q55": Q55, "q98": Q98}
