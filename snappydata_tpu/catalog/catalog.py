@@ -102,7 +102,16 @@ class Catalog:
                 base_table=_norm(base_table) if base_table else None)
             self._tables[key] = info
             self.generation += 1
-            return info
+        # resource broker ledger, keyed per catalog (same-named tables in
+        # different catalogs must not clobber each other). Internal
+        # scratch tables ('__'-named, e.g. the tiled-merge partials) stay
+        # out of the operator-facing ledger. Outside the catalog lock —
+        # the broker has its own and lock nesting must stay one-way.
+        if not key.split(".")[-1].startswith("__"):
+            from snappydata_tpu.resource import global_broker
+
+            global_broker().register_table(key, data, owner=id(self))
+        return info
 
     def drop_table(self, name: str, if_exists: bool = False) -> bool:
         key = _norm(name)
@@ -113,7 +122,12 @@ class Catalog:
                 raise ValueError(f"table not found: {name}")
             del self._tables[key]
             self.generation += 1
-            return True
+        # plan caches may keep the data object alive — unregister so a
+        # DROPped table stops counting toward broker memory pressure
+        from snappydata_tpu.resource import global_broker
+
+        global_broker().unregister_table(key, owner=id(self))
+        return True
 
     def create_view(self, name: str, plan, or_replace: bool = False) -> None:
         key = _norm(name)

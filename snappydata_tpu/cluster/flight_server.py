@@ -59,8 +59,22 @@ def result_to_arrow(result, sel: Optional[np.ndarray] = None) -> pa.Table:
                     return T.unscaled_to_python(dtype, v)
                 return fconv(v)
 
-            arrays.append(pa.array(
-                [cell(i, v) for i, v in enumerate(col)], type=pt))
+            cells = [cell(i, v) for i, v in enumerate(col)]
+            try:
+                arrays.append(pa.array(cells, type=pt))
+            except (pa.ArrowInvalid, pa.ArrowTypeError):
+                # the engine's int64-overflow fallback returns an
+                # APPROXIMATE float total that can be wider than the
+                # declared precision (decimal_sum_type caps at p=18) —
+                # widen the wire type rather than failing the export
+                # the local session happily answers (advisor round 5)
+                try:
+                    arrays.append(pa.array(
+                        cells, type=pa.decimal128(38, dtype.scale)))
+                except (pa.ArrowInvalid, pa.ArrowTypeError):
+                    arrays.append(pa.array(
+                        [None if c is None else float(c) for c in cells],
+                        type=pa.float64()))
         elif dtype.name == "string" or col.dtype == object:
             arrays.append(pa.array(
                 [None if (nmask is not None and nmask[i]) or v is None

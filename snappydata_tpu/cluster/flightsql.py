@@ -41,6 +41,12 @@ _SQL_NS = "type.googleapis.com/arrow.flight.protocol.sql."
 # ---------------------------------------------------------------------
 
 def _put_varint(out: bytearray, v: int) -> None:
+    if v < 0:
+        # proto varints are two's-complement over 64 bits: negative
+        # int32/int64 values encode as 10 bytes (e.g. the spec'd
+        # DoPutUpdateResult.record_count = -1 for 'unknown'). Without the
+        # mask the arithmetic shift below never terminates.
+        v &= 0xFFFFFFFFFFFFFFFF
     while True:
         b = v & 0x7F
         v >>= 7
@@ -49,6 +55,13 @@ def _put_varint(out: bytearray, v: int) -> None:
         else:
             out.append(b)
             return
+
+
+def varint_to_int64(v: int) -> int:
+    """Decoded varints are raw unsigned 64-bit values; reinterpret as the
+    signed int64 proto3 int32/int64 fields carry (-1 arrives as 2^64-1)."""
+    v &= 0xFFFFFFFFFFFFFFFF
+    return v - (1 << 64) if v >= (1 << 63) else v
 
 
 def _get_varint(buf: bytes, pos: int) -> Tuple[int, int]:
@@ -64,31 +77,44 @@ def _get_varint(buf: bytes, pos: int) -> Tuple[int, int]:
 
 def encode_fields(fields: List[Tuple[int, object]]) -> bytes:
     """fields: (field_number, value) — str/bytes → length-delimited,
-    int/bool → varint. Nones AND proto3 defaults (0, False, empty
-    str/bytes) are skipped, matching the official runtime's canonical
-    serialization byte for byte (asserted against golden fixtures
-    generated with google.protobuf — tests/test_flightsql_golden.py)."""
+    int/bool → varint, list/tuple → REPEATED field (one entry per
+    element). Nones AND proto3 defaults (0, False, empty str/bytes) are
+    skipped for SINGULAR fields only — that is proto3 canonical
+    serialization; a repeated-field element that happens to be a default
+    value (e.g. an empty string in CommandGetTables.table_types) is a
+    real element and must stay on the wire (advisor round 5). Matches
+    the official runtime byte for byte (golden fixtures generated with
+    google.protobuf — tests/test_flightsql_golden.py)."""
     out = bytearray()
-    for num, val in fields:
-        if val is None:
-            continue
+
+    def put_one(num: int, val, skip_defaults: bool) -> None:
         if isinstance(val, bool):
-            if not val:
-                continue
+            if skip_defaults and not val:
+                return
             _put_varint(out, (num << 3) | 0)
-            _put_varint(out, 1)
+            _put_varint(out, 1 if val else 0)
         elif isinstance(val, int):
-            if val == 0:
-                continue
+            if skip_defaults and val == 0:
+                return
             _put_varint(out, (num << 3) | 0)
             _put_varint(out, val)
         else:
             raw = val.encode("utf-8") if isinstance(val, str) else bytes(val)
-            if not raw:
-                continue
+            if skip_defaults and not raw:
+                return
             _put_varint(out, (num << 3) | 2)
             _put_varint(out, len(raw))
-            out += raw
+            out.extend(raw)
+
+    for num, val in fields:
+        if val is None:
+            continue
+        if isinstance(val, (list, tuple)):
+            for el in val:
+                if el is not None:
+                    put_one(num, el, skip_defaults=False)
+        else:
+            put_one(num, val, skip_defaults=True)
     return bytes(out)
 
 
@@ -183,28 +209,35 @@ class FlightSqlHandler:
                 "db_schema_name": pa.array(["app"], pa.utf8())})
         # CommandGetTables
         pattern = _s(f, 3)
+        # repeated table_types (field 4): empty list = no filter; an
+        # empty-string ELEMENT is a real (nothing-matching) filter
+        # entry, preserved by the repeated-aware codec
+        type_filter = {v.decode("utf-8", "replace").upper()
+                       for v in f.get(4, [])}
         include_schema = bool(f.get(5, [0])[0])
         names, types, schemas = [], [], []
-        for info in sess.catalog.list_tables():
-            nm = info.name
-            if pattern and not _like_match(pattern, nm):
-                continue
-            names.append(nm)
-            types.append("TABLE")
-            if include_schema:
-                fields = [pa.field(fl.name, _ARROW_OF(fl.dtype),
-                                   fl.nullable)
-                          for fl in info.schema.fields
-                          if not fl.name.startswith("__")]
-                schemas.append(pa.schema(fields)
-                               .serialize().to_pybytes())
-        for vname in sorted(getattr(sess.catalog, "_views", {})):
-            if pattern and not _like_match(pattern, vname):
-                continue
-            names.append(vname)
-            types.append("VIEW")
-            if include_schema:
-                schemas.append(pa.schema([]).serialize().to_pybytes())
+        if not type_filter or "TABLE" in type_filter:
+            for info in sess.catalog.list_tables():
+                nm = info.name
+                if pattern and not _like_match(pattern, nm):
+                    continue
+                names.append(nm)
+                types.append("TABLE")
+                if include_schema:
+                    fields = [pa.field(fl.name, _ARROW_OF(fl.dtype),
+                                       fl.nullable)
+                              for fl in info.schema.fields
+                              if not fl.name.startswith("__")]
+                    schemas.append(pa.schema(fields)
+                                   .serialize().to_pybytes())
+        if not type_filter or "VIEW" in type_filter:
+            for vname in sorted(getattr(sess.catalog, "_views", {})):
+                if pattern and not _like_match(pattern, vname):
+                    continue
+                names.append(vname)
+                types.append("VIEW")
+                if include_schema:
+                    schemas.append(pa.schema([]).serialize().to_pybytes())
         cols = {
             "catalog_name": pa.array(["snappydata"] * len(names),
                                      pa.utf8()),
@@ -253,9 +286,9 @@ class FlightSqlHandler:
 
     def _query_schema(self, sess, sql: str, params) -> "pa.Schema":
         schema = sess.query_schema(sql)
-        return pa.schema([pa.field(fl.name, _ARROW_OF(fl.dtype),
-                                   fl.nullable)
-                          for fl in schema.fields])
+        return _widen_decimal_schema(pa.schema(
+            [pa.field(fl.name, _ARROW_OF(fl.dtype), fl.nullable)
+             for fl in schema.fields]))
 
     # -- DoGet ---------------------------------------------------------
 
@@ -270,7 +303,7 @@ class FlightSqlHandler:
             body = json.loads((_b(f, 1) or b"{}").decode("utf-8"))
             result = sess.sql(body["sql"],
                               params=tuple(body.get("params", ())))
-            table = result_to_arrow(result)
+            table = _widen_decimal_table(result_to_arrow(result))
         elif kind == "CommandPreparedStatementQuery":
             handle = _b(f, 1) or b""
             with self._lock:
@@ -280,15 +313,20 @@ class FlightSqlHandler:
                     "unknown prepared statement handle")
             result = sess.sql(st["sql"],
                               params=tuple(st.get("params", ())))
-            table = result_to_arrow(result)
+            table = _widen_decimal_table(result_to_arrow(result))
         elif kind in ("CommandGetCatalogs", "CommandGetDbSchemas",
                       "CommandGetTables"):
             table = self._catalog_rows(sess, kind, f)
         else:
             raise flight.FlightServerError(
                 f"unsupported FlightSQL ticket {kind}")
+        # 0-row results still need one (empty) batch carrying the schema;
+        # pa.record_batch([], schema=non-empty-schema) raises — build the
+        # empty arrays explicitly
         batches = table.to_batches(max_chunksize=65536) or \
-            [pa.record_batch([], schema=table.schema)]
+            [pa.RecordBatch.from_arrays(
+                [pa.array([], type=f.type) for f in table.schema],
+                schema=table.schema)]
         return flight.GeneratorStream(table.schema, iter(batches))
 
     # -- DoAction ------------------------------------------------------
@@ -330,9 +368,12 @@ class FlightSqlHandler:
         if kind == "CommandStatementUpdate":
             sql = _s(f, 1, "")
             result = sess.sql(sql)
+            # spec: record_count = -1 means 'unknown' (statements like
+            # DDL whose result carries no row count) — encoded as a
+            # 10-byte two's-complement varint
             n = int(result.rows()[0][0]) if result.num_rows and \
                 result.columns and np.issubdtype(
-                    np.asarray(result.columns[0]).dtype, np.number) else 0
+                    np.asarray(result.columns[0]).dtype, np.number) else -1
             writer.write(encode_fields([(1, n)]))   # DoPutUpdateResult
             return
         if kind == "CommandPreparedStatementQuery":
@@ -351,6 +392,28 @@ class FlightSqlHandler:
             return
         raise flight.FlightServerError(
             f"unsupported FlightSQL DoPut {kind}")
+
+
+def _widen_decimal_schema(schema: "pa.Schema") -> "pa.Schema":
+    """FlightSQL surface only: decimals travel as decimal128(38, s) so
+    the GetFlightInfo schema and the DoGet stream ALWAYS agree — the
+    engine's int64-overflow fallback can produce totals wider than the
+    declared precision, and stock drivers that pre-allocate readers
+    from FlightInfo reject a stream whose types differ. (The plain
+    Flight ticket surface keeps exact declared types — the in-repo
+    client and the exchange path read the stream schema directly.)"""
+    fields = []
+    for f in schema:
+        if pa.types.is_decimal(f.type) and f.type.precision < 38:
+            f = pa.field(f.name, pa.decimal128(38, f.type.scale),
+                         f.nullable)
+        fields.append(f)
+    return pa.schema(fields)
+
+
+def _widen_decimal_table(table: "pa.Table") -> "pa.Table":
+    wide = _widen_decimal_schema(table.schema)
+    return table if wide == table.schema else table.cast(wide)
 
 
 def _like_match(pattern: str, name: str) -> bool:
@@ -418,11 +481,14 @@ class FlightSqlClient:
         if buf is None:
             return 0
         f = decode_fields(buf.to_pybytes())
-        return int(f.get(1, [0])[0])
+        return varint_to_int64(int(f.get(1, [0])[0]))
 
     def get_tables(self, pattern: Optional[str] = None,
-                   include_schema: bool = False) -> pa.Table:
-        payload = encode_fields([(3, pattern), (5, include_schema)])
+                   include_schema: bool = False,
+                   table_types: Optional[Sequence[str]] = None) -> pa.Table:
+        payload = encode_fields([(3, pattern),
+                                 (4, list(table_types or ())),
+                                 (5, include_schema)])
         return self._read(self._info("CommandGetTables", payload))
 
     def get_catalogs(self) -> pa.Table:

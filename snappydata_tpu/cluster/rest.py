@@ -26,14 +26,23 @@ class JobRegistry:
         self._lock = threading.Lock()
 
     def submit_sql(self, sql: str, params=(), session=None) -> str:
+        from snappydata_tpu import resource
+
         job_id = uuid.uuid4().hex[:12]
         sess = session or self.session
+        # the job's governor context is created AND registered up front
+        # so its queryId is visible (GET /jobs/<id>) and cancellable
+        # (POST /queries/<qid>/cancel) from the moment of submission —
+        # even before the worker thread reaches admission
+        ctx = resource.global_broker().watch(
+            resource.new_query(sql, user=sess.user))
         with self._lock:
-            self._jobs[job_id] = {"status": "RUNNING", "sql": sql}
+            self._jobs[job_id] = {"status": "RUNNING", "sql": sql,
+                                  "queryId": ctx.query_id}
 
         def run():
             try:
-                result = sess.sql(sql, params=params)
+                result = sess.sql(sql, params=params, query_ctx=ctx)
                 with self._lock:
                     self._jobs[job_id].update(
                         status="FINISHED",
@@ -43,6 +52,11 @@ class JobRegistry:
             except Exception as e:
                 with self._lock:
                     self._jobs[job_id].update(status="ERROR", error=str(e))
+            finally:
+                # idempotent: clears the watched registration even when
+                # the statement failed before reaching admission (parse
+                # errors included)
+                resource.global_broker().release(ctx)
 
         threading.Thread(target=run, daemon=True).start()
         return job_id
@@ -212,6 +226,21 @@ class RestService:
                                     "plan": [r[0] for r in plan.rows()]})
                     except Exception as e:  # noqa: BLE001
                         self._send({"error": str(e)}, 500)
+                elif path == "/queries":
+                    # live governed queries (running + queued) from the
+                    # resource broker — query text leaks literals, so
+                    # the same auth gate as /jobs applies
+                    if self._principal_session() is None:
+                        return
+                    from snappydata_tpu import resource
+
+                    self._send(resource.global_broker().queries())
+                elif path == "/queries/ledger":
+                    if self._principal_session() is None:
+                        return
+                    from snappydata_tpu import resource
+
+                    self._send(resource.global_broker().ledger())
                 elif path == "/metrics/json":
                     self._send(global_registry().snapshot())
                 elif path == "/metrics/prometheus":
@@ -289,6 +318,30 @@ class RestService:
                         body["sql"], tuple(body.get("params", ())),
                         session=sess)
                     self._send({"jobId": job_id, "status": "STARTED"})
+                elif path.startswith("/queries/") and \
+                        path.endswith("/cancel"):
+                    # cooperative cancel: flags the query's context; the
+                    # engine stops it at the next batch/tile boundary.
+                    # Non-admin principals may only cancel their own.
+                    sess = self._principal_session()
+                    if sess is None:
+                        return
+                    qid = path[len("/queries/"):-len("/cancel")]
+                    from snappydata_tpu import resource
+
+                    # same is-not-None test as _principal_session: a
+                    # falsy-but-configured provider must still gate
+                    gate = sess.user if (svc.auth_tokens or
+                                         svc.auth_provider is not None) \
+                        else None
+                    try:
+                        ok = resource.global_broker().cancel(
+                            qid, "cancelled via REST", user=gate)
+                    except PermissionError as e:
+                        self._send({"error": str(e)}, 403)
+                        return
+                    self._send({"queryId": qid, "cancelled": ok},
+                               200 if ok else 404)
                 elif path == "/rebalance":
                     # SYS.REBALANCE_ALL_BUCKETS analogue (operator
                     # action; admin only when auth is on)

@@ -90,6 +90,28 @@ class Properties:
     # to host, from which they rebuild on next access)
     device_cache_bytes: int = 0               # 0 = unlimited
 
+    # Resource governor (resource/broker.py; ref: critical-heap-percentage
+    # admission + LowMemoryException fail-fast). memory_limit_bytes is the
+    # unified host+device budget admission meters query estimates against;
+    # 0 disables admission accounting (queries still register for CANCEL/
+    # timeout). Crossing high_watermark × limit of MEASURED usage triggers
+    # graceful degradation (plan-cache evict → batch spill → cancel the
+    # hungriest query) down to low_watermark × limit.
+    memory_limit_bytes: int = 0
+    memory_high_watermark: float = 0.85
+    memory_low_watermark: float = 0.70
+    # Bounded admission FIFO: queries that don't fit wait here up to
+    # admission_wait_s before being rejected with LowMemoryException.
+    admission_queue_depth: int = 16
+    admission_wait_s: float = 30.0
+    # Per-principal fair slots: one user may hold at most this many
+    # concurrently admitted queries (0 = unlimited).
+    admission_slots_per_user: int = 0
+    # Statement timeout (spark.sql.broadcastTimeout analogue for whole
+    # queries): a query running past this is cancelled cooperatively at
+    # the next batch/tile boundary with SQLSTATE XCL52. 0 = none.
+    query_timeout_s: float = 0.0
+
     # Tiled scans ("table ≫ HBM"): when one column table's decoded bind
     # exceeds this budget, aggregate queries stream the batch axis through
     # the same compiled program tile by tile and merge partials (ref:
@@ -128,6 +150,8 @@ class Properties:
             cur = getattr(self, key_norm)
             if isinstance(cur, bool) and isinstance(value, str):
                 value = value.lower() in ("1", "true", "yes", "on")
+            elif isinstance(cur, float) and not isinstance(value, bool):
+                value = float(value)
             elif isinstance(cur, int) and not isinstance(value, bool):
                 value = int(value)
             setattr(self, key_norm, value)

@@ -42,6 +42,7 @@ from snappydata_tpu.engine.exprs import (STRING_VALUE_FUNCS, CompileError,
                                          _or_null)
 from snappydata_tpu.engine.result import Result, empty_result
 from snappydata_tpu.ops import pallas_group as _pg
+from snappydata_tpu.resource.context import check_current
 from snappydata_tpu.sql import ast
 from snappydata_tpu.sql.analyzer import expr_type, _expr_name
 
@@ -207,6 +208,9 @@ class CompiledPlan:
     def execute(self, params: Tuple) -> Result:
         from snappydata_tpu.observability.metrics import global_registry
 
+        # one compiled dispatch is the atomic unit of work — the
+        # cooperative cancellation point sits right before it
+        check_current()
         reg = global_registry()
         # data-dependent validity (e.g. join build-key uniqueness): raises
         # CompileError -> executor reroutes to the host path
@@ -1159,6 +1163,25 @@ class Compiler:
         post_aux_off = len(self.aux_builders) - len(post_builder.aux_builders)
         builder_aux_off = 0  # builder auxes registered first (see _builder_for)
 
+        # scan-tile scale (dynamic aux, so the jitted program is shared
+        # across tiles): under scan_tile_bytes tiling each execution sees
+        # one window of the table, and the exact-decimal sum overflow
+        # guard must bound the MERGED total across all tiles — per-tile
+        # bounds can each pass while the int64 partial-merge total wraps
+        # silently (advisor round 5). 1.0 outside a tile pass.
+        rel_inputs = list(self.relations)
+        tile_scale_aux = len(self.aux_builders)
+
+        def _tile_scale(params, _rels=rel_inputs):
+            from snappydata_tpu.storage.device import current_scan_scale
+
+            scale = 1.0
+            for r in _rels:
+                scale = max(scale, current_scan_scale(r.info.data))
+            return np.float64(scale)
+
+        self.aux_builders.append(_tile_scale)
+
         out_cols = []
         for e_out, e_rw, dt in zip(plan.agg_exprs, select_rewritten, out_types):
             provider = None
@@ -1349,13 +1372,20 @@ class Compiler:
                             # total CAN exceed int64 (p=18, ~1e18 rows'
                             # headroom notwithstanding) — bound-check
                             # max|v| * count and reroute to the host
-                            # path instead of wrapping silently
+                            # path instead of wrapping silently. The
+                            # tile scale extends the bound to the
+                            # merged total of a scan_tile_bytes pass:
+                            # if every tile keeps absmax·count·T below
+                            # 2^62 then |Σ tiles| < 2^62 too.
                             absmax = seg("max",
                                          jnp.where(w, jnp.abs(acc), 0))
                             cnt_w = seg("count", w)
+                            tscale = jnp.asarray(
+                                ctx.aux[tile_scale_aux], jnp.float64)
                             overflow = overflow | jnp.any(
                                 absmax.astype(jnp.float64)
-                                * cnt_w.astype(jnp.float64) >= 2.0 ** 62)
+                                * cnt_w.astype(jnp.float64)
+                                * tscale >= 2.0 ** 62)
                         slot_arrays.append(
                             seg("sum", jnp.where(w, acc, 0)))
                 elif kind == "sumsq":
@@ -1923,6 +1953,12 @@ class Executor:
         self.props = props or config.global_properties()
         self._plan_cache: Dict = {}
         self._depth = 0
+        # plan caches are the first thing the resource broker evicts
+        # under memory pressure (weak registration — executors die with
+        # their sessions)
+        from snappydata_tpu.resource import global_broker
+
+        global_broker().register_executor(self)
 
     def clear_cache(self):
         self._plan_cache.clear()
@@ -1930,6 +1966,7 @@ class Executor:
     def execute(self, plan: ast.Plan, params: Tuple = ()) -> Result:
         from snappydata_tpu.observability.metrics import global_registry
 
+        check_current()  # cancellation point: every (sub)plan execution
         if self._depth:  # nested calls (unions, host fallback) count once
             return self._execute_with_host_ops(plan, params)
         reg = global_registry()
@@ -2100,6 +2137,7 @@ class Executor:
         for view in m.views:
             if have >= n:
                 break
+            check_current()  # batch boundary = cancellation point
             decoded += 1
             live = view.live_mask()
             lazy = data._decode_all(view)

@@ -1120,6 +1120,9 @@ def _unsort(sorted_vals, sorted_idx, dtype):
 # --------------------------------------------------------------------------
 
 def eval_plan(plan: ast.Plan, params, executor) -> Result:
+    from snappydata_tpu.resource.context import check_current
+
+    check_current()  # host fallback entry = cancellation point
     cols, nulls, names, dtypes, n = _eval_rel(plan, params, executor)
     return Result(names, cols, nulls, dtypes)
 
@@ -1134,10 +1137,19 @@ def _eval_rel(plan: ast.Plan, params, executor):
             arrays, col_nulls, cnt = info.data.to_arrays_with_nulls()
             cols = [np.asarray(a) for a in arrays]
         else:
-            m = info.data.snapshot()
+            from snappydata_tpu.resource.context import check_current
+            from snappydata_tpu.storage.device import host_scan_units
+
+            # honor the active scan window (same pinned snapshot and
+            # unit slice as build_device_table): when a tile of a
+            # scan_tile_bytes pass falls back to host — e.g. the exact-
+            # decimal overflow guard fired — it must read ITS tile only,
+            # or the merge would double-count every other tile
+            m, views, row_chunks = host_scan_units(info.data)
             chunks: List[List[np.ndarray]] = [[] for _ in info.schema.fields]
             nchunks: List[List[np.ndarray]] = [[] for _ in info.schema.fields]
-            for view in m.views:
+            for view in views:
+                check_current()  # batch boundary = cancellation point
                 live = view.live_mask()
                 lazy = info.data._decode_all(view)
                 for i, f in enumerate(info.schema.fields):
@@ -1146,12 +1158,13 @@ def _eval_rel(plan: ast.Plan, params, executor):
                     nchunks[i].append(
                         nm[live] if nm is not None
                         else np.zeros(int(live.sum()), dtype=np.bool_))
-            if m.row_count:
+            for pos, take in row_chunks:
+                sl = slice(pos, pos + take)
                 for i, f in enumerate(info.schema.fields):
-                    chunks[i].append(np.asarray(m.row_arrays[i]))
-                    rn = m.row_nulls[i] if m.row_nulls and \
+                    chunks[i].append(np.asarray(m.row_arrays[i])[sl])
+                    rn = m.row_nulls[i][sl] if m.row_nulls and \
                         m.row_nulls[i] is not None else \
-                        np.zeros(m.row_count, dtype=np.bool_)
+                        np.zeros(take, dtype=np.bool_)
                     nchunks[i].append(rn)
             cols = [np.concatenate(ch) if ch else
                     np.empty(0, dtype=f.dtype.np_dtype)

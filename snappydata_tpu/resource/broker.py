@@ -1,0 +1,430 @@
+"""Process-wide resource broker: unified ledger + admission control.
+
+Reference: SnappyUnifiedMemoryManager meters every storage/execution
+allocation against eviction/critical heap percentages and fails new
+work with LowMemoryException instead of dying
+(SnappyUnifiedMemoryManager.scala:379-401, docs/best_practices/
+memory_management.md:86-103). This module is the TPU-first analogue:
+
+- **accounting**: one ledger over host bytes (resident encoded batches,
+  row-delta buffers, spill files) and device bytes (cached decoded
+  plates) per table, unifying the previously scattered `nbytes` /
+  `_DeviceCacheBudget` bookkeeping behind `ledger()` with high/low
+  watermarks;
+- **admission control**: `admit()` either admits, queues (bounded FIFO
+  with per-principal fair slots), or rejects with a SnappyData-style
+  `LowMemoryException` (SQLSTATE XCL54). Crossing the high watermark
+  triggers graceful degradation in order: evict compiled-plan caches,
+  spill cold batches to disk, then cancel the hungriest admitted query;
+- **cancellation**: `cancel(query_id)` flags the query's context; the
+  cooperative checks threaded through the engine stop it at the next
+  batch/tile boundary.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+from snappydata_tpu import config
+from snappydata_tpu.observability.metrics import global_registry
+from snappydata_tpu.resource.context import (CancelException,
+                                             LowMemoryException,
+                                             QueryContext)
+
+
+def _host_table_bytes(data) -> int:
+    """Resident host bytes of one table: encoded batch arrays that are
+    actually in RAM (memmapped spill pages count 0 — the OS page cache
+    owns them) plus the row-delta buffer; row tables charge their live
+    rows at decoded width (they hold Python-object lists, so this is an
+    estimate — but ZERO would hide them from the ledger entirely)."""
+    total = 0
+    manifest = getattr(data, "_manifest", None)
+    if manifest is not None and hasattr(manifest, "views"):
+        from snappydata_tpu.storage.hoststore import batch_resident_bytes
+
+        for v in manifest.views:
+            try:
+                total += batch_resident_bytes(v.batch)
+            except Exception:
+                pass
+        for a in manifest.row_arrays or ():
+            if a is not None and getattr(a, "dtype", None) is not None \
+                    and a.dtype != object:
+                total += int(a.nbytes)
+        return total
+    live = getattr(data, "_live", None)
+    if live is not None and getattr(data, "schema", None) is not None:
+        from snappydata_tpu.resource.estimate import _decoded_row_width
+
+        try:
+            # count(True) — tombstoned update slots must not double the
+            # charge (an updated row flags the old slot dead)
+            return live.count(True) * _decoded_row_width(data.schema)
+        except Exception:
+            return 0
+    return total
+
+
+class ResourceBroker:
+    """One broker per process (see `global_broker()`); multi-node setups
+    run one per member, exactly like the reference's per-JVM memory
+    manager."""
+
+    def __init__(self, props=None):
+        self.props = props or config.global_properties()
+        self._cond = threading.Condition(threading.Lock())
+        self._active: Dict[str, QueryContext] = {}
+        self._queue: List[QueryContext] = []
+        self._inflight_bytes = 0
+        # the table registry gets its OWN lock: metrics gauges walk it
+        # while the metrics registry lock is held, and admission bumps
+        # metrics counters while _cond is held — sharing _cond here
+        # would be a lock-order inversion (snapshot deadlock)
+        self._tables_lock = threading.Lock()
+        # keyed (owner, name): one process holds many Catalog instances
+        # (per-test sessions, scratch merges) — name-only keys let a
+        # same-named table in another catalog silently replace this one's
+        # ledger line
+        self._tables: Dict[Tuple[int, str], "weakref.ref"] = {}
+        self._executors: "weakref.WeakSet" = weakref.WeakSet()
+        # submitted-but-not-yet-admitted contexts (jobserver): visible
+        # and cancellable from the moment of submission
+        self._watched: Dict[str, QueryContext] = {}
+        self._measured_cache: Tuple[float, int, int] = (0.0, 0, 0)
+        reg = global_registry()
+        reg.gauge("governor_inflight_bytes",
+                  lambda: float(self._inflight_bytes))
+        reg.gauge("governor_active_queries", lambda: float(len(self._active)))
+        reg.gauge("governor_queued_queries", lambda: float(len(self._queue)))
+        # one cached ledger walk serves both gauges per scrape window
+        reg.gauge("governor_host_bytes",
+                  lambda: float(self.measured_bytes(max_age_s=1.0)[0]))
+        reg.gauge("governor_device_bytes",
+                  lambda: float(self.measured_bytes(max_age_s=1.0)[1]))
+
+    # -- knobs (read live so SET takes effect without a restart) --------
+
+    def _limit(self) -> int:
+        return int(self.props.memory_limit_bytes or 0)
+
+    def accounting_enabled(self) -> bool:
+        return self._limit() > 0
+
+    def _high_bytes(self, limit: int) -> float:
+        return limit * float(self.props.memory_high_watermark)
+
+    def _low_bytes(self, limit: int) -> float:
+        return limit * float(self.props.memory_low_watermark)
+
+    # -- ledger ---------------------------------------------------------
+
+    def register_table(self, name: str, data, owner: int = 0) -> None:
+        with self._tables_lock:
+            self._tables[(owner, name.lower())] = weakref.ref(data)
+
+    def unregister_table(self, name: str, owner: int = 0) -> None:
+        """DROP TABLE must drop the ledger line too: plan caches can
+        keep the data object alive (strong refs in compiled relations),
+        and a dropped table still counting toward memory pressure would
+        trigger degradation to free bytes the user already released."""
+        with self._tables_lock:
+            self._tables.pop((owner, name.lower()), None)
+
+    def register_executor(self, executor) -> None:
+        self._executors.add(executor)
+
+    def _iter_tables(self) -> List[Tuple[str, object]]:
+        out = []
+        with self._tables_lock:
+            dead = []
+            for (owner, nm), ref in self._tables.items():
+                data = ref()
+                if data is None:
+                    dead.append((owner, nm))
+                else:
+                    out.append((nm, data))
+            for k in dead:
+                self._tables.pop(k, None)
+        return out
+
+    def ledger(self) -> dict:
+        """Point-in-time unified ledger: per-table host/device bytes,
+        spill-file bytes, and per-query admitted estimates."""
+        from snappydata_tpu.storage import hoststore
+        from snappydata_tpu.storage.device import device_cache_bytes_by_table
+
+        tables = self._iter_tables()
+        host: Dict[str, int] = {}
+        for nm, data in tables:   # same-named tables in two catalogs SUM
+            host[nm] = host.get(nm, 0) + _host_table_bytes(data)
+        device = device_cache_bytes_by_table(tables)
+        with self._cond:
+            queries = {qid: int(ctx.estimate_bytes)
+                       for qid, ctx in self._active.items()}
+        return {
+            "host": host,
+            "device": device,
+            "spill_file_bytes": hoststore.spill_file_bytes(),
+            "host_total": sum(host.values()),
+            "device_total": sum(device.values()),
+            "queries": queries,
+            "inflight_bytes": int(self._inflight_bytes),
+        }
+
+    def measured_bytes(self, max_age_s: float = 0.0) -> Tuple[int, int]:
+        """(host_bytes, device_bytes) actually in use. `max_age_s` lets
+        cheap consumers (metrics gauges) reuse a recent walk instead of
+        re-summing every table's batches per scrape."""
+        if max_age_s > 0:
+            ts, h, d = self._measured_cache
+            if time.monotonic() - ts <= max_age_s:
+                return h, d
+        from snappydata_tpu.storage.device import device_cache_bytes_by_table
+
+        tables = self._iter_tables()
+        host = sum(_host_table_bytes(d) for _, d in tables)
+        device = sum(device_cache_bytes_by_table(tables).values())
+        self._measured_cache = (time.monotonic(), host, device)
+        return host, device
+
+    # -- admission ------------------------------------------------------
+
+    def _has_room(self, ctx: QueryContext, limit: int) -> bool:
+        return self._inflight_bytes + ctx.estimate_bytes <= limit
+
+    def _fair_slot_free(self, ctx: QueryContext) -> bool:
+        slots = int(self.props.admission_slots_per_user or 0)
+        if slots <= 0:
+            return True
+        held = sum(1 for c in self._active.values() if c.user == ctx.user)
+        return held < slots
+
+    def admit(self, ctx: QueryContext, estimate_bytes: int = 0,
+              timeout_s: float = 0.0) -> QueryContext:
+        """Admit, queue, or reject `ctx`. On admit the context is
+        registered (visible to `queries()`/`cancel()`) and its statement
+        deadline starts. Callers MUST pair with `release(ctx)`."""
+        reg = global_registry()
+        ctx.estimate_bytes = int(estimate_bytes or 0)
+        if ctx.cancelled:
+            # cancelled in the submit→admit window (watched jobserver
+            # contexts): never start running
+            raise CancelException(
+                f"query {ctx.query_id} "
+                f"{ctx.cancel_reason or 'cancelled'} before admission")
+        limit = self._limit()
+        if limit <= 0:
+            # governor accounting off: admit unconditionally, but still
+            # register so CANCEL / timeouts / REST visibility work
+            with self._cond:
+                self._active[ctx.query_id] = ctx
+                self._inflight_bytes += ctx.estimate_bytes
+            ctx.start(timeout_s)
+            reg.inc("governor_admitted")
+            return ctx
+        if ctx.estimate_bytes > limit:
+            reg.inc("governor_rejected")
+            raise LowMemoryException(
+                f"query estimate {ctx.estimate_bytes} bytes exceeds "
+                f"memory_limit_bytes={limit}; rejected before execution "
+                f"(raise the limit or narrow the scan)")
+        # memory pressure (measured, not just planned): degrade first.
+        # A short-lived cache bounds the per-admission ledger walk under
+        # concurrent short queries; watermark staleness of 0.25s is noise
+        host, device = self.measured_bytes(max_age_s=0.25)
+        if host + device > self._high_bytes(limit):
+            self._degrade(int(self._low_bytes(limit)), requester=ctx)
+        # a statement timeout covers queue time too (the reference's
+        # query-cancel timer starts at submission, not first row):
+        # the deadline is pinned NOW so ctx.start() cannot re-arm it
+        stmt_deadline = None
+        wait_s = float(self.props.admission_wait_s)
+        if timeout_s and timeout_s > 0:
+            stmt_deadline = time.monotonic() + float(timeout_s)
+            ctx.deadline = stmt_deadline
+            wait_s = min(wait_s, float(timeout_s))
+        deadline = time.monotonic() + wait_s
+        queued = False
+        with self._cond:
+            while True:
+                if ctx.cancelled:
+                    if queued:
+                        self._queue.remove(ctx)
+                    raise CancelException(
+                        f"query {ctx.query_id} "
+                        f"{ctx.cancel_reason or 'cancelled'} while queued")
+                # FIFO over MEMORY, but a head blocked purely by its
+                # principal's fair slot must not starve other users
+                # (head-of-line): ctx may go when it fits and everything
+                # ahead of it is fair-slot-blocked
+                ahead = self._queue[:self._queue.index(ctx)] if queued \
+                    else list(self._queue)
+                if self._has_room(ctx, limit) \
+                        and self._fair_slot_free(ctx) \
+                        and all(not self._fair_slot_free(e)
+                                for e in ahead):
+                    if queued:
+                        self._queue.remove(ctx)
+                    self._active[ctx.query_id] = ctx
+                    self._inflight_bytes += ctx.estimate_bytes
+                    ctx.start(timeout_s)
+                    reg.inc("governor_admitted")
+                    self._cond.notify_all()
+                    return ctx
+                if not queued:
+                    depth = int(self.props.admission_queue_depth)
+                    if len(self._queue) >= max(0, depth):
+                        reg.inc("governor_rejected")
+                        raise LowMemoryException(
+                            f"admission queue full ({len(self._queue)} "
+                            f"waiting, depth {depth}); query rejected")
+                    self._queue.append(ctx)
+                    ctx.state = "queued"
+                    queued = True
+                    reg.inc("governor_queued")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    self._queue.remove(ctx)
+                    if stmt_deadline is not None \
+                            and time.monotonic() >= stmt_deadline:
+                        # the STATEMENT timeout expired while queued:
+                        # that is a cancellation (XCL52), not a
+                        # memory rejection
+                        ctx.cancel("timed out (query_timeout_s) "
+                                   "while queued for admission")
+                        reg.inc("governor_timeouts")
+                        raise CancelException(
+                            f"query {ctx.query_id} exceeded its "
+                            f"statement timeout while queued")
+                    reg.inc("governor_rejected")
+                    raise LowMemoryException(
+                        f"query {ctx.query_id} waited {wait_s:.1f}s "
+                        f"for admission ({self._inflight_bytes} of "
+                        f"{limit} bytes in flight); rejected")
+                self._cond.wait(min(remaining, 0.25))
+
+    def watch(self, ctx: QueryContext) -> QueryContext:
+        """Register a context BEFORE admission (jobserver submissions):
+        it shows in `queries()` and `cancel()` finds it from the moment
+        of submission — a cancel landing in the submit→admit window
+        makes `admit()` raise instead of being dropped with a 404.
+        Cleared by `release()` (call release even on failed admits)."""
+        with self._cond:
+            self._watched[ctx.query_id] = ctx
+        return ctx
+
+    def release(self, ctx: QueryContext) -> None:
+        with self._cond:
+            self._watched.pop(ctx.query_id, None)
+            if self._active.pop(ctx.query_id, None) is not None:
+                self._inflight_bytes -= ctx.estimate_bytes
+                if self._inflight_bytes < 0:
+                    self._inflight_bytes = 0
+            ctx.state = "finished"
+            self._cond.notify_all()
+
+    # -- degradation ----------------------------------------------------
+
+    def _degrade(self, target_bytes: int,
+                 requester: Optional[QueryContext] = None) -> None:
+        """Graceful pressure relief, cheapest first (ref: evict → spill →
+        cancel ordering of SnappyStorageEvictor + CancelException):
+        1. drop compiled-plan caches, 2. spill cold batches to disk,
+        3. cancel the hungriest admitted query (never the requester)."""
+        reg = global_registry()
+        host, device = self.measured_bytes()
+        if host + device <= target_bytes:
+            return
+        for ex in list(self._executors):
+            try:
+                ex.clear_cache()
+            except Exception:
+                pass
+        reg.inc("governor_degrade_plan_evictions")
+        host, device = self.measured_bytes()
+        if host + device <= target_bytes:
+            return
+        from snappydata_tpu.storage import hoststore
+
+        for _nm, data in self._iter_tables():
+            host, device = self.measured_bytes()
+            excess = host + device - target_bytes
+            if excess <= 0:
+                return
+            if hasattr(data, "_manifest"):
+                # spill only down to the deficit — a marginal watermark
+                # crossing must not flush a whole hot table to disk
+                # (every later scan would re-decode it)
+                keep = max(0, _host_table_bytes(data) - excess)
+                try:
+                    if hoststore.spill_to_budget(data, keep):
+                        reg.inc("governor_degrade_spills")
+                except Exception:
+                    pass
+        host, device = self.measured_bytes()
+        if host + device <= target_bytes:
+            return
+        with self._cond:
+            victims = [c for c in self._active.values() if c is not requester]
+        if victims:
+            hungriest = max(victims, key=lambda c: c.estimate_bytes)
+            hungriest.cancel("cancelled by resource broker (low memory)")
+            reg.inc("governor_degrade_kills")
+            reg.inc("governor_cancelled")
+            with self._cond:
+                self._cond.notify_all()
+
+    # -- cancellation / visibility --------------------------------------
+
+    def cancel(self, query_id: str, reason: str = "cancelled by request",
+               user: Optional[str] = None) -> bool:
+        """Flag a running or queued query. `user` (when given and not
+        admin) may only cancel their own queries."""
+        with self._cond:
+            ctx = self._lookup_locked(query_id)
+            if ctx is None:
+                return False
+            if user is not None and user != "admin" and ctx.user != user:
+                raise PermissionError(
+                    f"user {user!r} may not cancel query {query_id} "
+                    f"owned by {ctx.user!r}")
+            ctx.cancel(reason)
+            self._cond.notify_all()
+        global_registry().inc("governor_cancelled")
+        return True
+
+    def queries(self) -> List[dict]:
+        with self._cond:
+            seen = {c.query_id: c for c in self._watched.values()}
+            seen.update({c.query_id: c for c in self._queue})
+            seen.update({c.query_id: c for c in self._active.values()})
+            out = [c.describe() for c in seen.values()]
+        out.sort(key=lambda d: d["submitted_ts"])
+        return out
+
+    def _lookup_locked(self, query_id: str) -> Optional[QueryContext]:
+        return self._active.get(query_id) \
+            or next((c for c in self._queue if c.query_id == query_id),
+                    None) \
+            or self._watched.get(query_id)
+
+    def lookup(self, query_id: str) -> Optional[QueryContext]:
+        with self._cond:
+            return self._lookup_locked(query_id)
+
+
+_global_broker: Optional[ResourceBroker] = None
+_global_lock = threading.Lock()
+
+
+def global_broker() -> ResourceBroker:
+    global _global_broker
+    if _global_broker is None:
+        with _global_lock:
+            if _global_broker is None:
+                _global_broker = ResourceBroker()
+    return _global_broker

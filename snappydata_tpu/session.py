@@ -189,7 +189,8 @@ class SnappySession:
     # SQL entry (ref SnappySession.sql:179)
     # ------------------------------------------------------------------
 
-    def sql(self, sql_text: str, params: Sequence[Any] = ()) -> Result:
+    def sql(self, sql_text: str, params: Sequence[Any] = (),
+            query_ctx=None) -> Result:
         stmt = parse(sql_text)
         if isinstance(stmt, ast.Query):
             # live query log feeding the dashboard / REST plan UI (ref:
@@ -197,12 +198,37 @@ class SnappySession:
             import time as _time
 
             t0 = _time.time()
-            result = self.execute_statement(stmt, tuple(params))
+            result = self._governed_query(sql_text, stmt, tuple(params),
+                                          query_ctx)
             self._log_query(sql_text, (_time.time() - t0) * 1000.0,
                             result.num_rows)
             from snappydata_tpu.engine.result import finalize_decimals
 
             return finalize_decimals(result)
+        if query_ctx is not None:
+            # jobserver submissions govern non-SELECT statements too: the
+            # pre-created context is admitted (estimate 0 — DML cost has
+            # no scan estimate yet) so CANCEL and query_timeout_s apply,
+            # e.g. to INSERT INTO ... SELECT through the executor's
+            # cooperative checks
+            from snappydata_tpu import resource
+
+            if resource.current_query() is None:
+                broker = resource.global_broker()
+                if not query_ctx.sql:
+                    query_ctx.sql = sql_text
+                try:
+                    broker.admit(query_ctx, 0,
+                                 float(self.conf.query_timeout_s or 0.0))
+                    with resource.query_scope(query_ctx):
+                        return self._sql_statement(stmt, sql_text,
+                                                   tuple(params))
+                finally:
+                    broker.release(query_ctx)
+        return self._sql_statement(stmt, sql_text, tuple(params))
+
+    def _sql_statement(self, stmt: ast.Statement, sql_text: str,
+                       params) -> Result:
         ds = self.disk_store
         if ds is not None and isinstance(
                 stmt, (ast.InsertInto, ast.UpdateStmt, ast.DeleteStmt,
@@ -301,6 +327,48 @@ class SnappySession:
                     f"deploy:{stmt.name.lower()}", None)
                 ds.save_catalog(self.catalog)
         return result
+
+    def _governed_query(self, sql_text: str, stmt: ast.Query, params,
+                        query_ctx=None) -> Result:
+        """Resource-governor choke point for top-level queries: submit a
+        memory estimate, get admitted/queued/rejected, run under a
+        QueryContext so CANCEL/timeout/broker kills stop the scan at the
+        next tile boundary (ref: SnappyUnifiedMemoryManager admission +
+        CancelException checks in generated scan loops). Nested
+        executions — tile partials, the tiled-merge scratch session,
+        subquery rewrites — inherit the outer context and skip
+        re-admission."""
+        from snappydata_tpu import resource
+
+        if resource.current_query() is not None:
+            return self.execute_statement(stmt, params)
+        broker = resource.global_broker()
+        ctx = query_ctx or resource.new_query(sql_text, self.user)
+        if not ctx.sql:
+            ctx.sql = sql_text
+        # the estimate walk (per-table row counts) only matters when an
+        # actual byte budget meters it — skip the cost on the default
+        # ungoverned config, where admit() is register-only
+        estimate = 0
+        if broker.accounting_enabled():
+            estimate = resource.estimate_statement_bytes(self.catalog, stmt)
+            tile = self._tile_budget()
+            if tile > 0 and not params \
+                    and self._tilable_agg_shape(stmt.plan) is not None:
+                # the engine streams this shape tile-by-tile under
+                # scan_tile_bytes: peak memory is ~one tile, not the
+                # full decoded table — charging the full table would
+                # make every out-of-core aggregate un-admittable
+                estimate = min(estimate, tile)
+        try:
+            # admit INSIDE the try: release() also clears a watched
+            # (jobserver-submitted) context when admission fails
+            broker.admit(ctx, estimate,
+                         float(self.conf.query_timeout_s or 0.0))
+            with resource.query_scope(ctx):
+                return self.execute_statement(stmt, params)
+        finally:
+            broker.release(ctx)
 
     def execute_statement(self, stmt: ast.Statement, user_params=()) -> Result:
         self._authorize(stmt)
@@ -593,21 +661,11 @@ class SnappySession:
             pass
         return 0  # unknown memory (e.g. CPU): tiling off unless explicit
 
-    def _maybe_tiled_aggregate(self, plan: ast.Plan,
-                               user_params) -> Optional[Result]:
-        """Execute an aggregate over ONE oversized column table as a
-        streamed tile pass: bind `scan_tile_bytes`-sized windows of the
-        batch axis through the SAME compiled partial program, then merge
-        partials (avg = sum/count etc.) — the reference scans batch-at-a-
-        time off disk for the same reason (ColumnFormatIterator read-ahead,
-        core/.../columnar/impl/ColumnFormatIterator.scala:60-162); HBM
-        never holds the whole table. Returns None → run untiled."""
-        if getattr(self, "_in_tile", False) or user_params:
-            return None
-        budget = self._tile_budget()
-        if budget <= 0:
-            return None
-        # shape: [Sort|Limit]* [Filter(having)] Aggregate(single table)
+    def _tilable_agg_shape(self, plan: ast.Plan):
+        """Shared shape probe for the tile pass and the governor's
+        admission estimate: ([Sort|Limit]* [Filter(having)]
+        Aggregate(single column table), no subqueries/windows).
+        Returns (outer, having, node, info, exprs) or None."""
         outer: List[ast.Plan] = []
         node = plan
         while isinstance(node, (ast.Sort, ast.Limit)):
@@ -658,6 +716,26 @@ class SnappySession:
         info = self.catalog.lookup_table(rels[0])
         if info is None or not isinstance(info.data, ColumnTableData):
             return None
+        return outer, having, node, info, exprs
+
+    def _maybe_tiled_aggregate(self, plan: ast.Plan,
+                               user_params) -> Optional[Result]:
+        """Execute an aggregate over ONE oversized column table as a
+        streamed tile pass: bind `scan_tile_bytes`-sized windows of the
+        batch axis through the SAME compiled partial program, then merge
+        partials (avg = sum/count etc.) — the reference scans batch-at-a-
+        time off disk for the same reason (ColumnFormatIterator read-ahead,
+        core/.../columnar/impl/ColumnFormatIterator.scala:60-162); HBM
+        never holds the whole table. Returns None → run untiled."""
+        if getattr(self, "_in_tile", False) or user_params:
+            return None
+        budget = self._tile_budget()
+        if budget <= 0:
+            return None
+        shaped = self._tilable_agg_shape(plan)
+        if shaped is None:
+            return None
+        outer, having, node, info, exprs = shaped
         data = info.data
 
         from snappydata_tpu.storage.device import (scan_unit_count,
@@ -712,12 +790,18 @@ class SnappySession:
 
         from snappydata_tpu.observability.metrics import global_registry
 
+        from snappydata_tpu.resource import check_current
+
         pieces: List[Result] = []
         self._in_tile = True
         try:
             for lo in range(0, units, tile_units):
+                # tile boundary = cancellation point: CANCEL <id>,
+                # statement timeouts and broker kills land here, within
+                # one tile of the signal
+                check_current()
                 with scan_window(data, lo, min(lo + tile_units, units),
-                                 manifest):
+                                 manifest, tile_units=tile_units):
                     pieces.append(self.sql(partial_sql))
                 global_registry().inc("scan_tiles")
         finally:

@@ -44,12 +44,16 @@ _scan_windows: contextvars.ContextVar = contextvars.ContextVar(
 
 
 @contextlib.contextmanager
-def scan_window(data, lo: int, hi: int, manifest=None):
+def scan_window(data, lo: int, hi: int, manifest=None, tile_units=None):
     """Restrict build_device_table for `data` to units [lo, hi).
     `manifest` pins one snapshot across a multi-tile pass so concurrent
-    mutations can't make tiles disagree about the table version."""
+    mutations can't make tiles disagree about the table version.
+    `tile_units` is the NOMINAL window width of the pass — the last
+    window may be truncated, and current_scan_scale needs the nominal
+    width to compute the true tile count."""
     cur = dict(_scan_windows.get() or {})
-    cur[id(data)] = (int(lo), int(hi), manifest)
+    cur[id(data)] = (int(lo), int(hi), manifest,
+                     int(tile_units) if tile_units else int(hi - lo))
     tok = _scan_windows.set(cur)
     try:
         yield
@@ -92,14 +96,9 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
     from snappydata_tpu.parallel.mesh import MeshContext
 
     ctx = MeshContext.current()
-    wentry = (_scan_windows.get() or {}).get(id(data))
-    window = None
-    if wentry is not None:
-        window = (wentry[0], wentry[1])
-        if wentry[2] is not None:
-            manifest = wentry[2]   # pinned snapshot for the tile pass
-    if manifest is None:
-        manifest = data.snapshot()
+    # shared unit-splitting contract with the host fallback (_scan_units):
+    # pinned snapshot, batches-then-row-chunks order, window slice
+    manifest, views, row_chunks, window = _scan_units(data, manifest)
     # cache key includes the mesh token (placement differs under a mesh;
     # token is process-unique, unlike id() which gets reused after GC)
     # and the scan window (tiles of one version coexist under the LRU)
@@ -124,20 +123,6 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
 
     schema = data.schema
     cap = data.capacity
-    views = manifest.views
-    # split row-buffer snapshot rows into trailing chunks of `cap`
-    row_chunks: list = []
-    if manifest.row_count > 0:
-        pos = 0
-        while pos < manifest.row_count:
-            take = min(cap, manifest.row_count - pos)
-            row_chunks.append((pos, take))
-            pos += take
-    if window is not None:
-        units = [("v", v) for v in views] + [("r", rc) for rc in row_chunks]
-        units = units[window[0]:window[1]]
-        views = [u for k, u in units if k == "v"]
-        row_chunks = [u for k, u in units if k == "r"]
     b_actual = len(views) + len(row_chunks)
     b = _next_pow2(b_actual) if data_pow2() else max(1, b_actual)
     b = max(b, 1)
@@ -647,3 +632,85 @@ def _entry_bytes(dt_cols: Dict) -> int:
         return int(v.nbytes) if hasattr(v, "nbytes") else 0
 
     return sum(arr_bytes(v) for v in dt_cols.values())
+
+
+def device_cache_bytes_by_table(tables) -> Dict[str, int]:
+    """Device-side ledger for the resource broker: cached decoded plate
+    bytes per table, read straight off each table's `_device_cache`
+    (pull-based, so dropped tables simply stop appearing — nothing is
+    pinned). `tables` is an iterable of (name, data)."""
+    out: Dict[str, int] = {}
+    for name, data in tables:
+        caches = getattr(data, "_device_cache", None)
+        if not caches:
+            continue
+        try:  # same-named tables of different catalogs sum, not replace
+            out[name] = out.get(name, 0) + sum(
+                _entry_bytes(c) for c in list(caches.values()))
+        except Exception:
+            out.setdefault(name, 0)
+    return out
+
+
+def current_scan_scale(data) -> float:
+    """How many windows the active tile pass splits `data`'s scan into
+    (1.0 outside a tile pass). The exact-decimal sum overflow guard
+    multiplies its per-tile max|v|·count bound by this so the bound
+    covers the MERGED total across tiles, not just each tile (several
+    tiles could each pass the per-tile bound while their int64 partial-
+    merge total wraps silently — advisor round 5)."""
+    wentry = (_scan_windows.get() or {}).get(id(data))
+    if wentry is None:
+        return 1.0
+    lo, hi, manifest = wentry[:3]
+    total = scan_unit_count(data, manifest)
+    # nominal width, not this window's: the last tile of a pass may be
+    # truncated (e.g. 10 units in tiles of 4 → (8,10)), and deriving the
+    # count from a truncated width would over-scale the overflow guard,
+    # rerouting a safely-summable final tile to the slow host path
+    width = max(1, wentry[3] if len(wentry) > 3 else hi - lo)
+    return float(max(1, -(-total // width)))
+
+
+def _scan_units(data, manifest=None):
+    """THE unit-splitting contract shared by the device bind and the
+    host fallback: (manifest, views, row_chunks, window) honoring the
+    active scan window — pinned snapshot, unit order (batches then
+    row-buffer chunks of `capacity` rows), [lo, hi) slice. Both sides
+    MUST read through this one helper: if they ever disagreed on unit
+    order, a tile falling back to host would silently read different
+    rows than the device tile it replaces (the double-count bug class).
+    row_chunks are (start, take) row-buffer slices."""
+    wentry = (_scan_windows.get() or {}).get(id(data))
+    window = None
+    if wentry is not None:
+        window = (wentry[0], wentry[1])
+        if wentry[2] is not None:
+            manifest = wentry[2]
+    if manifest is None:
+        manifest = data.snapshot()
+    # (wentry[3], when present, is the pass's nominal tile width — used
+    # only by current_scan_scale, never for unit slicing)
+    views = list(manifest.views)
+    row_chunks = []
+    cap = data.capacity
+    if manifest.row_count > 0:
+        pos = 0
+        while pos < manifest.row_count:
+            take = min(cap, manifest.row_count - pos)
+            row_chunks.append((pos, take))
+            pos += take
+    if window is not None:
+        units = [("v", v) for v in views] + [("r", rc) for rc in row_chunks]
+        units = units[window[0]:window[1]]
+        views = [u for k, u in units if k == "v"]
+        row_chunks = [u for k, u in units if k == "r"]
+    return manifest, views, row_chunks, window
+
+
+def host_scan_units(data, manifest=None):
+    """(manifest, views, row_chunks) for a HOST-side scan of `data` —
+    the host fallback's view of the same units build_device_table
+    binds (see _scan_units)."""
+    manifest, views, row_chunks, _window = _scan_units(data, manifest)
+    return manifest, views, row_chunks

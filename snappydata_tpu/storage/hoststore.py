@@ -23,6 +23,7 @@ import itertools
 import os
 import shutil
 import tempfile
+import threading
 import weakref
 from typing import Optional, Tuple
 
@@ -30,6 +31,15 @@ import numpy as np
 
 _spill_dir: Optional[str] = None
 _spill_ids = itertools.count()  # unique filenames (id() values recycle)
+_spill_lock = threading.Lock()
+_spill_bytes = 0                # live spill-file bytes (broker ledger)
+
+
+def spill_file_bytes() -> int:
+    """Total bytes currently held in live spill files — the spill side
+    of the resource broker's unified host ledger."""
+    with _spill_lock:
+        return _spill_bytes
 
 
 def _dir() -> str:
@@ -124,11 +134,18 @@ def spill_batch(batch) -> Tuple[int, object]:
                                        offset=off, shape=shape)
         new_cols.append(dataclasses.replace(col, **repl) if repl else col)
     new_batch = dataclasses.replace(batch, columns=tuple(new_cols))
-    weakref.finalize(new_batch, _unlink_quiet, path)
+    global _spill_bytes
+    with _spill_lock:
+        _spill_bytes += freed
+    weakref.finalize(new_batch, _unlink_quiet, path, freed)
     return freed, new_batch
 
 
-def _unlink_quiet(path: str) -> None:
+def _unlink_quiet(path: str, nbytes: int = 0) -> None:
+    global _spill_bytes
+    if nbytes:
+        with _spill_lock:
+            _spill_bytes -= nbytes
     try:
         os.unlink(path)
     except OSError:

@@ -268,7 +268,14 @@ class ConfluentKafkaBroker(Broker):
         end = offset + max_records
         out: List[dict] = []
         done = False
-        deadline = _time.monotonic() + self.poll_timeout_s * 10
+        # PROGRESS-based deadline: the window re-arms on every non-empty
+        # poll(). A fixed overall deadline wedged exactly-once replay
+        # permanently — a legitimately large WAL-logged offset range
+        # always overran it, and the retry refetches the same range from
+        # its start offset, making zero forward progress (advisor round
+        # 5). Only a broker that goes SILENT for a full window times out.
+        window_s = self.poll_timeout_s * 10
+        deadline = _time.monotonic() + window_s
         try:
             while not done:
                 if _time.monotonic() >= deadline:
@@ -279,11 +286,12 @@ class ConfluentKafkaBroker(Broker):
                     raise TimeoutError(
                         f"kafka fetch timed out: {topic}[{partition}] "
                         f"offsets [{offset}, {end}) after "
-                        f"{self.poll_timeout_s * 10:.1f}s "
+                        f"{window_s:.1f}s without progress "
                         f"({len(out)} records in); retryable")
                 msg = self._consumer.poll(self.poll_timeout_s)
                 if msg is None:
                     continue
+                deadline = _time.monotonic() + window_s  # made progress
                 err = msg.error()
                 if err is not None:
                     if err.code() == self._eof_code:

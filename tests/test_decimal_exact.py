@@ -168,6 +168,74 @@ def test_sum_overflow_falls_back_not_wraps(session):
     assert float(got) > 0       # int64 wraparound would go negative
 
 
+def test_sum_overflow_guard_covers_merged_total_across_tiles(session):
+    """Under scan_tile_bytes tiling, each tile can pass the per-tile
+    max|v|*count < 2^62 bound while the merged total wraps int64 — the
+    guard must scale its bound by the tile count so the MERGED total is
+    covered (advisor round 5). 5 tiles x 4 rows x 9e17: per-tile sum
+    3.6e18 < 2^62, merged 1.8e19 > int64 max."""
+    saved = session.conf.scan_tile_bytes
+    try:
+        session.sql("CREATE TABLE tile_big (v DECIMAL(18,0)) USING column "
+                    "OPTIONS (column_batch_rows '4', "
+                    "column_max_delta_rows '4')")
+        session.insert_arrays(
+            "tile_big", [np.full(20, 9.0e17, dtype=np.float64)])
+        session.conf.scan_tile_bytes = 60   # one 4-row batch per tile
+        got = session.sql("SELECT sum(v) FROM tile_big").rows()[0][0]
+        exact = 9.0e17 * 20                 # 1.8e19
+        # rel covers f32-plate rounding of the approximate fallback
+        # (~2e-8); a silent int64 wrap would be negative / off by >2x
+        assert float(got) == pytest.approx(exact, rel=1e-6)
+        assert float(got) > 0               # int64 wrap would go negative
+    finally:
+        session.conf.scan_tile_bytes = saved
+
+
+def test_tile_host_fallback_reads_only_its_tile(session):
+    """When ONE tile reroutes to the host path (its per-tile bound
+    fires), the host evaluation must honor the scan window: reading the
+    whole table from inside a tile made the merge double-count every
+    other tile (observed 3.96e19 for an exact total of 1.8e19)."""
+    saved = session.conf.scan_tile_bytes
+    try:
+        # 8-row tiles: per-tile 8 x 9e17 = 7.2e18 >= 2^62 -> every tile
+        # falls back to host, which must see ONLY its own 8 rows
+        session.sql("CREATE TABLE tile_hf (v DECIMAL(18,0)) USING column "
+                    "OPTIONS (column_batch_rows '8', "
+                    "column_max_delta_rows '8')")
+        session.insert_arrays(
+            "tile_hf", [np.full(20, 9.0e17, dtype=np.float64)])
+        session.conf.scan_tile_bytes = 100
+        got = session.sql("SELECT sum(v) FROM tile_hf").rows()[0][0]
+        # rel covers f32-plate rounding (~2e-8); the whole-table
+        # double-count bug this guards against was off by 2.2x
+        assert float(got) == pytest.approx(9.0e17 * 20, rel=1e-6)
+    finally:
+        session.conf.scan_tile_bytes = saved
+
+
+def test_scan_scale_uses_nominal_tile_width(session):
+    """The overflow-guard tile scale must come from the pass's NOMINAL
+    window width: the last tile may be truncated (10 units in tiles of
+    4 → window (8,10)) and a width of 2 would claim 5 tiles where 3
+    exist, over-scaling the guard into spurious host fallbacks."""
+    from snappydata_tpu.storage.device import (current_scan_scale,
+                                               scan_window)
+
+    session.sql("CREATE TABLE ts_w (v BIGINT) USING column OPTIONS "
+                "(column_batch_rows '4', column_max_delta_rows '4')")
+    session.insert_arrays("ts_w", [np.arange(40, dtype=np.int64)])
+    data = session.catalog.describe("ts_w").data
+    m = data.snapshot()
+    assert len(m.views) == 10
+    with scan_window(data, 8, 10, m, tile_units=4):
+        assert current_scan_scale(data) == 3.0
+    with scan_window(data, 0, 4, m, tile_units=4):
+        assert current_scan_scale(data) == 3.0
+    assert current_scan_scale(data) == 1.0   # outside any pass
+
+
 def test_wide_precision_keeps_float_path(session):
     session.sql("CREATE TABLE wp (v DECIMAL(28,2)) USING column")
     session.sql("INSERT INTO wp VALUES (1.25), (2.50)")

@@ -106,6 +106,59 @@ def test_execute_update(client, server):
     assert n >= 1
 
 
+def test_execute_update_ddl_reports_unknown_count(client, server):
+    """Spec: DoPutUpdateResult.record_count = -1 means 'unknown' — a DDL
+    has no row count. The 10-byte negative varint must terminate (the
+    codec used to loop forever on negatives — advisor round 5)."""
+    n = client.execute_update("CREATE TABLE fs_ddl (x BIGINT) USING column")
+    assert n == -1
+
+
+def test_get_tables_type_filter(client, server):
+    """CommandGetTables.table_types is a REPEATED field: list-valued
+    filters reach the server (elements that are proto3 defaults
+    included) and narrow the result."""
+    _, s = server
+    s.sql("CREATE VIEW fs_v AS SELECT k FROM fs_t")
+    try:
+        only_tables = client.get_tables(table_types=["TABLE"])
+        names = [v.as_py() for v in only_tables.column("table_name")]
+        assert "fs_t" in names and "fs_v" not in names
+        only_views = client.get_tables(table_types=["VIEW"])
+        names = [v.as_py() for v in only_views.column("table_name")]
+        assert names and all(
+            t.as_py() == "VIEW" for t in only_views.column("table_type"))
+        assert "fs_v" in names
+        # an empty-string element is a real (nothing-matching) filter
+        none_match = client.get_tables(table_types=[""])
+        assert none_match.num_rows == 0
+    finally:
+        s.sql("DROP VIEW fs_v")
+
+
+def test_decimal_overflow_fallback_exports_over_flight(client, server):
+    """A decimal SUM whose exact int64 path overflowed returns an
+    APPROXIMATE float total wider than the declared DECIMAL(18,0) —
+    Flight export must widen the wire type (or fall back to float64),
+    not raise ArrowInvalid (advisor round 5)."""
+    _, s = server
+    n = 64
+    s.sql("CREATE TABLE fs_big (v DECIMAL(18,0)) USING column")
+    s.insert_arrays("fs_big", [np.full(n, 9.0e17, dtype=np.float64)])
+    local = float(s.sql("SELECT sum(v) AS s FROM fs_big").rows()[0][0])
+    sql = "SELECT sum(v) AS s FROM fs_big"
+    info = client._info("CommandStatementQuery",
+                        encode_fields([(1, sql)]))
+    t = client._read(info)
+    wire = float(t.column("s")[0].as_py())
+    assert wire == pytest.approx(local, rel=1e-9)
+    assert wire == pytest.approx(9.0e17 * n, rel=1e-9)  # ~5.76e19
+    # drivers pre-allocate from GetFlightInfo: the advertised schema and
+    # the DoGet stream must AGREE (decimals normalize to decimal128(38,s)
+    # on the FlightSQL surface)
+    assert info.schema == t.schema
+
+
 def test_prepared_statement(client):
     ps = client.prepare("SELECT count(*) AS c FROM fs_t WHERE k < ?")
     t1 = ps.execute([100])

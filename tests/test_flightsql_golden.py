@@ -56,6 +56,13 @@ GOLDEN = {
     "TicketStatementQuery": "0a137b2273716c223a202253454c4543542031227d",
     "DoPutUpdateResult": "08b5b8f0fe2d",
     "DoPutUpdateResult_zero": "",
+    # record_count = -1 ('unknown' per the FlightSql spec): proto varints
+    # are two's-complement over 64 bits -> 10-byte encoding
+    "DoPutUpdateResult_unknown": "08ffffffffffffffffff01",
+    # repeated table_types with an EMPTY-STRING element: a real element,
+    # NOT a droppable proto3 default (that omission rule is for
+    # singular fields only — advisor round 5)
+    "CommandGetTables_empty_type": "2200220456494557",
     "Any_CommandStatementQuery":
         "0a43747970652e676f6f676c65617069732e636f6d2f6172726f772e666c69"
         "6768742e70726f746f636f6c2e73716c2e436f6d6d616e6453746174656d65"
@@ -83,6 +90,8 @@ CONTENT = {
     "TicketStatementQuery": [(1, b'{"sql": "SELECT 1"}')],
     "DoPutUpdateResult": [(1, 12345678901)],
     "DoPutUpdateResult_zero": [(1, 0)],
+    "DoPutUpdateResult_unknown": [(1, -1)],
+    "CommandGetTables_empty_type": [(4, ["", "VIEW"])],
 }
 
 
@@ -111,6 +120,19 @@ def test_codec_decodes_official_bytes():
     assert f[1] == [12345678901]
     assert decode_fields(
         bytes.fromhex(GOLDEN["DoPutUpdateResult_zero"])) == {}
+
+    # negative record_count: raw varint is unsigned; the signed helper
+    # recovers -1 (and the codec's encoder terminates — it used to loop
+    # forever on negatives)
+    from snappydata_tpu.cluster.flightsql import varint_to_int64
+
+    f = decode_fields(bytes.fromhex(GOLDEN["DoPutUpdateResult_unknown"]))
+    assert varint_to_int64(f[1][0]) == -1
+    assert varint_to_int64(12345678901) == 12345678901
+
+    # repeated-field elements survive even when they are default values
+    f = decode_fields(bytes.fromhex(GOLDEN["CommandGetTables_empty_type"]))
+    assert f[4] == [b"", b"VIEW"]
 
 
 def test_codec_encodes_byte_identical():
@@ -178,6 +200,10 @@ def test_fixture_provenance_official_runtime(tmp_path):
         "DoPutUpdateResult": pb.DoPutUpdateResult(
             record_count=12345678901),
         "DoPutUpdateResult_zero": pb.DoPutUpdateResult(record_count=0),
+        "DoPutUpdateResult_unknown": pb.DoPutUpdateResult(
+            record_count=-1),
+        "CommandGetTables_empty_type": pb.CommandGetTables(
+            table_types=["", "VIEW"]),
     }
     any_msg = any_pb2.Any()
     any_msg.Pack(pb.CommandStatementQuery(query="SELECT 1"),

@@ -241,6 +241,35 @@ def test_fetch_timeout_is_retryable_not_data_loss(fake_confluent):
         b.fetch("t", 0, 0, 3)
 
 
+def test_fetch_deadline_is_progress_based(fake_confluent):
+    """A legitimately large offset range that delivers slowly but
+    STEADILY must complete: the deadline re-arms on every non-empty
+    poll(). The old fixed overall deadline wedged exactly-once replay
+    permanently — the retry refetches the same WAL-logged range from
+    its start offset, zero forward progress (advisor round 5)."""
+    import time as _time
+
+    b, c = _mk(fake_confluent)      # poll_timeout_s=0.01 -> window 0.1s
+    recs = [{"id": i} for i in range(8)]
+    c.load(0, recs)
+
+    orig_poll = c.poll
+
+    def slow_poll(timeout=None):
+        _time.sleep(0.03)           # 8 records: 0.24s total > 0.1s window
+        return orig_poll(timeout)
+
+    c.poll = slow_poll
+    got = b.fetch("t", 0, 0, 8)     # fixed deadline would TimeoutError
+    assert [r["id"] for r in got] == list(range(8))
+
+    # a SILENT broker still times out (progress-based, not unbounded)
+    c.assign = lambda tps: None
+    c.poll = lambda timeout=None: (_time.sleep(0.005), None)[1]
+    with pytest.raises(TimeoutError, match="retryable"):
+        b.fetch("t", 0, 0, 8)
+
+
 def test_fetch_detects_retention_expiry(fake_confluent):
     """A replayed range starting below the low watermark = permanent
     loss -> loud replay-gap error, NOT a silent skip-to-earliest."""
