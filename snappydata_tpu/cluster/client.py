@@ -5,11 +5,17 @@ jdbc/.../Constant.scala:29-33)."""
 from __future__ import annotations
 
 import json
-from typing import List, Optional, Sequence
+import random
+import time
+from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 import pyarrow as pa
 import pyarrow.flight as flight
+
+from snappydata_tpu import config
+from snappydata_tpu.cluster.retry import CircuitBreaker, ExponentialBackoff
+from snappydata_tpu.fault import failpoints
 
 
 class SnappyClient:
@@ -35,6 +41,17 @@ class SnappyClient:
             self._addresses.append(address)
         self._locator = locator
         self._conn: Optional[flight.FlightClient] = None
+        props = config.global_properties()
+        # per-address circuit breakers: a member that failed establishment
+        # breaker_failures times in a row is SKIPPED during failover while
+        # its breaker is open (no connect-timeout tax per request), probed
+        # again half-open after breaker_reset_s — and always retried as a
+        # last resort when no other member connects
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._backoff = ExponentialBackoff(
+            props.retry_backoff_base_s, props.retry_backoff_max_s,
+            jitter=props.retry_jitter,
+            rng=random.Random(props.fault_seed))
         if locator and not address:
             self._refresh_from_locator()
 
@@ -65,29 +82,60 @@ class SnappyClient:
         self._login(conn)
         return conn
 
+    def _breaker(self, addr: str) -> CircuitBreaker:
+        br = self._breakers.get(addr)
+        if br is None:
+            props = config.global_properties()
+            br = self._breakers[addr] = CircuitBreaker(
+                props.breaker_failures, props.breaker_reset_s)
+        return br
+
+    def _try_establish(self, addr: str) -> Optional[flight.FlightClient]:
+        """Attempt one address, recording the outcome in its breaker.
+        Returns None on (non-auth) failure; re-raises auth errors."""
+        br = self._breaker(addr)
+        try:
+            conn = self._establish(addr)
+        except flight.FlightUnauthenticatedError:
+            raise   # bad credentials — failover can't fix that
+        except Exception as e:  # failover to the next member
+            br.record_failure()
+            self._last_establish_err = e
+            return None
+        br.record_success()
+        return conn
+
     def _client(self) -> flight.FlightClient:
         if self._conn is not None:
             return self._conn
-        last_err: Optional[Exception] = None
+        self._last_establish_err: Optional[Exception] = None
+        skipped: List[str] = []
         for addr in list(self._addresses):
-            try:
-                self._conn = self._establish(addr)
-                return self._conn
-            except flight.FlightUnauthenticatedError:
-                raise   # bad credentials — failover can't fix that
-            except Exception as e:  # failover to the next member
-                last_err = e
+            if not self._breaker(addr).allow():
+                skipped.append(addr)   # breaker open: known-dead, skip
+                continue
+            conn = self._try_establish(addr)
+            if conn is not None:
+                self._conn = conn
+                return conn
         if self._locator:
             self._refresh_from_locator()
             for addr in self._addresses:
-                try:
-                    self._conn = self._establish(addr)
-                    return self._conn
-                except flight.FlightUnauthenticatedError:
-                    raise
-                except Exception as e:
-                    last_err = e
-        raise ConnectionError(f"no reachable member: {last_err}")
+                if addr in skipped:
+                    continue
+                conn = self._try_establish(addr)
+                if conn is not None:
+                    self._conn = conn
+                    return conn
+        # last resort: open breakers never REDUCE availability — when no
+        # healthy member connected, try the skipped ones anyway
+        for addr in skipped:
+            conn = self._try_establish(addr)
+            if conn is not None:
+                self._conn = conn
+                return conn
+        raise ConnectionError(
+            f"no reachable member: {self._last_establish_err}")
 
     def _invalidate(self) -> None:
         self._conn = None
@@ -99,20 +147,34 @@ class SnappyClient:
         on connection loss when `retry` (only for idempotent requests —
         a blind retry of e.g. repartition would duplicate rows), and once
         on an expired login token (re-login via reconnect)."""
+        def guarded():
+            # flight.rpc failpoint: `before` simulates a request that
+            # never reached the server; `after` simulates a response
+            # lost AFTER the server applied (the case _NON_IDEMPOTENT
+            # exists for — a blind retry would double-apply)
+            failpoints.hit("flight.rpc")
+            out = once()
+            failpoints.hit("flight.rpc", phase="after")
+            return out
+
         try:
-            return once()
+            return guarded()
         except flight.FlightUnauthenticatedError:
             if self._user is None or self._token is None:
                 raise
             self._invalidate()   # reconnect → fresh login
-            return once()
+            return guarded()
         except (flight.FlightUnavailableError, ConnectionError):
             # ALWAYS drop the dead connection so the next call fails over;
             # only re-issuing this request is gated on idempotency
             self._invalidate()
             if not retry:
                 raise
-            return once()
+            from snappydata_tpu.observability.metrics import global_registry
+
+            global_registry().inc("failover_retries")
+            time.sleep(self._backoff.delay(0))
+            return guarded()
 
     def _action(self, name: str, body: dict, retry: bool = True) -> dict:
         def once():

@@ -26,13 +26,16 @@ scatter + merge:
 from __future__ import annotations
 
 import dataclasses
+import random as _random
 import time as _time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from snappydata_tpu import config as _config
 from snappydata_tpu import types as T
 from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.cluster.retry import CircuitBreaker, ExponentialBackoff
 from snappydata_tpu.parallel.hashing import bucket_of_np
 from snappydata_tpu.sql import ast
 from snappydata_tpu.sql.parser import parse
@@ -42,7 +45,16 @@ from snappydata_tpu.sql.render import RenderError, render_expr, render_plan
 
 
 class DistributedError(Exception):
-    pass
+    """Cluster-plane failure. `failed_addresses` names every member whose
+    death contributed (in failure order, duplicates possible across
+    retries) and `attempts` counts fan-out attempts made — so an operator
+    can tell one flaky member from a cluster-wide outage."""
+
+    def __init__(self, message: str = "",
+                 failed_addresses: Sequence[str] = (), attempts: int = 0):
+        super().__init__(message)
+        self.failed_addresses = tuple(failed_addresses)
+        self.attempts = attempts
 
 
 class DistributedUnsupported(DistributedError):
@@ -94,6 +106,18 @@ class DistributedSession:
             ((b % n) + 1) % n if n > 1 else None
             for b in range(num_buckets)]
         self.alive: List[bool] = [True] * n
+        props = _config.global_properties()
+        # failover retry policy: exponential backoff with SEEDED jitter
+        # between fan-out attempts, and a per-member circuit breaker so a
+        # repeatedly-failing member is declared dead without eating a
+        # fresh probe timeout every time (cluster/retry.py)
+        self._backoff = ExponentialBackoff(
+            props.retry_backoff_base_s, props.retry_backoff_max_s,
+            jitter=props.retry_jitter,
+            rng=_random.Random(props.fault_seed))
+        self.breakers: List[CircuitBreaker] = [
+            CircuitBreaker(props.breaker_failures, props.breaker_reset_s)
+            for _ in range(n)]
         # planning catalog: schemas only (no data) on the lead
         self.planner = SnappySession(catalog=Catalog())
 
@@ -121,6 +145,9 @@ class DistributedSession:
         if not self.alive[index]:
             return
         self.alive[index] = False
+        from snappydata_tpu.observability.metrics import global_registry
+
+        global_registry().inc("failover_member_failed")
         promoted: Dict[int, List[int]] = {}   # new primary -> buckets
         for b in range(self.num_buckets):
             if self.bucket_map[b] != index:
@@ -194,13 +221,71 @@ class DistributedSession:
                             dead_targets.add(nr)
                         break
                 if not ok:
-                    # NEVER2 claim a replica that wasn't copied (phantom
+                    # NEVER claim a replica that wasn't copied (phantom
                     # redundancy silently loses the bucket on the next
-                    # death) — degrade honestly instead
+                    # death) — degrade honestly instead, COUNTED so an
+                    # operator sees it and can run restore_redundancy()
+                    global_registry().inc("failover_redundancy_degraded",
+                                          len(buckets))
                     for b in buckets:
                         self.replica_map[b] = None
         for si in dead_targets:  # a peer involved was dead too
             self.mark_server_failed(si)
+
+    def degraded_buckets(self) -> List[int]:
+        """Buckets currently WITHOUT a redundant copy while redundancy is
+        configured (their next primary death loses them)."""
+        if not any(info.partition_by and info.redundancy > 0
+                   for info in self.planner.catalog.list_tables()):
+            return []
+        return [b for b in range(self.num_buckets)
+                if self.replica_map[b] is None
+                and self.alive[self.bucket_map[b]]]
+
+    def restore_redundancy(self) -> dict:
+        """Re-replicate every bucket that lost its redundant copy (an
+        earlier failover degraded honestly when a copy failed mid-
+        restoration). Purge-then-copy per table keeps the op idempotent
+        — a partially-copied shadow from the failed attempt must not
+        double-count after the next promotion. The manual twin of the
+        reference's automatic redundancy recovery (REST: POST
+        /redundancy/restore)."""
+        red_tables = [info for info in self.planner.catalog.list_tables()
+                      if info.partition_by and info.redundancy > 0]
+        restored = 0
+        if red_tables and sum(self.alive) > 1:
+            to_copy: Dict[Tuple[int, int], List[int]] = {}
+            for b in range(self.num_buckets):
+                p = self.bucket_map[b]
+                if not self.alive[p] or self.replica_map[b] is not None:
+                    continue
+                nr = self._next_alive({p}, start=b)
+                if nr is None:
+                    continue
+                to_copy.setdefault((p, nr), []).append(b)
+            for (p, nr), buckets in to_copy.items():
+                ok = True
+                for info in red_tables:
+                    body = {"table": info.name,
+                            "key": info.partition_by[0],
+                            "buckets": buckets,
+                            "num_buckets": self.num_buckets}
+                    try:
+                        self.servers[nr].purge_replica(dict(body))
+                        self.servers[p].replicate(
+                            dict(body, target=self.server_addresses[nr]))
+                    except Exception:
+                        ok = False
+                        break
+                if ok:
+                    for b in buckets:
+                        self.replica_map[b] = nr
+                    restored += len(buckets)
+        from snappydata_tpu.observability.metrics import global_registry
+
+        global_registry().inc("failover_redundancy_restored", restored)
+        return {"restored_buckets": restored,
+                "degraded_buckets": len(self.degraded_buckets())}
 
     def replace_server(self, index: int, address: str) -> None:
         """A restarted/replacement member rejoins at `index` EMPTY: its
@@ -256,6 +341,7 @@ class DistributedSession:
         self.servers[index] = client
         self.server_addresses[index] = address
         self.alive[index] = True
+        self.breakers[index].record_success()  # fresh member, fresh slate
         getattr(self, "_bcast_cache", {}).clear()
         getattr(self, "_shuf_cache", {}).clear()
         getattr(self, "_gather_cache", {}).clear()
@@ -344,20 +430,44 @@ class DistributedSession:
     def _probe(self, index: int) -> bool:
         """Distinguish 'member died' from 'statement failed': a failed
         call against a server that still answers ping is an APPLICATION
-        error and must propagate, not trigger failover."""
+        error and must propagate, not trigger failover. The per-member
+        circuit breaker short-circuits the probe while OPEN (a member
+        that just failed several consecutive probes is dead until the
+        breaker half-opens — no fresh connect timeout per caller)."""
+        br = self.breakers[index]
+        if not br.allow():
+            return False
         try:
             self.servers[index]._invalidate()
             self.servers[index].ping()
+            br.record_success()
             return True
         except Exception:
+            br.record_failure()
             return False
 
-    def _fan(self, fn, retries: int = 1):
+    def _fan(self, fn, retries: Optional[int] = None):
         """Run fn(server) on every ALIVE server (read path — fn must be
         idempotent); a member failure triggers failover (replica
-        promotion) and ONE full restart so results are complete, not
-        partial."""
+        promotion) and a full restart so results are complete, not
+        partial. Restarts are bounded (`failover_retries`) and separated
+        by exponential backoff with seeded jitter — a cascading outage
+        must not turn the lead into a hot retry loop."""
+        from snappydata_tpu.observability.metrics import global_registry
+
+        if retries is None:
+            retries = _config.global_properties().failover_retries
+        failed_addrs: List[str] = []
         for attempt in range(retries + 1):
+            if not self._alive():
+                # fanning over ZERO members must fail loudly, not return
+                # an empty gather that surfaces as an opaque Arrow error
+                raise DistributedError(
+                    "no alive data servers to fan out to",
+                    failed_addresses=failed_addrs or [
+                        a for i, a in enumerate(self.server_addresses)
+                        if not self.alive[i]],
+                    attempts=attempt)
             out = []
             failed = None
             for si, srv in self._alive():
@@ -370,29 +480,45 @@ class DistributedSession:
                     break
             if failed is None:
                 return out
+            failed_addrs.append(self.server_addresses[failed])
             self.mark_server_failed(failed)
             if sum(self.alive) == 0:
-                raise DistributedError("all data servers failed")
+                raise DistributedError(
+                    f"all data servers failed (members lost this "
+                    f"fan-out: {', '.join(failed_addrs)})",
+                    failed_addresses=failed_addrs, attempts=attempt + 1)
             if attempt == retries:
                 raise DistributedError(
-                    f"server {self.server_addresses[failed]} failed and "
-                    f"retries exhausted")
+                    f"fan-out failed after {attempt + 1} attempts; "
+                    f"failed members: {', '.join(failed_addrs)} "
+                    f"({sum(self.alive)} of {len(self.servers)} still "
+                    f"alive)", failed_addresses=failed_addrs,
+                    attempts=attempt + 1)
+            global_registry().inc("failover_retries")
+            self._backoff.sleep(attempt, metric="failover_backoff")
 
     def _fan_mutation(self, fn):
         """Run fn(server) ONCE per alive server (mutations are NOT
         idempotent — never re-execute on a server that already applied).
         A dead member is failed over and skipped: its shard's mutation
         survives through the replica shadows the OTHER servers mirror."""
+        if not self._alive():
+            raise DistributedError("no alive data servers to fan out to")
         out = []
+        failed_addrs: List[str] = []
         for si, srv in self._alive():
             try:
                 out.append(fn(srv))
             except Exception:
                 if self._probe(si):
                     raise
+                failed_addrs.append(self.server_addresses[si])
                 self.mark_server_failed(si)
         if sum(self.alive) == 0:
-            raise DistributedError("all data servers failed")
+            raise DistributedError(
+                f"all data servers failed (members lost: "
+                f"{', '.join(failed_addrs)})",
+                failed_addresses=failed_addrs, attempts=1)
         return out
 
     # ------------------------------------------------------------------
@@ -588,6 +714,7 @@ class DistributedSession:
         # where each row's replica copy LANDED (-1 = nowhere yet); used
         # both for progress and for the promotion-dedup below
         rep_sent_to = np.full(n, -1, dtype=np.int64)
+        load_failed_addrs: List[str] = []
         for _attempt in range(4):  # survives members dying MID-LOAD
             owner = np.asarray(self.bucket_map)[buckets]
             rep = np.asarray(
@@ -627,6 +754,7 @@ class DistributedSession:
                 if not np.any(pending_rep):
                     break
                 continue
+            load_failed_addrs.append(self.server_addresses[failed])
             self.mark_server_failed(failed)
             # primary writes the dead server acked WITHOUT a replica copy
             # yet are gone with it — re-deliver them to the new owner
@@ -641,9 +769,21 @@ class DistributedSession:
                 covered = done & (new_rep >= 0) & (new_rep != rep)
                 rep_sent_to[covered] = new_rep[covered]
             if sum(self.alive) == 0:
-                raise DistributedError("all data servers failed mid-load")
+                raise DistributedError(
+                    f"all data servers failed mid-load (members lost: "
+                    f"{', '.join(load_failed_addrs)})",
+                    failed_addresses=load_failed_addrs,
+                    attempts=_attempt + 1)
+            from snappydata_tpu.observability.metrics import \
+                global_registry
+
+            global_registry().inc("failover_retries")
+            self._backoff.sleep(_attempt, metric="failover_backoff")
         if not done.all():
-            raise DistributedError("insert incomplete after failovers")
+            raise DistributedError(
+                f"insert incomplete after failovers (members lost: "
+                f"{', '.join(load_failed_addrs)})",
+                failed_addresses=load_failed_addrs, attempts=4)
         return n
 
     def _insert_values(self, stmt: ast.InsertInto):
@@ -697,6 +837,11 @@ class DistributedSession:
         except DistributedUnsupported:
             raise
         except (DistributedError, RenderError, NotDecomposableError) as e:
+            if isinstance(e, DistributedError) and not any(self.alive):
+                # a gather over a fully-dead cluster cannot succeed:
+                # keep the context-rich error (failed members, attempts)
+                # instead of a second, emptier failure from the fallback
+                raise
             # the downgrade to bounded gather is correct but is a real
             # perf cliff: account it visibly (dist_downgrades rides the
             # /status/api/v1 + /metrics/json snapshots) instead of

@@ -473,7 +473,12 @@ class SnappyFlightServer(flight.FlightServerBase):
 
     def do_get(self, context, ticket: flight.Ticket):
         from snappydata_tpu.cluster.flightsql import unpack_any
+        from snappydata_tpu.fault import failpoints
 
+        # server-side failpoint: an injected raise here reaches clients
+        # as a Flight error from a member that is otherwise ALIVE — the
+        # probe-then-raise (no-failover) path in DistributedSession
+        failpoints.hit("flight.serve")
         fsql = unpack_any(ticket.ticket)
         if fsql is not None:
             return self.flightsql.do_get(context, fsql[0], fsql[1])
@@ -596,6 +601,13 @@ class SnappyFlightServer(flight.FlightServerBase):
 
     def do_action(self, context, action: flight.Action):
         name = action.type
+        if name != "ping":
+            # ping stays exempt: liveness probes must answer truthfully
+            # or an injected app-level fault would masquerade as member
+            # death and trigger a spurious failover
+            from snappydata_tpu.fault import failpoints
+
+            failpoints.hit("flight.serve")
         if name in ("CreatePreparedStatement", "ClosePreparedStatement"):
             from snappydata_tpu.cluster.flightsql import unpack_any
 

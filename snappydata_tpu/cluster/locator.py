@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import logging
 import socket
 import socketserver
 import threading
@@ -25,6 +26,9 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from snappydata_tpu import config
+from snappydata_tpu.fault import failpoints
+
+_log = logging.getLogger("snappydata_tpu.cluster.locator")
 
 PRIMARY_LEAD_LOCK = "__PRIMARY_LEADER_LS"
 
@@ -189,32 +193,63 @@ class LocatorClient:
     heartbeat thread)."""
 
     def __init__(self, address: str, member_id: str, role: str,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 request_timeout_s: float = 5.0):
         self.address = address
         self.member_id = member_id
         self.role = role
         self.host = host
         self.port = port
+        # connect AND read timeout: a wedged locator socket must not
+        # park the heartbeat thread inside _lock forever (every other
+        # locator call would then block on the lock behind it)
+        self.request_timeout_s = request_timeout_s
         self._lock = threading.Lock()
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
         self._hb: Optional[threading.Thread] = None
         self.last_view = -1
 
+    def _close_locked(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
     def _request(self, payload: dict) -> dict:
         with self._lock:
             if self._sock is None:
                 h, p = self.address.rsplit(":", 1)
-                self._sock = socket.create_connection((h, int(p)), timeout=5)
+                self._sock = socket.create_connection(
+                    (h, int(p)), timeout=self.request_timeout_s)
+                # create_connection's timeout persists as the socket
+                # timeout, but make the read deadline explicit — it is
+                # the contract, not a connect-time leftover
+                self._sock.settimeout(self.request_timeout_s)
                 self._fh = self._sock.makefile("rwb")
-            self._fh.write((json.dumps(payload) + "\n").encode("utf-8"))
-            self._fh.flush()
-            line = self._fh.readline()
+            try:
+                self._fh.write((json.dumps(payload) + "\n").encode("utf-8"))
+                self._fh.flush()
+                line = self._fh.readline()
+            except (socket.timeout, OSError) as e:
+                # timed-out/broken socket: its stream buffer is desynced,
+                # drop it so the next request reconnects cleanly
+                self._close_locked()
+                raise ConnectionError(f"locator request failed: {e}")
             if not line:
-                self._sock.close()
-                self._sock = None
+                self._close_locked()
                 raise ConnectionError("locator connection lost")
-            return json.loads(line.decode("utf-8"))
+            try:
+                return json.loads(line.decode("utf-8"))
+            except ValueError:
+                # partial/garbled response (locator died mid-write): the
+                # stream is desynced — surface it as the connection loss
+                # it is, so the heartbeat loop's re-register path (not a
+                # silent thread death) handles it
+                self._close_locked()
+                raise ConnectionError("locator sent a garbled response")
 
     def register(self) -> dict:
         resp = self._request({"op": "register", "member_id": self.member_id,
@@ -228,9 +263,17 @@ class LocatorClient:
         return resp
 
     def start_heartbeats(self, interval_s: float = 1.0) -> None:
+        """Background heartbeat loop. Failures route through `logging`
+        and the `member_heartbeat_failures` counter (a heartbeat thread
+        that dies printing to stderr is how a member gets silently swept
+        out — the metric is what an operator alarms on); transient
+        connection errors re-register and keep beating."""
+        from snappydata_tpu.observability.metrics import global_registry
+
         def loop():
             while not self._stop.wait(interval_s):
                 try:
+                    failpoints.hit("locator.heartbeat")
                     resp = self._request({"op": "heartbeat",
                                           "member_id": self.member_id})
                     if resp.get("rejoin"):
@@ -239,22 +282,22 @@ class LocatorClient:
                 except RuntimeError as e:
                     # protocol mismatch after a locator upgrade: say so
                     # loudly and stop — silent sweep-out helps nobody
-                    import sys
-
-                    print(f"member {self.member_id}: {e}; stopping "
-                          f"heartbeats", file=sys.stderr)
+                    global_registry().inc("member_heartbeat_failures")
+                    _log.error("member %s: %s; stopping heartbeats",
+                               self.member_id, e)
                     return
-                except (ConnectionError, OSError):
+                except (ConnectionError, OSError) as e:
+                    global_registry().inc("member_heartbeat_failures")
+                    _log.warning("member %s: heartbeat failed (%s); "
+                                 "re-registering", self.member_id, e)
                     try:
                         self.register()
-                    except RuntimeError as e:
-                        import sys
-
-                        print(f"member {self.member_id}: {e}; stopping "
-                              f"heartbeats", file=sys.stderr)
+                    except RuntimeError as e2:
+                        _log.error("member %s: %s; stopping heartbeats",
+                                   self.member_id, e2)
                         return
                     except (ConnectionError, OSError):
-                        pass
+                        pass   # locator still down: retry next tick
 
         self._hb = threading.Thread(target=loop, daemon=True)
         self._hb.start()

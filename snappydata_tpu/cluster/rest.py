@@ -241,6 +241,18 @@ class RestService:
                     from snappydata_tpu import resource
 
                     self._send(resource.global_broker().ledger())
+                elif path == "/faults":
+                    # fault-injection surface (chaos tooling): armed
+                    # failpoints + fire counts. Same admin gate as the
+                    # POST side — fault state reveals operational detail
+                    if self._admin_session("fault state") is None:
+                        return
+                    from snappydata_tpu.fault import failpoints
+
+                    self._send({
+                        "faults": failpoints.registry().list(),
+                        "injected":
+                            global_registry().counter("fault_injected")})
                 elif path == "/metrics/json":
                     self._send(global_registry().snapshot())
                 elif path == "/metrics/prometheus":
@@ -266,6 +278,19 @@ class RestService:
                     self._send(svc.jobs.list())
                 else:
                     self._send({"error": "not found"}, 404)
+
+            def _admin_session(self, action_desc):
+                """Operator-action gate: resolved principal, admin-only
+                when auth is configured; None → 401/403 already sent."""
+                sess = self._principal_session()
+                if sess is None:
+                    return None
+                if (svc.auth_tokens or svc.auth_provider) and \
+                        sess.user != "admin":
+                    self._send({"error": f"{action_desc} requires "
+                                         f"admin"}, 403)
+                    return None
+                return sess
 
             def _principal_session(self):
                 """Resolve the request principal; None → 401 already sent."""
@@ -342,26 +367,59 @@ class RestService:
                         return
                     self._send({"queryId": qid, "cancelled": ok},
                                200 if ok else 404)
-                elif path == "/rebalance":
-                    # SYS.REBALANCE_ALL_BUCKETS analogue (operator
-                    # action; admin only when auth is on)
-                    sess = self._principal_session()
-                    if sess is None:
+                elif path == "/faults":
+                    # arm/disarm failpoints at runtime (the chaos
+                    # harness's remote control). Injecting faults is an
+                    # operator action: admin only when auth is on.
+                    if self._admin_session("fault injection") is None:
                         return
-                    if (svc.auth_tokens or svc.auth_provider) and \
-                            sess.user != "admin":
-                        self._send({"error": "rebalance requires admin"},
-                                   403)
+                    from snappydata_tpu.fault import failpoints
+
+                    reg = failpoints.registry()
+                    try:
+                        if body.get("clear"):
+                            reg.clear()
+                        elif body.get("disarm"):
+                            reg.disarm(body["name"])
+                        elif "seed" in body and "name" not in body \
+                                and "spec" not in body:
+                            reg.reseed(int(body["seed"]))
+                        elif "spec" in body:   # compact-grammar string
+                            reg.arm_from_spec(body["spec"])
+                        else:
+                            def _opt(key, cast):
+                                v = body.get(key)
+                                return None if v is None else cast(v)
+                            reg.arm(body["name"], body["action"],
+                                    param=float(body.get("param", 0.0)),
+                                    exc=body.get("exc", "io"),
+                                    phase=body.get("phase", "before"),
+                                    count=_opt("count", int),
+                                    every=_opt("every", int),
+                                    p=_opt("p", float))
+                    except (KeyError, ValueError, TypeError) as e:
+                        self._send({"error": f"bad fault spec: {e}"}, 400)
+                        return
+                    self._send({"faults": reg.list()})
+                elif path in ("/rebalance", "/redundancy/restore"):
+                    # SYS.REBALANCE_ALL_BUCKETS analogue + redundancy
+                    # re-restoration (operator actions; admin only when
+                    # auth is on)
+                    if self._admin_session("operator action") is None:
                         return
                     if svc.distributed is None:
                         self._send({"error": "no cluster session on "
                                              "this lead"}, 409)
                         return
                     try:
-                        self._send(svc.distributed.rebalance())
+                        if path == "/rebalance":
+                            self._send(svc.distributed.rebalance())
+                        else:
+                            self._send(
+                                svc.distributed.restore_redundancy())
                     except Exception as e:
-                        # rebalance is restartable: report how it failed
-                        # rather than aborting the connection
+                        # both ops are restartable: report how they
+                        # failed rather than aborting the connection
                         self._send({"error": str(e)}, 500)
                 else:
                     self._send({"error": "not found"}, 404)

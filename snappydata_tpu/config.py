@@ -137,6 +137,29 @@ class Properties:
     member_timeout_s: float = 5.0             # ref: ClusterManagerTestBase.scala:72
     stats_interval_s: float = 5.0             # ref: Constant.DEFAULT_CALC_TABLE_SIZE_SERVICE_INTERVAL
 
+    # Failover / retry (cluster/retry.py; exercised by fault/failpoints).
+    # A fan-out retries up to failover_retries times after member-death
+    # failovers, sleeping an exponential backoff with seeded jitter in
+    # between; per-peer circuit breakers stop probing a member that
+    # failed breaker_failures consecutive probes until breaker_reset_s
+    # elapses (then one half-open probe decides).
+    failover_retries: int = 2
+    retry_backoff_base_s: float = 0.05
+    retry_backoff_max_s: float = 2.0
+    retry_jitter: float = 0.5
+    breaker_failures: int = 3
+    breaker_reset_s: float = 5.0
+    # Seed for the fault-injection registry's probabilistic arming and
+    # the backoff jitter RNG — chaos schedules replay deterministically
+    # (env twin: SNAPPY_TPU_FAULT_SEED).
+    fault_seed: int = 0
+    # Boot-time failpoint arming, same compact grammar as the
+    # SNAPPY_TPU_FAULTS env twin (fault/failpoints.py):
+    # "wal.append=torn_write:7@1;flight.rpc=latency:0.01@p0.25".
+    # Read once when the registry is created; runtime changes go
+    # through fault.arm()/REST POST /faults.
+    faults: str = ""
+
     # Streaming (ref: SnappySinkCallback.scala:49-360)
     sink_state_table: str = "snappysys_internal____sink_state_table"
     sink_max_retries: int = 3

@@ -1,0 +1,16 @@
+"""Fault injection + failure-handling primitives (failpoints, backoff,
+circuit breaker). See fault/failpoints.py for the failpoint registry and
+cluster/retry.py for the retry policies the injected faults exercise."""
+
+from snappydata_tpu.fault.failpoints import (ACTIONS, KNOWN_POINTS,
+                                             FailpointRegistry,
+                                             FaultConnectionDropped,
+                                             FaultError, FaultSpec, arm,
+                                             clear, disarm, hit, registry,
+                                             reseed)
+
+__all__ = [
+    "ACTIONS", "KNOWN_POINTS", "FailpointRegistry", "FaultSpec",
+    "FaultError", "FaultConnectionDropped", "arm", "clear", "disarm",
+    "hit", "registry", "reseed",
+]

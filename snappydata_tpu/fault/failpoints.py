@@ -1,0 +1,290 @@
+"""Deterministic failpoint fault injection.
+
+The reference tests its HA story with a fault-injection battery (SURVEY.md
+§399 "Failure detection / elastic recovery / fault injection": forced
+disconnects, oplog corruption, member kills under load). This module is
+the process-wide registry those tests need: named fault points threaded
+through the storage, cluster, streaming, and device layers, armed at
+runtime (tests, REST `POST /faults`), via env (`SNAPPY_TPU_FAULTS`), or
+programmatically.
+
+A fault point is a NAME the production code calls `hit()` on; arming a
+spec under that name decides what happens at the next hit(s):
+
+actions
+  raise       raise an exception (`exc`: io | conn | runtime | timeout)
+  latency     sleep `param` seconds, then continue
+  torn_write  return the spec to the hook site, which truncates `param`
+              bytes mid-record and simulates a crash (storage paths)
+  drop        raise FaultConnectionDropped (a ConnectionError — the
+              client failover paths treat it exactly like a lost peer)
+
+arming modes (combinable with `phase`: before | after the guarded op)
+  count=N     fire at most N times (one-shot: count=1), then lie dormant
+  every=N     fire on every Nth eligible hit
+  p=0.25      fire probabilistically — the registry RNG is SEEDED
+              (constructor / SNAPPY_TPU_FAULT_SEED / reseed()), so a
+              chaos schedule replays byte-for-byte
+
+Wired fault points (grep `failpoints.hit` for the live list):
+  wal.append, checkpoint.write, flight.rpc (client side), flight.serve
+  (server side), locator.heartbeat, kafka.fetch, device.transfer
+
+Every fired fault bumps `fault_injected` and `fault_injected_<name>` in
+the global metrics registry, so a chaos harness can assert its schedule
+actually executed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import threading
+import time
+from typing import Dict, List, Optional
+
+
+class FaultError(IOError):
+    """Injected I/O-shaped failure (action `raise` with exc='io', and the
+    crash half of `torn_write`)."""
+
+
+class FaultConnectionDropped(ConnectionError):
+    """Injected connection loss (action `drop`): flows through the same
+    failover handling as a genuinely dead peer."""
+
+
+_EXC = {
+    "io": FaultError,
+    "conn": FaultConnectionDropped,
+    "runtime": RuntimeError,
+    "timeout": TimeoutError,
+}
+
+ACTIONS = ("raise", "latency", "torn_write", "drop")
+
+# canonical points wired into the engine — arming other names is allowed
+# (new hook sites don't need a registry edit), these are documentation
+KNOWN_POINTS = (
+    "wal.append", "checkpoint.write", "flight.rpc", "flight.serve",
+    "locator.heartbeat", "kafka.fetch", "device.transfer",
+)
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    name: str
+    action: str
+    param: float = 0.0          # latency seconds / torn-write bytes
+    exc: str = "io"             # exception family for `raise`
+    phase: str = "before"       # before | after the guarded operation
+    count: Optional[int] = None  # fire at most N times
+    every: Optional[int] = None  # fire on every Nth hit
+    p: Optional[float] = None   # fire with probability p (seeded RNG)
+    hits: int = 0               # eligible hit() evaluations
+    fired: int = 0              # times the action actually ran
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        return {k: v for k, v in d.items() if v is not None}
+
+
+class FailpointRegistry:
+    def __init__(self, seed: Optional[int] = None):
+        self._lock = threading.RLock()
+        self._specs: Dict[str, List[FaultSpec]] = {}
+        if seed is None:
+            seed = int(os.environ.get("SNAPPY_TPU_FAULT_SEED", "0") or 0)
+            if not seed:
+                seed = self._config_int("fault_seed")
+        self._seed = seed
+        self._rng = random.Random(seed)
+        env = os.environ.get("SNAPPY_TPU_FAULTS")
+        if env:
+            self.arm_from_spec(env)
+        conf_spec = self._config_str("faults")
+        if conf_spec:
+            self.arm_from_spec(conf_spec)
+
+    @staticmethod
+    def _config_int(key: str) -> int:
+        try:
+            from snappydata_tpu import config
+
+            return int(config.global_properties().get(key) or 0)
+        except Exception:
+            return 0
+
+    @staticmethod
+    def _config_str(key: str) -> str:
+        try:
+            from snappydata_tpu import config
+
+            return str(config.global_properties().get(key) or "")
+        except Exception:
+            return ""
+
+    # -- arming --------------------------------------------------------
+
+    def arm(self, name: str, action: str, param: float = 0.0,
+            exc: str = "io", phase: str = "before",
+            count: Optional[int] = None, every: Optional[int] = None,
+            p: Optional[float] = None) -> FaultSpec:
+        if action not in ACTIONS:
+            raise ValueError(f"unknown fault action {action!r}; "
+                             f"one of {ACTIONS}")
+        if exc not in _EXC:
+            raise ValueError(f"unknown exc family {exc!r}; "
+                             f"one of {tuple(_EXC)}")
+        if phase not in ("before", "after"):
+            raise ValueError("phase must be 'before' or 'after'")
+        if action == "torn_write" and phase == "after":
+            # no hook site interprets a torn_write AFTER the guarded op
+            # — arming one would count as injected without ever firing,
+            # giving a chaos schedule false coverage
+            raise ValueError("torn_write only supports phase='before'")
+        spec = FaultSpec(name, action, float(param), exc, phase,
+                         count, every, p)
+        with self._lock:
+            self._specs.setdefault(name, []).append(spec)
+        return spec
+
+    def arm_from_spec(self, text: str) -> List[FaultSpec]:
+        """Arm from a compact string (env/REST):
+
+            name=action[:param][@trigger][!exc][#after][;...]
+
+        trigger: bare int N → count=N (one-shot: @1); eN → every=N;
+        pX → probability X. A JSON list of spec objects is also
+        accepted: '[{"name": "wal.append", "action": "raise"}]'.
+        """
+        text = text.strip()
+        out: List[FaultSpec] = []
+        if text.startswith("[") or text.startswith("{"):
+            items = json.loads(text)
+            if isinstance(items, dict):
+                items = [items]
+            for it in items:
+                out.append(self.arm(**it))
+            return out
+        for entry in text.split(";"):
+            entry = entry.strip()
+            if not entry:
+                continue
+            name, _, rest = entry.partition("=")
+            phase = "before"
+            if rest.endswith("#after"):
+                phase, rest = "after", rest[:-len("#after")]
+            exc = "io"
+            if "!" in rest:
+                rest, _, exc = rest.partition("!")
+            count = every = p = None
+            if "@" in rest:
+                rest, _, trig = rest.partition("@")
+                if trig.startswith("p"):
+                    p = float(trig[1:])
+                elif trig.startswith("e"):
+                    every = int(trig[1:])
+                else:
+                    count = int(trig)
+            action, _, param = rest.partition(":")
+            out.append(self.arm(name.strip(), action.strip(),
+                                param=float(param) if param else 0.0,
+                                exc=exc, phase=phase, count=count,
+                                every=every, p=p))
+        return out
+
+    def disarm(self, name: str) -> bool:
+        with self._lock:
+            return self._specs.pop(name, None) is not None
+
+    def clear(self) -> None:
+        with self._lock:
+            self._specs.clear()
+
+    def reseed(self, seed: int) -> None:
+        """Restart the probabilistic-arming RNG — a chaos schedule with
+        the same seed and the same hit sequence replays exactly."""
+        with self._lock:
+            self._seed = seed
+            self._rng = random.Random(seed)
+
+    def list(self) -> List[dict]:
+        with self._lock:
+            return [s.to_dict() for specs in self._specs.values()
+                    for s in specs]
+
+    # -- the hook ------------------------------------------------------
+
+    def hit(self, name: str, phase: str = "before") -> Optional[FaultSpec]:
+        """Called by production code at a fault point. Fast no-op when
+        nothing is armed. Returns the triggering spec for `torn_write`
+        (the site interprets `param` = bytes to cut); raises/sleeps for
+        the other actions."""
+        if not self._specs:          # hot-path guard, no lock
+            return None
+        triggered: Optional[FaultSpec] = None
+        with self._lock:
+            for spec in self._specs.get(name, ()):
+                if spec.phase != phase:
+                    continue
+                if spec.count is not None and spec.fired >= spec.count:
+                    continue
+                spec.hits += 1
+                if spec.p is not None:
+                    fire = self._rng.random() < spec.p
+                elif spec.every is not None:
+                    fire = spec.hits % spec.every == 0
+                else:
+                    fire = True
+                if not fire:
+                    continue
+                spec.fired += 1
+                triggered = spec
+                break
+        if triggered is None:
+            return None
+        from snappydata_tpu.observability.metrics import global_registry
+
+        reg = global_registry()
+        reg.inc("fault_injected")
+        reg.inc(f"fault_injected_{name.replace('.', '_')}")
+        if triggered.action == "latency":
+            time.sleep(triggered.param)
+            return None
+        if triggered.action == "drop":
+            raise FaultConnectionDropped(
+                f"failpoint {name}: injected connection drop")
+        if triggered.action == "raise":
+            raise _EXC[triggered.exc](
+                f"failpoint {name}: injected failure")
+        return triggered             # torn_write: site applies it
+
+
+_global = FailpointRegistry()
+
+
+def registry() -> FailpointRegistry:
+    return _global
+
+
+def hit(name: str, phase: str = "before") -> Optional[FaultSpec]:
+    return _global.hit(name, phase)
+
+
+def arm(name: str, action: str, **kw) -> FaultSpec:
+    return _global.arm(name, action, **kw)
+
+
+def disarm(name: str) -> bool:
+    return _global.disarm(name)
+
+
+def clear() -> None:
+    _global.clear()
+
+
+def reseed(seed: int) -> None:
+    _global.reseed(seed)

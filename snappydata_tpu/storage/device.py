@@ -132,6 +132,13 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
 
         b = round_up_to(b, ctx.num_devices)
 
+    # device.transfer failpoint: one hit per table build (not per column
+    # — the build is the unit a caller can retry); an injected raise
+    # models a flaky accelerator runtime rejecting the host→HBM upload
+    from snappydata_tpu.fault import failpoints
+
+    failpoints.hit("device.transfer")
+
     def _place(host_array):
         from snappydata_tpu.parallel.mesh import shard_batches
 

@@ -273,11 +273,31 @@ def decode_validity(col: EncodedColumn, capacity: Optional[int] = None) -> Optio
 
 # --- at-rest compression (ref: CompressionUtils LZ4/Snappy; env has zlib) ---
 
+_zstd_available: Optional[bool] = None
+
+
+def _have_zstd() -> bool:
+    global _zstd_available
+    if _zstd_available is None:
+        try:
+            import zstandard  # noqa: F401
+
+            _zstd_available = True
+        except ImportError:
+            _zstd_available = False
+    return _zstd_available
+
+
 def compress_bytes(raw: bytes, codec: str) -> Tuple[str, bytes]:
     if codec == "zstd":
-        import zstandard
+        if _have_zstd():
+            import zstandard
 
-        return "zstd", zstandard.ZstdCompressor(level=1).compress(raw)
+            return "zstd", zstandard.ZstdCompressor(level=1).compress(raw)
+        # zstandard not installed: degrade to the stdlib codec instead of
+        # failing every WAL append / checkpoint on this machine (each
+        # record tags the codec actually used, so mixed files read fine)
+        codec = "zlib"
     if codec == "zlib":
         return "zlib", zlib.compress(raw, level=1)
     return "none", raw

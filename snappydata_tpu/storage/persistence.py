@@ -27,21 +27,35 @@ from __future__ import annotations
 import dataclasses
 import io
 import json
+import logging
 import os
 import struct
 import threading
+import zlib
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from snappydata_tpu import types as T
+from snappydata_tpu.fault import failpoints
 from snappydata_tpu.storage.batch import ColumnBatch
 from snappydata_tpu.storage.encoding import (ColumnStats, EncodedColumn,
                                              Encoding)
 from snappydata_tpu.storage.table_store import (BatchView, ColumnTableData,
                                                 RowTableData)
 
-_MAGIC = b"SNTP"
+_MAGIC = b"SNTP"    # legacy records: no checksum (read-compat only)
+_MAGIC2 = b"SNT2"   # checksummed records: trailing CRC32 over head+parts
+
+_log = logging.getLogger("snappydata_tpu.storage.persistence")
+
+
+class CorruptRecordError(IOError):
+    """A record whose bytes are provably damaged (bad magic, CRC mismatch,
+    garbled checksummed header) — as opposed to a torn TAIL, which is the
+    expected shape of a crash mid-append and is simply where replay stops.
+    Callers on the recovery path salvage the valid prefix and quarantine
+    the rest (salvage_file) instead of failing boot."""
 
 
 import contextlib
@@ -148,20 +162,35 @@ def write_record(fh, header: dict, arrays: List[Optional[np.ndarray]]) -> None:
     if any(c != "none" for c in codecs):
         head_obj["codecs"] = codecs
     head = json.dumps(head_obj).encode("utf-8")
-    fh.write(_MAGIC)
+    # CRC32 over head + stored (possibly compressed) parts, trailing the
+    # record: verify-on-read catches bit rot that is the right LENGTH (a
+    # torn tail is caught by short reads; a flipped byte was not, and
+    # used to replay silently — the whole point of the checksum)
+    crc = zlib.crc32(head)
+    for p in parts:
+        crc = zlib.crc32(p, crc)
+    fh.write(_MAGIC2)
     fh.write(struct.pack("<I", len(head)))
     fh.write(head)
     for p in parts:
         fh.write(p)
+    fh.write(struct.pack("<I", crc & 0xFFFFFFFF))
 
 
 def read_records(fh):
+    """Yield (header, arrays) until EOF or a torn tail (crash mid-append:
+    stop cleanly). Raise CorruptRecordError on provable mid-file damage —
+    bad magic or a CRC mismatch on a checksummed record."""
     while True:
         magic = fh.read(4)
         if len(magic) < 4:
             return
-        if magic != _MAGIC:
-            raise IOError("corrupt record (bad magic)")
+        if magic == _MAGIC2:
+            checksummed = True
+        elif magic == _MAGIC:
+            checksummed = False
+        else:
+            raise CorruptRecordError("corrupt record (bad magic)")
         lenbytes = fh.read(4)
         if len(lenbytes) < 4:
             return  # torn tail
@@ -171,26 +200,54 @@ def read_records(fh):
             return  # torn tail
         try:
             head = json.loads(raw_head.decode("utf-8"))
-        except (ValueError, UnicodeDecodeError):
-            return  # torn/garbled tail record (crash mid-write)
-        parts = []
+            sizes = list(head["sizes"])
+        except (ValueError, UnicodeDecodeError, KeyError, TypeError):
+            if checksummed:
+                # a checksummed record's header was fully present but
+                # does not parse: damage, not a tear
+                raise CorruptRecordError("corrupt record (garbled header)")
+            return  # legacy torn/garbled tail record (crash mid-write)
+        raw_parts = []
         ok = True
-        codecs = head.get("codecs")
-        for pi, size in enumerate(head["sizes"]):
+        for size in sizes:
             p = fh.read(size)
             if len(p) < size:  # torn tail write (crash mid-record)
                 ok = False
                 break
+            raw_parts.append(p)
+        if not ok:
+            return
+        if checksummed:
+            crc_bytes = fh.read(4)
+            if len(crc_bytes) < 4:
+                return  # torn tail: crc never made it to disk
+            crc = zlib.crc32(raw_head)
+            for p in raw_parts:
+                crc = zlib.crc32(p, crc)
+            if (crc & 0xFFFFFFFF) != struct.unpack("<I", crc_bytes)[0]:
+                raise CorruptRecordError("corrupt record (CRC mismatch)")
+        parts = []
+        codecs = head.get("codecs")
+        for pi, p in enumerate(raw_parts):
             if codecs is not None and codecs[pi] != "none":
                 from snappydata_tpu.storage.encoding import decompress_bytes
 
                 try:
                     p = decompress_bytes(codecs[pi], p)
+                except ImportError:
+                    # codec module missing on THIS machine (e.g. a zstd
+                    # record read where only zlib exists): a config
+                    # problem — never quarantine sound data over it
+                    raise
                 except Exception:
-                    return  # garbled tail (crash mid-write): stop cleanly
+                    if checksummed:
+                        # CRC passed yet the codec rejects it: damage in
+                        # a shape the checksum covered — impossible
+                        # without a writer bug, but never replay it
+                        raise CorruptRecordError(
+                            "corrupt record (undecodable part)")
+                    return  # garbled legacy tail: stop cleanly
             parts.append(p)
-        if not ok:
-            return
         arrays: List[Optional[np.ndarray]] = []
         pos = 0
         for m in head["arrays"]:
@@ -198,6 +255,84 @@ def read_records(fh):
             pos += m["nparts"]
             arrays.append(_arr_from_parts(m, ps))
         yield head["h"], arrays
+
+
+def _read_first_header(path: str) -> Optional[dict]:
+    """First record's user header (the `h` field) WITHOUT reading or
+    decoding the payload parts — for boot-time metadata peeks. Returns
+    None on an empty/torn/damaged head; no CRC verification (callers
+    that consume the payload go through read_records)."""
+    with open(path, "rb") as fh:
+        magic = fh.read(4)
+        if magic not in (_MAGIC, _MAGIC2):
+            return None
+        lenbytes = fh.read(4)
+        if len(lenbytes) < 4:
+            return None
+        (hlen,) = struct.unpack("<I", lenbytes)
+        raw_head = fh.read(hlen)
+        if len(raw_head) < hlen:
+            return None
+        try:
+            return json.loads(raw_head.decode("utf-8")).get("h")
+        except (ValueError, UnicodeDecodeError, AttributeError):
+            return None
+
+
+def salvage_scan(path: str) -> Tuple[int, Optional[CorruptRecordError]]:
+    """Walk `path`'s records; return (byte offset past the last fully
+    valid record, the CorruptRecordError if damage stopped the walk —
+    None for a clean file or a plain torn tail)."""
+    with open(path, "rb") as fh:
+        valid_end = 0
+        gen = read_records(fh)
+        while True:
+            try:
+                next(gen)
+            except StopIteration:
+                return valid_end, None
+            except CorruptRecordError as e:
+                return valid_end, e
+            valid_end = fh.tell()
+
+
+def salvage_file(path: str, counter: str = "wal_corrupt_records") -> int:
+    """Repair a record file in place: quarantine everything past the last
+    valid record to `path + '.corrupt'` and truncate the file to the
+    valid prefix, so recovery keeps every intact record AND subsequent
+    appends land at a readable position (an un-truncated torn tail would
+    strand later appends behind unreadable bytes). Bumps `counter` when
+    the cut was provable corruption rather than a crash tear. Returns
+    the number of quarantined bytes (0 = file was clean/absent)."""
+    if not os.path.exists(path):
+        return 0
+    valid_end, err = salvage_scan(path)
+    size = os.path.getsize(path)
+    if valid_end >= size:
+        return 0
+    with open(path, "rb") as fh:
+        fh.seek(valid_end)
+        bad = fh.read()
+    with open(path + ".corrupt", "ab") as out:
+        out.write(bad)
+        out.flush()
+        os.fsync(out.fileno())
+    with open(path, "rb+") as fh:
+        fh.truncate(valid_end)
+        fh.flush()
+        os.fsync(fh.fileno())
+    if err is not None:
+        from snappydata_tpu.observability.metrics import global_registry
+
+        global_registry().inc(counter)
+        _log.warning(
+            "%s: %s at byte %d — salvaged %d-byte prefix, quarantined "
+            "%d bytes to %s", path, err, valid_end, valid_end, len(bad),
+            path + ".corrupt")
+    else:
+        _log.info("%s: torn tail (%d bytes) truncated after crash; "
+                  "quarantined to %s", path, len(bad), path + ".corrupt")
+    return len(bad)
 
 
 # --------------------------------------------------------------------------
@@ -286,6 +421,14 @@ class DiskStore:
         self._lock = threading.Lock()
         self.mutation_lock = threading.RLock()
         self._wal_fh: Optional[io.BufferedWriter] = None
+        # boot-time repair: quarantine damaged/torn suffixes BEFORE the
+        # first append — appending after a torn tail would strand the new
+        # (acked!) records behind bytes replay can never traverse
+        salvage_file(self._wal_path())
+        # the log stays clean across ordinary appends (whole records,
+        # flushed+fsynced); only a torn-write crash dirties it again —
+        # this flag lets replay/reopen skip redundant full-file rescans
+        self._wal_clean = True
         self._wal_seq = self._scan_last_seq()
 
     def _wal_path(self) -> str:
@@ -298,6 +441,16 @@ class DiskStore:
         — the reference's oplog stores fsync before truncating. A power
         loss right after os.replace without these leaves an empty/partial
         file whose covering WAL records were already discarded."""
+        spec = failpoints.hit("checkpoint.write")
+        if spec is not None and spec.action == "torn_write":
+            # crash mid-write of the checkpoint artifact: the tmp file
+            # loses its tail and the replace never happens — the previous
+            # artifact (and the un-rotated WAL) stay authoritative
+            with open(tmp, "rb+") as fh:
+                fh.truncate(max(0, os.path.getsize(tmp)
+                                - max(1, int(spec.param))))
+            raise failpoints.FaultError(
+                "failpoint checkpoint.write: injected torn write")
         with open(tmp, "rb") as fh:
             os.fsync(fh.fileno())
         os.replace(tmp, dst)
@@ -308,11 +461,38 @@ class DiskStore:
             os.close(dfd)
 
     def _scan_last_seq(self) -> int:
+        """Next-seq floor = max over the WAL *and* every checkpoint's
+        folded wal_seq. The checkpoint fences are load-bearing: rotation
+        can leave the WAL EMPTY while manifests hold the high-water
+        mark — seeding from the WAL alone made a post-rotation reboot
+        mint seqs BELOW the fence, and recovery silently skipped those
+        acked records (found by the seeded chaos harness)."""
         last = 0
         if os.path.exists(self._wal_path()):
             with open(self._wal_path(), "rb") as fh:
                 for header, _ in read_records(fh):
                     last = max(last, header.get("seq", 0))
+        tdir = os.path.join(self.path, "tables")
+        for name in (os.listdir(tdir) if os.path.isdir(tdir) else ()):
+            mpath = os.path.join(tdir, name, "manifest.json")
+            if os.path.exists(mpath):
+                try:
+                    with open(mpath) as fh:
+                        last = max(last,
+                                   int(json.load(fh).get("wal_seq", 0)))
+                except (OSError, ValueError, TypeError):
+                    pass   # damaged manifest: recovery handles it
+            rpath = os.path.join(tdir, name, "rows.dat")
+            if os.path.exists(rpath):
+                try:
+                    # header-only read: the folded wal_seq sits in the
+                    # first record's JSON head — decoding the full row
+                    # snapshot here would double recovery's boot cost
+                    head = _read_first_header(rpath)
+                    if head is not None:
+                        last = max(last, int(head.get("wal_seq", 0)))
+                except (OSError, IOError, ValueError, TypeError):
+                    pass
         return last
 
     # -- catalog ---------------------------------------------------------
@@ -370,7 +550,7 @@ class DiskStore:
             fname = f"batch-{b.batch_id}.col"
             fpath = os.path.join(tdir, fname)
             if not os.path.exists(fpath):  # immutable → write once
-                self._write_batch(fpath, b)
+                self._write_batch(fpath, b, info.schema)
             entry = {"file": fname, "batch_id": b.batch_id,
                      "num_rows": b.num_rows, "capacity": b.capacity}
             if view.delete_mask is not None:
@@ -420,13 +600,22 @@ class DiskStore:
                 folded[info.name] = seq
             self._rotate_wal(folded)
 
-    def _write_batch(self, fpath: str, batch: ColumnBatch) -> None:
+    def _write_batch(self, fpath: str, batch: ColumnBatch,
+                     schema: Optional[T.Schema] = None) -> None:
         with open(fpath + ".tmp", "wb") as fh:
             for i, col in enumerate(batch.columns):
                 stats = col.stats
                 header = {
                     "col": i, "encoding": int(col.encoding),
                     "dtype": _dtype_to_json(col.dtype),
+                    # column NAME at write time: batch files are
+                    # write-once, so a later ALTER leaves them with a
+                    # different column set than the manifest — load
+                    # aligns by these names (legacy files without them
+                    # fall back to the manifest's positional remap)
+                    "name": (schema.fields[i].name.lower()
+                             if schema is not None
+                             and i < len(schema.fields) else None),
                     "num_rows": col.num_rows,
                     "stats": None if stats is None else {
                         "min": _json_safe(stats.min),
@@ -451,7 +640,15 @@ class DiskStore:
         arrays), 'delete_keys' (key-tuple arrays + key column names),
         'drop' (incarnation marker). Returns the record's seq."""
         with self._lock:
+            spec = failpoints.hit("wal.append")  # raise/latency fire here
             if self._wal_fh is None:
+                # reopen-time repair: if a tear was left since the log
+                # was last open (torn-write fault path below), appending
+                # after it would strand this record behind bytes replay
+                # can never traverse
+                if not self._wal_clean:
+                    salvage_file(self._wal_path())
+                    self._wal_clean = True
                 self._wal_fh = open(self._wal_path(), "ab")
             self._wal_seq += 1
             header = {"kind": kind, "table": table, "seq": self._wal_seq}
@@ -465,9 +662,28 @@ class DiskStore:
                 payload = list(arrays or [])
                 header["ncols"] = len(payload)
                 payload += list(nulls or [None] * len(payload))
+            if spec is not None and spec.action == "torn_write":
+                # crash mid-append: only a prefix of the record reaches
+                # disk. The mutation raises (never acked, never applied)
+                # and the store must be reopened like a real crash —
+                # boot-time salvage then truncates the tear.
+                buf = io.BytesIO()
+                write_record(buf, header, payload)
+                raw = buf.getvalue()
+                cut = max(1, int(spec.param))
+                self._wal_fh.write(raw[:max(0, len(raw) - cut)])
+                self._wal_fh.flush()
+                os.fsync(self._wal_fh.fileno())
+                self._wal_fh.close()
+                self._wal_fh = None
+                self._wal_clean = False   # tear on disk until salvaged
+                raise failpoints.FaultError(
+                    f"failpoint wal.append: injected torn write "
+                    f"({cut} bytes cut)")
             write_record(self._wal_fh, header, payload)
             self._wal_fh.flush()
             os.fsync(self._wal_fh.fileno())
+            failpoints.hit("wal.append", phase="after")
             return self._wal_seq
 
     def current_wal_seq(self) -> int:
@@ -481,6 +697,15 @@ class DiskStore:
         with self._lock:
             if not os.path.exists(self._wal_path()):
                 return
+            if self._wal_fh is not None:
+                self._wal_fh.close()
+                self._wal_fh = None
+            # a mid-file corrupt record must not abort the checkpoint:
+            # salvage the prefix, quarantine the damage, rotate what's
+            # readable (the damaged record's mutation was acked against
+            # bytes that no longer exist — quarantine + counter is the
+            # honest response, failing every future checkpoint is not)
+            salvage_file(self._wal_path())
             keep: List[Tuple[dict, list]] = []
             with open(self._wal_path(), "rb") as fh:
                 for header, arrays in read_records(fh):
@@ -491,9 +716,6 @@ class DiskStore:
             with open(tmp, "wb") as fh:
                 for header, arrays in keep:
                     write_record(fh, header, arrays)
-            if self._wal_fh is not None:
-                self._wal_fh.close()
-                self._wal_fh = None
             self._durable_replace(tmp, self._wal_path())
 
     def drop_table_dir(self, table: str) -> None:
@@ -609,6 +831,7 @@ class DiskStore:
             rpath = os.path.join(tdir, "rows.dat")
             seq = 0
             if os.path.exists(rpath):
+                salvage_file(rpath, counter="batch_corrupt_records")
                 with open(rpath, "rb") as fh:
                     for header, arrays in read_records(fh):
                         seq = header.get("wal_seq", 0)
@@ -639,23 +862,58 @@ class DiskStore:
                      for nm in saved_names]
         views = []
         for entry in manifest["batches"]:
-            batch = self._read_batch(os.path.join(tdir, entry["file"]),
-                                     entry, info.schema)
+            fpath = os.path.join(tdir, entry["file"])
+            try:
+                # FileNotFoundError covers the boot AFTER a quarantine:
+                # the manifest still names the file until the next
+                # checkpoint rewrites it — a missing batch must skip the
+                # same way the corrupt one did, not fail boot
+                batch, file_names = self._read_batch(fpath, entry,
+                                                     info.schema)
+            except (CorruptRecordError, FileNotFoundError) as e:
+                # a damaged immutable batch cannot be partially used (a
+                # missing column would desync the columnar views):
+                # quarantine the whole file, count it, keep booting —
+                # the reference's disk stores quarantine bad oplogs the
+                # same way rather than refusing to start
+                from snappydata_tpu.observability.metrics import \
+                    global_registry
+
+                global_registry().inc("batch_corrupt_records")
+                _log.error(
+                    "%s: %s — quarantining batch file (%d rows lost) "
+                    "and continuing recovery", fpath, e,
+                    entry.get("num_rows", -1))
+                if os.path.exists(fpath):
+                    os.replace(fpath, fpath + ".corrupt")
+                continue
             delete_mask = _unb64(entry.get("delete_mask"), np.bool_)
             deltas = tuple(
                 (d["col"], _unb64(d["hit"], np.bool_),
                  _unb64_any(d["values"]),
                  _unb64(d["nulls"], np.bool_) if d.get("nulls") else None)
                 for d in entry.get("deltas", ()))
-            if remap is not None:
-                by_name = dict(zip(saved_names, batch.columns))
-                import dataclasses as _dc
+            import dataclasses as _dc
 
+            # align the batch's columns to the CURRENT schema. Batch
+            # files are write-once, so their column set reflects the
+            # schema at WRITE time — which may predate both the
+            # manifest's saved_names and today's schema (ALTERs in
+            # between). Files that recorded names align exactly; legacy
+            # files fall back to the manifest's positional remap.
+            if file_names is not None:
+                align_names = file_names if file_names != cur_names \
+                    else None
+            else:
+                align_names = saved_names if remap is not None else None
+            if align_names is not None:
+                by_name = dict(zip(align_names, batch.columns))
                 batch = _dc.replace(batch, columns=tuple(
                     by_name[nm] if nm in by_name
                     else data._all_null_column(ci, f.dtype, batch.num_rows)
                     for ci, (nm, f) in enumerate(
                         zip(cur_names, info.schema.fields))))
+            if remap is not None:
                 deltas = tuple((remap[ci], hit, vals, vn)
                                for ci, hit, vals, vn in deltas
                                if remap[ci] is not None)
@@ -670,6 +928,7 @@ class DiskStore:
                             ci, np.asarray(col.dictionary, dtype=object))
             rb = os.path.join(tdir, "rowbuf.dat")
             if os.path.exists(rb):
+                salvage_file(rb, counter="batch_corrupt_records")
                 with open(rb, "rb") as fh:
                     for header, arrays in read_records(fh):
                         n_cols = len(saved_names)
@@ -696,11 +955,26 @@ class DiskStore:
             data._publish(tuple(views))
         return manifest.get("wal_seq", 0)
 
-    def _read_batch(self, fpath: str, entry: dict,
-                    schema: T.Schema) -> ColumnBatch:
+    def _read_batch(self, fpath: str, entry: dict, schema: T.Schema
+                    ) -> Tuple[ColumnBatch, Optional[List[str]]]:
+        """Read a batch file; returns (batch, column names recorded at
+        write time — None for legacy files without them). Quarantine-
+        worthy damage (CRC mismatch, bad magic, unreadable trailing
+        bytes) raises CorruptRecordError; a CLEAN file with a different
+        column set than today's schema is NOT damage — batch files are
+        write-once and may predate an ALTER (the caller aligns by
+        name)."""
         cols = []
+        names: List[Optional[str]] = []
         with open(fpath, "rb") as fh:
-            for header, arrays in read_records(fh):
+            gen = read_records(fh)
+            last_good = 0
+            while True:
+                try:
+                    rec = next(gen)       # CorruptRecordError propagates
+                except StopIteration:
+                    break
+                header, arrays = rec
                 data_arr, dictionary, runs, validity = arrays
                 st = header.get("stats")
                 stats = None if st is None else ColumnStats(
@@ -710,13 +984,33 @@ class DiskStore:
                     _dtype_from_json(header["dtype"]),
                     header["num_rows"], data_arr, dictionary=dictionary,
                     runs=runs, validity=validity, stats=stats))
-        return ColumnBatch(entry["batch_id"], 0, entry["num_rows"],
-                           entry["capacity"], tuple(cols))
+                names.append(header.get("name"))
+                last_good = fh.tell()
+        size = os.path.getsize(fpath)
+        if last_good < size:
+            # the file ends in bytes no record accounts for: a tear,
+            # not a schema-drift artifact
+            raise CorruptRecordError(
+                f"batch file torn: {size - last_good} unreadable "
+                f"trailing bytes after {len(cols)} columns")
+        if not cols:
+            raise CorruptRecordError("batch file holds no records")
+        file_names = [n for n in names] \
+            if all(n is not None for n in names) else None
+        return (ColumnBatch(entry["batch_id"], 0, entry["num_rows"],
+                            entry["capacity"], tuple(cols)), file_names)
 
     def _replay_wal(self, catalog, session, folded: Dict[str, int]) -> None:
         wal = self._wal_path()
         if not os.path.exists(wal):
             return
+        # the store may have been dirtied since construction (torn-write
+        # crash): re-salvage so the tear is quarantined instead of
+        # aborting boot mid-replay; skipped when the log is known clean
+        # (construction salvaged it and only whole records followed)
+        if not getattr(self, "_wal_clean", False):
+            salvage_file(wal)
+            self._wal_clean = True
         # replay must not re-journal (records already ARE the journal)
         with _no_journal(session):
             self._replay_wal_inner(catalog, session, folded, wal)

@@ -399,6 +399,13 @@ class KafkaSource:
     # -- Source contract ----------------------------------------------
 
     def next_batch(self, batch_id: int):
+        # kafka.fetch failpoint: an injected raise/drop surfaces exactly
+        # like a broker outage — the streaming loop records last_error
+        # and replays the SAME batch next tick (offset log unchanged),
+        # which is the exactly-once contract under test
+        from snappydata_tpu.fault import failpoints
+
+        failpoints.hit("kafka.fetch")
         ranges = self._logged_ranges(batch_id)
         if ranges is None:
             ranges = self._plan_new_batch(batch_id)

@@ -1,0 +1,236 @@
+"""Seeded chaos harness (the tentpole's acceptance battery): randomized
+fault schedules driven by the failpoint registry against (a) a durable
+single-node store and (b) a real 3-node cluster, asserting the core
+invariants the hardening must hold:
+
+  - no acknowledged row is lost across crash-recovery;
+  - no mutation is ever double-applied (blind retry is forbidden on the
+    at-most-once paths);
+  - replicas converge after failover — queries stay complete;
+  - recovery is idempotent (boot twice → identical state);
+  - fan-out retries are bounded and separated by backoff;
+  - ≥ 50 faults actually fire across WAL, RPC, and heartbeat failpoints
+    on the 3-node cluster (asserted via the fault_injected counter).
+
+Schedules are SEEDED (registry RNG + python Random) so a failing run
+replays exactly. The quick schedules here run in tier-1; the long
+randomized battery at the bottom is additionally marked `slow`.
+"""
+
+import random
+import time
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.chaos
+
+from snappydata_tpu import SnappySession, fault
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.observability.metrics import global_registry
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+# -----------------------------------------------------------------------
+# single-node durability chaos
+# -----------------------------------------------------------------------
+
+def _run_durability_schedule(tmp_path, seed: int, n_ops: int):
+    """Seeded insert/checkpoint stream with torn-write / raise faults on
+    wal.append and checkpoint.write; every fault is treated as a crash
+    (store reopened). Returns the set of ACKED keys."""
+    rng = random.Random(seed)
+    fault.reseed(seed)
+    d = str(tmp_path)
+    s = SnappySession(catalog=Catalog(), data_dir=d, recover=False)
+    s.sql("CREATE TABLE t (k BIGINT, v DOUBLE) USING column")
+    acked = []
+
+    def crash_and_recover(old):
+        try:
+            old.disk_store.close()
+        except Exception:
+            pass
+        return SnappySession(data_dir=d, recover=True)
+
+    for i in range(n_ops):
+        r = rng.random()
+        if r < 0.15:
+            fault.arm("wal.append", "torn_write",
+                      param=rng.randint(1, 40), count=1)
+        elif r < 0.25:
+            fault.arm("wal.append", "raise", count=1)
+        elif r < 0.33:
+            fault.arm("checkpoint.write", "torn_write",
+                      param=rng.randint(1, 60), count=1)
+        try:
+            s.sql(f"INSERT INTO t VALUES ({i}, {i}.5)")
+            acked.append(i)
+        except Exception:
+            s = crash_and_recover(s)
+            got = {r0[0] for r0 in s.sql("SELECT k FROM t").rows()}
+            assert set(acked) <= got, \
+                f"acked rows lost mid-schedule: {set(acked) - got}"
+        if rng.random() < 0.2:
+            try:
+                s.checkpoint()
+            except Exception:
+                s = crash_and_recover(s)
+    fault.clear()
+    s.disk_store.close()
+    # final recovery: exactly the acked set — nothing lost, nothing
+    # double-applied (count equality catches duplicates)
+    s2 = SnappySession(data_dir=d, recover=True)
+    rows = s2.sql("SELECT k FROM t ORDER BY k").rows()
+    assert [r[0] for r in rows] == sorted(acked)
+    s2.disk_store.close()
+    # recovery is idempotent: a second boot sees the identical state
+    s3 = SnappySession(data_dir=d, recover=True)
+    assert s3.sql("SELECT k FROM t ORDER BY k").rows() == rows
+    s3.disk_store.close()
+    return set(acked)
+
+
+def test_chaos_durability_quick(tmp_path):
+    before = global_registry().counter("fault_injected")
+    acked = _run_durability_schedule(tmp_path, seed=20260803, n_ops=60)
+    injected = global_registry().counter("fault_injected") - before
+    assert injected >= 10, f"schedule only injected {injected} faults"
+    assert len(acked) >= 20       # the system made real progress too
+
+
+# -----------------------------------------------------------------------
+# 3-node cluster chaos
+# -----------------------------------------------------------------------
+
+def test_chaos_cluster_schedule(tmp_path):
+    from snappydata_tpu.cluster import LocatorNode, ServerNode
+    from snappydata_tpu.cluster.distributed import DistributedSession
+
+    injected_before = global_registry().counter("fault_injected")
+    seed = 424242
+    rng = random.Random(seed)
+    fault.reseed(seed)
+
+    locator = LocatorNode().start()
+    sessions = [SnappySession(catalog=Catalog(),
+                              data_dir=str(tmp_path / f"srv{i}"),
+                              recover=False) for i in range(3)]
+    servers = [ServerNode(locator.address, s).start() for s in sessions]
+    ds = DistributedSession(
+        server_addresses=[s.flight_address for s in servers])
+    try:
+        ds.sql("CREATE TABLE tx (k BIGINT, v DOUBLE) USING column "
+               "OPTIONS (partition_by 'k', redundancy '1')")
+        ds.sql("CREATE TABLE mut (k BIGINT) USING column "
+               "OPTIONS (partition_by 'k')")
+        expected = 0
+
+        def insert_batch(n):
+            nonlocal expected
+            ks = np.arange(expected, expected + n, dtype=np.int64)
+            ds.insert_arrays("tx", [ks, ks * 0.5])
+            expected += n   # acked
+
+        insert_batch(200)
+
+        # ---- phase A: fault storm over reads + routed inserts --------
+        # latency + connection drops on client RPC, app-level raises on
+        # the server's Flight handler, heartbeat failures, slow WAL
+        fault.arm("flight.rpc", "latency", param=0.002, p=0.35)
+        fault.arm("flight.rpc", "drop", p=0.15)
+        fault.arm("flight.serve", "raise", exc="runtime", every=9)
+        fault.arm("locator.heartbeat", "raise", exc="conn", every=2)
+        fault.arm("wal.append", "latency", param=0.001, p=0.6)
+        hb_before = global_registry().counter("member_heartbeat_failures")
+        ok_reads = 0
+        for i in range(24):
+            try:
+                got = ds.sql("SELECT count(*) FROM tx").rows()[0][0]
+                # correctness under chaos: a SUCCESSFUL read is EXACT
+                assert got == expected, (i, got, expected)
+                ok_reads += 1
+            except Exception:
+                pass   # availability may suffer; correctness may not
+            if rng.random() < 0.5:
+                try:
+                    insert_batch(rng.randint(1, 8))
+                except Exception:
+                    pass   # un-acked: excluded from `expected` by design
+        assert ok_reads >= 3, "storm starved every read — schedule too hot"
+
+        # ---- phase B: at-most-once mutation (response lost AFTER the
+        # server applied — the blind-retry trap) ----------------------
+        fault.disarm("flight.rpc")   # deterministic one-shot only
+        fault.arm("flight.rpc", "drop", phase="after", count=1)
+        with pytest.raises((ConnectionError, Exception)) as ei:
+            ds.servers[1].execute("INSERT INTO mut VALUES (7)")
+        assert isinstance(ei.value, ConnectionError)
+        fault.disarm("flight.rpc")
+        time.sleep(0.05)
+        got = ds.sql("SELECT count(*) FROM mut").rows()[0][0]
+        assert got == 1, f"mutation applied {got} times (must be exactly 1)"
+
+        # ---- phase C: injected server-side WAL tear mid-load →
+        # failover; redundancy keeps the acked rows complete -----------
+        fault.arm("wal.append", "torn_write", param=11, count=1)
+        insert_batch(120)   # survives the member dying mid-load
+        fault.clear()
+        got = ds.sql("SELECT count(*) FROM tx").rows()[0][0]
+        assert got == expected, (got, expected)
+        # app-level faults during the failover's redundancy restoration
+        # may have degraded buckets HONESTLY (counted, never phantom) —
+        # heal them so the next death cannot lose data
+        healed = ds.restore_redundancy()
+        assert healed["degraded_buckets"] == 0, healed
+
+        # ---- phase D: hard member kill → replicas converge ----------
+        victim = next(i for i in range(3) if ds.alive[i])
+        servers[victim].stop()
+        got = ds.sql("SELECT count(*) FROM tx").rows()[0][0]
+        assert got == expected, \
+            f"replicas did not converge after failover: {got} != {expected}"
+        # bounded retries with backoff actually happened
+        snap = global_registry().snapshot()
+        assert snap["counters"].get("failover_member_failed", 0) >= 1
+        assert snap["counters"].get("failover_retries", 0) >= 1 or \
+            snap["timers"].get("failover_backoff", {}).get("count", 0) >= 1
+
+        # heartbeat faults fired and were survived + counted
+        assert global_registry().counter(
+            "member_heartbeat_failures") > hb_before
+
+        # ---- the acceptance bar: ≥ 50 faults across WAL, RPC and
+        # heartbeat failpoints on this 3-node cluster ------------------
+        snap = global_registry().snapshot()["counters"]
+        injected = snap.get("fault_injected", 0) - injected_before
+        assert injected >= 50, f"only {injected} faults injected"
+        for point in ("fault_injected_wal_append",
+                      "fault_injected_flight_rpc",
+                      "fault_injected_locator_heartbeat"):
+            assert snap.get(point, 0) >= 1, f"{point} never fired"
+    finally:
+        fault.clear()
+        ds.close()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        locator.stop()
+
+
+# -----------------------------------------------------------------------
+# long randomized battery (slow tier)
+# -----------------------------------------------------------------------
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_chaos_durability_long(tmp_path, seed):
+    _run_durability_schedule(tmp_path, seed=seed, n_ops=250)

@@ -1,0 +1,481 @@
+"""Failpoint fault-injection framework: registry semantics (actions,
+arming modes, seeded determinism), the compact spec grammar, the REST
+control surface, and the per-layer hook sites + hardening satellites
+(heartbeat logging/metrics, locator read timeout, _fan error context,
+backoff/circuit-breaker units)."""
+
+import json
+import socket
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import fault
+from snappydata_tpu.cluster.retry import CircuitBreaker, ExponentialBackoff
+from snappydata_tpu.fault.failpoints import (FailpointRegistry,
+                                             FaultConnectionDropped,
+                                             FaultError)
+from snappydata_tpu.observability.metrics import global_registry
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+# -----------------------------------------------------------------------
+# registry semantics
+# -----------------------------------------------------------------------
+
+def test_unarmed_hit_is_noop():
+    assert fault.hit("nothing.armed") is None
+
+
+def test_raise_action_families():
+    fault.arm("p.io", "raise", exc="io")
+    with pytest.raises(IOError):
+        fault.hit("p.io")
+    fault.arm("p.conn", "raise", exc="conn")
+    with pytest.raises(ConnectionError):
+        fault.hit("p.conn")
+    fault.arm("p.rt", "raise", exc="runtime")
+    with pytest.raises(RuntimeError):
+        fault.hit("p.rt")
+    fault.arm("p.to", "raise", exc="timeout")
+    with pytest.raises(TimeoutError):
+        fault.hit("p.to")
+
+
+def test_drop_action_is_connection_error():
+    fault.arm("p.d", "drop")
+    with pytest.raises(FaultConnectionDropped):
+        fault.hit("p.d")
+
+
+def test_one_shot_count():
+    fault.arm("p.c", "raise", count=2)
+    for _ in range(2):
+        with pytest.raises(FaultError):
+            fault.hit("p.c")
+    assert fault.hit("p.c") is None   # spent
+    assert fault.hit("p.c") is None
+
+
+def test_every_n():
+    fault.arm("p.e", "raise", every=3)
+    fired = 0
+    for _ in range(9):
+        try:
+            fault.hit("p.e")
+        except FaultError:
+            fired += 1
+    assert fired == 3   # hits 3, 6, 9
+
+
+def test_probabilistic_is_seeded_and_deterministic():
+    def run(seed):
+        reg = FailpointRegistry(seed=seed)
+        reg.arm("p.p", "raise", p=0.5)
+        out = []
+        for _ in range(50):
+            try:
+                reg.hit("p.p")
+                out.append(0)
+            except FaultError:
+                out.append(1)
+        return out
+
+    a, b = run(42), run(42)
+    assert a == b                     # same seed → same schedule
+    assert 5 < sum(a) < 45            # actually probabilistic
+    assert run(43) != a               # different seed → different schedule
+
+
+def test_latency_action_sleeps_and_continues():
+    fault.arm("p.l", "latency", param=0.05, count=1)
+    t0 = time.monotonic()
+    assert fault.hit("p.l") is None
+    assert time.monotonic() - t0 >= 0.045
+
+
+def test_torn_write_returns_spec_to_site():
+    fault.arm("p.t", "torn_write", param=7)
+    spec = fault.hit("p.t")
+    assert spec is not None and spec.action == "torn_write"
+    assert spec.param == 7
+
+
+def test_phase_after():
+    fault.arm("p.a", "raise", phase="after")
+    assert fault.hit("p.a") is None           # before-phase: not eligible
+    with pytest.raises(FaultError):
+        fault.hit("p.a", phase="after")
+
+
+def test_fired_faults_bump_metrics():
+    before = global_registry().counter("fault_injected")
+    fault.arm("metric.point", "raise", count=3)
+    for _ in range(3):
+        with pytest.raises(FaultError):
+            fault.hit("metric.point")
+    assert global_registry().counter("fault_injected") == before + 3
+    assert global_registry().counter("fault_injected_metric_point") >= 3
+
+
+def test_compact_spec_grammar():
+    specs = fault.registry().arm_from_spec(
+        "wal.append=torn_write:7@1;"
+        "flight.rpc=latency:0.01@p0.25;"
+        "locator.heartbeat=raise@e3!conn;"
+        "flight.rpc=drop@2#after")
+    by = {}
+    for s in specs:
+        by.setdefault(s.name, []).append(s)
+    tw = by["wal.append"][0]
+    assert (tw.action, tw.param, tw.count) == ("torn_write", 7.0, 1)
+    lat = by["flight.rpc"][0]
+    assert (lat.action, lat.p) == ("latency", 0.25)
+    hb = by["locator.heartbeat"][0]
+    assert (hb.action, hb.every, hb.exc) == ("raise", 3, "conn")
+    drop = by["flight.rpc"][1]
+    assert (drop.action, drop.count, drop.phase) == ("drop", 2, "after")
+
+
+def test_json_spec():
+    specs = fault.registry().arm_from_spec(
+        '[{"name": "a.b", "action": "raise", "count": 1}]')
+    assert specs[0].name == "a.b" and specs[0].count == 1
+
+
+def test_bad_action_rejected():
+    with pytest.raises(ValueError):
+        fault.arm("x", "explode")
+    with pytest.raises(ValueError):
+        fault.arm("x", "raise", exc="nope")
+
+
+def test_disarm_and_list():
+    fault.arm("a.b", "raise")
+    fault.arm("c.d", "latency", param=0.1)
+    names = {d["name"] for d in fault.registry().list()}
+    assert names == {"a.b", "c.d"}
+    assert fault.disarm("a.b") is True
+    assert fault.disarm("a.b") is False
+    assert {d["name"] for d in fault.registry().list()} == {"c.d"}
+
+
+# -----------------------------------------------------------------------
+# backoff + circuit breaker units
+# -----------------------------------------------------------------------
+
+def test_backoff_growth_and_cap():
+    b = ExponentialBackoff(base_s=0.1, max_s=0.5, multiplier=2.0,
+                           jitter=0.0)
+    assert b.delay(0) == pytest.approx(0.1)
+    assert b.delay(1) == pytest.approx(0.2)
+    assert b.delay(2) == pytest.approx(0.4)
+    assert b.delay(3) == pytest.approx(0.5)   # capped
+    assert b.delay(10) == pytest.approx(0.5)
+
+
+def test_backoff_jitter_bounded_and_seeded():
+    import random
+
+    b1 = ExponentialBackoff(0.1, 1.0, jitter=0.5, rng=random.Random(7))
+    b2 = ExponentialBackoff(0.1, 1.0, jitter=0.5, rng=random.Random(7))
+    d1 = [b1.delay(2) for _ in range(10)]
+    d2 = [b2.delay(2) for _ in range(10)]
+    assert d1 == d2                       # seeded → reproducible
+    assert all(0.2 <= d <= 0.4 for d in d1)   # within [d*(1-j), d]
+    assert len(set(d1)) > 1               # actually jittered
+
+
+def test_circuit_breaker_stale_half_open_probe_recovers():
+    """A half-open probe whose caller never records an outcome (an
+    exception path that re-raises) must not wedge the breaker shut —
+    after the reset timeout a fresh probe slot opens."""
+    clock = [0.0]
+    cb = CircuitBreaker(failure_threshold=1, reset_timeout_s=5.0,
+                        clock=lambda: clock[0])
+    cb.record_failure()                  # open
+    clock[0] = 5.1
+    assert cb.allow()                    # half-open probe granted
+    # ... probe abandoned: no success/failure recorded
+    assert not cb.allow()
+    clock[0] = 10.3
+    assert cb.allow()                    # stale probe aged out: retry
+    cb.record_success()
+    assert cb.state == CircuitBreaker.CLOSED
+
+
+def test_circuit_breaker_lifecycle():
+    clock = [0.0]
+    cb = CircuitBreaker(failure_threshold=2, reset_timeout_s=5.0,
+                        clock=lambda: clock[0])
+    assert cb.allow()
+    cb.record_failure()
+    assert cb.allow()                     # below threshold: still closed
+    cb.record_failure()
+    assert cb.state == CircuitBreaker.OPEN
+    assert not cb.allow()                 # open: peers skipped
+    clock[0] = 5.1
+    assert cb.allow()                     # half-open: one probe slot
+    assert not cb.allow()                 # ... and only one
+    cb.record_failure()                   # probe failed → re-open
+    assert cb.state == CircuitBreaker.OPEN
+    clock[0] = 10.3
+    assert cb.allow()
+    cb.record_success()                   # probe succeeded → closed
+    assert cb.state == CircuitBreaker.CLOSED
+    assert cb.allow() and cb.allow()
+
+
+# -----------------------------------------------------------------------
+# hook sites
+# -----------------------------------------------------------------------
+
+def test_checkpoint_write_fault_keeps_previous_checkpoint(tmp_path):
+    from snappydata_tpu import SnappySession
+    from snappydata_tpu.catalog import Catalog
+
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE t (k BIGINT) USING column")
+    s.sql("INSERT INTO t VALUES (1), (2)")
+    s.checkpoint()
+    s.sql("INSERT INTO t VALUES (3)")
+    fault.arm("checkpoint.write", "torn_write", param=5, count=1)
+    with pytest.raises(IOError):
+        s.checkpoint()
+    fault.clear()
+    s.disk_store.close()
+    s2 = SnappySession(data_dir=str(tmp_path), recover=True)
+    # the aborted checkpoint never became visible; the WAL still covers
+    # everything → no acked row lost, none double-applied
+    assert s2.sql("SELECT k FROM t ORDER BY k").rows() == [(1,), (2,), (3,)]
+    s2.disk_store.close()
+
+
+def test_wal_append_raise_fault_never_applies_mutation(tmp_path):
+    from snappydata_tpu import SnappySession
+    from snappydata_tpu.catalog import Catalog
+
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE t (k BIGINT) USING column")
+    s.sql("INSERT INTO t VALUES (1)")
+    fault.arm("wal.append", "raise", count=1)
+    with pytest.raises(IOError):
+        s.sql("INSERT INTO t VALUES (2)")
+    # journal-before-apply: the failed journal means the row is neither
+    # in memory now nor on disk after recovery
+    assert s.sql("SELECT count(*) FROM t").rows()[0][0] == 1
+    s.disk_store.close()
+    s2 = SnappySession(data_dir=str(tmp_path), recover=True)
+    assert s2.sql("SELECT count(*) FROM t").rows()[0][0] == 1
+    s2.disk_store.close()
+
+
+def test_kafka_fetch_fault_replays_same_batch(session):
+    from snappydata_tpu.streaming.kafka import InProcessBroker, KafkaSource
+
+    broker = InProcessBroker(num_partitions=2)
+    broker.produce("topic", [{"k": i, "v": i * 1.0} for i in range(10)],
+                   key_field="k")
+    src = KafkaSource(session, "q1", broker, "topic", ["k", "v"])
+    fault.arm("kafka.fetch", "raise", count=1)
+    with pytest.raises(IOError):
+        src.next_batch(0)
+    # the injected outage did not consume anything: the SAME batch
+    # replays fully (offset log intact → exactly-once contract)
+    cols, nxt = src.next_batch(0)
+    assert len(cols["k"]) == 10 and nxt == 1
+
+
+def test_device_transfer_fault_surfaces(session):
+    session.sql("CREATE TABLE dt (k BIGINT, v DOUBLE) USING column")
+    session.sql("INSERT INTO dt VALUES (1, 1.0), (2, 2.0)")
+    fault.arm("device.transfer", "raise", exc="runtime", count=1)
+    with pytest.raises(Exception):
+        session.sql("SELECT sum(v) FROM dt")
+    fault.clear()
+    assert session.sql("SELECT sum(v) FROM dt").rows()[0][0] == \
+        pytest.approx(3.0)
+
+
+# -----------------------------------------------------------------------
+# locator heartbeat satellite: logging + metric + read timeout
+# -----------------------------------------------------------------------
+
+def test_heartbeat_failures_counted_and_survived():
+    from snappydata_tpu.cluster.locator import Locator, LocatorClient
+
+    loc = Locator(port=0).start()
+    try:
+        lc = LocatorClient(loc.address, "m1", "server")
+        lc.register()
+        before = global_registry().counter("member_heartbeat_failures")
+        fault.arm("locator.heartbeat", "raise", exc="conn", count=3)
+        lc.start_heartbeats(interval_s=0.02)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and \
+                global_registry().counter(
+                    "member_heartbeat_failures") < before + 3:
+            time.sleep(0.02)
+        assert global_registry().counter(
+            "member_heartbeat_failures") >= before + 3
+        # the loop survived the failures: member still registered after
+        # the faults are exhausted (it re-registers + keeps beating)
+        time.sleep(0.1)
+        assert any(m.member_id == "m1" for m in lc.members())
+        lc.close()
+    finally:
+        loc.stop()
+
+
+def test_locator_garbled_response_is_connection_error():
+    """A locator dying mid-response-write leaves a partial JSON line:
+    that must surface as ConnectionError (the heartbeat loop's
+    re-register path), never a ValueError that kills the thread."""
+    from snappydata_tpu.cluster.locator import LocatorClient
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    host, port = srv.getsockname()
+
+    def answer_garbled():
+        conn, _ = srv.accept()
+        conn.recv(4096)
+        conn.sendall(b'{"ok": tr\n')   # truncated mid-token
+        conn.close()
+
+    t = threading.Thread(target=answer_garbled, daemon=True)
+    t.start()
+    try:
+        lc = LocatorClient(f"{host}:{port}", "m1", "server",
+                           request_timeout_s=2.0)
+        with pytest.raises(ConnectionError):
+            lc.members()
+        assert lc._sock is None     # stream dropped for a clean reconnect
+    finally:
+        srv.close()
+
+
+def test_locator_request_timeout_unwedges_heartbeat():
+    """A locator that accepts but never answers must not hang _request
+    (and with it the heartbeat thread + every waiter on _lock)."""
+    from snappydata_tpu.cluster.locator import LocatorClient
+
+    silent = socket.socket()
+    silent.bind(("127.0.0.1", 0))
+    silent.listen(1)
+    host, port = silent.getsockname()
+    try:
+        lc = LocatorClient(f"{host}:{port}", "m1", "server",
+                           request_timeout_s=0.2)
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            lc.members()
+        assert time.monotonic() - t0 < 2.0   # bounded, not wedged
+        # the lock is free again for the next caller
+        assert lc._lock.acquire(timeout=1.0)
+        lc._lock.release()
+    finally:
+        silent.close()
+
+
+# -----------------------------------------------------------------------
+# _fan failure context satellite
+# -----------------------------------------------------------------------
+
+def test_fan_error_carries_failed_addresses_and_attempts():
+    from snappydata_tpu.cluster.distributed import (DistributedError,
+                                                    DistributedSession)
+
+    ds = DistributedSession.__new__(DistributedSession)
+    ds.server_addresses = ["h1:1", "h2:2"]
+    ds.servers = [object(), object()]
+    ds.alive = [True, True]
+    ds.num_buckets = 4
+    ds.bucket_map = [0, 1, 0, 1]
+    ds.replica_map = [None] * 4
+    ds._backoff = ExponentialBackoff(0.001, 0.002, jitter=0.0)
+    ds.breakers = [CircuitBreaker(1, 99.0) for _ in range(2)]
+
+    class _Planner:
+        class catalog:
+            @staticmethod
+            def list_tables():
+                return []
+    ds.planner = _Planner()
+
+    def boom(_srv):
+        raise ConnectionError("down")
+
+    ds._probe = lambda i: False    # every failure is a member death
+    with pytest.raises(DistributedError) as ei:
+        ds._fan(boom, retries=1)
+    err = ei.value
+    assert err.failed_addresses          # names the members that died
+    assert err.attempts >= 1
+    assert "h1:1" in str(err) or "h2:2" in str(err)
+
+
+# -----------------------------------------------------------------------
+# REST control surface
+# -----------------------------------------------------------------------
+
+def _req(url, data=None):
+    req = urllib.request.Request(
+        url, data=json.dumps(data).encode() if data is not None else None,
+        headers={"Content-Type": "application/json"} if data else {})
+    with urllib.request.urlopen(req) as resp:
+        return json.loads(resp.read().decode())
+
+
+def test_rest_faults_roundtrip(session):
+    from snappydata_tpu.cluster.rest import RestService
+    from snappydata_tpu.observability.stats_service import TableStatsService
+
+    svc = RestService(session, TableStatsService(session.catalog),
+                      host="127.0.0.1", port=0).start()
+    base = f"http://{svc.host}:{svc.port}"
+    try:
+        out = _req(f"{base}/faults")
+        assert out["faults"] == []
+        _req(f"{base}/faults", {"name": "flight.rpc", "action": "latency",
+                                "param": 0.01, "p": 0.5})
+        out = _req(f"{base}/faults")
+        assert out["faults"][0]["name"] == "flight.rpc"
+        assert out["faults"][0]["p"] == 0.5
+        # compact-grammar arm + reseed + disarm + clear
+        _req(f"{base}/faults", {"spec": "wal.append=raise@1"})
+        assert {f["name"] for f in _req(f"{base}/faults")["faults"]} == \
+            {"flight.rpc", "wal.append"}
+        _req(f"{base}/faults", {"seed": 1234})
+        _req(f"{base}/faults", {"name": "wal.append", "disarm": True})
+        assert {f["name"] for f in _req(f"{base}/faults")["faults"]} == \
+            {"flight.rpc"}
+        _req(f"{base}/faults", {"clear": True})
+        assert _req(f"{base}/faults")["faults"] == []
+        # malformed spec answers 400, not a dropped connection
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            _req(f"{base}/faults", {"name": "x", "action": "explode"})
+        assert ei.value.code == 400
+        # JSON-string numerics are coerced, not stored raw (a str count
+        # used to TypeError inside the production hit() path)
+        _req(f"{base}/faults", {"name": "rest.coerce", "action": "raise",
+                                "count": "2", "p": "1.0"})
+        for _ in range(2):
+            with pytest.raises(IOError):
+                fault.hit("rest.coerce")
+        assert fault.hit("rest.coerce") is None   # count=2 spent
+        _req(f"{base}/faults", {"clear": True})
+    finally:
+        svc.stop()
