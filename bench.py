@@ -163,10 +163,11 @@ def main() -> None:
                 setattr(config.global_properties(), flag, False)
                 s.executor.clear_cache()
 
-    ingest_rows_per_s = sink_events_per_s = None
+    ingest_rows_per_s = sink_events_per_s = durable_ingest = None
     try:   # secondary benches must not kill the headline numbers
         ingest_rows_per_s = _ingest_bench()
         sink_events_per_s = _sink_bench()
+        durable_ingest = _durable_ingest_bench()
     except Exception as e:
         print(f"bench: ingest/sink bench failed: {e}",
               file=sys.stderr, flush=True)
@@ -186,6 +187,10 @@ def main() -> None:
             "sf": sf,
             "rows": n_rows,
             "load_s": round(load_s, 2),
+            # ingest throughput tracked alongside Q1/Q6 (the r04→r05
+            # per-append-fsync regression was only visible by diffing
+            # load_s by hand)
+            "load_rows_per_s": round(n_rows / load_s, 1),
             "q1_s": round(timings["q1"], 4),
             "q6_s": round(timings["q6"], 4),
             "q1_rows_per_s": round(rows_per_s["q1"], 1),
@@ -203,6 +208,10 @@ def main() -> None:
             "q1_pallas_s": pallas["q1_pallas_s"],
             "ingest_rows_per_s": ingest_rows_per_s,
             "sink_events_per_s": sink_events_per_s,
+            # durable (WAL'd) ingest per wal_fsync_mode, with the fsync
+            # count each mode paid — the group-commit write path's
+            # evidence record
+            "durable_ingest": durable_ingest,
             # in-trace decode counters: bytes actually shipped over the
             # host->device link for RLE/bitset binds vs the decoded
             # plate bytes they replaced (round-4 device_decode feature,
@@ -323,6 +332,74 @@ def _ingest_bench(n: int = 2_000_000) -> float:
     dt = time.time() - t0
     s.stop()
     return round(n / dt, 1)
+
+
+def _durable_ingest_bench(n_stmts: int = 64,
+                          rows_per_stmt: int = 20_000) -> dict:
+    """Durable ingest rows/s + WAL fsync count per wal_fsync_mode —
+    `group` (default) vs `always` (the pre-group-commit behavior). The
+    per-statement stream is the shape where grouping matters: `group`
+    coalesces concurrent commits and pipelines encode against the
+    fsync, `always` pays one fsync per record."""
+    import shutil
+    import tempfile
+    import threading
+
+    from snappydata_tpu import SnappySession, config
+    from snappydata_tpu.catalog import Catalog
+    from snappydata_tpu.observability.metrics import global_registry
+
+    out = {}
+    props = config.global_properties()
+    saved = props.get("wal_fsync_mode")
+    # warmup outside the timed region: the first durable session pays
+    # one-time import/encode costs that would bias whichever mode ran
+    # first
+    wd = tempfile.mkdtemp(prefix="snappy_bench_wal_warm_")
+    w = SnappySession(catalog=Catalog(), data_dir=wd, recover=False)
+    w.sql("CREATE TABLE w (k BIGINT, v DOUBLE) USING column")
+    for i in range(8):
+        w.insert_arrays("w", [np.arange(1000, dtype=np.int64),
+                              np.ones(1000)])
+    w.stop()
+    w.disk_store.close()
+    shutil.rmtree(wd, ignore_errors=True)
+    try:
+        for mode in ("group", "always"):
+            props.set("wal_fsync_mode", mode)
+            d = tempfile.mkdtemp(prefix=f"snappy_bench_wal_{mode}_")
+            s = SnappySession(catalog=Catalog(), data_dir=d,
+                              recover=False)
+            s.sql("CREATE TABLE w (k BIGINT, v DOUBLE) USING column")
+            fsync0 = global_registry().counter("wal_fsync_count")
+            chunks = [np.arange(i * rows_per_stmt, (i + 1) * rows_per_stmt,
+                                dtype=np.int64) for i in range(n_stmts)]
+            t0 = time.time()
+            # 4 concurrent committers: the group-commit coalescing shape
+            workers = []
+            for w in range(4):
+                def run(lo=w):
+                    for i in range(lo, n_stmts, 4):
+                        s.insert_arrays("w", [chunks[i],
+                                              chunks[i] * 0.5])
+                workers.append(threading.Thread(target=run))
+            for t in workers:
+                t.start()
+            for t in workers:
+                t.join()
+            dt = time.time() - t0
+            fsyncs = global_registry().counter("wal_fsync_count") - fsync0
+            out[mode] = {
+                "rows_per_s": round(n_stmts * rows_per_stmt / dt, 1),
+                "fsyncs": fsyncs,
+                "statements": n_stmts,
+            }
+            s.stop()
+            s.disk_store.close()
+            shutil.rmtree(d, ignore_errors=True)
+    finally:
+        props.set("wal_fsync_mode", saved)
+    return out
 
 
 def _sink_bench(n: int = 200_000) -> float:

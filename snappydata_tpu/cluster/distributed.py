@@ -346,6 +346,18 @@ class DistributedSession:
         getattr(self, "_shuf_cache", {}).clear()
         getattr(self, "_gather_cache", {}).clear()
 
+    def flush_wals(self) -> dict:
+        """Cluster-wide durability barrier: force every alive member to
+        drain + fsync its WAL commit buffer (Flight action `wal_sync`).
+        Under the default `wal_fsync_mode=group` every member ack is
+        already fsync-covered, so this is a fast no-op; under
+        `interval:<ms>` it closes the relaxed-ack window on demand (REST:
+        POST /wal/flush). Idempotent — safe to retry across failover."""
+        results = self._fan(lambda srv: srv._action("wal_sync", {}))
+        return {"flushed_members": len(results),
+                "durable_members": sum(1 for r in results
+                                       if r.get("durable"))}
+
     def rebalance(self) -> dict:
         """Even out bucket primaries across the ALIVE members — the
         SYS.REBALANCE_ALL_BUCKETS analogue (ref: docs/reference/

@@ -584,18 +584,25 @@ class SnappyFlightServer(flight.FlightServerBase):
         # session mutations: journal first so a concurrent checkpoint can't
         # fold un-journaled rows, and carry null masks so recovery doesn't
         # turn bulk-ingested NULLs into zeros).
+        # sync_force: the put RESPONSE is a durability ack the lead's
+        # fan-out (and its replica bookkeeping) relies on — the covering
+        # WAL fsync is forced even when this server runs
+        # wal_fsync_mode=interval. Relaxed acks are a local-session
+        # policy, never a network one. Scoped to THIS put's record so
+        # one client's ack never waits on other sessions' records.
         if isinstance(info.data, RowTableData):
             from snappydata_tpu.session import _restore_none_arrays
 
             raw = _restore_none_arrays(arrays, nulls)
             self.session._journal_then(
                 info, "insert", raw, None,
-                lambda: info.data.insert_arrays(raw))
+                lambda: info.data.insert_arrays(raw), sync_force=True)
         else:
             nmask = nulls if any(m is not None for m in nulls) else None
             self.session._journal_then(
                 info, "insert", arrays, nmask,
-                lambda: info.data.insert_arrays(arrays, nulls=nmask))
+                lambda: info.data.insert_arrays(arrays, nulls=nmask),
+                sync_force=True)
 
     # -- ops --------------------------------------------------------------
 
@@ -657,6 +664,16 @@ class SnappyFlightServer(flight.FlightServerBase):
                 raise flight.FlightServerError("checkpoint requires admin")
             self.session.checkpoint()
             yield flight.Result(b"{}")
+        elif name == "wal_sync":
+            # cluster-wide durability barrier (DistributedSession
+            # .flush_wals / REST POST /wal/flush): drain+fsync this
+            # member's commit buffer past any relaxed interval-mode ack
+            self._session_for(body)   # credential gate when auth on
+            ds = self.session.disk_store
+            if ds is not None:
+                ds.wal_sync(force=True)
+            yield flight.Result(json.dumps(
+                {"durable": ds is not None}).encode("utf-8"))
         elif name == "catalog":
             # thin-client catalog protocol (ref: StoreHiveCatalog serving
             # getCatalogMetadata to connectors; SmartConnectorExternalCatalog
@@ -859,17 +876,22 @@ class SnappyFlightServer(flight.FlightServerBase):
         nulls = [np.asarray(nm)[mask] if nm is not None else None
                  for nm in result.nulls]
         nmask = nulls if any(m is not None for m in nulls) else None
+        # sync_force: the promotion is a network-level ack to the lead's
+        # failover bookkeeping AND the shadow rows are deleted right
+        # below — the covering fsync must land BEFORE the only other
+        # copy goes away, even under wal_fsync_mode=interval
         if isinstance(info.data, RowTableData):
             from snappydata_tpu.session import _restore_none_arrays
 
             raw = _restore_none_arrays(arrays, nulls)
             self.session._journal_then(
                 info, "insert", raw, None,
-                lambda: info.data.insert_arrays(raw))
+                lambda: info.data.insert_arrays(raw), sync_force=True)
         else:
             self.session._journal_then(
                 info, "insert", arrays, nmask,
-                lambda: info.data.insert_arrays(arrays, nulls=nmask))
+                lambda: info.data.insert_arrays(arrays, nulls=nmask),
+                sync_force=True)
         # remove promoted rows from the shadow so a LATER promotion of
         # other buckets can't double-promote these
         from snappydata_tpu.parallel.hashing import bucket_of_np

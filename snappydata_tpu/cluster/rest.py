@@ -100,6 +100,16 @@ def _render_dashboard(svc) -> str:
     counters = "".join(
         f"<tr><td>{esc(str(k))}</td><td>{v}</td></tr>"
         for k, v in sorted(snap["counters"].items()))
+    from snappydata_tpu.observability.stats_service import \
+        durability_snapshot
+
+    wal = durability_snapshot()
+    rows_w = "".join(
+        f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
+        for k, v in wal.items() if not isinstance(v, dict)) + (
+        f"<tr><td>wal_group_flush_ms (mean/max)</td>"
+        f"<td>{wal['wal_group_flush_ms']['mean_ms']} / "
+        f"{wal['wal_group_flush_ms']['max_ms']}</td></tr>")
     recent = list(reversed(svc.session.recent_queries()))[:25]
     rows_q = "".join(
         f"<tr><td>{esc(str(q['sql']))[:120]}</td><td>{q['ms']}</td>"
@@ -126,6 +136,7 @@ text-align:left}}h2{{margin-top:1.5em}}</style></head><body>
 <h2>Streaming queries ({len(streams)})</h2>
 <table><tr><th>query</th><th>table</th><th>active</th><th>batches</th>
 <th>rows</th><th>rows/s</th><th>last error</th></tr>{rows_s}</table>
+<h2>Durability (WAL group commit)</h2><table>{rows_w}</table>
 <h2>Counters</h2><table>{counters}</table>
 <h2>Recent queries ({len(recent)})</h2>
 <table><tr><th>sql</th><th>ms</th><th>rows</th><th>user</th></tr>{rows_q}
@@ -189,6 +200,14 @@ class RestService:
                                 "tables": svc.stats_service.current()})
                 elif path == "/status/api/v1/tables":
                     self._send(svc.stats_service.current())
+                elif path == "/status/api/v1/wal":
+                    # group-commit write-path stats: fsync mode/knobs +
+                    # wal_fsync_count / wal_group_commit_batches /
+                    # wal_bytes_written / flush timings
+                    from snappydata_tpu.observability.stats_service import \
+                        durability_snapshot
+
+                    self._send(durability_snapshot())
                 elif path == "/status/api/v1/streaming":
                     # streaming query progress (ref: the structured-
                     # streaming UI tab / StreamingQueryManager.active);
@@ -401,6 +420,24 @@ class RestService:
                         self._send({"error": f"bad fault spec: {e}"}, 400)
                         return
                     self._send({"faults": reg.list()})
+                elif path == "/wal/flush":
+                    # durability barrier: drain+fsync the WAL commit
+                    # buffer past any relaxed interval-mode ack — on the
+                    # whole cluster when this lead has one, else locally
+                    if self._admin_session("operator action") is None:
+                        return
+                    try:
+                        if svc.distributed is not None:
+                            self._send(svc.distributed.flush_wals())
+                        elif svc.session.disk_store is not None:
+                            svc.session.disk_store.wal_sync(force=True)
+                            self._send({"flushed_members": 1,
+                                        "durable_members": 1})
+                        else:
+                            self._send({"flushed_members": 0,
+                                        "durable_members": 0})
+                    except Exception as e:
+                        self._send({"error": str(e)}, 500)
                 elif path in ("/rebalance", "/redundancy/restore"):
                     # SYS.REBALANCE_ALL_BUCKETS analogue + redundancy
                     # re-restoration (operator actions; admin only when

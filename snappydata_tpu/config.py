@@ -40,6 +40,24 @@ class Properties:
     # zstd level 1 is the env's LZ4-class codec
     compression_codec: str = "zstd"           # "zstd" | "zlib" | "none"
 
+    # WAL group commit (storage/persistence.py; ref: the oplog store
+    # groups disk writes instead of syncing per record). Modes:
+    #   always        fsync every append (one fsync per record)
+    #   group         appends buffer; the ACK waits for the covering
+    #                 group fsync (default — per-statement durability at
+    #                 per-group fsync cost)
+    #   interval:<ms> acks return before the fsync; the flusher syncs
+    #                 every <ms> (relaxed: a crash may lose the last
+    #                 <ms> of locally-acked writes)
+    wal_fsync_mode: str = "group"
+    # commit-buffer bound: a group drains (backpressure) once its framed
+    # records exceed this many bytes
+    wal_buffer_bytes: int = 8 << 20
+    # how long the background flusher lets a group accumulate before it
+    # drains un-acked tails (also the default interval for interval mode
+    # when no :<ms> suffix is given)
+    wal_group_ms: float = 3.0
+
     # Host memory budget for resident column batches; above it the
     # coldest batches spill to disk as memmaps (transparently reloaded
     # through the OS page cache). 0 = unlimited. Ref:

@@ -27,8 +27,11 @@ arming modes (combinable with `phase`: before | after the guarded op)
               chaos schedule replays byte-for-byte
 
 Wired fault points (grep `failpoints.hit` for the live list):
-  wal.append, checkpoint.write, flight.rpc (client side), flight.serve
-  (server side), locator.heartbeat, kafka.fetch, device.transfer
+  wal.append (per RECORD, at append time), wal.group_commit (per GROUP,
+  at the batched write+fsync drain — torn_write tears the group's tail,
+  the mid-group crash shape), checkpoint.write, flight.rpc (client
+  side), flight.serve (server side), locator.heartbeat, kafka.fetch,
+  device.transfer
 
 Every fired fault bumps `fault_injected` and `fault_injected_<name>` in
 the global metrics registry, so a chaos harness can assert its schedule
@@ -68,8 +71,8 @@ ACTIONS = ("raise", "latency", "torn_write", "drop")
 # canonical points wired into the engine — arming other names is allowed
 # (new hook sites don't need a registry edit), these are documentation
 KNOWN_POINTS = (
-    "wal.append", "checkpoint.write", "flight.rpc", "flight.serve",
-    "locator.heartbeat", "kafka.fetch", "device.transfer",
+    "wal.append", "wal.group_commit", "checkpoint.write", "flight.rpc",
+    "flight.serve", "locator.heartbeat", "kafka.fetch", "device.transfer",
 )
 
 

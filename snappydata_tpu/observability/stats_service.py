@@ -18,6 +18,40 @@ from snappydata_tpu.observability.metrics import global_registry
 from snappydata_tpu.storage.table_store import RowTableData
 
 
+def durability_snapshot() -> dict:
+    """WAL group-commit stats: live policy knobs + the write-path
+    counters (wal_fsync_count, wal_group_commit_batches,
+    wal_bytes_written, wal_group_flush timings) for REST
+    `/status/api/v1/wal` and the dashboard's Durability section.
+    records_per_fsync is the amortization the group commit buys — 1.0
+    means always-mode behavior, higher means grouped."""
+    from snappydata_tpu import config
+
+    snap = global_registry().snapshot()
+    c = snap["counters"]
+    t = snap["timers"].get("wal_group_flush", {})
+    props = config.global_properties()
+    fsyncs = c.get("wal_fsync_count", 0)
+    records = c.get("wal_records_written", 0)
+    return {
+        "wal_fsync_mode": props.get("wal_fsync_mode"),
+        "wal_buffer_bytes": props.get("wal_buffer_bytes"),
+        "wal_group_ms": props.get("wal_group_ms"),
+        "wal_fsync_count": fsyncs,
+        "wal_group_commit_batches": c.get("wal_group_commit_batches", 0),
+        "wal_records_written": records,
+        "wal_bytes_written": c.get("wal_bytes_written", 0),
+        "wal_records_per_fsync":
+            round(records / fsyncs, 2) if fsyncs else None,
+        "wal_group_flush_ms": {
+            "count": t.get("count", 0),
+            "mean_ms": round(t.get("mean_s", 0.0) * 1e3, 3),
+            "max_ms": round(t.get("max_s", 0.0) * 1e3, 3),
+        },
+        "wal_corrupt_records": c.get("wal_corrupt_records", 0),
+    }
+
+
 class TableStatsService:
     def __init__(self, catalog, interval_s: Optional[float] = None,
                  registry=None):

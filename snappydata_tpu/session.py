@@ -243,9 +243,15 @@ class SnappySession:
             from snappydata_tpu.catalog.catalog import _norm
 
             with ds.mutation_lock:
-                ds.wal_append(_norm(table), "sql", sql=sql_text,
-                              params=tuple(params))
-                return self.execute_statement(stmt, tuple(params))
+                seq = ds.wal_append(_norm(table), "sql", sql=sql_text,
+                                    params=tuple(params))
+                result = self.execute_statement(stmt, tuple(params))
+            # ack gate (group commit): the record may still sit in the
+            # commit buffer — wal_sync blocks until the covering fsync,
+            # OUTSIDE the mutation lock so concurrent committers coalesce
+            # into one group fsync instead of serializing on it
+            ds.wal_sync(seq)
+            return result
         result = self.execute_statement(stmt, tuple(params))
         if ds is not None:
             from snappydata_tpu.catalog.catalog import _norm
@@ -1091,14 +1097,28 @@ class SnappySession:
         resolved, _ = self.analyzer.analyze_plan(plan)
         return _output_schema(resolved)
 
-    def _journal_then(self, info, kind: str, arrays, nulls, apply_fn):
-        """WAL-then-apply under the mutation lock (no-op without a store)."""
-        if self.disk_store is None:
+    def _journal_then(self, info, kind: str, arrays, nulls, apply_fn,
+                      sync_force: bool = False):
+        """WAL-then-apply under the mutation lock, then ack after the
+        covering group fsync (no-op without a store). The journal append
+        only BUFFERS the framed record; while apply_fn encodes/cuts
+        batches the background flusher can already be fsyncing the group
+        — encode CPU work overlaps disk latency — and wal_sync releases
+        the ack once the fsync covers this record's seq. `sync_force`
+        makes the ack wait for the fsync even under
+        wal_fsync_mode=interval — network surfaces (Flight do_put,
+        replica promotion) set it, scoped to exactly THIS record's seq
+        so one put never waits on (or fails for) other sessions'
+        records."""
+        ds = self.disk_store
+        if ds is None:
             return apply_fn()
-        with self.disk_store.mutation_lock:
-            self.disk_store.wal_append(info.name, kind, arrays=arrays,
-                                       nulls=nulls)
-            return apply_fn()
+        with ds.mutation_lock:
+            seq = ds.wal_append(info.name, kind, arrays=arrays,
+                                nulls=nulls)
+            out = apply_fn()
+        ds.wal_sync(seq, force=sync_force)
+        return out
 
     def insert(self, table: str, *rows) -> int:
         self._require(table, "insert")
@@ -1164,10 +1184,12 @@ class SnappySession:
         if self.disk_store is None:
             return apply()
         with self.disk_store.mutation_lock:
-            self.disk_store.wal_append(
+            seq = self.disk_store.wal_append(
                 info.name, "delete_keys", arrays=key_arrays,
                 extra={"key_columns": list(key_columns)})
-            return apply()
+            out = apply()
+        self.disk_store.wal_sync(seq)   # ack after the covering fsync
+        return out
 
     def update(self, table: str, where_sql: str, new_values: Dict[str, Any]
                ) -> int:

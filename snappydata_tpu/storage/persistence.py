@@ -31,6 +31,7 @@ import logging
 import os
 import struct
 import threading
+import time
 import zlib
 from typing import Dict, List, Optional, Tuple
 
@@ -134,7 +135,13 @@ def _arr_from_parts(meta: dict, parts: List[bytes]) -> Optional[np.ndarray]:
         .reshape(meta["shape"]).copy()
 
 
-def write_record(fh, header: dict, arrays: List[Optional[np.ndarray]]) -> None:
+def frame_record(header: dict, arrays: List[Optional[np.ndarray]]) -> bytes:
+    """Assemble one record as a single contiguous buffer: magic, head
+    length, JSON head, parts, trailing CRC32. The CRC is computed in ONE
+    pass over the assembled head+parts region (no per-part incremental
+    loop) and callers issue ONE write for the whole record — the group
+    commit drain concatenates these frames and syncs them with one
+    write+fsync per group."""
     from snappydata_tpu import config
     from snappydata_tpu.storage.encoding import compress_bytes
 
@@ -162,19 +169,23 @@ def write_record(fh, header: dict, arrays: List[Optional[np.ndarray]]) -> None:
     if any(c != "none" for c in codecs):
         head_obj["codecs"] = codecs
     head = json.dumps(head_obj).encode("utf-8")
+    buf = bytearray()
+    buf += _MAGIC2
+    buf += struct.pack("<I", len(head))
+    buf += head
+    for p in parts:
+        buf += p
     # CRC32 over head + stored (possibly compressed) parts, trailing the
     # record: verify-on-read catches bit rot that is the right LENGTH (a
     # torn tail is caught by short reads; a flipped byte was not, and
     # used to replay silently — the whole point of the checksum)
-    crc = zlib.crc32(head)
-    for p in parts:
-        crc = zlib.crc32(p, crc)
-    fh.write(_MAGIC2)
-    fh.write(struct.pack("<I", len(head)))
-    fh.write(head)
-    for p in parts:
-        fh.write(p)
-    fh.write(struct.pack("<I", crc & 0xFFFFFFFF))
+    crc = zlib.crc32(memoryview(buf)[8:])
+    buf += struct.pack("<I", crc & 0xFFFFFFFF)
+    return bytes(buf)
+
+
+def write_record(fh, header: dict, arrays: List[Optional[np.ndarray]]) -> None:
+    fh.write(frame_record(header, arrays))
 
 
 def read_records(fh):
@@ -207,25 +218,24 @@ def read_records(fh):
                 # does not parse: damage, not a tear
                 raise CorruptRecordError("corrupt record (garbled header)")
             return  # legacy torn/garbled tail record (crash mid-write)
-        raw_parts = []
-        ok = True
-        for size in sizes:
-            p = fh.read(size)
-            if len(p) < size:  # torn tail write (crash mid-record)
-                ok = False
-                break
-            raw_parts.append(p)
-        if not ok:
-            return
+        # ONE read for all parts (+ the CRC when checksummed) and ONE
+        # CRC pass over the contiguous body — the read-side twin of the
+        # zero-copy frame assembly on the write side
+        total = sum(sizes)
+        body = fh.read(total + (4 if checksummed else 0))
+        if len(body) < total + (4 if checksummed else 0):
+            return  # torn tail write (crash mid-record / mid-group)
         if checksummed:
-            crc_bytes = fh.read(4)
-            if len(crc_bytes) < 4:
-                return  # torn tail: crc never made it to disk
-            crc = zlib.crc32(raw_head)
-            for p in raw_parts:
-                crc = zlib.crc32(p, crc)
-            if (crc & 0xFFFFFFFF) != struct.unpack("<I", crc_bytes)[0]:
+            crc = zlib.crc32(memoryview(body)[:total],
+                             zlib.crc32(raw_head))
+            if (crc & 0xFFFFFFFF) != \
+                    struct.unpack("<I", body[total:total + 4])[0]:
                 raise CorruptRecordError("corrupt record (CRC mismatch)")
+        raw_parts = []
+        pos0 = 0
+        for size in sizes:
+            raw_parts.append(body[pos0:pos0 + size])
+            pos0 += size
         parts = []
         codecs = head.get("codecs")
         for pi, p in enumerate(raw_parts):
@@ -420,6 +430,9 @@ class DiskStore:
         os.makedirs(os.path.join(path, "tables"), exist_ok=True)
         self._lock = threading.Lock()
         self.mutation_lock = threading.RLock()
+        # serializes WAL file writes/rotation; lock order is always
+        # _io_lock -> _lock, never the reverse
+        self._io_lock = threading.RLock()
         self._wal_fh: Optional[io.BufferedWriter] = None
         # boot-time repair: quarantine damaged/torn suffixes BEFORE the
         # first append — appending after a torn tail would strand the new
@@ -430,6 +443,40 @@ class DiskStore:
         # this flag lets replay/reopen skip redundant full-file rescans
         self._wal_clean = True
         self._wal_seq = self._scan_last_seq()
+        # --- group commit state (wal_fsync_mode group|interval) --------
+        # appends land here as (seq, framed bytes); a drain concatenates
+        # the group and issues ONE write+fsync. Acks go through wal_sync,
+        # which blocks until the covering fsync — the PR 2 no-acked-row-
+        # lost invariant is preserved by gating the ack, not the append.
+        self._commit_buf: List[Tuple[int, bytes]] = []
+        self._commit_bytes = 0
+        self._commit_first_t: Optional[float] = None
+        self._buffered_seq = self._wal_seq    # highest seq in the buffer
+        self._durable_seq = self._wal_seq     # highest fsync-covered seq
+        # seq ranges whose group drain failed (torn/IO error): waiters on
+        # them must raise their ack instead of hanging forever. The
+        # durable watermark is advanced PAST a lost range when it is
+        # poisoned (nothing will ever make those records durable), so
+        # barrier syncs and later waiters don't wedge on it — the
+        # specific-seq lost check still fails the lost records' own acks.
+        self._lost: List[Tuple[int, int, BaseException]] = []
+        # highest seq whose wal_append RETURNED (its statement went on
+        # to apply): losing a record at or below this watermark means
+        # memory may exceed the journal; losing one above it cannot
+        # (the append raised before the caller applied anything)
+        self._returned_seq = self._wal_seq
+        # set when a drain failure left APPLIED-but-unjournaled state in
+        # memory (the mutation raised at ack time, after apply): the
+        # store is crash-shaped — checkpoints refuse to fold that state
+        # into durable artifacts until the store is reopened/recovered
+        self._wal_damaged = False
+        # torn wal.append groups waiting for their crash write: FIFO,
+        # flushed under _io_lock by WHOEVER writes next, so no other
+        # bytes can reach the log before them (file order == seq order)
+        self._pending_torn: List[Tuple[List[Tuple[int, bytes]], int]] = []
+        self._commit_cond = threading.Condition(self._lock)
+        self._flusher: Optional[threading.Thread] = None
+        self._closed = False
 
     def _wal_path(self) -> str:
         return os.path.join(self.path, "wal.log")
@@ -589,9 +636,32 @@ class DiskStore:
                 os.remove(os.path.join(tdir, f))
 
     def checkpoint(self, catalog) -> None:
+        # crash fence: after a failed group drain, in-memory state can
+        # hold rows whose statements RAISED at ack time (applied, then
+        # the covering fsync failed). Folding that state into a durable
+        # checkpoint would silently persist rows the client was told
+        # failed — the Postgres fsync-panic lesson. Recovery (reopen)
+        # rebuilds memory from the journal alone and clears the fence.
+        if self._wal_damaged:
+            raise IOError(
+                "WAL group drain failed earlier; in-memory state may "
+                "exceed the journal — reopen/recover the store before "
+                "checkpointing")
         # mutation_lock: no writer can be between journal and apply, so
         # every snapshot state == everything journaled up to wal_seq
         with self.mutation_lock:
+            # drain the commit buffer BEFORE folding anything: the
+            # snapshot below must only ever fold rows whose WAL records
+            # are already fsynced — folding a buffered record and THEN
+            # failing its drain would durably persist a statement whose
+            # ack raised (the fence above can't catch a failure that
+            # happens after folding). A failed drain aborts the
+            # checkpoint here, before any durable artifact is touched.
+            self.wal_sync(force=True)
+            if self._wal_damaged:
+                raise IOError(
+                    "WAL group drain failed; store must be reopened "
+                    "before checkpointing")
             self.save_catalog(catalog)
             seq = self.current_wal_seq()
             folded = {}
@@ -628,7 +698,55 @@ class DiskStore:
                               col.validity])
         self._durable_replace(fpath + ".tmp", fpath)
 
-    # -- WAL -------------------------------------------------------------
+    # -- WAL (group commit) ----------------------------------------------
+
+    @staticmethod
+    def _wal_policy() -> Tuple[str, float, int]:
+        """(mode, group window seconds, buffer bytes) parsed from config.
+        Modes (`wal_fsync_mode`):
+
+        always        every append drains+fsyncs before returning (the
+                      pre-group-commit behavior; one fsync per record);
+        group         appends buffer; the ACK (wal_sync) drains the whole
+                      group with one write+fsync — concurrent committers
+                      coalesce, a lone committer pays one fsync that the
+                      background flusher usually starts while the caller
+                      is still applying/encoding (pipelined);
+        interval:<ms> appends buffer and acks return WITHOUT waiting; the
+                      flusher fsyncs every <ms>. Relaxed durability: a
+                      crash may lose up to <ms> of ACKED local writes
+                      (network surfaces still force a covering fsync)."""
+        from snappydata_tpu import config
+
+        props = config.global_properties()
+        raw = str(props.get("wal_fsync_mode") or "group").strip().lower()
+        group_s = max(0.0, float(props.get("wal_group_ms") or 0.0)) / 1e3
+        buffer_bytes = int(props.get("wal_buffer_bytes") or (8 << 20))
+        if raw.startswith("interval"):
+            _, _, ms = raw.partition(":")
+            try:
+                if ms:
+                    group_s = max(0.0, float(ms)) / 1e3
+            except ValueError:
+                pass
+            return "interval", group_s, buffer_bytes
+        if raw not in ("always", "group"):
+            raw = "group"
+        return raw, group_s, buffer_bytes
+
+    def _ensure_fh(self) -> io.BufferedWriter:
+        """Open (and, after a torn-write crash, salvage) the log for
+        appending. Caller holds _io_lock."""
+        if self._wal_fh is None:
+            # reopen-time repair: if a tear was left since the log was
+            # last open (torn-write fault paths), appending after it
+            # would strand new records behind bytes replay can never
+            # traverse
+            if not self._wal_clean:
+                salvage_file(self._wal_path())
+                self._wal_clean = True
+            self._wal_fh = open(self._wal_path(), "ab")
+        return self._wal_fh
 
     def wal_append(self, table: str, kind: str, sql: Optional[str] = None,
                    params: Optional[tuple] = None,
@@ -638,20 +756,19 @@ class DiskStore:
         """Append one record to the global log. kinds:
         'sql' (statement text + scalar params), 'insert'/'put' (raw column
         arrays), 'delete_keys' (key-tuple arrays + key column names),
-        'drop' (incarnation marker). Returns the record's seq."""
+        'drop' (incarnation marker). Returns the record's seq.
+
+        Group commit: the framed record lands in the commit buffer; the
+        covering fsync is released by wal_sync(seq) — callers MUST gate
+        their ack on it (session/_journal_then/flight do_put all do)."""
+        mode, _group_s, buffer_bytes = self._wal_policy()
+        spec = failpoints.hit("wal.append")   # per-RECORD failpoint:
+        # raise/latency fire here with the same hit cadence as before
+        # group commit existed, so seeded chaos schedules keep coverage
         with self._lock:
-            spec = failpoints.hit("wal.append")  # raise/latency fire here
-            if self._wal_fh is None:
-                # reopen-time repair: if a tear was left since the log
-                # was last open (torn-write fault path below), appending
-                # after it would strand this record behind bytes replay
-                # can never traverse
-                if not self._wal_clean:
-                    salvage_file(self._wal_path())
-                    self._wal_clean = True
-                self._wal_fh = open(self._wal_path(), "ab")
             self._wal_seq += 1
-            header = {"kind": kind, "table": table, "seq": self._wal_seq}
+            seq = self._wal_seq
+            header = {"kind": kind, "table": table, "seq": seq}
             if extra:
                 header.update(extra)
             payload: List[Optional[np.ndarray]] = []
@@ -662,29 +779,299 @@ class DiskStore:
                 payload = list(arrays or [])
                 header["ncols"] = len(payload)
                 payload += list(nulls or [None] * len(payload))
-            if spec is not None and spec.action == "torn_write":
-                # crash mid-append: only a prefix of the record reaches
-                # disk. The mutation raises (never acked, never applied)
-                # and the store must be reopened like a real crash —
-                # boot-time salvage then truncates the tear.
-                buf = io.BytesIO()
-                write_record(buf, header, payload)
-                raw = buf.getvalue()
+            # frame through the module-level frame_record (the seam the
+            # disk-full tests patch) so injected write failures surface
+            # HERE, before the caller applies — an encode/frame error
+            # must fail the statement synchronously, never the
+            # background drain. One buffer, no intermediate copies.
+            raw = frame_record(header, payload)
+            torn = spec is not None and spec.action == "torn_write"
+            if torn:
                 cut = max(1, int(spec.param))
-                self._wal_fh.write(raw[:max(0, len(raw) - cut)])
-                self._wal_fh.flush()
-                os.fsync(self._wal_fh.fileno())
-                self._wal_fh.close()
-                self._wal_fh = None
+                raw = raw[:max(0, len(raw) - cut)]
+            self._commit_buf.append((seq, raw))
+            self._commit_bytes += len(raw)
+            self._buffered_seq = seq
+            if self._commit_first_t is None:
+                self._commit_first_t = time.monotonic()
+            full = self._commit_bytes >= buffer_bytes
+            if torn:
+                # swap the group out IN THIS critical section so no
+                # concurrent append can land BEHIND the torn bytes (it
+                # would be fsynced yet truncated by salvage — an acked
+                # row lost), and queue it as a PENDING torn write: the
+                # next writer to hold _io_lock (us, a concurrent drain,
+                # or the flusher) writes it FIRST, so no higher-seq
+                # record can reach the file before this group and
+                # replay order stays seq order
+                group, self._commit_buf = self._commit_buf, []
+                self._commit_bytes = 0
+                self._commit_first_t = None
+                self._pending_torn.append((group, seq))
+            elif mode != "always":
+                self._ensure_flusher_locked()
+                self._commit_cond.notify_all()
+        if torn:
+            # crash mid-append: earlier buffered records reach disk whole
+            # (they were never at fault — their acks still release), THIS
+            # record loses its tail, and the store must be reopened like
+            # a real crash — boot-time salvage then truncates the tear.
+            with self._io_lock:
+                self._flush_pending_torn()
+            raise failpoints.FaultError(
+                f"failpoint wal.append: injected torn write "
+                f"({max(1, int(spec.param))} bytes cut)")
+        if mode == "always" or full:
+            # always: per-record durability (the legacy contract);
+            # full: backpressure — the buffer bound is wal_buffer_bytes
+            self._drain_upto(seq)
+        failpoints.hit("wal.append", phase="after")
+        with self._lock:
+            # from here the caller applies: losing this record later
+            # (failed drain) means memory-exceeds-journal divergence
+            self._returned_seq = max(self._returned_seq, seq)
+        return seq
+
+    def _flush_pending_torn(self) -> None:
+        """Write queued torn groups (crash mid-append). Caller holds
+        _io_lock — called by every writer before it touches the file, so
+        torn bytes always precede later records. Each group's LAST
+        record is torn; it is written, fsynced, and the log is closed
+        dirty (boot/reopen salvage truncates the tear). Complete records
+        keep their acks (durable watermark advances over them); the torn
+        record's seq is poisoned so any other waiter on it raises
+        instead of hanging."""
+        while True:
+            with self._lock:
+                if not self._pending_torn:
+                    return
+                group, torn_seq = self._pending_torn.pop(0)
+            try:
+                fh = self._ensure_fh()
+                fh.write(b"".join(raw for _, raw in group))
+                fh.flush()
+                os.fsync(fh.fileno())
+                covered = group[-2][0] if len(group) > 1 else None
+                with self._lock:
+                    if covered is not None:
+                        self._durable_seq = max(self._durable_seq,
+                                                covered)
+                    self._lost.append((torn_seq, torn_seq,
+                                       failpoints.FaultError(
+                                           "wal.append: torn write")))
+                    # the torn record never returned from wal_append
+                    # (never applied): no divergence/fence — and the
+                    # watermark moves past it so barriers don't wedge
+                    # on a seq that can never drain
+                    self._durable_seq = max(self._durable_seq, torn_seq)
+                    self._commit_cond.notify_all()
+            except Exception as e:
+                # a REAL I/O failure on top of the injected tear: nothing
+                # in this group is provably durable — poison it all so no
+                # waiter hangs on an unreachable watermark
+                with self._lock:
+                    self._lost.append((group[0][0], torn_seq, e))
+                    if group[0][0] <= self._returned_seq:
+                        # earlier records in the group were applied but
+                        # are now unjournaled — crash-shaped divergence
+                        self._wal_damaged = True
+                    self._durable_seq = max(self._durable_seq, torn_seq)
+                    self._commit_cond.notify_all()
+            finally:
+                if self._wal_fh is not None:
+                    try:
+                        self._wal_fh.close()
+                    except Exception:
+                        pass
+                    self._wal_fh = None
                 self._wal_clean = False   # tear on disk until salvaged
-                raise failpoints.FaultError(
-                    f"failpoint wal.append: injected torn write "
-                    f"({cut} bytes cut)")
-            write_record(self._wal_fh, header, payload)
-            self._wal_fh.flush()
-            os.fsync(self._wal_fh.fileno())
-            failpoints.hit("wal.append", phase="after")
-            return self._wal_seq
+
+    def wal_sync(self, seq: Optional[int] = None,
+                 force: bool = False) -> None:
+        """Block until every record with seq ≤ `seq` is covered by an
+        fsync — THE ack gate of the group-commit write path. `seq=None`
+        targets everything appended so far. In `interval` mode the ack is
+        relaxed (returns immediately) unless `force=True` — network
+        surfaces (Flight do_put, replica fan-out) force it so a remote
+        ack always implies durability."""
+        mode, _group_s, _bb = self._wal_policy()
+        with self._lock:
+            barrier = seq is None
+            if barrier:
+                seq = self._buffered_seq
+            else:
+                # a specific record's ack: raise if IT was lost
+                self._check_lost_locked(seq)
+            if self._durable_seq >= seq:
+                return
+        if mode == "interval" and not force:
+            return
+        if barrier:
+            # barrier semantics (checkpoint, /wal/flush, wal_sync
+            # action): make everything still PENDING durable. Records
+            # lost to an EARLIER failed drain are gone — their own acks
+            # already raised — and must not fail every future barrier;
+            # only a failure of the drain we perform NOW propagates.
+            while True:
+                self._drain()
+                with self._lock:
+                    if self._durable_seq >= seq:
+                        return
+        else:
+            self._drain_upto(seq)
+
+    def _check_lost_locked(self, seq: int) -> None:
+        for lo, hi, exc in self._lost:
+            if lo <= seq <= hi:
+                raise exc
+
+    def _drain(self) -> None:
+        """Flush the commit buffer as ONE contiguous write + ONE fsync
+        (the group). Serialized on _io_lock: while one drainer fsyncs,
+        later appends pile into the fresh buffer and the next drain
+        covers them all — the classic leader-based group commit."""
+        with self._io_lock:
+            # torn crash writes queued ahead of us go to the file FIRST
+            # (their seqs are lower), then _ensure_fh below salvages the
+            # tear before this group lands
+            self._flush_pending_torn()
+            with self._lock:
+                if not self._commit_buf:
+                    return
+                group, self._commit_buf = self._commit_buf, []
+                nbytes, self._commit_bytes = self._commit_bytes, 0
+                self._commit_first_t = None
+            first, last = group[0][0], group[-1][0]
+            lost_from = first
+            t0 = time.monotonic()
+            try:
+                # per-GROUP failpoint: torn-write tears the group's tail
+                # (the mid-group crash shape); raise fails the whole
+                # drain — INSIDE the try so the swapped-out group is
+                # poisoned like any real drain failure (a waiter must
+                # never spin on records that left the buffer unwritten)
+                spec = failpoints.hit("wal.group_commit")
+                data = group[0][1] if len(group) == 1 else \
+                    b"".join(raw for _, raw in group)
+                if spec is not None and spec.action == "torn_write":
+                    cut = max(1, int(spec.param))
+                    keep = max(0, len(data) - cut)
+                    fh = self._ensure_fh()
+                    fh.write(data[:keep])
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                    # records whose frames lie ENTIRELY inside the
+                    # written-and-fsynced prefix are durable — their acks
+                    # must still release; only the torn tail's waiters
+                    # fail (salvage truncates exactly that tail on boot)
+                    end = 0
+                    covered = first - 1
+                    for s_, raw_ in group:
+                        end += len(raw_)
+                        if end <= keep:
+                            covered = s_
+                    with self._lock:
+                        self._durable_seq = max(self._durable_seq,
+                                                covered)
+                    lost_from = covered + 1
+                    raise failpoints.FaultError(
+                        f"failpoint wal.group_commit: injected torn "
+                        f"group write ({cut} bytes cut, "
+                        f"{len(group)} records)")
+                fh = self._ensure_fh()
+                fh.write(data)
+                fh.flush()
+                os.fsync(fh.fileno())
+            except BaseException as e:
+                # the group's records may be torn or absent on disk: the
+                # store is crash-shaped. Poison the seq range so every
+                # waiter's ack RAISES (instead of hanging on a durable
+                # watermark that will never cover it), and force a
+                # reopen-salvage before the next append.
+                with self._lock:
+                    self._lost.append((lost_from, last, e))
+                    if lost_from <= self._returned_seq:
+                        # a RETURNED record was lost: its statement went
+                        # on to apply, so memory now exceeds the journal
+                        # — fence checkpoints until reopen. (A record
+                        # lost before its append returned — always-mode
+                        # inline drain — never applied: no divergence.)
+                        self._wal_damaged = True
+                    # nothing will ever make the lost range durable:
+                    # advance the watermark past it so barriers and
+                    # later waiters don't wedge (the lost records' own
+                    # acks still raise via _check_lost_locked)
+                    self._durable_seq = max(self._durable_seq, last)
+                    self._commit_cond.notify_all()
+                if self._wal_fh is not None:
+                    try:
+                        self._wal_fh.close()
+                    except Exception:
+                        pass
+                    self._wal_fh = None
+                self._wal_clean = False
+                raise
+            from snappydata_tpu.observability.metrics import global_registry
+
+            reg = global_registry()
+            reg.inc("wal_fsync_count")
+            reg.inc("wal_group_commit_batches")
+            reg.inc("wal_records_written", len(group))
+            reg.inc("wal_bytes_written", len(data))
+            reg.record_time("wal_group_flush", time.monotonic() - t0)
+            with self._lock:
+                self._durable_seq = max(self._durable_seq, last)
+                self._commit_cond.notify_all()
+
+    def _drain_upto(self, seq: int) -> None:
+        while True:
+            with self._lock:
+                self._check_lost_locked(seq)
+                if self._durable_seq >= seq:
+                    return
+            self._drain()
+            with self._lock:
+                self._check_lost_locked(seq)
+                if self._durable_seq >= seq:
+                    return
+
+    def _ensure_flusher_locked(self) -> None:
+        """Start (or restart) the background flusher. It drains groups
+        that aged past the group window / interval, which (a) overlaps
+        the fsync with the caller's encode/apply work — the pipelined
+        ingest lane — and (b) bounds the relaxed-ack window of interval
+        mode. Caller holds _lock."""
+        self._closed = False
+        if self._flusher is None or not self._flusher.is_alive():
+            t = threading.Thread(target=self._flusher_loop, daemon=True,
+                                 name=f"wal-flusher-{id(self):x}")
+            self._flusher = t
+            t.start()
+
+    def _flusher_loop(self) -> None:
+        while True:
+            with self._lock:
+                idle = 0
+                while not self._commit_buf and not self._closed:
+                    self._commit_cond.wait(timeout=0.5)
+                    idle += 1
+                    if idle >= 10 and not self._commit_buf:
+                        # park after ~5s idle; respawned on demand
+                        self._flusher = None
+                        return
+                if self._closed:
+                    self._flusher = None
+                    return
+                mode, group_s, buffer_bytes = self._wal_policy()
+                age = time.monotonic() - (self._commit_first_t
+                                          or time.monotonic())
+                if age < group_s and self._commit_bytes < buffer_bytes:
+                    self._commit_cond.wait(timeout=group_s - age)
+                    continue   # re-evaluate: an ack drain may have run
+            try:
+                self._drain()
+            except Exception:
+                pass   # seq range poisoned; waiters raise it as the ack
 
     def current_wal_seq(self) -> int:
         with self._lock:
@@ -694,12 +1081,14 @@ class DiskStore:
         """Drop records already folded into every table's checkpoint.
         Safe because replay fences on per-table wal_seq anyway — rotation
         is pure space reclamation."""
-        with self._lock:
-            if not os.path.exists(self._wal_path()):
-                return
-            if self._wal_fh is not None:
-                self._wal_fh.close()
-                self._wal_fh = None
+        self._drain()   # the file we rewrite must hold every append
+        with self._io_lock:
+            with self._lock:
+                if not os.path.exists(self._wal_path()):
+                    return
+                if self._wal_fh is not None:
+                    self._wal_fh.close()
+                    self._wal_fh = None
             # a mid-file corrupt record must not abort the checkpoint:
             # salvage the prefix, quarantine the damage, rotate what's
             # readable (the damaged record's mutation was acked against
@@ -723,13 +1112,24 @@ class DiskStore:
         recreate must not resurrect old batches — review finding)."""
         import shutil
 
-        self.wal_append(table, "drop")
+        seq = self.wal_append(table, "drop")
+        # the marker must be ON DISK before the table dir disappears —
+        # force past interval mode's relaxed ack
+        self.wal_sync(seq, force=True)
         tdir = os.path.join(self.path, "tables", table)
         if os.path.isdir(tdir):
             shutil.rmtree(tdir)
 
     def close(self) -> None:
+        try:
+            # a clean shutdown must not lose interval-mode acked tails
+            self._drain()
+        except Exception:
+            pass   # crash-shaped close: salvage handles it on reboot
         with self._lock:
+            self._closed = True
+            self._commit_cond.notify_all()
+        with self._io_lock:
             if self._wal_fh is not None:
                 self._wal_fh.close()
                 self._wal_fh = None

@@ -406,14 +406,13 @@ class ColumnTableData:
             views = list(self._manifest.views)
             pos = 0
             if n >= self.max_delta_rows:
+                slices = []
                 while n - pos >= self.max_delta_rows:
                     take = min(self.capacity, n - pos)
-                    sl = slice(pos, pos + take)
-                    views.append(self._cut_batch(
-                        [a[sl] for a in arrays],
-                        [m[sl] if m is not None else None for m in nulls],
-                        {i: c[sl] for i, c in str_codes.items()}))
+                    slices.append(slice(pos, pos + take))
                     pos += take
+                views.extend(self._cut_batches_pipelined(
+                    arrays, nulls, str_codes, slices))
             if pos < n:
                 self._row_buffer.append(
                     [a[pos:] for a in arrays],
@@ -441,10 +440,45 @@ class ColumnTableData:
 
             hoststore.spill_to_budget(self, budget)
 
+    # rows below which the pipelined cut isn't worth its thread overhead
+    _PIPELINE_MIN_ROWS = 1 << 16
+
+    def _cut_batches_pipelined(self, arrays, nulls, str_codes, slices
+                               ) -> List[BatchView]:
+        """Ingest fast lane: encode the batches of one bulk insert on a
+        two-worker pipeline (double-buffered) so batch k+1's CRC/encode
+        CPU work overlaps batch k's — and, on the durable path, overlaps
+        the WAL group fsync the background flusher is running for this
+        statement's journal record. Safe because the fused string encode
+        already interned every value (str_codes covers all dictionary
+        columns), so workers only READ the append-only dictionaries.
+        Batch ids are pre-assigned in slice order; views keep insertion
+        order."""
+        if not slices:
+            return []
+        total = sum(sl.stop - sl.start for sl in slices)
+        pipelined = (len(slices) > 1 and total >= self._PIPELINE_MIN_ROWS
+                     and all(i in str_codes for i in self._dicts))
+
+        def args_for(sl):
+            return ([a[sl] for a in arrays],
+                    [m[sl] if m is not None else None for m in nulls],
+                    {i: c[sl] for i, c in str_codes.items()})
+
+        if not pipelined:
+            return [self._cut_batch(*args_for(sl)) for sl in slices]
+        from concurrent.futures import ThreadPoolExecutor
+
+        ids = [next(self._batch_ids) for _ in slices]
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            futs = [ex.submit(self._cut_batch, *args_for(sl), batch_id=bid)
+                    for sl, bid in zip(slices, ids)]
+            return [f.result() for f in futs]
+
     def _cut_batch(self, arrays: List[np.ndarray],
                    nulls: Optional[List[Optional[np.ndarray]]] = None,
-                   str_codes: Optional[Dict[int, np.ndarray]] = None
-                   ) -> BatchView:
+                   str_codes: Optional[Dict[int, np.ndarray]] = None,
+                   batch_id: Optional[int] = None) -> BatchView:
         from snappydata_tpu.storage import bitmask
         from snappydata_tpu.storage.encoding import (ColumnStats,
                                                      EncodedColumn, Encoding)
@@ -473,7 +507,8 @@ class ColumnTableData:
         if nulls is not None and any(m is not None and m.any() for m in nulls):
             validities = [~m if m is not None else None for m in nulls]
         batch = ColumnBatch.from_arrays(
-            next(self._batch_ids), 0, self.schema, arrays, self.capacity,
+            next(self._batch_ids) if batch_id is None else batch_id,
+            0, self.schema, arrays, self.capacity,
             validities=validities, dictionaries=dicts,
             precoded=precoded)
         return BatchView(batch)

@@ -18,6 +18,7 @@ randomized battery at the bottom is additionally marked `slow`.
 """
 
 import random
+import threading
 import time
 
 import numpy as np
@@ -103,6 +104,97 @@ def test_chaos_durability_quick(tmp_path):
     injected = global_registry().counter("fault_injected") - before
     assert injected >= 10, f"schedule only injected {injected} faults"
     assert len(acked) >= 20       # the system made real progress too
+
+
+def test_chaos_mid_group_commit_schedule(tmp_path):
+    """Seeded chaos over the GROUP COMMIT drain: torn-write / raise
+    faults on wal.group_commit (the mid-group crash shape) and torn
+    writes on wal.append, while 4 concurrent committers stream inserts
+    in `group` mode. Invariants after every crash-recovery:
+
+      - every ACKED key survives (acks gate on the covering fsync);
+      - nothing double-applies (count == count distinct);
+      - the unacked group tail truncates as a crash TEAR, never counted
+        as corruption (wal_corrupt_records untouched)."""
+    from snappydata_tpu import config
+    from snappydata_tpu.catalog import Catalog as _Cat
+
+    seed = 20260803
+    rng = random.Random(seed)
+    fault.reseed(seed)
+    props = config.global_properties()
+    saved_mode = props.get("wal_fsync_mode")
+    props.set("wal_fsync_mode", "group")
+    d = str(tmp_path)
+    corrupt_before = global_registry().counter("wal_corrupt_records")
+    injected_before = global_registry().counter("fault_injected")
+    acked = set()
+    lock = threading.Lock()
+    try:
+        s = SnappySession(catalog=_Cat(), data_dir=d, recover=False)
+        s.sql("CREATE TABLE t (k BIGINT) USING column")
+        for rnd in range(6):
+            sess = s
+            stop = threading.Event()
+
+            def committer(w, sess=sess, rnd=rnd):
+                i = rnd * 100_000 + w * 10_000
+                while not stop.is_set():
+                    i += 1
+                    try:
+                        sess.sql(f"INSERT INTO t VALUES ({i})")
+                        with lock:
+                            acked.add(i)
+                    except Exception:
+                        return   # crash-shaped failure: worker stops
+            threads = [threading.Thread(target=committer, args=(w,))
+                       for w in range(4)]
+            base_acked = len(acked)
+            for t in threads:
+                t.start()
+            # progress-based window (not a fixed sleep): arm the fault
+            # only after real commits landed, so the ≥-progress floor
+            # below holds even on a heavily contended machine
+            deadline = time.time() + 10.0
+            while len(acked) < base_acked + 8 and time.time() < deadline:
+                time.sleep(0.005)
+            r = rng.random()
+            if r < 0.4:
+                fault.arm("wal.group_commit", "torn_write",
+                          param=rng.randint(1, 80), count=1)
+            elif r < 0.7:
+                fault.arm("wal.group_commit", "raise", count=1)
+            else:
+                fault.arm("wal.append", "torn_write",
+                          param=rng.randint(1, 40), count=1)
+            time.sleep(0.05)
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+            assert not any(t.is_alive() for t in threads), \
+                "a committer hung on its ack"
+            fault.clear()
+            # crash + recover; every acked key must be there, exactly once
+            try:
+                s.disk_store.close()
+            except Exception:
+                pass
+            s = SnappySession(data_dir=d, recover=True)
+            got = {r0[0] for r0 in s.sql("SELECT k FROM t").rows()}
+            assert acked <= got, \
+                f"acked rows lost mid-schedule: {sorted(acked - got)[:5]}"
+            n_all = s.sql("SELECT count(*) FROM t").rows()[0][0]
+            n_dst = s.sql("SELECT count(DISTINCT k) FROM t").rows()[0][0]
+            assert n_all == n_dst, "double-applied rows after recovery"
+        assert len(acked) >= 40, "schedule starved every committer"
+        assert global_registry().counter("fault_injected") > \
+            injected_before, "no fault actually fired"
+        assert global_registry().counter("wal_corrupt_records") == \
+            corrupt_before, "a crash tear was miscounted as corruption"
+        s.disk_store.close()
+    finally:
+        fault.clear()
+        props.set("wal_fsync_mode", saved_mode)
 
 
 # -----------------------------------------------------------------------

@@ -31,15 +31,18 @@ class TestDiskFull:
                 np.arange(i * 100, (i + 1) * 100, dtype=np.int64),
                 np.ones(100)])
 
-        real_write = persistence.write_record
+        # frame_record is the seam the WAL append goes through (the
+        # group-commit path frames before buffering; a failure here must
+        # surface BEFORE the mutation applies)
+        real_frame = persistence.frame_record
         state = {"full": True}
 
-        def failing_write(fh, header, arrays):
+        def failing_frame(header, arrays):
             if state["full"]:
                 raise OSError(errno.ENOSPC, "No space left on device")
-            return real_write(fh, header, arrays)
+            return real_frame(header, arrays)
 
-        monkeypatch.setattr(persistence, "write_record", failing_write)
+        monkeypatch.setattr(persistence, "frame_record", failing_frame)
         with pytest.raises(OSError, match="No space left"):
             s.insert_arrays("ev", [np.arange(500, 600, dtype=np.int64),
                                    np.ones(100)])

@@ -1,0 +1,441 @@
+"""Group-commit write path (tentpole of the batched-WAL-fsync PR):
+
+- zero-copy record framing: one contiguous buffer, single CRC pass,
+  byte-identical to what write_record streams to disk;
+- CI perf guard: an N-append burst in `group` mode costs O(groups)
+  fsyncs, not O(records) — the per-append-fsync regression (BENCH_r05
+  load_s 30.6s → 119.8s) cannot silently return;
+- ack semantics per wal_fsync_mode: `group` acks only after the
+  covering fsync (bytes provably on disk before the statement returns),
+  `always` pays one fsync per record, `interval:<ms>` acks early and
+  the flusher closes the window;
+- mid-group torn writes: the unacked group tail is truncated cleanly
+  as a crash tear (never quarantined as corruption), records fully
+  inside the fsynced prefix keep their acks;
+- a failed group drain poisons every covered waiter (acks RAISE, never
+  hang) and the store heals for subsequent appends.
+"""
+
+import io
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import config, fault
+from snappydata_tpu.observability.metrics import global_registry
+from snappydata_tpu.storage.persistence import (DiskStore, frame_record,
+                                                read_records, write_record)
+
+
+@pytest.fixture(autouse=True)
+def _wal_knobs():
+    """Restore the WAL policy knobs and the failpoint registry."""
+    props = config.global_properties()
+    saved = {k: props.get(k) for k in
+             ("wal_fsync_mode", "wal_buffer_bytes", "wal_group_ms")}
+    fault.clear()
+    yield props
+    for k, v in saved.items():
+        props.set(k, v)
+    fault.clear()
+
+
+def _wal_seqs(path):
+    with open(path, "rb") as fh:
+        return [h["seq"] for h, _ in read_records(fh)]
+
+
+# -----------------------------------------------------------------------
+# zero-copy framing
+# -----------------------------------------------------------------------
+
+def test_frame_record_single_buffer_matches_write_record():
+    header = {"kind": "insert", "table": "t", "seq": 7, "ncols": 2}
+    arrays = [np.arange(1000, dtype=np.int64),
+              np.array(["a", None, "b"] * 333 + ["a"], dtype=object)]
+    framed = frame_record(header, arrays)
+    buf = io.BytesIO()
+    write_record(buf, header, arrays)
+    assert buf.getvalue() == framed          # write_record IS the frame
+    buf.seek(0)
+    (got_h, got_arrays), = list(read_records(buf))
+    assert got_h == header
+    np.testing.assert_array_equal(got_arrays[0], arrays[0])
+    assert list(got_arrays[1]) == list(arrays[1])
+
+
+# -----------------------------------------------------------------------
+# fsync accounting per mode (the CI perf guard)
+# -----------------------------------------------------------------------
+
+def test_group_mode_burst_fsync_count_is_o_groups(tmp_path, _wal_knobs):
+    """300 buffered appends + one sync must cost a HANDFUL of fsyncs.
+    This is the guard against the r05 regression: per-append fsync made
+    ingest 4x slower; group commit amortizes records into groups."""
+    props = _wal_knobs
+    props.set("wal_fsync_mode", "group")
+    props.set("wal_group_ms", 500.0)        # flusher stays out of the way
+    ds = DiskStore(str(tmp_path))
+    before = global_registry().counter("wal_fsync_count")
+    n = 300
+    for i in range(n):
+        ds.wal_append("t", "sql", sql=f"INSERT INTO t VALUES ({i})")
+    ds.wal_sync()                            # ONE covering drain
+    fsyncs = global_registry().counter("wal_fsync_count") - before
+    assert fsyncs <= 8, \
+        f"{fsyncs} fsyncs for {n} records — group commit not grouping"
+    # nothing was lost to the batching: every record is on disk
+    assert _wal_seqs(os.path.join(str(tmp_path), "wal.log")) == \
+        list(range(1, n + 1))
+    ds.close()
+
+
+def test_always_mode_pays_one_fsync_per_record(tmp_path, _wal_knobs):
+    props = _wal_knobs
+    props.set("wal_fsync_mode", "always")
+    ds = DiskStore(str(tmp_path))
+    before = global_registry().counter("wal_fsync_count")
+    for i in range(20):
+        ds.wal_append("t", "sql", sql=f"stmt {i}")
+    assert global_registry().counter("wal_fsync_count") - before == 20
+    ds.close()
+
+
+def test_buffer_bound_applies_backpressure(tmp_path, _wal_knobs):
+    """Appends past wal_buffer_bytes drain inline — the commit buffer
+    is bounded, not an unbounded memory sink."""
+    props = _wal_knobs
+    props.set("wal_fsync_mode", "group")
+    props.set("wal_group_ms", 10_000.0)
+    props.set("wal_buffer_bytes", 4096)
+    ds = DiskStore(str(tmp_path))
+    # incompressible payload: the at-rest codec must not shrink it back
+    # under the buffer bound
+    big = np.random.default_rng(0).integers(0, 1 << 62, 600)
+    before = global_registry().counter("wal_fsync_count")
+    for _ in range(5):
+        ds.wal_append("t", "insert", arrays=[big])
+    assert global_registry().counter("wal_fsync_count") - before >= 4
+    ds.close()
+
+
+# -----------------------------------------------------------------------
+# ack semantics
+# -----------------------------------------------------------------------
+
+def test_group_ack_means_bytes_on_disk_before_return(tmp_path, _wal_knobs):
+    """After a session statement returns (the ack), its WAL record is
+    already fsync-covered ON DISK — verified by parsing wal.log without
+    any close/flush, then by crash-shaped recovery (old store never
+    closed)."""
+    from snappydata_tpu import SnappySession
+    from snappydata_tpu.catalog import Catalog
+
+    props = _wal_knobs
+    props.set("wal_fsync_mode", "group")
+    d = str(tmp_path)
+    s = SnappySession(catalog=Catalog(), data_dir=d, recover=False)
+    s.sql("CREATE TABLE t (k BIGINT) USING column")
+    for i in range(5):
+        s.sql(f"INSERT INTO t VALUES ({i})")
+    # the ack gate: all five records are parseable from disk RIGHT NOW
+    assert len(_wal_seqs(os.path.join(d, "wal.log"))) == 5
+    # crash shape: recover in a fresh session without closing the old one
+    s2 = SnappySession(data_dir=d, recover=True)
+    assert [r[0] for r in s2.sql("SELECT k FROM t ORDER BY k").rows()] \
+        == [0, 1, 2, 3, 4]
+    s2.disk_store.close()
+    s.disk_store.close()
+
+
+def test_interval_mode_relaxed_ack_then_flusher_covers(tmp_path,
+                                                       _wal_knobs):
+    import time as _time
+
+    props = _wal_knobs
+    props.set("wal_fsync_mode", "interval:40")
+    ds = DiskStore(str(tmp_path))
+    ds.wal_append("t", "sql", sql="one")
+    ds.wal_sync()        # relaxed: returns without draining
+    # within ~10x the interval the background flusher must have synced
+    wal = os.path.join(str(tmp_path), "wal.log")
+    deadline = _time.time() + 2.0
+    while _time.time() < deadline:
+        if os.path.exists(wal) and _wal_seqs(wal):
+            break
+        _time.sleep(0.02)
+    assert _wal_seqs(wal) == [1], "flusher never closed the interval"
+    # force=True is the hard barrier network surfaces use
+    ds.wal_append("t", "sql", sql="two")
+    ds.wal_sync(force=True)
+    assert _wal_seqs(wal) == [1, 2]
+    ds.close()
+
+
+def test_close_drains_interval_mode_tail(tmp_path, _wal_knobs):
+    props = _wal_knobs
+    props.set("wal_fsync_mode", "interval:60000")   # flusher won't fire
+    ds = DiskStore(str(tmp_path))
+    ds.wal_append("t", "sql", sql="tail")
+    ds.close()           # clean shutdown must not lose the acked tail
+    assert _wal_seqs(os.path.join(str(tmp_path), "wal.log")) == [1]
+
+
+# -----------------------------------------------------------------------
+# mid-group torn writes + drain failure
+# -----------------------------------------------------------------------
+
+def test_mid_group_torn_tail_truncates_cleanly(tmp_path, _wal_knobs):
+    """A torn group write: records fully inside the fsynced prefix keep
+    their acks; the torn tail is truncated on reboot as a crash tear —
+    NOT counted as corruption (wal_corrupt_records untouched)."""
+    props = _wal_knobs
+    props.set("wal_fsync_mode", "group")
+    props.set("wal_group_ms", 10_000.0)      # keep the group buffered
+    d = str(tmp_path)
+    ds = DiskStore(d)
+    for i in range(3):
+        ds.wal_append("t", "sql", sql=f"stmt {i}")
+    fault.arm("wal.group_commit", "torn_write", param=5, count=1)
+    corrupt_before = global_registry().counter("wal_corrupt_records")
+    with pytest.raises(IOError):
+        ds.wal_sync()                        # drain tears the tail
+    # seqs 1..2 were fully inside the written prefix: durable, acked
+    ds.wal_sync(seq=2)                       # must NOT raise
+    with pytest.raises(IOError):
+        ds.wal_sync(seq=3)                   # the torn record's ack fails
+    ds.close()
+    # reboot: salvage truncates the tear; the fsynced prefix survives
+    ds2 = DiskStore(d)
+    assert _wal_seqs(os.path.join(d, "wal.log")) == [1, 2]
+    assert global_registry().counter("wal_corrupt_records") == \
+        corrupt_before, "a clean crash tear was miscounted as corruption"
+    # the store accepts appends again and they land after the prefix
+    ds2.wal_append("t", "sql", sql="post-crash")
+    ds2.wal_sync()
+    assert _wal_seqs(os.path.join(d, "wal.log"))[-1] > 2
+    ds2.close()
+
+
+def test_failed_group_drain_poisons_every_waiter(tmp_path, _wal_knobs):
+    """An IO error mid-drain must RAISE every covered ack (never hang)
+    and the store must heal for subsequent appends."""
+    props = _wal_knobs
+    props.set("wal_fsync_mode", "group")
+    props.set("wal_group_ms", 10_000.0)
+    ds = DiskStore(str(tmp_path))
+    seqs = [ds.wal_append("t", "sql", sql="a"),
+            ds.wal_append("t", "sql", sql="b")]
+    fault.arm("wal.group_commit", "raise", count=1)
+    errors = []
+
+    def sync(seq):
+        try:
+            ds.wal_sync(seq)
+        except Exception as e:
+            errors.append(e)
+
+    threads = [threading.Thread(target=sync, args=(q,)) for q in seqs]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert not any(t.is_alive() for t in threads), "a waiter hung"
+    assert len(errors) == 2, f"both acks must fail, got {errors}"
+    # healed: the next append+sync succeeds
+    seq = ds.wal_append("t", "sql", sql="after")
+    ds.wal_sync(seq)
+    assert seq in _wal_seqs(os.path.join(str(tmp_path), "wal.log"))
+    ds.close()
+
+
+def test_torn_record_failpoint_still_fires_per_record(tmp_path,
+                                                      _wal_knobs):
+    """wal.append torn_write keeps its PER-RECORD semantics under group
+    mode: earlier acked rows survive, the torn statement is lost, the
+    store reopens like a real crash — chaos coverage is not weakened."""
+    from snappydata_tpu import SnappySession
+    from snappydata_tpu.catalog import Catalog
+
+    props = _wal_knobs
+    props.set("wal_fsync_mode", "group")
+    d = str(tmp_path)
+    s = SnappySession(catalog=Catalog(), data_dir=d, recover=False)
+    s.sql("CREATE TABLE t (k BIGINT) USING column")
+    s.sql("INSERT INTO t VALUES (1)")
+    fault.arm("wal.append", "torn_write", param=9, count=1)
+    with pytest.raises(IOError):
+        s.sql("INSERT INTO t VALUES (2)")
+    s2 = SnappySession(data_dir=d, recover=True)
+    assert [r[0] for r in s2.sql("SELECT k FROM t ORDER BY k").rows()] \
+        == [1]
+    s2.disk_store.close()
+    s.disk_store.close()
+
+
+def test_failed_drain_fences_checkpoint_until_reopen(tmp_path,
+                                                     _wal_knobs):
+    """After a failed group drain the statement RAISED but its rows were
+    already applied in memory (journal→apply→ack order). A checkpoint
+    must refuse to fold that crash-shaped state into durable artifacts
+    — otherwise rows the client was told FAILED silently become
+    durable. Reopen/recovery rebuilds memory from the journal alone and
+    clears the fence."""
+    from snappydata_tpu import SnappySession
+    from snappydata_tpu.catalog import Catalog
+
+    props = _wal_knobs
+    props.set("wal_fsync_mode", "group")
+    d = str(tmp_path)
+    s = SnappySession(catalog=Catalog(), data_dir=d, recover=False)
+    s.sql("CREATE TABLE t (k BIGINT) USING column")
+    s.sql("INSERT INTO t VALUES (1)")
+    fault.arm("wal.group_commit", "raise", count=1)
+    with pytest.raises(IOError):
+        s.sql("INSERT INTO t VALUES (2)")    # applied, never journaled
+    with pytest.raises(IOError, match="reopen"):
+        s.checkpoint()                        # the fence
+    s.disk_store.close()
+    # recovery: only the acked row — and checkpoints work again
+    s2 = SnappySession(data_dir=d, recover=True)
+    assert [r[0] for r in s2.sql("SELECT k FROM t ORDER BY k").rows()] \
+        == [1]
+    s2.checkpoint()
+    s2.disk_store.close()
+
+
+def test_stale_poison_does_not_wedge_barriers(tmp_path, _wal_knobs):
+    """A single torn append must not fail every later durability
+    barrier: the torn record is gone (its own ack raised), so
+    wal_sync(force=True) with no seq — and checkpoint(), which uses it
+    — must succeed immediately afterwards; only the torn seq's OWN ack
+    keeps raising."""
+    from snappydata_tpu import SnappySession
+    from snappydata_tpu.catalog import Catalog
+
+    props = _wal_knobs
+    props.set("wal_fsync_mode", "group")
+    d = str(tmp_path)
+    s = SnappySession(catalog=Catalog(), data_dir=d, recover=False)
+    s.sql("CREATE TABLE t (k BIGINT) USING column")
+    s.sql("INSERT INTO t VALUES (1)")
+    fault.arm("wal.append", "torn_write", param=9, count=1)
+    with pytest.raises(IOError):
+        s.sql("INSERT INTO t VALUES (2)")     # torn: never applied
+    ds = s.disk_store
+    torn_seq = ds.current_wal_seq()
+    ds.wal_sync(force=True)                   # barrier: must NOT raise
+    with pytest.raises(IOError):
+        ds.wal_sync(seq=torn_seq)             # the torn record's own ack
+    s.checkpoint()                            # memory == journal: allowed
+    s.sql("INSERT INTO t VALUES (3)")
+    s.disk_store.close()
+    s2 = SnappySession(data_dir=d, recover=True)
+    assert [r[0] for r in s2.sql("SELECT k FROM t ORDER BY k").rows()] \
+        == [1, 3]
+    s2.disk_store.close()
+
+
+def test_checkpoint_drains_before_folding(tmp_path, _wal_knobs):
+    """checkpoint() must fsync the commit buffer BEFORE folding state:
+    a failed drain aborts the checkpoint with no durable artifact
+    touched (folding first would durably persist a record whose ack
+    later raises)."""
+    from snappydata_tpu import SnappySession
+    from snappydata_tpu.catalog import Catalog
+
+    props = _wal_knobs
+    props.set("wal_fsync_mode", "group")
+    props.set("wal_group_ms", 10_000.0)
+    d = str(tmp_path)
+    s = SnappySession(catalog=Catalog(), data_dir=d, recover=False)
+    s.sql("CREATE TABLE t (k BIGINT) USING column")
+    s.sql("INSERT INTO t VALUES (1)")            # acked, durable
+    # leave an un-drained record in the commit buffer
+    s.disk_store.wal_append("t", "sql", sql="INSERT INTO t VALUES (99)")
+    fault.arm("wal.group_commit", "raise", count=1)
+    with pytest.raises(IOError):
+        s.checkpoint()
+    # the abort happened before any TABLE state was folded (catalog.json
+    # exists from the CREATE TABLE DDL itself, not from this checkpoint)
+    assert not os.path.exists(os.path.join(d, "tables", "t",
+                                           "manifest.json"))
+    s.disk_store.close()
+
+
+def test_rest_wal_status_and_flush(tmp_path, _wal_knobs):
+    """GET /status/api/v1/wal surfaces the group-commit counters and
+    knobs; POST /wal/flush is the durability barrier that closes the
+    interval-mode relaxed-ack window; the dashboard renders the
+    Durability section."""
+    import json
+    import urllib.request
+
+    from snappydata_tpu import SnappySession
+    from snappydata_tpu.catalog import Catalog
+    from snappydata_tpu.cluster.rest import RestService
+    from snappydata_tpu.observability import TableStatsService
+
+    props = _wal_knobs
+    props.set("wal_fsync_mode", "interval:60000")   # flusher won't fire
+    s = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                      recover=False)
+    s.sql("CREATE TABLE t (k BIGINT) USING column")
+    svc = RestService(s, TableStatsService(s.catalog)).start()
+    try:
+        s.sql("INSERT INTO t VALUES (1)")   # relaxed ack: not synced yet
+        base = f"http://{svc.host}:{svc.port}"
+        wal = json.loads(urllib.request.urlopen(
+            base + "/status/api/v1/wal").read())
+        assert wal["wal_fsync_mode"].startswith("interval")
+        for key in ("wal_fsync_count", "wal_group_commit_batches",
+                    "wal_bytes_written", "wal_group_flush_ms"):
+            assert key in wal, key
+        req = urllib.request.Request(
+            base + "/wal/flush", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        out = json.loads(urllib.request.urlopen(req).read())
+        assert out == {"flushed_members": 1, "durable_members": 1}
+        # the barrier closed the window: the record is on disk NOW
+        assert _wal_seqs(os.path.join(str(tmp_path), "wal.log"))
+        html = urllib.request.urlopen(base + "/dashboard").read().decode()
+        assert "Durability (WAL group commit)" in html
+    finally:
+        svc.stop()
+        s.disk_store.close()
+
+
+def test_concurrent_committers_coalesce_and_recover(tmp_path, _wal_knobs):
+    """4 committer threads through a real session: every acked row is
+    fsync-covered, groups coalesce (fewer fsyncs than statements), and
+    recovery returns exactly the acked set."""
+    from snappydata_tpu import SnappySession
+    from snappydata_tpu.catalog import Catalog
+
+    props = _wal_knobs
+    props.set("wal_fsync_mode", "group")
+    d = str(tmp_path)
+    s = SnappySession(catalog=Catalog(), data_dir=d, recover=False)
+    s.sql("CREATE TABLE t (k BIGINT) USING column")
+    acked = []
+    lock = threading.Lock()
+
+    def committer(base):
+        for i in range(base, base + 25):
+            s.sql(f"INSERT INTO t VALUES ({i})")
+            with lock:
+                acked.append(i)
+
+    threads = [threading.Thread(target=committer, args=(w * 100,))
+               for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    s.disk_store.close()
+    s2 = SnappySession(data_dir=d, recover=True)
+    got = sorted(r[0] for r in s2.sql("SELECT k FROM t").rows())
+    assert got == sorted(acked)
+    s2.disk_store.close()

@@ -200,7 +200,10 @@ def test_statement_timeout_covers_queue_time(props):
         broker.admit(q3, estimate_bytes=100, timeout_s=5.0)
         try:
             assert q3.deadline is not None
-            assert q3.deadline - t0 <= 5.0 + 0.1
+            # generous slack: a scheduling hiccup between t0 and the
+            # deadline arming flaked the 0.1s bound on a loaded box; a
+            # re-armed deadline would still blow well past this
+            assert q3.deadline - t0 <= 5.0 + 1.0
         finally:
             broker.release(q3)
     finally:

@@ -85,6 +85,95 @@ def test_bit_flip_sweep_crc_rejects_every_single_byte_corruption(tmp_path):
         corrupt_counter_before + (hi - lo) // 2
 
 
+def _make_group_wal(tmp_path, records_per_group=3, groups=3):
+    """A wal.log written by the GROUP COMMIT path: each group of records
+    lands as one contiguous write+fsync. Returns (path, per-record start
+    offsets) — on disk the framing is identical to per-record writes,
+    which is exactly what keeps salvage/replay working unchanged."""
+    from snappydata_tpu import config
+    from snappydata_tpu.storage.persistence import DiskStore
+
+    props = config.global_properties()
+    saved_mode, saved_ms = props.get("wal_fsync_mode"), \
+        props.get("wal_group_ms")
+    props.set("wal_fsync_mode", "group")
+    props.set("wal_group_ms", 10_000.0)
+    try:
+        d = str(tmp_path / "gstore")
+        ds = DiskStore(d)
+        for g in range(groups):
+            for r in range(records_per_group):
+                i = g * records_per_group + r
+                ds.wal_append("t", "insert",
+                              arrays=[np.arange(6, dtype=np.int64) + i])
+            ds.wal_sync()          # ONE drain per group
+        ds.close()
+    finally:
+        props.set("wal_fsync_mode", saved_mode)
+        props.set("wal_group_ms", saved_ms)
+    path = os.path.join(d, "wal.log")
+    starts = []
+    with open(path, "rb") as fh:
+        while True:
+            starts.append(fh.tell())
+            try:
+                next(iter(read_records(fh)))
+            except StopIteration:
+                starts.pop()
+                break
+    return path, starts
+
+
+def test_group_framed_log_truncation_sweep(tmp_path):
+    """Truncate a group-committed log at EVERY byte of the final GROUP:
+    recovery keeps exactly the records whose frames fully survive —
+    a mid-group crash only ever costs the (un-acked) torn tail."""
+    base, starts = _make_group_wal(tmp_path)
+    raw = open(base, "rb").read()
+    final_group_start = starts[-3]           # last group = 3 records
+    assert len(starts) == 9
+    for cut in range(final_group_start, len(raw)):
+        p = str(tmp_path / "wal.log")
+        with open(p, "wb") as fh:
+            fh.write(raw[:cut])
+        got = _recovered_seqs(p)
+        # every fully-written record survives, partial frames never do
+        n_whole = sum(1 for s0 in starts[6:] if
+                      (starts + [len(raw)])[starts.index(s0) + 1] <= cut)
+        assert got == list(range(1, 7 + n_whole)), \
+            f"cut at {cut} recovered {got}"
+        os.remove(p)
+        if os.path.exists(p + ".corrupt"):
+            os.remove(p + ".corrupt")
+
+
+def test_group_framed_log_bit_flip_sweep(tmp_path):
+    """Flip one byte in the MIDDLE group of a group-committed log: the
+    damaged record must never replay; the prefix always survives."""
+    base, starts = _make_group_wal(tmp_path)
+    raw = open(base, "rb").read()
+    lo, hi = starts[3], starts[6]            # the middle group's bytes
+    step = max(1, (hi - lo) // 64)           # sampled sweep: keep tier-1 fast
+    for ofs in range(lo, hi, step):
+        bad = bytearray(raw)
+        bad[ofs] ^= 0xFF
+        p = str(tmp_path / "wal.log")
+        with open(p, "wb") as fh:
+            fh.write(bytes(bad))
+        got = _recovered_seqs(p)
+        assert all(q <= 3 for q in got) or got[:3] == [1, 2, 3], \
+            f"flip at {ofs} recovered {got}"
+        # records 4..6 overlap the flip region: whichever record holds
+        # the flipped byte must not replay
+        flipped_rec = 4 + max(i for i, s0 in enumerate(starts[3:6])
+                              if s0 <= ofs)
+        assert flipped_rec not in got, \
+            f"flip at {ofs} replayed damaged record {flipped_rec}"
+        os.remove(p)
+        if os.path.exists(p + ".corrupt"):
+            os.remove(p + ".corrupt")
+
+
 def test_session_level_torn_tail_recovery(tmp_path):
     """End-to-end: a crash mid-append of the LAST insert loses only that
     (un-acked) insert; recovery is idempotent across repeated boots."""
