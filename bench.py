@@ -116,15 +116,37 @@ def main() -> None:
     print(f"bench: Q1 full-value check OK (max rel err "
           f"{q1_max_rel_err:.2e})", file=sys.stderr, flush=True)
 
+    from snappydata_tpu.observability.metrics import global_registry
+
     timings = {}
+    agg_detail = {}
     for name, q in (("q1", tpch.Q1), ("q6", tpch.Q6)):
         s.sql(q)  # compile + first run
+        c0 = dict(global_registry().snapshot()["counters"])
         best = float("inf")
         for _ in range(repeats):
             t0 = time.time()
             s.sql(q)
             best = min(best, time.time() - t0)
         timings[name] = best
+        # chosen reduction strategy + fused-pass counts, so the bench
+        # trajectory explains ITSELF (which strategy the auto table
+        # picked, whether the group-index cache carried the repeats)
+        c1 = global_registry().snapshot()["counters"]
+
+        def delta(key):
+            return c1.get(key, 0) - c0.get(key, 0)
+
+        agg_detail[name] = {
+            "reduce_passes_per_run":
+                round(delta("agg_reduce_passes") / repeats, 2),
+            "strategies": {
+                st: delta(f"agg_strategy_{st}")
+                for st in ("unroll", "scatter", "matmul", "pallas")
+                if delta(f"agg_strategy_{st}")},
+            "gidx_cache_hits": delta("gidx_cache_hits"),
+            "gidx_cache_misses": delta("gidx_cache_misses"),
+        }
 
     # ---- device-only timings (jitted fn on resident arrays) ------------
     # separates XLA execute time from the session/bind/host overhead the
@@ -140,9 +162,14 @@ def main() -> None:
 
     # Pallas side-by-sides (TPU only; default-off paths — measured here
     # so next round can flip them on with evidence): the global Kahan
-    # reduction on Q6 and the fused grouped-aggregate kernel on Q1
-    pallas = {"q6_pallas_s": None, "q1_pallas_s": None}
+    # reduction on Q6 and the fused grouped-aggregate kernel on Q1.
+    # On CPU the kernels only run in interpreter mode (correctness, not
+    # speed) — say so explicitly instead of a null that reads like an
+    # attempted-but-failed TPU timing.
+    pallas = {"q6_pallas_s": "skipped (cpu interpret)",
+              "q1_pallas_s": "skipped (cpu interpret)"}
     if platform == "tpu":
+        pallas = {"q6_pallas_s": None, "q1_pallas_s": None}
         for field, flag, q in (
                 ("q6_pallas_s", "pallas_reduce", tpch.Q6),
                 ("q1_pallas_s", "pallas_group_reduce", tpch.Q1)):
@@ -206,6 +233,10 @@ def main() -> None:
             "q1_max_rel_err": q1_max_rel_err,
             "q6_pallas_s": pallas["q6_pallas_s"],
             "q1_pallas_s": pallas["q1_pallas_s"],
+            # reduction-strategy evidence per headline query (strategy
+            # picked by the auto table, fused passes per run, gidx
+            # cache behavior across the repeats)
+            "agg": agg_detail,
             "ingest_rows_per_s": ingest_rows_per_s,
             "sink_events_per_s": sink_events_per_s,
             # durable (WAL'd) ingest per wal_fsync_mode, with the fsync

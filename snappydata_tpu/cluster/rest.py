@@ -100,8 +100,8 @@ def _render_dashboard(svc) -> str:
     counters = "".join(
         f"<tr><td>{esc(str(k))}</td><td>{v}</td></tr>"
         for k, v in sorted(snap["counters"].items()))
-    from snappydata_tpu.observability.stats_service import \
-        durability_snapshot
+    from snappydata_tpu.observability.stats_service import (
+        durability_snapshot, scan_snapshot)
 
     wal = durability_snapshot()
     rows_w = "".join(
@@ -110,6 +110,10 @@ def _render_dashboard(svc) -> str:
         f"<tr><td>wal_group_flush_ms (mean/max)</td>"
         f"<td>{wal['wal_group_flush_ms']['mean_ms']} / "
         f"{wal['wal_group_flush_ms']['max_ms']}</td></tr>")
+    agg = scan_snapshot()
+    rows_agg = "".join(
+        f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
+        for k, v in agg.items())
     recent = list(reversed(svc.session.recent_queries()))[:25]
     rows_q = "".join(
         f"<tr><td>{esc(str(q['sql']))[:120]}</td><td>{q['ms']}</td>"
@@ -137,6 +141,8 @@ text-align:left}}h2{{margin-top:1.5em}}</style></head><body>
 <table><tr><th>query</th><th>table</th><th>active</th><th>batches</th>
 <th>rows</th><th>rows/s</th><th>last error</th></tr>{rows_s}</table>
 <h2>Durability (WAL group commit)</h2><table>{rows_w}</table>
+<h2>Aggregation engine (reduction strategy / tiled scans)</h2>
+<table>{rows_agg}</table>
 <h2>Counters</h2><table>{counters}</table>
 <h2>Recent queries ({len(recent)})</h2>
 <table><tr><th>sql</th><th>ms</th><th>rows</th><th>user</th></tr>{rows_q}
@@ -208,6 +214,14 @@ class RestService:
                         durability_snapshot
 
                     self._send(durability_snapshot())
+                elif path == "/status/api/v1/scan":
+                    # aggregation read-path stats: chosen reduction
+                    # strategies, fused-pass counts, group-index cache
+                    # hit rate, tiled-scan device merges + overlap
+                    from snappydata_tpu.observability.stats_service import \
+                        scan_snapshot
+
+                    self._send(scan_snapshot())
                 elif path == "/status/api/v1/streaming":
                     # streaming query progress (ref: the structured-
                     # streaming UI tab / StreamingQueryManager.active);

@@ -100,6 +100,26 @@ class Properties:
     # per-lane Kahan partials, f64 combine outside (ops/pallas_group.py).
     # Same default-OFF-until-measured policy as pallas_reduce.
     pallas_group_reduce: bool = False
+    # Grouped-aggregate reduction strategy (ops/reduction.py): every
+    # compatible slot of a query packs into one [N, S] matrix per
+    # accumulator family and reduces in a single fused dispatch.
+    #   auto     backend-keyed: CPU float sums+counts via one-hot matmul
+    #            (BLAS gemm, one-hot reused by the group-index cache)
+    #            when the one-hot fits, else segment_sum; TPU keeps the
+    #            measured unrolled masked reductions for G <= 64, else
+    #            scatter; exact int64 sums and min/max never matmul
+    #   unroll   G masked reductions over the packed block (old default)
+    #   scatter  jax.ops.segment_* along axis 0, one pass
+    #   matmul   one-hot [S,N]@[N,G] in the accumulator dtype
+    # The knob participates in the compiled plan's static key, so
+    # flipping it re-specializes without clearing plan caches.
+    agg_reduce_strategy: str = "auto"
+    # Group-index cache: aggregates whose plan shape allows it split into
+    # a cached prefix (validity mask + combined group index + matmul
+    # one-hot) keyed on (plan, table versions, params) and a main phase,
+    # so repeated dashboard queries skip gidx recomputation. Byte budget
+    # for cached entries; 0 disables the cache.
+    gidx_cache_bytes: int = 3 << 30
     max_groups: int = 1 << 16                 # static upper bound for generic group-by output
     batches_pow2_bucketing: bool = True       # pad #batches to pow2 → fewer recompiles
 

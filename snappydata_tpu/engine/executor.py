@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import threading
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -187,7 +188,17 @@ def _row_table_device(info, used):
 
 
 class CompiledPlan:
-    """A device region compiled to a jitted function + bind metadata."""
+    """A device region compiled to a jitted function + bind metadata.
+
+    Aggregates may additionally carry a two-phase split (`traced_pre` /
+    `traced_main`): phase A computes the combined group index + validity
+    mask (+ the matmul one-hot), phase B evaluates the slots.  Phase A's
+    device outputs are cached in a module-level LRU keyed on (plan,
+    static sizes, params, bound table identity) so repeated dashboard
+    queries over an unchanged table skip gidx recomputation entirely
+    (`gidx_cache_hits`).  Partial-raw compiles (the tiled scan's device
+    merge) instead expose `execute_raw`, which returns the device
+    outputs without the device_get/assemble round trip."""
 
     def __init__(self, relations: List[_RelationInput],
                  aux_builders: List[Callable],
@@ -195,7 +206,11 @@ class CompiledPlan:
                  traced: Callable,
                  out_scope: List["_ScopeCol"],
                  is_aggregate: bool,
-                 bind_checks: Optional[List[Callable]] = None):
+                 bind_checks: Optional[List[Callable]] = None,
+                 traced_pre: Optional[Callable] = None,
+                 traced_main: Optional[Callable] = None,
+                 agg_notes: Optional[Dict] = None,
+                 tile_merge: Optional[Dict] = None):
         self.relations = relations
         self.aux_builders = aux_builders
         self.static_providers = static_providers
@@ -203,9 +218,19 @@ class CompiledPlan:
         self.out_scope = out_scope  # dict_provider read at assemble time
         self.is_aggregate = is_aggregate
         self.bind_checks = bind_checks or []
+        self.traced_pre = traced_pre
+        self.traced_main = traced_main
+        # trace-time notes per static key: chosen reduction strategies +
+        # fused dispatch count, surfaced as per-execution metrics
+        self.agg_notes = agg_notes
+        # partial-raw merge metadata: per-output merge ops + group-card
+        # check for the tiled scan's on-device partial merge
+        self.tile_merge = tile_merge
         self._jitted: Dict[tuple, Callable] = {}
+        self._jitted_pre: Dict[tuple, Callable] = {}
+        self._jitted_main: Dict[tuple, Callable] = {}
 
-    def execute(self, params: Tuple) -> Result:
+    def _bind(self, params: Tuple):
         from snappydata_tpu.observability.metrics import global_registry
 
         # one compiled dispatch is the atomic unit of work — the
@@ -222,12 +247,15 @@ class CompiledPlan:
             keep = r.keep_mask(dt, params)
             take_idx = None
             if keep is not None and not keep.all():
-                # batch skipping: gather only qualifying batches (padded to
-                # a pow2 bucket so executable shapes stay stable)
+                # batch skipping: gather only qualifying batches (padded
+                # to a {2^k, 1.5*2^k} bucket so executable shapes stay
+                # stable — same bucketing as the bind)
+                from snappydata_tpu.storage.device import batch_bucket
+
                 kept = np.flatnonzero(keep)
                 reg.inc("column_batches_skipped",
                         int(dt.num_batches - len(kept)))
-                b_new = max(1, 1 << (max(1, len(kept)) - 1).bit_length())
+                b_new = batch_bucket(len(kept))
                 pad_valid = np.zeros(b_new, dtype=bool)
                 pad_valid[:len(kept)] = True
                 idx = np.zeros(b_new, dtype=np.int64)
@@ -254,12 +282,61 @@ class CompiledPlan:
         aux = [jnp.asarray(b(params)) for b in self.aux_builders]
         static = tuple(p() for p in self.static_providers)
         pvals = tuple(_param_scalar(v) for v in params)
+        return tables, arrays, aux, static, pvals
 
-        fn = self._jitted.get(static)
-        if fn is None:
-            fn = jax.jit(functools.partial(self.traced, static))
-            self._jitted[static] = fn
-        outs = fn(tuple(arrays), tuple(aux), pvals)
+    def _run_device(self, params: Tuple):
+        """Bind + dispatch; returns (tables, outs) with outs still ON
+        DEVICE (async) — callers decide when/whether to transfer."""
+        from snappydata_tpu.observability.metrics import global_registry
+
+        reg = global_registry()
+        tables, arrays, aux, static, pvals = self._bind(params)
+        from snappydata_tpu.storage.device import scan_window_active
+
+        # tile windows rotate bind identity every tile — the split-phase
+        # cache could never hit and would churn LRU entries dashboards
+        # actually reuse, so windowed binds run the fused single phase
+        use_pre = self.traced_pre is not None \
+            and (config.global_properties().gidx_cache_bytes or 0) > 0 \
+            and not scan_window_active()
+        if use_pre:
+            try:
+                hash(params)
+                pkey = params
+            except TypeError:  # unhashable literal: skip caching
+                pkey = None
+        if use_pre and pkey is not None:
+            pre = _pre_cache_get(self, static, pkey, tables)
+            if pre is None:
+                reg.inc("gidx_cache_misses")
+                fnp = self._jitted_pre.get(static)
+                if fnp is None:
+                    fnp = jax.jit(functools.partial(self.traced_pre, static))
+                    self._jitted_pre[static] = fnp
+                pre = fnp(tuple(arrays), tuple(aux), pvals)
+                _pre_cache_put(self, static, pkey, tables, pre)
+            else:
+                reg.inc("gidx_cache_hits")
+            fn = self._jitted_main.get(static)
+            if fn is None:
+                fn = jax.jit(functools.partial(self.traced_main, static))
+                self._jitted_main[static] = fn
+            outs = fn(tuple(arrays), tuple(aux), pvals, pre)
+        else:
+            fn = self._jitted.get(static)
+            if fn is None:
+                fn = jax.jit(functools.partial(self.traced, static))
+                self._jitted[static] = fn
+            outs = fn(tuple(arrays), tuple(aux), pvals)
+        note = self.agg_notes.get(static) if self.agg_notes else None
+        if note is not None:
+            reg.inc("agg_reduce_passes", note["passes"])
+            for s in note["strategies"]:
+                reg.inc("agg_strategy_" + s)
+        return tables, outs
+
+    def execute(self, params: Tuple) -> Result:
+        tables, outs = self._run_device(params)
         # single bulk device→host transfer (per-array .asarray costs one
         # round trip each — painful over a remote/tunneled TPU link)
         outs = jax.device_get(outs)
@@ -269,6 +346,23 @@ class CompiledPlan:
                 "max_groups, or an exact-decimal sum at int64 risk): "
                 "host path")
         return self._assemble(outs, tables)
+
+    def execute_raw(self, params: Tuple):
+        """Run the compiled region and return (mask, pairs, overflow)
+        still on device — the tiled scan merges per-tile partials there
+        instead of round-tripping each tile through the host."""
+        _tables, outs = self._run_device(params)
+        return outs
+
+    def tile_merge_ok(self) -> bool:
+        """Bind-time check that a partial-raw compile's group-index space
+        is data-independent and small enough for aligned [G] merging."""
+        if not self.tile_merge:
+            return False
+        try:
+            return self.tile_merge["cards"]() <= self.tile_merge["max_groups"]
+        except CompileError:
+            return False
 
     def _assemble(self, outs, tables) -> Result:
         """Device outputs → host Result.
@@ -299,6 +393,115 @@ class CompiledPlan:
 
 def data_needs_mask(v, mask) -> bool:
     return int(np.prod(np.shape(v))) == mask.shape[0]
+
+
+# --- group-index (phase A) cache -----------------------------------------
+# Aggregate plans split into a cacheable prefix — validity mask, combined
+# group index, and (on the matmul strategy) the one-hot — and a main
+# phase.  Entries key on (plan identity, static sizes, params) and pin
+# the exact DeviceTable objects they were computed from: table mutation
+# rotates the device cache to new objects, which invalidates the entry
+# without any explicit version plumbing (tile windows and mesh
+# placements produce distinct DeviceTables too, so they can never alias).
+# LRU, byte-capped by properties.gidx_cache_bytes.
+
+_PRE_CACHE: "Dict[tuple, dict]" = {}
+_PRE_CACHE_BYTES = [0]
+# concurrent sessions (Flight server threads, jobserver workers) execute
+# compiled plans in parallel — every cache mutation holds this lock so
+# eviction races can't KeyError a query or corrupt the byte accounting
+_PRE_CACHE_LOCK = threading.Lock()
+
+
+def gidx_cache_nbytes() -> int:
+    """Bytes of device arrays pinned by the group-index cache — the
+    resource broker folds this into its unified device ledger."""
+    return int(_PRE_CACHE_BYTES[0])
+
+
+def _bind_identity(tables):
+    """Per-bind identity tokens: the `valid` arrays live in the device
+    cache's per-(version, window, mesh) entry and are REUSED across
+    binds while that snapshot is current — the DeviceTable wrapper
+    itself is rebuilt per bind, so it can't serve as the token.  A
+    mutation (or window/mesh change) rotates to fresh arrays, which
+    invalidates cache entries without explicit version plumbing."""
+    return [t.valid for t in tables]
+
+
+def _pre_cache_get(plan, static, pkey, tables):
+    key = (id(plan), static, pkey)
+    ident = _bind_identity(tables)
+    with _PRE_CACHE_LOCK:
+        entry = _PRE_CACHE.get(key)
+        if entry is None:
+            return None
+        if entry["plan"]() is not plan \
+                or len(entry["binds"]) != len(ident) \
+                or any(r() is not t
+                       for r, t in zip(entry["binds"], ident)):
+            _PRE_CACHE.pop(key, None)
+            _PRE_CACHE_BYTES[0] -= entry["nbytes"]
+            return None
+        entry["tick"] = _pre_cache_tick()
+        return entry["pre"]
+
+
+_pre_tick = [0]
+
+
+def _pre_cache_tick() -> int:
+    _pre_tick[0] += 1
+    return _pre_tick[0]
+
+
+def _pre_cache_put(plan, static, pkey, tables, pre) -> None:
+    import weakref
+
+    budget = int(config.global_properties().gidx_cache_bytes or 0)
+    nbytes = sum(int(getattr(a, "nbytes", 0))
+                 for a in jax.tree_util.tree_leaves(pre))
+    if nbytes > budget:
+        return  # one oversized entry would evict everything for nothing
+    binds = tuple(weakref.ref(t) for t in _bind_identity(tables))
+    with _PRE_CACHE_LOCK:
+        # entries of GC'd plans (plan-cache eviction, dropped sessions)
+        # or rotated binds (table mutated: old device arrays collected,
+        # and a changed-literal pkey means the stale key is never probed
+        # again) are dead weight until LRU pressure — purge them eagerly
+        for k in [k for k, e in _PRE_CACHE.items()
+                  if e["plan"]() is None
+                  or any(r() is None for r in e["binds"])]:
+            _PRE_CACHE_BYTES[0] -= _PRE_CACHE.pop(k)["nbytes"]
+        while _PRE_CACHE and _PRE_CACHE_BYTES[0] + nbytes > budget:
+            victim = min(_PRE_CACHE, key=lambda k: _PRE_CACHE[k]["tick"])
+            _PRE_CACHE_BYTES[0] -= _PRE_CACHE.pop(victim)["nbytes"]
+        old = _PRE_CACHE.pop((id(plan), static, pkey), None)
+        if old is not None:  # concurrent miss on one key: replace once
+            _PRE_CACHE_BYTES[0] -= old["nbytes"]
+        _PRE_CACHE[(id(plan), static, pkey)] = {
+            "plan": weakref.ref(plan), "binds": binds,
+            "pre": pre, "nbytes": nbytes, "tick": _pre_cache_tick()}
+        _PRE_CACHE_BYTES[0] += nbytes
+
+
+def clear_gidx_cache() -> None:
+    with _PRE_CACHE_LOCK:
+        _PRE_CACHE.clear()
+        _PRE_CACHE_BYTES[0] = 0
+
+
+# the single source of truth for strategy names lives in ops/reduction —
+# the token index mapping below must stay aligned with resolve_strategy
+from snappydata_tpu.ops.reduction import STRATEGIES as _STRATEGY_NAMES  # noqa: E402
+
+
+def _strategy_token(props) -> int:
+    """agg_reduce_strategy as a small int riding the compiled plan's
+    STATIC key — flipping the knob re-specializes instead of serving a
+    stale trace."""
+    s = str(props.get("agg_reduce_strategy", "auto") or "auto").lower()
+    return _STRATEGY_NAMES.index(s) if s in _STRATEGY_NAMES else 0
 
 
 def _row_count_of(info) -> int:
@@ -398,9 +601,14 @@ class Compiler:
     """Compiles one device region (Relation/Filter/Project/Join[/Aggregate
     root]) into a CompiledPlan."""
 
-    def __init__(self, catalog, props):
+    def __init__(self, catalog, props, partial_raw: bool = False):
         self.catalog = catalog
         self.props = props
+        # partial-raw mode (tiled scans): compile a partial-aggregate
+        # plan whose outputs stay mergeable [G] arrays — group cards are
+        # forced data-independent (nullable keys always get their NULL
+        # code slot) so every tile shares one aligned group-index space
+        self.partial_raw = partial_raw
         self.relations: List[_RelationInput] = []
         self.aux_builders: List[Callable] = []
         self.static_providers: List[Callable] = []
@@ -427,7 +635,7 @@ class Compiler:
 
         n_rel = len(self.relations)
 
-        def traced(static, arrays, aux, params):
+        def make_ctx(static, arrays, aux, params) -> "_TraceCtx":
             # unpack per-relation arrays
             rel_runtimes = []
             pos = 0
@@ -442,16 +650,58 @@ class Compiler:
                 valid = arrays[pos]
                 pos += 1
                 rel_runtimes.append((cols, valid))
-            rt = _TraceCtx(rel_runtimes, aux, params, static)
-            out = emitter(rt)
-            return out
+            return _TraceCtx(rel_runtimes, aux, params, static)
+
+        def traced(static, arrays, aux, params):
+            return emitter(make_ctx(static, arrays, aux, params))
+
+        traced_pre = traced_main = None
+        pre_emit = getattr(self, "_agg_pre_emit", None)
+        if pre_emit is not None and not self.partial_raw \
+                and self._pre_cacheable(plan):
+            main_emit = self._agg_main_emit
+
+            def traced_pre(static, arrays, aux, params):
+                return pre_emit(make_ctx(static, arrays, aux, params))
+
+            def traced_main(static, arrays, aux, params, pre):
+                return main_emit(make_ctx(static, arrays, aux, params), pre)
 
         out_scope = [oc if isinstance(oc, _ScopeCol)
                      else _ScopeCol(oc.name, oc.dtype, oc.dict_provider)
                      for oc in out_cols]
         return CompiledPlan(self.relations, self.aux_builders,
                             self.static_providers, traced, out_scope, is_agg,
-                            self.bind_checks)
+                            self.bind_checks,
+                            traced_pre=traced_pre, traced_main=traced_main,
+                            agg_notes=getattr(self, "_agg_notes", None),
+                            tile_merge=getattr(self, "_tile_merge", None))
+
+    def _pre_cacheable(self, plan: ast.Plan) -> bool:
+        """Is the aggregate's prefix (valid + gidx) safe and worthwhile
+        to cache?  Requires GROUP BY (a global aggregate's gidx is
+        trivial), a single relation (no join for phase B to re-run), and
+        no user-defined functions (device-lowered builtins are all
+        deterministic; UDF determinism is unknowable)."""
+        if not isinstance(plan, ast.Aggregate) or not plan.group_exprs:
+            return False
+        if len(self.relations) != 1:
+            return False
+        udfs = getattr(self.catalog, "_functions", None) or {}
+        if udfs:
+            names = {n.lower() for n in udfs}
+
+            def any_udf(p) -> bool:
+                for e in ast.plan_exprs(p):
+                    for sub in ast.walk(e):
+                        if isinstance(sub, ast.Func) \
+                                and sub.name.lower() in names:
+                            return True
+                return any(any_udf(k) for k in p.children())
+
+            if any_udf(plan):
+                return False
+        return True
 
     # -- node emitters -----------------------------------------------------
 
@@ -1147,6 +1397,27 @@ class Compiler:
                 key_infos.append(("generic", None, None))
 
         max_groups = props.max_groups
+        partial_raw = self.partial_raw
+
+        # Direct-column keys + forced NULL extension: in partial-raw mode
+        # a nullable base-column key claims its extra NULL code slot even
+        # when the bound plate happens to carry no null mask — whether a
+        # window of the table contains NULLs is data-dependent, and the
+        # tiled merge needs every tile to agree on the group-index space.
+        key_direct: List[bool] = []
+        key_force_null: List[bool] = []
+        for g in groups:
+            base = g.child if isinstance(g, ast.Alias) else g
+            direct = isinstance(base, ast.Col) and base.index is not None
+            key_direct.append(direct)
+            key_force_null.append(bool(partial_raw and direct
+                                       and scope[base.index].nullable))
+
+        # reduction-strategy knob rides the static key: flipping
+        # agg_reduce_strategy re-specializes the executable, no plan
+        # cache flush needed
+        strategy_si = self._add_static(lambda p=props: _strategy_token(p))
+        notes = self._agg_notes = {}
 
         # post-aggregation expression evaluation over [G] arrays
         out_types = [expr_type(e) for e in plan.agg_exprs]
@@ -1189,147 +1460,270 @@ class Compiler:
                 provider = key_infos[e_rw.key][2]
             out_cols.append(OutCol(_expr_name(e_out), dt, provider))
 
-        def run_agg(ctx) -> tuple:
+        # partial-raw merge metadata: one merge op per output column so
+        # the tiled scan can fold per-tile [G] partials on device.  Only
+        # sound when every output is a bare key/slot ref and every key is
+        # a direct dict/bool column — data-independent cards mean every
+        # tile shares one aligned group-index space.
+        if partial_raw:
+            tags: List[tuple] = []
+            merge_ok = True
+            for e_rw in select_rewritten:
+                if isinstance(e_rw, _KeyRef):
+                    tags.append(("key", e_rw.key))
+                elif isinstance(e_rw, _SlotRef):
+                    op = {"count": "sum", "sum": "sum", "sumsq": "sum",
+                          "min": "min", "max": "max"}.get(
+                              slots[e_rw.slot][0])
+                    if op is None:
+                        merge_ok = False
+                    tags.append(("slot", op))
+                else:
+                    merge_ok = False
+            for ki, (kind, _si, _prov) in enumerate(key_infos):
+                if kind == "generic" or not key_direct[ki]:
+                    merge_ok = False
+            if merge_ok:
+                def _cards_total(_infos=list(key_infos),
+                                 _force=list(key_force_null)) -> int:
+                    total = 1
+                    for (kind, _si, prov), force in zip(_infos, _force):
+                        card = 2 if kind == "bool" \
+                            else _padded_size(len(prov()))
+                        total *= card + (1 if force else 0)
+                    return total
+
+                self._tile_merge = {"tags": tags, "cards": _cards_total,
+                                    "max_groups": max_groups}
+
+        def shape_info(ctx, kdvals, n):
+            """Static group-shape decision shared by both phases:
+            (fast, cards, eff_cards, num_groups)."""
+            cards = []
+            fast = True
+            for (kind, si, _), kd in zip(key_infos, kdvals):
+                if kind == "dict":
+                    cards.append(ctx.static[si])
+                elif kind == "bool":
+                    cards.append(2)
+                else:
+                    fast = False
+                    cards.append(None)
+            # NULL group keys form their own group (SQL semantics): a
+            # nullable key gets one extra code slot = card, claimed by
+            # rows whose key is NULL (partial-raw forces the slot for
+            # nullable base columns — see key_force_null)
+            eff_cards = [c + 1 if c is not None
+                         and (kd.null is not None or force) else c
+                         for c, kd, force in zip(cards, kdvals,
+                                                 key_force_null)]
+            if fast and int(np.prod(eff_cards)) <= max_groups:
+                num_groups = int(np.prod(eff_cards))
+            else:
+                fast = False
+                # bound segments by the (static) padded row count: a
+                # table smaller than max_groups can never overflow
+                num_groups = min(max_groups, n)
+            return fast, cards, eff_cards, num_groups
+
+        def compute_pre(ctx, rt, out, valid):
+            """Combined group index + overflow flag — the cacheable
+            prefix of every grouped aggregate."""
+            n = valid.shape[0]
+            overflow = jnp.asarray(False)
+            if not groups:
+                return jnp.where(valid, 0, 1).astype(jnp.int32), overflow
+            kdvals = [kr(rt) for kr in key_runs]
+            fast, cards, eff_cards, num_groups = shape_info(ctx, kdvals, n)
+            if fast:
+                gidx = jnp.zeros(n, dtype=jnp.int64)
+                for kd, card, ecard in zip(kdvals, cards, eff_cards):
+                    kv = _broadcast_to_mask(kd.value, out.valid) \
+                        .reshape(-1).astype(jnp.int64)
+                    if kd.null is not None:
+                        nb = _broadcast_to_mask(kd.null, out.valid) \
+                            .reshape(-1)
+                        kv = jnp.where(nb, card, kv)
+                    gidx = gidx * ecard + kv
+            else:
+                combined = _combine_keys(
+                    [DVal(_broadcast_to_mask(k.value, out.valid)
+                          .reshape(-1),
+                          _broadcast_to_mask(k.null, out.valid)
+                          .reshape(-1) if k.null is not None else None,
+                          k.dtype) for k in kdvals])
+                combined = jnp.where(valid, combined, _I64_MAX)
+                uniq = jnp.unique(combined, size=num_groups + 1,
+                                  fill_value=_I64_MAX)
+                # overflow ⟺ the sentinel got pushed out of the
+                # (size num_groups+1) unique set ⟺ > num_groups real
+                # keys — silent truncation would return WRONG results,
+                # so the executor reruns on the exact host path
+                if num_groups < n:
+                    overflow = uniq[-1] != _I64_MAX
+                gidx = jnp.searchsorted(uniq, combined)
+            # int32 group index: num_groups <= max_groups (65536) always
+            # fits, and it halves the cached-gidx bytes + one-hot
+            # comparison traffic
+            return (jnp.where(valid, gidx, num_groups)
+                    .astype(jnp.int32), overflow)
+
+        def fsum_strategy_of(ctx, n, nseg):
+            from snappydata_tpu.ops import reduction
+
+            return reduction.resolve_strategy(
+                _STRATEGY_NAMES[ctx.static[strategy_si]],
+                jax.default_backend(), nseg, n, "fsum", jnp.float64)
+
+        def run_pre(ctx):
+            """Phase A: (valid, gidx, onehot-or-None, overflow) — the
+            group-index-cache entry."""
+            from snappydata_tpu.ops import reduction
+
             out = child(ctx)
             rt = Runtime(out.cols, ctx.params, ctx.aux_slice(builder))
             valid = out.valid.reshape(-1)
+            gidx, overflow = compute_pre(ctx, rt, out, valid)
             n = valid.shape[0]
-
-            # --- group index ---
-            overflow = jnp.asarray(False)
-            if not groups:
-                gidx = jnp.zeros(n, dtype=jnp.int32)
-                num_groups = 1
-                key_vals: List[DVal] = []
-                fast = True
-            else:
+            if groups:
                 kdvals = [kr(rt) for kr in key_runs]
-                cards = []
-                fast = True
-                for (kind, si, _), kd in zip(key_infos, kdvals):
-                    if kind == "dict":
-                        cards.append(ctx.static[si])
-                    elif kind == "bool":
-                        cards.append(2)
-                    else:
-                        fast = False
-                        cards.append(None)
-                # NULL group keys form their own group (SQL semantics):
-                # a nullable key gets one extra code slot = card, claimed
-                # by rows whose key is NULL
-                eff_cards = [c + 1 if c is not None and kd.null is not None
-                             else c for c, kd in zip(cards, kdvals)]
-                if fast and int(np.prod(eff_cards)) <= max_groups:
-                    num_groups = int(np.prod(eff_cards))
-                    gidx = jnp.zeros(n, dtype=jnp.int64)
-                    for kd, card, ecard in zip(kdvals, cards, eff_cards):
-                        kv = _broadcast_to_mask(kd.value, out.valid) \
-                            .reshape(-1).astype(jnp.int64)
-                        if kd.null is not None:
-                            nb = _broadcast_to_mask(kd.null, out.valid) \
-                                .reshape(-1)
-                            kv = jnp.where(nb, card, kv)
-                        gidx = gidx * ecard + kv
-                    key_vals = kdvals
-                else:
-                    fast = False
-                    # bound segments by the (static) padded row count: a
-                    # table smaller than max_groups can never overflow
-                    num_groups = min(max_groups, n)
-                    combined = _combine_keys(
-                        [DVal(_broadcast_to_mask(k.value, out.valid)
-                              .reshape(-1),
-                              _broadcast_to_mask(k.null, out.valid)
-                              .reshape(-1) if k.null is not None else None,
-                              k.dtype) for k in kdvals])
-                    combined = jnp.where(valid, combined, _I64_MAX)
-                    uniq = jnp.unique(combined, size=num_groups + 1,
-                                      fill_value=_I64_MAX)
-                    # overflow ⟺ the sentinel got pushed out of the
-                    # (size num_groups+1) unique set ⟺ > num_groups real
-                    # keys — silent truncation would return WRONG results,
-                    # so the executor reruns on the exact host path
-                    if num_groups < n:
-                        overflow = uniq[-1] != _I64_MAX
-                    gidx = jnp.searchsorted(uniq, combined)
-                    key_vals = kdvals
-            gidx = jnp.where(valid, gidx, num_groups)
+                num_groups = shape_info(ctx, kdvals, n)[3]
+            else:
+                num_groups = 1
+            onehot = None
+            if fsum_strategy_of(ctx, n, num_groups) == "matmul":
+                # one-hot over the REAL groups only: an invalid row's
+                # one-hot row is all-zero, so it contributes nothing —
+                # the overflow segment is never consumed downstream
+                onehot = reduction.make_onehot(gidx, num_groups,
+                                               jnp.float64)
+            return valid, gidx, onehot, overflow
 
-            seg = functools.partial(_seg_reduce, gidx=gidx,
-                                    num_segments=num_groups + 1)
+        def run_main(ctx, pre=None) -> tuple:
+            from snappydata_tpu.ops import reduction
+
+            out = child(ctx)
+            rt = Runtime(out.cols, ctx.params, ctx.aux_slice(builder))
+            if pre is None:
+                valid = out.valid.reshape(-1)
+                gidx, overflow = compute_pre(ctx, rt, out, valid)
+                onehot = None
+            else:
+                # phase A's cached prefix: XLA DCEs the re-emitted filter
+                # predicate and key-combination math this phase skips
+                valid, gidx, onehot, overflow = pre
+            n = valid.shape[0]
+            if groups:
+                kdvals = [kr(rt) for kr in key_runs]
+                fast, cards, eff_cards, num_groups = shape_info(
+                    ctx, kdvals, n)
+                key_vals = kdvals
+            else:
+                fast, cards, eff_cards, num_groups = True, [], [], 1
+                key_vals: List[DVal] = []
+            nseg = num_groups + 1
+            backend = jax.default_backend()
+            req = _STRATEGY_NAMES[ctx.static[strategy_si]]
+            fsum_strat = fsum_strategy_of(ctx, n, num_groups)
+            if pre is None and fsum_strat == "matmul":
+                onehot = reduction.make_onehot(gidx, num_groups,
+                                               jnp.float64)
+            # accumulated during tracing, PUBLISHED (frozen) at the end
+            # of this function — a concurrent execution of the same
+            # plan must never iterate a set another thread's in-flight
+            # trace is still mutating
+            note = {"passes": 0, "strategies": set()}
 
             # --- slots ---
-            # Fused Pallas grouped path (the Q1 shape): dictionary/bool
-            # fast-path group index, G <= 64, f32 value plates. All
-            # eligible slots share ONE streaming VMEM pass with
-            # per-group per-lane Kahan partials (ops/pallas_group.py)
-            # instead of per-slot emulated-f64 segment reductions.
-            # Ineligible slots (int sums, sumsq, count_distinct, f64
-            # plates) keep the _seg_reduce path slot by slot.
-            use_pg = bool(groups) and fast \
-                and num_groups + 1 <= _pg.MAX_GROUPS \
-                and config.global_properties().pallas_group_reduce
-            # VMEM budget: stop fusing before a wide aggregate would
-            # fail the Mosaic compile; unfused slots keep _seg_reduce.
-            # The base reserves the gidx block plus the shared gvalid
-            # count op appended below.
-            pg_bytes = _pg.base_vmem_bytes() \
-                + _pg.op_vmem_bytes("count", num_groups + 1)
-            pg_masks = {id(valid)}  # the gvalid count op's mask
-            pg_vals: set = set()
-            # slots over the SAME argument expression (sum(x)+min(x),
-            # or avg's sum+count beside an explicit sum) must hand
-            # grouped_reduce the same array OBJECTS — its dedup is
-            # id()-keyed, and each slot's emit produces fresh traced
-            # arrays (review finding: the value dedup never fired)
-            pg_vw: Dict[object, tuple] = {}
-            fused = []  # (slot_idx, kind, values|None, mask)
-
-            def try_fuse(kind, v, w):
-                nonlocal pg_bytes
-                # grouped_reduce dedups inputs by identity: shared
-                # mask/value blocks (Q1: every slot shares the mask)
-                # cost their VMEM once
-                cost = _pg.op_vmem_bytes(
-                    kind, num_groups + 1,
-                    shared_mask=id(w) in pg_masks,
-                    shared_value=v is not None and id(v) in pg_vals)
-                if pg_bytes + cost > _pg.VMEM_BUDGET:
-                    return False
-                pg_bytes += cost
-                pg_masks.add(id(w))
-                if v is not None:
-                    pg_vals.add(id(v))
-                fused.append((len(slot_arrays), kind, v, w))
-                slot_arrays.append(None)
-                return True
-
-            slot_arrays = []
+            # Evaluate slot inputs once, dedup by argument expression:
+            # slots over the SAME argument (sum(x)+min(x), avg's
+            # sum+count beside an explicit sum) share array OBJECTS, so
+            # the pallas kernel's id()-keyed input dedup fires and count
+            # columns over one mask collapse to a single packed column.
+            evaluated: List[tuple] = []
+            arg_vw: Dict[object, tuple] = {}
             for (kind, arg), run in zip(slots, slot_arg_runs):
                 if run is None:  # count(*)
-                    if use_pg and try_fuse("count", None, valid):
-                        continue
-                    slot_arrays.append(seg("count", valid))
+                    evaluated.append(("count", None, valid, None, False))
                     continue
-                dv = run(rt)
-                v = _broadcast_to_mask(dv.value, out.valid).reshape(-1)
-                w = valid
-                if dv.null is not None:
-                    w = w & ~_broadcast_to_mask(dv.null, out.valid).reshape(-1)
-                if use_pg and (
-                        kind == "count"
-                        or (kind in ("sum", "min", "max")
-                            and v.dtype == jnp.float32)):
-                    hit = pg_vw.get(arg)
-                    if hit is not None:
-                        v, w = hit
-                    else:
-                        pg_vw[arg] = (v, w)
-                    if try_fuse(kind,
-                                None if kind == "count" else v, w):
+                hit = arg_vw.get(arg)
+                if hit is None:
+                    dv = run(rt)
+                    v = _broadcast_to_mask(dv.value, out.valid).reshape(-1)
+                    w = valid
+                    if dv.null is not None:
+                        w = w & ~_broadcast_to_mask(
+                            dv.null, out.valid).reshape(-1)
+                    # bare stored columns are finite on excluded/padded
+                    # rows (zero-initialized plates); computed
+                    # expressions can be Inf/NaN exactly where the
+                    # filter excluded them (sum(a/b) WHERE b <> 0), so
+                    # only bare columns may skip the matmul pre-mask
+                    hit = arg_vw[arg] = (v, w, dv.dtype,
+                                         isinstance(arg, ast.Col))
+                evaluated.append((kind,) + hit)
+
+            # Fused Pallas grouped path (the Q1 shape on TPU):
+            # dictionary/bool fast-path group index, G <= 64, f32 value
+            # plates — eligible slots share ONE streaming VMEM pass with
+            # per-group per-lane Kahan partials (ops/pallas_group.py).
+            # The VMEM budget stops fusing before a wide aggregate would
+            # fail the Mosaic compile; overflow slots take the packed
+            # families below.
+            use_pg = bool(groups) and fast and nseg <= _pg.MAX_GROUPS \
+                and config.global_properties().pallas_group_reduce
+            pg_bytes = _pg.base_vmem_bytes() \
+                + _pg.op_vmem_bytes("count", nseg)
+            pg_masks = {id(valid)}  # the gvalid count op's mask
+            pg_vals: set = set()
+            fused = []  # (slot_idx, kind, values|None, mask)
+            fused_idx: set = set()
+            if use_pg:
+                for i, (kind, v, w, sdt, _raw) in enumerate(evaluated):
+                    eligible = kind == "count" or (
+                        kind in ("sum", "min", "max") and v is not None
+                        and v.dtype == jnp.float32)
+                    if not eligible:
                         continue
+                    pv = None if kind == "count" else v
+                    cost = _pg.op_vmem_bytes(
+                        kind, nseg, shared_mask=id(w) in pg_masks,
+                        shared_value=pv is not None and id(pv) in pg_vals)
+                    if pg_bytes + cost > _pg.VMEM_BUDGET:
+                        continue
+                    pg_bytes += cost
+                    pg_masks.add(id(w))
+                    if pv is not None:
+                        pg_vals.add(id(pv))
+                    fused.append((i, kind, pv, w))
+                    fused_idx.add(i)
+
+            # Packed accumulator families: every remaining slot joins one
+            # [N, S] matrix per family and the family reduces in ONE
+            # fused dispatch (ops/reduction.py strategy table) — the old
+            # path issued one masked reduction per group per slot.
+            slot_arrays: List = [None] * len(slots)
+            fsum_cols: List[tuple] = []     # (slot idx, f64 contrib)
+            count_ws: List = []             # unique count masks
+            count_of: Dict[int, int] = {}   # id(mask) -> column
+            count_users: List[tuple] = []   # (slot idx, column)
+            isum_cols: List[tuple] = []     # (slot idx, int64 contrib)
+            minmax: Dict[tuple, list] = {}  # (kind, dtype) -> entries
+            guards: List[dict] = []         # decimal int64 bound checks
+
+            def count_col(w) -> int:
+                c = count_of.get(id(w))
+                if c is None:
+                    c = len(count_ws)
+                    count_ws.append(w)
+                    count_of[id(w)] = c
+                return c
+
+            for i, (kind, v, w, sdt, raw_col) in enumerate(evaluated):
+                if i in fused_idx:
+                    continue
                 if kind == "count":
-                    slot_arrays.append(seg("count", w))
+                    count_users.append((i, count_col(w)))
                 elif kind == "count_distinct":
                     # exact: sort (group, value-bits) pairs, count group
                     # boundaries where the value changes (sort-based
@@ -1342,75 +1736,154 @@ class Compiler:
                     new = jnp.ones_like(g_s, dtype=bool)
                     new = new.at[1:].set((g_s[1:] != g_s[:-1])
                                          | (v_s[1:] != v_s[:-1]))
-                    slot_arrays.append(jax.ops.segment_sum(
-                        new.astype(jnp.int64), g_s,
-                        num_segments=num_groups + 1))
+                    slot_arrays[i] = jax.ops.segment_sum(
+                        new.astype(jnp.int64), g_s, num_segments=nseg)
+                    note["passes"] += 1
                 elif kind == "sum":
                     if (not groups and v.dtype == jnp.float32
                             and config.global_properties().pallas_reduce):
                         # global f32 sum via the Pallas Kahan kernel:
                         # one compensated-f32 pass instead of the
-                        # emulated-f64 reduction. f32 inputs ONLY — the
-                        # TPU storage contract already keeps DOUBLE as
-                        # f32 plates, so nothing extra is truncated;
-                        # f64 plates (CPU policy) keep the exact path
-                        # (ops/pallas_reduce.py, incl. the cancellation
-                        # caveat)
+                        # emulated-f64 reduction (ops/pallas_reduce.py,
+                        # incl. the cancellation caveat)
                         from snappydata_tpu.ops.pallas_reduce import \
                             masked_kahan_sum
 
                         total = masked_kahan_sum(v, w)
-                        slot_arrays.append(jnp.stack(
-                            [total, jnp.zeros((), total.dtype)]))
-                    else:
-                        acc_dt = _acc_dtype(dv.dtype,
-                                            jnp.asarray(v).dtype)
-                        acc = v.astype(acc_dt)
-                        if acc_dt == jnp.int64 and dv.dtype is not None \
-                                and dv.dtype.name == "decimal":
+                        slot_arrays[i] = jnp.stack(
+                            [total, jnp.zeros((), total.dtype)])
+                        note["passes"] += 1
+                        note["strategies"].add("pallas")
+                        continue
+                    acc_dt = _acc_dtype(sdt, jnp.asarray(v).dtype)
+                    acc = v.astype(acc_dt)
+                    if acc_dt == jnp.int64:
+                        if sdt is not None and sdt.name == "decimal":
                             # exact scaled-int decimal sum: a group
-                            # total CAN exceed int64 (p=18, ~1e18 rows'
-                            # headroom notwithstanding) — bound-check
-                            # max|v| * count and reroute to the host
-                            # path instead of wrapping silently. The
-                            # tile scale extends the bound to the
-                            # merged total of a scan_tile_bytes pass:
-                            # if every tile keeps absmax·count·T below
-                            # 2^62 then |Σ tiles| < 2^62 too.
-                            absmax = seg("max",
-                                         jnp.where(w, jnp.abs(acc), 0))
-                            cnt_w = seg("count", w)
-                            tscale = jnp.asarray(
-                                ctx.aux[tile_scale_aux], jnp.float64)
-                            overflow = overflow | jnp.any(
-                                absmax.astype(jnp.float64)
-                                * cnt_w.astype(jnp.float64)
-                                * tscale >= 2.0 ** 62)
-                        slot_arrays.append(
-                            seg("sum", jnp.where(w, acc, 0)))
+                            # total CAN exceed int64 — bound-check
+                            # max|v| * count (scaled by the tile count
+                            # so a scan_tile_bytes pass bounds the
+                            # MERGED total) and reroute to the host
+                            # path instead of wrapping silently.  The
+                            # absmax rides the minmax family with the
+                            # int64-min filler: an all-masked group has
+                            # count 0, so filler * 0 never trips the
+                            # bound.
+                            tag = ("guard", len(guards))
+                            minmax.setdefault(("max", "int64"), []) \
+                                .append((tag, jnp.where(
+                                    w, jnp.abs(acc),
+                                    jnp.iinfo(jnp.int64).min)))
+                            guards.append({"absmax": tag,
+                                           "cnt": count_col(w)})
+                        isum_cols.append(
+                            (i, jnp.where(w, acc, jnp.int64(0))))
+                    elif fsum_strat == "matmul" and w is valid \
+                            and raw_col:
+                        # bare non-null column: an invalid row's one-hot
+                        # row is all-zero and its plate value is finite,
+                        # so the select pass is pure overhead
+                        # (packed_sum's finite-guard still covers NaN
+                        # DATA, falling back to the isolating scatter)
+                        fsum_cols.append((i, acc))
+                    else:
+                        fsum_cols.append((i, jnp.where(w, acc, 0.0)))
                 elif kind == "sumsq":
                     acc = v.astype(_acc_dtype(T.DOUBLE))
-                    slot_arrays.append(seg("sum", jnp.where(w, acc * acc, 0)))
-                elif kind == "min":
-                    big = _extreme(v.dtype, True)
-                    slot_arrays.append(seg("min", jnp.where(w, v, big)))
-                elif kind == "max":
-                    small = _extreme(v.dtype, False)
-                    slot_arrays.append(seg("max", jnp.where(w, v, small)))
+                    fsum_cols.append((i, jnp.where(w, acc * acc, 0.0)))
+                elif kind in ("min", "max"):
+                    fill = _extreme(v.dtype, kind == "min")
+                    minmax.setdefault(
+                        (kind, jnp.asarray(v).dtype.name), []).append(
+                        (("slot", i), jnp.where(w, v, fill)))
                 else:
                     raise CompileError(kind)
+
+            if not fused:
+                # the gvalid count joins the count family (and dedups
+                # with any count slot over the plain validity mask)
+                gvalid_col = count_col(valid)
+
+            # --- family dispatch: one fused reduction each ---
+            count_res = None
+            join_counts = bool(count_ws) and fsum_strat == "matmul"
+            if fsum_cols or join_counts:
+                cols = [c for _, c in fsum_cols]
+                if join_counts:
+                    # counts ride the f64 matmul pack as 0/1 columns —
+                    # exact below 2**53 rows, and an invalid row's
+                    # one-hot row is all-zero, so the plain-validity
+                    # count is literally a ones column
+                    for w in count_ws:
+                        cols.append(jnp.ones(n, jnp.float64) if w is valid
+                                    else jnp.where(w, 1.0, 0.0))
+                res = reduction.packed_sum(cols, gidx, num_groups,
+                                           fsum_strat, onehot=onehot)
+                note["passes"] += 1
+                note["strategies"].add(fsum_strat)
+                for pos, (i, _) in enumerate(fsum_cols):
+                    slot_arrays[i] = res[:, pos]
+                if join_counts:
+                    count_res = jnp.round(
+                        res[:, len(fsum_cols):]).astype(jnp.int64)
+            if count_ws and count_res is None:
+                cdt = reduction.count_pack_dtype(n)
+                # counts follow the float family's strategy (matmul was
+                # handled by joining above): on the unroll path that
+                # keeps the old fast int32 masked sums, on scatter one
+                # int pass — both exact under the bound-checked dtype
+                count_res = reduction.packed_sum(
+                    [w.astype(cdt) for w in count_ws], gidx, num_groups,
+                    fsum_strat).astype(jnp.int64)
+                note["passes"] += 1
+                note["strategies"].add(fsum_strat)
+            for i, c in count_users:
+                slot_arrays[i] = count_res[:, c]
+            if isum_cols:
+                istrat = reduction.resolve_strategy(
+                    req, backend, num_groups, n, "isum", jnp.int64)
+                ires = reduction.packed_sum(
+                    [c for _, c in isum_cols], gidx, num_groups, istrat)
+                note["passes"] += 1
+                note["strategies"].add(istrat)
+                for pos, (i, _) in enumerate(isum_cols):
+                    slot_arrays[i] = ires[:, pos]
+            guard_res: Dict[tuple, object] = {}
+            for (mkind, _dtname), entries in minmax.items():
+                mcols = [c for _, c in entries]
+                mstrat = reduction.resolve_strategy(
+                    req, backend, num_groups, n, "minmax",
+                    mcols[0].dtype)
+                mres = reduction.packed_minmax(mkind, mcols, gidx,
+                                               num_groups, mstrat)
+                note["passes"] += 1
+                note["strategies"].add(mstrat)
+                for pos, (tag, _) in enumerate(entries):
+                    if tag[0] == "slot":
+                        slot_arrays[tag[1]] = mres[:, pos]
+                    else:
+                        guard_res[tag] = mres[:, pos]
+            for g in guards:
+                absmax = guard_res[g["absmax"]]
+                cnt_w = count_res[:, g["cnt"]]
+                tscale = jnp.asarray(ctx.aux[tile_scale_aux], jnp.float64)
+                overflow = overflow | jnp.any(
+                    absmax.astype(jnp.float64)
+                    * cnt_w.astype(jnp.float64) * tscale >= 2.0 ** 62)
 
             if fused:
                 # the gvalid count rides the same streaming pass (its
                 # VMEM share is reserved in pg_bytes' base above)
                 ops = [(k, v, w) for _, k, v, w in fused]
                 ops.append(("count", None, valid))
-                pg_out = _pg.grouped_reduce(ops, gidx, num_groups + 1)
+                pg_out = _pg.grouped_reduce(ops, gidx, nseg)
                 for (i, _, _, _), r in zip(fused, pg_out[:-1]):
                     slot_arrays[i] = r
                 counts = pg_out[-1]
+                note["passes"] += 1
+                note["strategies"].add("pallas")
             else:
-                counts = seg("count", valid)
+                counts = count_res[:, gvalid_col]
             if groups:
                 gvalid = counts[:num_groups] > 0
             else:
@@ -1477,7 +1950,15 @@ class Compiler:
             for run, dt in zip(post_runs, out_types):
                 dv = run(post_rt)
                 pairs.append((dv.value, dv.null))
+            notes[ctx.static] = {"passes": note["passes"],
+                                 "strategies": frozenset(note["strategies"])}
             return gvalid, tuple(pairs), overflow
+
+        self._agg_pre_emit = run_pre
+        self._agg_main_emit = run_main
+
+        def run_agg(ctx) -> tuple:
+            return run_main(ctx, None)
 
         return run_agg, out_cols
 
@@ -1646,46 +2127,30 @@ def _padded_size(n: int) -> int:
     return 1 << max(0, (max(1, n) - 1).bit_length())
 
 
-_UNROLL_SEGMENTS = 64
+# The per-slot `_seg_reduce` (one masked reduction per group per slot)
+# was replaced by the packed per-family fused reductions in
+# ops/reduction.py — see Compiler._emit_aggregate's family dispatch.
 
 
-def _seg_reduce(kind: str, values, gidx, num_segments: int):
-    """Segmented reduction tuned for TPU.
-
-    XLA lowers scatter-adds serially on TPU — measured on v5e: a 12M-row
-    int64 segment_sum costs ~700ms and f32 ~100ms, while G unrolled masked
-    reductions or a one-hot matmul are at the dispatch floor. So:
-    - G ≤ 64 (the dictionary fast path, ref SnappyHashAggregateExec
-      dictionary keys): unrolled masked reductions, counts in int32.
-    - larger G (generic hash-grouping): one-hot matmul in f32 for sums
-      (MXU), scatter only where unavoidable (int sums / min / max).
-    """
-    if kind == "count":
-        ones = values.astype(jnp.int32)
-        if num_segments <= _UNROLL_SEGMENTS:
-            out = jnp.stack([jnp.sum(jnp.where(gidx == k, ones, 0))
-                             for k in range(num_segments)])
-        else:
-            # int32 scatter: exact, and ~7x cheaper than int64 scatter
-            out = jax.ops.segment_sum(ones, gidx, num_segments=num_segments)
-        return out.astype(jnp.int64)
-    if kind == "sum":
-        if num_segments <= _UNROLL_SEGMENTS:
-            return jnp.stack([jnp.sum(jnp.where(gidx == k, values, 0))
-                              for k in range(num_segments)])
-        # generic path: scatter-add. f32 scatter is ~9x cheaper than int64
-        # on TPU but int64 stays exact — keep exactness for integer sums.
-        # NEVER one-hot here: [N, G] materialization explodes at large G.
-        return jax.ops.segment_sum(values, gidx, num_segments=num_segments)
-    if kind in ("min", "max"):
-        fn = jnp.min if kind == "min" else jnp.max
-        if num_segments <= _UNROLL_SEGMENTS:
-            filler = _extreme(values.dtype, kind == "min")
-            return jnp.stack([fn(jnp.where(gidx == k, values, filler))
-                              for k in range(num_segments)])
-        seg_fn = jax.ops.segment_min if kind == "min" else jax.ops.segment_max
-        return seg_fn(values, gidx, num_segments=num_segments)
-    raise CompileError(kind)
+def merge_tile_outs(a, b, tags):
+    """Elementwise on-device merge of two raw (mask, pairs, overflow)
+    partial outputs over one ALIGNED group-index space (partial-raw
+    compiles force data-independent cards, so slot i of tile A and tile
+    B describe the same group).  Keys are decoded from the group index —
+    identical across tiles — so either side's array serves; sum slots
+    add (0 identity), min/max fold through their +/-inf fillers; the
+    masks and overflow flags OR."""
+    pairs = []
+    for (va, na), (vb, _nb), tag in zip(a[1], b[1], tags):
+        if tag[0] == "key":
+            pairs.append((va, na))
+        elif tag[1] == "min":
+            pairs.append((jnp.minimum(va, vb), None))
+        elif tag[1] == "max":
+            pairs.append((jnp.maximum(va, vb), None))
+        else:  # sum (covers counts and sumsq)
+            pairs.append((va + vb, None))
+    return (a[0] | b[0], tuple(pairs), a[2] | b[2])
 
 
 def _acc_dtype(dt: Optional[T.DataType], value_dtype=None):
@@ -1713,10 +2178,13 @@ def _acc_dtype(dt: Optional[T.DataType], value_dtype=None):
 
 
 def _extreme(np_dtype, positive: bool):
-    if jnp.issubdtype(np_dtype, jnp.floating):
-        return jnp.inf if positive else -jnp.inf
-    info = jnp.iinfo(np_dtype)
-    return info.max if positive else info.min
+    """Identity filler for min/max — delegates to ops/reduction so the
+    packed kernels and the executor's pack/key-decode fillers can never
+    drift apart (empty-group results must stay bit-identical across
+    strategies)."""
+    from snappydata_tpu.ops.reduction import _extreme_of
+
+    return _extreme_of(np_dtype, positive)
 
 
 def _key_bits(v):
@@ -1962,6 +2430,30 @@ class Executor:
 
     def clear_cache(self):
         self._plan_cache.clear()
+        clear_gidx_cache()
+
+    def compiled_partial(self, node: ast.Plan) -> Optional[CompiledPlan]:
+        """Compile an analyzed/tokenized partial-aggregate plan in
+        partial-raw mode for the tiled scan's on-device merge.  Plan-
+        cache aware (negative results cached too); None when the device
+        region can't lower it — the caller keeps the host-merge path."""
+        from snappydata_tpu.observability.metrics import global_registry
+
+        key = ("__partial_raw__", _plan_key(node, self.catalog),
+               self.catalog.generation)
+        hit = self._plan_cache.get(key)
+        if hit is None:
+            reg = global_registry()
+            try:
+                with reg.time("plan_compile"):
+                    hit = Compiler(self.catalog, self.props,
+                                   partial_raw=True).compile(node)
+            except CompileError:
+                hit = False
+            if len(self._plan_cache) >= self.props.plan_cache_size:
+                self._plan_cache.clear()
+            self._plan_cache[key] = hit
+        return hit or None
 
     def execute(self, plan: ast.Plan, params: Tuple = ()) -> Result:
         from snappydata_tpu.observability.metrics import global_registry

@@ -8,6 +8,15 @@ moments). This is the reference's partial/final aggregation planning
 (SnappyAggregationStrategy partial/final planning, SnappyStrategies.scala:
 464) re-usable wherever partials come from: data servers over Flight, or
 HBM-sized tiles of one oversized table.
+
+Contract the tiled scan's ON-DEVICE merge additionally relies on: every
+partial item is either a bare `__g<i>` group alias or a single
+decomposable aggregate `__p<i>` — never a composite expression — so a
+partial-raw compile (executor.Compiler(partial_raw=True)) can tag each
+output with its merge op (sum/min/max) and fold per-tile [G] partials
+elementwise on device.  The merge select stays valid over ALREADY-MERGED
+partials too: re-running sum/min/max over one row per group is the
+identity, which is how the device-merged path reuses the same merge SQL.
 """
 
 from __future__ import annotations

@@ -52,6 +52,43 @@ def durability_snapshot() -> dict:
     }
 
 
+def scan_snapshot() -> dict:
+    """Aggregation-engine / tiled-scan stats: live knobs + the read-path
+    counters for REST `/status/api/v1/scan` and the dashboard's
+    Aggregation section.  agg_reduce_passes counts fused reduction
+    dispatches (O(1) in slot count by construction — the CI perf guard
+    asserts it), agg_strategy_* which strategy the backend-aware table
+    picked, gidx_cache_* whether repeated queries skipped group-index
+    recomputation, and scan_tile_* whether tile partials merged on
+    device and overlapped bind with compute."""
+    from snappydata_tpu import config
+
+    snap = global_registry().snapshot()
+    c = snap["counters"]
+    props = config.global_properties()
+    hits = c.get("gidx_cache_hits", 0)
+    misses = c.get("gidx_cache_misses", 0)
+    return {
+        "agg_reduce_strategy": props.get("agg_reduce_strategy"),
+        "gidx_cache_bytes": props.get("gidx_cache_bytes"),
+        "scan_tile_bytes": props.get("scan_tile_bytes"),
+        "agg_reduce_passes": c.get("agg_reduce_passes", 0),
+        "agg_strategies": {
+            s: c.get(f"agg_strategy_{s}", 0)
+            for s in ("unroll", "scatter", "matmul", "pallas")
+            if c.get(f"agg_strategy_{s}", 0)},
+        "gidx_cache_hits": hits,
+        "gidx_cache_misses": misses,
+        "gidx_cache_hit_rate":
+            round(hits / (hits + misses), 3) if hits + misses else None,
+        "scan_tiles": c.get("scan_tiles", 0),
+        "scan_tile_device_merges": c.get("scan_tile_device_merges", 0),
+        "scan_tile_host_merges": c.get("scan_tile_host_merges", 0),
+        "scan_tile_prefetch_overlap":
+            c.get("scan_tile_prefetch_overlap", 0),
+    }
+
+
 class TableStatsService:
     def __init__(self, catalog, interval_s: Optional[float] = None,
                  registry=None):

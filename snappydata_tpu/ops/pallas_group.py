@@ -1,10 +1,11 @@
 """Pallas kernel: fused grouped aggregation for the dictionary fast path.
 
 The flagship scan shape (TPC-H Q1: GROUP BY two dictionary-encoded
-columns, a handful of SUM/AVG/COUNT slots) runs the `_seg_reduce`
-unrolled path today: G masked reductions per slot, each widening to
-emulated float64 on TPU — accurate but ~3% of HBM bandwidth (round-4
-verdict).  This kernel does the whole slot batch in ONE streaming pass:
+columns, a handful of SUM/AVG/COUNT slots) otherwise runs the packed
+per-family reductions (ops/reduction.py) — on TPU the auto strategy is
+G unrolled masked reductions over the packed block, each widening to
+emulated float64 (accurate but ~3% of HBM bandwidth, round-4 verdict).
+This kernel instead does the whole slot batch in ONE streaming pass:
 
 - the [rows, 128] f32 plates stream block-by-block through VMEM;
 - each of the 8x128 vector lanes keeps an independent Kahan
@@ -20,8 +21,8 @@ verdict).  This kernel does the whole slot batch in ONE streaming pass:
 
 COUNT accumulates in f32 (each lane's partial stays far below 2^24 —
 exact) and combines in int64; MIN/MAX keep plain masked partials with
-the same +/-inf fillers as `_seg_reduce`, so empty groups match the
-unrolled path bit-for-bit.
+the same +/-inf fillers as the packed families, so empty groups match
+the unrolled path bit-for-bit.
 
 Gated behind `properties.pallas_group_reduce` (default OFF until
 measured on hardware — bench.py records the side-by-side `q1_pallas_s`
@@ -59,17 +60,17 @@ _SUBLANES = 8
 _BLOCK_ROWS = 1024
 
 # G cap, counting the +1 overflow segment the executor reserves for
-# invalid rows. Matches `_UNROLL_SEGMENTS` — the same dictionary-card
-# regime where unrolled masked reductions beat scatters.
+# invalid rows. Matches reduction.UNROLL_MAX_SEGMENTS — the same
+# dictionary-card regime where unrolled masked reductions beat scatters.
 MAX_GROUPS = 64
 
 _KINDS = ("sum", "count", "min", "max")
 
 # Conservative VMEM budget for one fused call: double-buffered input
 # blocks + the [G, 8, 128] carries must fit alongside pallas overhead
-# in ~16MB. Callers use op_vmem_bytes() to stop fusing (falling back to
-# _seg_reduce slot by slot) before a wide aggregate would fail the
-# Mosaic compile outright.
+# in ~16MB. Callers use op_vmem_bytes() to stop fusing (overflow slots
+# take the packed-family reductions) before a wide aggregate would fail
+# the Mosaic compile outright.
 VMEM_BUDGET = 12 * 1024 * 1024
 
 
@@ -247,8 +248,8 @@ def grouped_reduce(ops: Sequence[Tuple[str, Optional[jnp.ndarray],
     slot's validity (row valid AND value non-null). gidx: int group
     index per element, < num_segments <= MAX_GROUPS. Returns one
     [num_segments] array per op: f64 for sums, int64 for counts, f32
-    (with +/-inf empty-group fillers, matching `_seg_reduce`) for
-    min/max.
+    (with +/-inf empty-group fillers, matching the packed families)
+    for min/max.
     """
     assert 1 <= num_segments <= MAX_GROUPS, num_segments
     kinds = tuple(k for k, _, _ in ops)

@@ -162,6 +162,9 @@ class ResourceBroker:
         for nm, data in tables:   # same-named tables in two catalogs SUM
             host[nm] = host.get(nm, 0) + _host_table_bytes(data)
         device = device_cache_bytes_by_table(tables)
+        from snappydata_tpu.engine.executor import gidx_cache_nbytes
+
+        gidx_bytes = gidx_cache_nbytes()
         with self._cond:
             queries = {qid: int(ctx.estimate_bytes)
                        for qid, ctx in self._active.items()}
@@ -170,7 +173,11 @@ class ResourceBroker:
             "device": device,
             "spill_file_bytes": hoststore.spill_file_bytes(),
             "host_total": sum(host.values()),
-            "device_total": sum(device.values()),
+            # group-index cache entries are device arrays too (valid +
+            # gidx + matmul one-hot, up to gidx_cache_bytes) — reclaimed
+            # with plan caches by the degradation ladder (clear_cache)
+            "gidx_cache_bytes": gidx_bytes,
+            "device_total": sum(device.values()) + gidx_bytes,
             "queries": queries,
             "inflight_bytes": int(self._inflight_bytes),
         }
@@ -185,9 +192,12 @@ class ResourceBroker:
                 return h, d
         from snappydata_tpu.storage.device import device_cache_bytes_by_table
 
+        from snappydata_tpu.engine.executor import gidx_cache_nbytes
+
         tables = self._iter_tables()
         host = sum(_host_table_bytes(d) for _, d in tables)
-        device = sum(device_cache_bytes_by_table(tables).values())
+        device = sum(device_cache_bytes_by_table(tables).values()) \
+            + gidx_cache_nbytes()
         self._measured_cache = (time.monotonic(), host, device)
         return host, device
 

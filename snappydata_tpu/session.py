@@ -798,25 +798,73 @@ class SnappySession:
 
         from snappydata_tpu.resource import check_current
 
+        # Compile the partial program ONCE: the old loop re-entered
+        # self.sql() per tile, re-parsing and re-analyzing partial_sql
+        # every tile.  Tiles now share one compiled executable, and when
+        # the partial's group-index space is provably tile-aligned
+        # (direct dict/bool keys — data-independent cards) the per-tile
+        # [G] partials tree-merge ON DEVICE, replacing the per-tile
+        # device_get -> scratch-table insert -> second SQL round trip.
+        tokenized = compiled = None
+        params: Tuple = ()
+        try:
+            from snappydata_tpu.sql.optimizer import optimize as _optimize
+            from snappydata_tpu.sql.parser import parse as _parse
+
+            pplan = _optimize(_parse(partial_sql).plan, self.catalog)
+            resolved_p, _ = self.analyzer.analyze_plan(pplan)
+            if self.conf.tokenize and self.conf.plan_caching:
+                tokenized, lit_params = tokenize_plan(resolved_p)
+            else:
+                from snappydata_tpu.sql.analyzer import \
+                    assign_param_positions
+
+                tokenized, lit_params = \
+                    assign_param_positions(resolved_p, 0), ()
+            params = tuple(lit_params)
+            compiled = self.executor.compiled_partial(tokenized)
+        except Exception:  # noqa: BLE001 — any analysis hiccup: SQL path
+            tokenized = None
+
+        merged: Optional[Result] = None
         pieces: List[Result] = []
         self._in_tile = True
         try:
-            for lo in range(0, units, tile_units):
-                # tile boundary = cancellation point: CANCEL <id>,
-                # statement timeouts and broker kills land here, within
-                # one tile of the signal
-                check_current()
-                with scan_window(data, lo, min(lo + tile_units, units),
-                                 manifest, tile_units=tile_units):
-                    pieces.append(self.sql(partial_sql))
-                global_registry().inc("scan_tiles")
+            if compiled is not None and self.default_mesh is None \
+                    and compiled.tile_merge is not None \
+                    and compiled.tile_merge_ok():
+                merged = self._tiled_device_pass(
+                    compiled, params, data, manifest, units, tile_units)
+            if merged is None:
+                for lo in range(0, units, tile_units):
+                    # tile boundary = cancellation point: CANCEL <id>,
+                    # statement timeouts and broker kills land here,
+                    # within one tile of the signal
+                    check_current()
+                    with scan_window(data, lo, min(lo + tile_units, units),
+                                     manifest, tile_units=tile_units):
+                        if tokenized is not None:
+                            pieces.append(self._execute_partial(
+                                tokenized, params))
+                        else:  # analysis failed: per-tile SQL fallback
+                            pieces.append(self.sql(partial_sql))
+                    global_registry().inc("scan_tiles")
+                global_registry().inc("scan_tile_host_merges")
         finally:
             self._in_tile = False
+        if merged is not None:
+            pieces = [merged]
 
         # merge in a THROWAWAY in-memory session (never journaled/persisted)
         from snappydata_tpu.catalog import Catalog as _Cat
+        from snappydata_tpu.engine.result import to_host_domain
 
         scratch_sess = SnappySession(catalog=_Cat(), conf=self.conf)
+        # the merge select must never re-enter the tile pass: partials
+        # of a generic-key aggregate can exceed the (tiny) tile budget,
+        # and a tiled merge would spawn scratch sessions recursively —
+        # each level re-emitting ~G partial rows, never converging
+        scratch_sess._in_tile = True
         first = pieces[0]
         fields_sql = ", ".join(
             f"{nm} {ddl_type(dt)}"
@@ -826,6 +874,10 @@ class SnappySession:
         sdata = scratch_sess.catalog.describe("__tile_partials").data
         for piece in pieces:
             if piece.num_rows:
+                # executor results carry exact decimals as scaled int64 —
+                # unscale into the host float domain the scratch DOUBLE
+                # columns expect (self.sql pieces arrive pre-finalized)
+                piece = to_host_domain(piece)
                 nmask = piece.nulls \
                     if any(m is not None for m in piece.nulls) else None
                 sdata.insert_arrays(piece.columns, nulls=nmask)
@@ -841,6 +893,77 @@ class SnappySession:
         from snappydata_tpu.cluster.distributed import _apply_outer
 
         return _apply_outer(result, outer, self)
+
+    def _execute_partial(self, tokenized, params) -> Result:
+        """One tile of the host-merge path through the pre-analyzed plan
+        (mirrors _run_query_inner's mesh composition)."""
+        if self.default_mesh is not None:
+            from snappydata_tpu.parallel.mesh import MeshContext
+
+            if MeshContext.current() is None:
+                with MeshContext(self.default_mesh):
+                    return self.executor.execute(tokenized, params)
+        return self.executor.execute(tokenized, params)
+
+    def _tiled_device_pass(self, compiled, params, data, manifest, units,
+                           tile_units) -> Optional[Result]:
+        """Stream scan tiles through ONE compiled partial executable and
+        tree-merge the per-tile [G] partial slots ON DEVICE (sum/min/max
+        over the shared group-index space).  JAX's async dispatch
+        double-buffers the pass: execute_raw never transfers, so the
+        host binds/uploads tile t+1 while the device reduces tile t — a
+        depth-2 throttle (block on tile t-1 after dispatching t) keeps
+        at most two tiles' plates in flight.  Returns the merged partial
+        Result, or None to fall back to the host-merge path (device
+        lowering refused a bind, or the int64 decimal bound tripped —
+        the exact host merge decides)."""
+        import jax
+
+        from snappydata_tpu.engine.executor import merge_tile_outs
+        from snappydata_tpu.engine.exprs import CompileError
+        from snappydata_tpu.observability.metrics import global_registry
+        from snappydata_tpu.resource import check_current
+        from snappydata_tpu.storage import device as device_mod
+
+        reg = global_registry()
+        tags = compiled.tile_merge["tags"]
+        outs: List[tuple] = []
+        try:
+            for lo in range(0, units, tile_units):
+                check_current()  # tile boundary = cancellation point
+                with device_mod.scan_window(
+                        data, lo, min(lo + tile_units, units), manifest,
+                        tile_units=tile_units):
+                    outs.append(compiled.execute_raw(params))
+                # counts WORK, not queries: when this pass aborts (bind
+                # CompileError / decimal overflow) the host rerun counts
+                # its tiles again — the query genuinely scanned twice
+                reg.inc("scan_tiles")
+                if len(outs) >= 2:
+                    prev = outs[-2]
+                    try:
+                        ready = prev[0].is_ready()
+                    except AttributeError:  # older jax: assume done
+                        ready = True
+                    if not ready:
+                        # this tile's bind/upload overlapped the previous
+                        # tile's device compute — the pipelining evidence
+                        reg.inc("scan_tile_prefetch_overlap")
+                        jax.block_until_ready(prev)
+        except CompileError:
+            return None
+        if len(outs) > 1:
+            reg.inc("scan_tile_device_merges", len(outs) - 1)
+        while len(outs) > 1:  # pairwise tree merge, all on device
+            nxt = [merge_tile_outs(outs[j], outs[j + 1], tags)
+                   for j in range(0, len(outs) - 1, 2)]
+            if len(outs) % 2:
+                nxt.append(outs[-1])
+            outs = nxt
+        host = jax.device_get(outs[0])
+        if bool(np.asarray(host[2])):
+            return None  # overflow flagged: exact host path decides
+        return compiled._assemble(host, [])
 
     def _gate_code_surface(self, what: str) -> None:
         """Code-execution surfaces (EXEC PYTHON, DEPLOY) on network-derived

@@ -33,6 +33,18 @@ def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
+def batch_bucket(n: int) -> int:
+    """Padded BATCH-axis size: the smallest of {2^k, 1.5 * 2^k} >= n.
+    Pure pow2 bucketing wasted up to ~50% of every device pass on dead
+    padded batches (TPC-H SF4: 184 batches -> 256, +39% rows swept by
+    every reduction); the intermediate 1.5x buckets cap the waste at
+    ~33% while still bounding executable shapes to two per octave."""
+    if n <= 1:
+        return 1
+    p = 1 << (n - 1).bit_length()
+    return p * 3 // 4 if p * 3 // 4 >= n else p
+
+
 # --- tiled scans: bind a WINDOW of the batch axis ------------------------
 # For tables whose decoded columns exceed the HBM budget, the session
 # streams scan units (column batches + row-buffer chunks) through the same
@@ -59,6 +71,11 @@ def scan_window(data, lo: int, hi: int, manifest=None, tile_units=None):
         yield
     finally:
         _scan_windows.reset(tok)
+
+
+def scan_window_active() -> bool:
+    """True inside any scan_window context (a tiled pass is binding)."""
+    return bool(_scan_windows.get())
 
 
 def scan_unit_count(data, manifest=None) -> int:
@@ -115,7 +132,12 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
     if window is not None and not _cache_budget.enabled():
         # no byte budget to evict for us: a tile pass must not accumulate
         # every window's arrays (the table is oversized by definition —
-        # that would re-materialize it on device); keep only this tile
+        # that would re-materialize it on device); keep only this tile.
+        # The session's double-buffered tile pass still holds the
+        # PREVIOUS tile's plates alive through its in-flight dispatch —
+        # dropping the cache entry here only releases our reference, so
+        # peak residency is bounded at two tiles, exactly the pipeline
+        # depth the pass throttles to.
         for k in [k for k in data._device_cache
                   if k != cache_key and k[2] is not None]:
             data._device_cache.pop(k, None)
@@ -124,7 +146,7 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
     schema = data.schema
     cap = data.capacity
     b_actual = len(views) + len(row_chunks)
-    b = _next_pow2(b_actual) if data_pow2() else max(1, b_actual)
+    b = batch_bucket(b_actual) if data_pow2() else max(1, b_actual)
     b = max(b, 1)
     if ctx is not None:
         # batch axis is the sharded axis: pad to a mesh multiple
