@@ -101,7 +101,7 @@ def _render_dashboard(svc) -> str:
         f"<tr><td>{esc(str(k))}</td><td>{v}</td></tr>"
         for k, v in sorted(snap["counters"].items()))
     from snappydata_tpu.observability.stats_service import (
-        durability_snapshot, scan_snapshot)
+        durability_snapshot, join_snapshot, scan_snapshot)
 
     wal = durability_snapshot()
     rows_w = "".join(
@@ -114,6 +114,10 @@ def _render_dashboard(svc) -> str:
     rows_agg = "".join(
         f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
         for k, v in agg.items())
+    jn = join_snapshot()
+    rows_jn = "".join(
+        f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
+        for k, v in jn.items())
     recent = list(reversed(svc.session.recent_queries()))[:25]
     rows_q = "".join(
         f"<tr><td>{esc(str(q['sql']))[:120]}</td><td>{q['ms']}</td>"
@@ -143,6 +147,8 @@ text-align:left}}h2{{margin-top:1.5em}}</style></head><body>
 <h2>Durability (WAL group commit)</h2><table>{rows_w}</table>
 <h2>Aggregation engine (reduction strategy / tiled scans)</h2>
 <table>{rows_agg}</table>
+<h2>Join engine (device path / build cache / expansion)</h2>
+<table>{rows_jn}</table>
 <h2>Counters</h2><table>{counters}</table>
 <h2>Recent queries ({len(recent)})</h2>
 <table><tr><th>sql</th><th>ms</th><th>rows</th><th>user</th></tr>{rows_q}
@@ -222,6 +228,14 @@ class RestService:
                         scan_snapshot
 
                     self._send(scan_snapshot())
+                elif path == "/status/api/v1/join":
+                    # join-engine stats: device vs host-path counts (host
+                    # fallbacks itemized by reason), build-artifact cache
+                    # hit rate/bytes, one-to-many expansion factor
+                    from snappydata_tpu.observability.stats_service import \
+                        join_snapshot
+
+                    self._send(join_snapshot())
                 elif path == "/status/api/v1/streaming":
                     # streaming query progress (ref: the structured-
                     # streaming UI tab / StreamingQueryManager.active);

@@ -123,6 +123,22 @@ class Properties:
     max_groups: int = 1 << 16                 # static upper bound for generic group-by output
     batches_pow2_bucketing: bool = True       # pad #batches to pow2 → fewer recompiles
 
+    # Device join engine (engine/executor._emit_join + ops/join.py).
+    # device_join is the master switch — OFF reroutes every join to the
+    # exact host hash join (the bench times the r05-era host path with
+    # it; checked per BIND, so flipping needs no plan-cache flush).
+    device_join: bool = True
+    # Byte cap on ONE join's expanded output (non-unique builds expand
+    # probe rows into match pairs on a {2^k, 1.5*2^k}-bucketed axis);
+    # beyond it the query falls back to the host join with a loud
+    # stderr warning + join_fallback_expand_bytes counter. 0 = no cap.
+    join_expand_max_bytes: int = 2 << 30
+    # Build-artifact cache (sorted keys + order permutation + uniqueness
+    # verdict per build-side snapshot): LRU byte budget, ledgered by the
+    # resource broker next to the gidx cache. 0 disables caching (every
+    # bind re-sorts; the device join itself stays on).
+    join_build_cache_bytes: int = 1 << 30
+
     # Memory (ref: SnappyUnifiedMemoryManager eviction-heap-percentage —
     # here the budget caps cached DEVICE arrays; eviction drops them back
     # to host, from which they rebuild on next access)

@@ -8,9 +8,14 @@ SnappyHashAggregateExec, HashJoinExec):
   Relation  → stacked [B,C] device arrays (storage/device.py)
   Filter    → valid &= predicate
   Project   → expression re-map
-  Join      → build-side sort + searchsorted probe, in-trace
-              (PK/FK equi joins — the HashJoinExec replicated/collocated
-              case; general joins fall back to host hash join)
+  Join      → sorted build + searchsorted match RANGES per probe row
+              (the HashJoinExec replicated/collocated case).  Unique
+              builds gather directly; non-unique builds prefix-sum the
+              ranges into a {2^k, 1.5*2^k}-bucketed expanded output
+              (inner/left/right/full/semi/anti — ops/join.py); sorted
+              build artifacts are cached per snapshot so repeated joins
+              skip the argsort.  Non-equi and residual-on-outer shapes
+              fall back to the host hash join, counted by reason.
   Aggregate → segment_sum/min/max over a combined group index; dictionary
               fast path mirrors the reference's dictionary-key aggregation
               (SnappyHashAggregateExec dictionary fast path :83-95)
@@ -77,6 +82,12 @@ class _RelationInput:
         self.info = info
         self.used = used
         self.sargs: List[Tuple[int, str, Callable]] = []
+        # artifact-backed join builds: the cached sorted-key order
+        # indexes the FULL flat plate layout, so bind-time batch
+        # skipping (which gathers a subset of batches) must not reshape
+        # this relation's arrays — the in-trace pass mask applies the
+        # filter instead
+        self.no_skip = False
 
     def bind(self):
         from snappydata_tpu.storage.device import build_device_table
@@ -88,7 +99,7 @@ class _RelationInput:
 
     def keep_mask(self, dt, params) -> Optional[np.ndarray]:
         """bool [B] of batches that can contain matches; None = keep all."""
-        if not self.sargs:
+        if not self.sargs or self.no_skip:
             return None
         keep = None
         for ci, op, get_lit in self.sargs:
@@ -342,9 +353,9 @@ class CompiledPlan:
         outs = jax.device_get(outs)
         if bool(np.asarray(outs[2])):
             raise CompileError(
-                "device aggregate overflow (group-by cardinality beyond "
-                "max_groups, or an exact-decimal sum at int64 risk): "
-                "host path")
+                "device overflow (group-by cardinality beyond max_groups, "
+                "an exact-decimal sum at int64 risk, or a join expansion "
+                "past its bound): host path")
         return self._assemble(outs, tables)
 
     def execute_raw(self, params: Tuple):
@@ -512,14 +523,60 @@ def _row_count_of(info) -> int:
     return info.data.snapshot().total_rows()
 
 
-_uniq_cache: Dict[Tuple[int, int, Tuple[int, ...]], tuple] = {}
+def _join_reject(reason: str, msg: str) -> None:
+    """Reasoned device-join fallback: count the rejection (total + per
+    reason string, so operators can see WHY joins leave the device) and
+    reroute to the exact host join via CompileError."""
+    from snappydata_tpu.observability.metrics import global_registry
+
+    reg = global_registry()
+    reg.inc("join_host_fallbacks")
+    reg.inc("join_fallback_" + reason)
+    raise CompileError(msg)
 
 
-def _require_unique_build(info, ordinals: Tuple[int, ...]) -> None:
-    """Raise CompileError unless `info`'s columns `ordinals` are jointly
-    unique in the CURRENT snapshot (cached per mutation version). Runs at
-    bind time, so data changes re-validate; a failure reroutes the query
-    to the exact host join."""
+def _check_device_join_enabled(props) -> None:
+    """Per-execution master switch (a bind check, so flipping the conf
+    knob needs no plan-cache flush — the bench uses it to time the
+    r05-era host-join path side by side)."""
+    if not props.get("device_join", True) \
+            or not config.global_properties().get("device_join", True):
+        _join_reject("disabled", "device_join=off: host path")
+
+
+def _count_device_join() -> None:
+    from snappydata_tpu.observability.metrics import global_registry
+
+    global_registry().inc("join_device_joins")
+
+
+_expand_cap_warned: set = set()
+
+
+def _warn_expand_cap(est: int, cap: int) -> None:
+    """The expansion-cap fallback must be LOUD (ISSUE requirement): the
+    query silently dropping to a single-threaded pandas join reads as a
+    hang to operators.  Once per (estimate bucket, cap)."""
+    import sys
+
+    key = (est.bit_length(), cap)
+    if key in _expand_cap_warned:
+        return
+    _expand_cap_warned.add(key)
+    print(f"warning: device join expansion (~{est:,} bytes) exceeds "
+          f"join_expand_max_bytes ({cap:,}) — query runs on the HOST "
+          f"join path (single-threaded); raise the knob to keep it on "
+          f"device", file=sys.stderr)
+
+
+_absmax_cache: Dict[Tuple[int, int, int], tuple] = {}
+
+
+def _require_f64_exact_int_key(info, ordinal: int) -> None:
+    """Mixed int/float equi keys compare in the float64 domain; an int64
+    key with |v| >= 2^53 would falsely match/miss after the cast.
+    Verified per bind (cached per mutation version) — values at risk
+    reroute to the exact host join."""
     import weakref
 
     from snappydata_tpu.storage.table_store import RowTableData
@@ -527,36 +584,31 @@ def _require_unique_build(info, ordinals: Tuple[int, ...]) -> None:
     data = info.data
     ver = data.version if isinstance(data, RowTableData) \
         else data.snapshot().version
-    key = (id(data), ver, ordinals)
+    key = (id(data), ver, ordinal)
     ok = None
-    entry = _uniq_cache.get(key)
+    entry = _absmax_cache.get(key)
     if entry is not None:
         ref, cached_ok = entry
-        # id() values are reused after GC: the weakref proves the cached
-        # verdict belongs to THIS data object, not a dead table's
         if ref() is data:
             ok = cached_ok
     if ok is None:
-        cols = _host_key_columns(info, ordinals)
-        n = int(cols[0].shape[0]) if cols else 0
-        if n == 0:
+        col = _host_key_columns(info, (ordinal,))[0]
+        if col.size == 0:
             ok = True
-        elif len(cols) == 1:
-            import pandas as pd
-
-            ok = len(pd.unique(cols[0])) == n
         else:
-            import pandas as pd
-
-            ok = not pd.DataFrame(
-                {i: c for i, c in enumerate(cols)}).duplicated().any()
-        if len(_uniq_cache) > 4096:
-            _uniq_cache.clear()
-        _uniq_cache[key] = (weakref.ref(data), ok)
+            vals = np.abs(np.asarray(
+                [0 if v is None else v for v in col], dtype=np.int64)) \
+                if col.dtype == object else np.abs(col.astype(np.int64))
+            ok = int(vals.max()) < (1 << 53)
+        if len(_absmax_cache) > 4096:
+            _absmax_cache.clear()
+        _absmax_cache[key] = (weakref.ref(data), ok)
     if not ok:
-        raise CompileError(
-            f"join build side {info.name} has duplicate keys on columns "
-            f"{ordinals}; host path")
+        _join_reject(
+            "int_float_key_2p53",
+            f"join key {info.name}.{info.schema.fields[ordinal].name} "
+            f"holds int values at |v| >= 2^53 — the float64 key domain "
+            f"would be inexact; host path")
 
 
 def _host_key_columns(info, ordinals: Tuple[int, ...]) -> List[np.ndarray]:
@@ -725,7 +777,7 @@ class Compiler:
                 v = _broadcast_to_mask(dv.value, out.valid)
                 nl = dv.null
                 pairs.append((v, nl))
-            return out.valid, tuple(pairs), jnp.asarray(False)
+            return out.valid, tuple(pairs), ctx.overflow
 
         return run_root, scope
 
@@ -1004,7 +1056,7 @@ class Compiler:
                 dv = r(rt2)
                 pairs.append((_broadcast_to_mask(dv.value, flatmask),
                               dv.null))
-            return flatmask, tuple(pairs), jnp.asarray(False)
+            return flatmask, tuple(pairs), ctx.overflow
 
         return run_window, out_scope
 
@@ -1111,149 +1163,510 @@ class Compiler:
     # -- join --------------------------------------------------------------
 
     def _emit_join(self, plan: ast.Join):
+        """General device join: sorted build + searchsorted match RANGES.
+
+        Unique builds (the dim/PK case) gather their single passing match
+        directly on the probe shape; non-unique builds prefix-sum the
+        range widths into a bind-time-bucketed expanded output
+        (ops/join.expand) — one-to-many/many-to-many inner, left, right
+        and full outer all stay on device.  The sorted build keys +
+        argsort order are a cached artifact keyed on the build's bind
+        identity (ops/join.build_artifact), so repeated executions skip
+        the per-execution argsort; query filters on the build side apply
+        through a pass mask over the sorted order instead of re-sorting.
+        Shapes with no device lowering reroute to the exact host join via
+        reasoned `join_fallback_*` counters."""
+        from snappydata_tpu.ops import join as _dj
+
+        props = self.props
+        rel_lo = len(self.relations)
         left, lscope = self._emit_rel(plan.left)
+        rel_mid = len(self.relations)
         right, rscope = self._emit_rel(plan.right)
+        rel_hi = len(self.relations)
         nleft = len(lscope)
         how = plan.how
 
         equi, residual = _split_equi(plan.condition, nleft)
         if not equi:
-            raise CompileError("non-equi join not supported on device")
-        if how in ("right", "full"):
-            # the device join only NULL-extends the PROBE side; right/full
-            # need unmatched BUILD rows too — host path (which implements
-            # the full pair/NULL-extension semantics)
-            raise CompileError(f"{how} outer join: host path")
+            _join_reject("non_equi",
+                         "non-equi/cross join not supported on device")
         if residual is not None and how != "inner":
             # an ON-clause residual on an outer join NULL-extends failing
             # pairs — the device's post-join filter would DROP them; and
             # semi/anti drop the right columns before the residual could
             # run. Host path evaluates residuals per candidate pair.
-            raise CompileError(f"{how} join with residual: host path")
+            _join_reject("residual_outer",
+                         f"{how} join with residual: host path")
+        self.bind_checks.append(
+            lambda _p=self.props: _check_device_join_enabled(_p))
 
-        # The device join is sort+searchsorted: ONE build-side match per
-        # probe row. That is exact only when the build (right) side is
-        # UNIQUE on the join keys (the overwhelmingly common dim/PK build
-        # side). Anything else (N:M, 1:N on the build side) must take the
-        # host path or rows are silently dropped. Semi/anti only need
-        # membership, so they are exempt.
-        if how not in ("semi", "anti"):
-            sources = [self._resolve_build_source(plan.right, ri - nleft)
-                       for _, ri in equi]
-            if any(s is None for s in sources):
-                raise CompileError(
-                    "join build side uniqueness unprovable on device "
-                    "(derived build columns); host path")
-            info_r = sources[0][0]
-            if any(s[0] is not info_r for s in sources):
-                raise CompileError(
-                    "join build keys span multiple base tables; host path")
-            ords = tuple(sorted({s[1] for s in sources}))
-            self.bind_checks.append(
-                lambda _i=info_r, _o=ords: _require_unique_build(_i, _o))
+        # -- per-pair key domain: how both sides encode into int64 --------
+        enc_spec: List[str] = []
+        for li, ri in equi:
+            ldt = lscope[li].dtype
+            rdt = rscope[ri - nleft].dtype
+            if ldt is None or rdt is None:
+                _join_reject("untyped_key",
+                             "join key without a static type: host path")
+            if ldt.name == "string" or rdt.name == "string":
+                if ldt.name != rdt.name:
+                    _join_reject("string_nonstring_key",
+                                 "string vs non-string join key: host path")
+                enc_spec.append("raw")
+                continue
+            l_ex = ldt.name == "decimal" \
+                and np.dtype(ldt.device_dtype()).kind == "i"
+            r_ex = rdt.name == "decimal" \
+                and np.dtype(rdt.device_dtype()).kind == "i"
+            if l_ex or r_ex:
+                # exact decimals carry SCALED int64 plates — comparable
+                # only against the same scale's scaled domain
+                if not (l_ex and r_ex and ldt.scale == rdt.scale):
+                    _join_reject("decimal_key_mix",
+                                 "exact-decimal join key against a "
+                                 "different value domain: host path")
+                enc_spec.append("raw")
+                continue
+            lk = np.dtype(ldt.device_dtype())
+            rk = np.dtype(rdt.device_dtype())
+            if (lk.kind == "f" or rk.kind == "f") and lk != rk:
+                # mixed int/float (or f32/f64): compare in float64 —
+                # exact for the float side; int sides are bind-checked
+                # below to stay under 2^53
+                enc_spec.append("f64")
+            else:
+                enc_spec.append("raw")
 
-        # string join keys: each table has its OWN dictionary, so codes are
-        # not comparable across tables — build a bind-time translation LUT
-        # mapping left codes into the right table's code space (unmatched
-        # values → -1, which equals no real code)
+        # -- base-source resolution (build AND probe sides) ---------------
+        bsources = [self._resolve_join_source(plan.right, ri - nleft,
+                                              rel_mid, rel_hi)
+                    for _, ri in equi]
+        psources = [self._resolve_join_source(plan.left, li,
+                                              rel_lo, rel_mid)
+                    for li, _ in equi]
+        build_rel = build_ords = None
+        if all(s is not None for s in bsources) \
+                and len({id(s[0]) for s in bsources}) == 1:
+            build_rel = bsources[0][0]
+            build_ords = tuple(s[2] for s in bsources)
+        probe_rel = None
+        if all(s is not None for s in psources) \
+                and len({id(s[0]) for s in psources}) == 1:
+            probe_rel = psources[0][0]
+
+        # mixed int/float exactness: bind-check every INT side's values —
+        # a derived int key can't be proven under 2^53
+        for pi, (li, ri) in enumerate(equi):
+            if enc_spec[pi] != "f64":
+                continue
+            for side_dt, src in ((lscope[li].dtype, psources[pi]),
+                                 (rscope[ri - nleft].dtype, bsources[pi])):
+                if np.dtype(side_dt.device_dtype()).kind not in ("i", "u"):
+                    continue
+                if src is None:
+                    _join_reject("mixed_key_unprovable",
+                                 "mixed int/float join key on a derived "
+                                 "column (2^53 exactness unprovable): "
+                                 "host path")
+                self.bind_checks.append(
+                    lambda _i=src[1], _o=src[2]:
+                    _require_f64_exact_int_key(_i, _o))
+
+        # string join keys: each table has its OWN dictionary, so codes
+        # are not comparable across tables — translate left codes into
+        # the right table's code space via a vectorized LUT (unmatched
+        # values → -1, which equals no real code), cached per dictionary
+        # version when both are base-table dictionaries
         str_trans: Dict[int, int] = {}
+        trans_getters: Dict[int, Callable] = {}
         for pi, (li, ri) in enumerate(equi):
             lprov = lscope[li].dict_provider
             rprov = rscope[ri - nleft].dict_provider
             if lprov is None or rprov is None:
                 continue
+            ck = owners = None
+            if psources[pi] is not None and bsources[pi] is not None:
+                ck = ("trans", id(psources[pi][1].data), psources[pi][2],
+                      id(bsources[pi][1].data), bsources[pi][2])
+                owners = (psources[pi][1].data, bsources[pi][1].data)
 
-            def build_trans(params, _lp=lprov, _rp=rprov):
-                ld = _lp()
-                rd = _rp()
-                lookup = {v: i for i, v in enumerate(rd.tolist())}
-                trans = np.fromiter(
-                    (lookup.get(v, -1) for v in ld.tolist()),
-                    dtype=np.int32, count=len(ld))
-                size = max(1, 1 << (max(1, len(trans)) - 1).bit_length())
-                if size > len(trans):
-                    trans = np.concatenate(
-                        [trans, np.full(size - len(trans), -1,
-                                        dtype=np.int32)])
-                return trans
+            def build_trans(params, _lp=lprov, _rp=rprov, _ck=ck,
+                            _ow=owners):
+                return _dj.translate_codes(_lp(), _rp(), cache_key=_ck,
+                                           owners=_ow)
 
             self.aux_builders.append(build_trans)
             str_trans[pi] = len(self.aux_builders) - 1
+            trans_getters[pi] = (
+                lambda _lp=lprov, _rp=rprov, _ck=ck, _ow=owners:
+                _dj.translate_codes(_lp(), _rp(), cache_key=_ck,
+                                    owners=_ow))
 
-        joint_scope = lscope + rscope if how not in ("semi", "anti") else lscope
-        out_scope = [_ScopeCol(s.name, s.dtype, s.dict_provider,
-                               True if how == "left" else s.nullable)
-                     for s in joint_scope]
+        artifact_mode = build_rel is not None
+        if not artifact_mode and how not in ("semi", "anti"):
+            # semi/anti only need membership (any build works, sorted
+            # in-trace); everything else needs the artifact's uniqueness
+            # verdict / expansion bound, both of which require base
+            # columns to read outside the trace
+            _join_reject("derived_build",
+                         "join build side is a derived relation: "
+                         "host path")
+
+        # a build side with NO in-trace filter keeps every row of a real
+        # key's sorted run live (dead/NULL rows are key-sentineled to the
+        # end) — the dense range math skips the pass prefix-sum and its
+        # per-execution searchsorteds (the hot Q3-class shape)
+        def _has_filter(p: ast.Plan) -> bool:
+            return isinstance(p, ast.Filter) \
+                or any(_has_filter(k) for k in p.children())
+
+        build_filtered = _has_filter(plan.right)
+
+        art_aux = None
+        artifact_of = None
+        if artifact_mode:
+            build_rel.no_skip = True  # order indexes the FULL flat layout
+            enc_sig = tuple(enc_spec)
+
+            def artifact_of(_rel=build_rel, _ords=build_ords,
+                            _sig=enc_sig):
+                dt = _rel.bind()
+
+                def compute():
+                    pairs = []
+                    anynull = None
+                    for ci, spec in zip(_ords, _sig):
+                        v = dt.columns[ci].reshape(-1)
+                        nl = dt.nulls.get(ci)
+                        nl = nl.reshape(-1) if nl is not None else None
+                        if spec == "f64":
+                            v = v.astype(jnp.float64)
+                        pairs.append((v, nl))
+                        anynull = _or_null(anynull, nl)
+                    return _dj.encode_build_keys(
+                        pairs, dt.valid.reshape(-1), anynull)
+
+                return _dj.build_artifact(dt.valid, (_ords, _sig), compute)
+
+            # _bind evaluates aux builders BEFORE static providers, so
+            # stashing the artifact here lets mode_provider reuse it —
+            # otherwise a cache-disabled (or over-budget) bind pays the
+            # build argsort + uniqueness device_get TWICE per execution
+            art_tls = threading.local()
+
+            def _aux_artifact(params):
+                art = artifact_of()
+                if how not in ("semi", "anti"):
+                    # mode_provider is the stash's only consumer; a
+                    # semi/anti bind must not leave the artifact pinned
+                    # in the thread-local (invisible to the cache ledger)
+                    art_tls.art = art
+                return art["packed"]
+
+            self.aux_builders.append(_aux_artifact)
+            art_aux = len(self.aux_builders) - 1
+
+        mode_si = bucket_si = None
+        if artifact_mode and how not in ("semi", "anti"):
+            tls = threading.local()
+            null_extend = how in ("left", "full")
+
+            def _row_width() -> int:
+                """Approximate bytes per expanded output row (value +
+                null byte per used column of both sides + the mask)."""
+                w = 1
+                for r in (probe_rel, build_rel):
+                    if r is None:
+                        continue
+                    for ci in r.used:
+                        f = r.info.schema.fields[ci]
+                        try:
+                            w += np.dtype(
+                                f.dtype.device_dtype()).itemsize + 1
+                        except Exception:
+                            w += 9
+                return w
+
+            def _check_expand_cap(slots: int) -> None:
+                cap = int(props.get("join_expand_max_bytes", 0) or 0)
+                est = slots * _row_width()
+                if cap and est > cap:
+                    _warn_expand_cap(est, cap)
+                    _join_reject(
+                        "expand_bytes",
+                        f"join expansion needs ~{est:,} bytes > "
+                        f"join_expand_max_bytes={cap:,}: host path")
+
+            def mode_provider() -> int:
+                from snappydata_tpu.observability.metrics import \
+                    global_registry
+
+                reg = global_registry()
+                art = getattr(art_tls, "art", None)
+                art_tls.art = None  # consume: never reuse across binds
+                if art is None:
+                    art = artifact_of()
+                # right/full outer appends F build-extension slots (one
+                # per build flat row) to every output column — they count
+                # against the byte cap exactly like expansion slots
+                fext = int(art["skeys"].shape[0]) \
+                    if how in ("right", "full") else 0
+                # join_device_joins counts only once the bind can no
+                # longer reject — a reroute below must not ALSO show up
+                # as a device join in the dashboard's device/host split
+                if art["unique"]:
+                    if fext:
+                        probe_slots = int(probe_rel.bind().valid.size) \
+                            if probe_rel is not None else 0
+                        _check_expand_cap(probe_slots + fext)
+                    tls.bucket = 0
+                    reg.inc("join_device_joins")
+                    return 0
+                if probe_rel is None:
+                    _join_reject(
+                        "derived_probe_nonunique",
+                        "one-to-many join with a derived probe side "
+                        "(expansion bound unprovable): host path")
+                dtp = probe_rel.bind()
+
+                def compute_pkeys():
+                    pairs = []
+                    anynull = None
+                    for pi2, (s, spec) in enumerate(
+                            zip(psources, enc_spec)):
+                        v = dtp.columns[s[2]].reshape(-1)
+                        nl = dtp.nulls.get(s[2])
+                        nl = nl.reshape(-1) if nl is not None else None
+                        getter = trans_getters.get(pi2)
+                        if getter is not None:
+                            trans = jnp.asarray(getter())
+                            v = trans[jnp.clip(v, 0, trans.shape[0] - 1)]
+                        if spec == "f64":
+                            v = v.astype(jnp.float64)
+                        pairs.append((v, nl))
+                        anynull = _or_null(anynull, nl)
+                    return (_dj.encode_probe_keys(pairs, anynull),
+                            dtp.valid.reshape(-1))
+
+                bound = _dj.probe_expand_bound(
+                    art, dtp.valid, tuple(s[2] for s in psources),
+                    null_extend, compute_pkeys)
+                bucket = _dj.expand_bucket(max(1, bound))
+                _check_expand_cap(bucket + fext)
+                reg.inc("join_device_joins")
+                reg.inc("join_expand_out_rows", bucket)
+                reg.inc("join_expand_probe_rows",
+                        max(1, int(dtp.total_rows)))
+                tls.bucket = bucket
+                return 1
+
+            mode_si = self._add_static(mode_provider)
+            # registered AFTER mode_provider: _bind evaluates statics in
+            # order, so the thread-local bucket is always fresh
+            bucket_si = self._add_static(
+                lambda: int(getattr(tls, "bucket", 0)))
+        else:
+            self.bind_checks.append(_count_device_join)
+
+        if how in ("semi", "anti"):
+            out_scope = [_ScopeCol(s.name, s.dtype, s.dict_provider,
+                                   s.nullable) for s in lscope]
+        else:
+            lnul = how in ("right", "full")
+            rnul = how in ("left", "full")
+            out_scope = [_ScopeCol(s.name, s.dtype, s.dict_provider,
+                                   True if lnul else s.nullable)
+                         for s in lscope] + \
+                        [_ScopeCol(s.name, s.dtype, s.dict_provider,
+                                   True if rnul else s.nullable)
+                         for s in rscope]
         builder = self._builder_for(lscope + rscope)
-        residual_run = builder.emit(residual) if residual is not None else None
+        residual_run = builder.emit(residual) if residual is not None \
+            else None
 
         def run_join(ctx) -> RelOut:
             lo = left(ctx)
             ro = right(ctx)
             lpairs = [lo.cols[k] for k, _ in equi]
             rpairs = [ro.cols[k - nleft] for _, k in equi]
-            # mixed int/float key pairs compare in a common float64 domain
-            # (bitcasting one side against a value-cast other never matched)
-            def coerce_pair(a: DVal, b: DVal):
-                a_f = jnp.issubdtype(jnp.asarray(a.value).dtype, jnp.floating)
-                b_f = jnp.issubdtype(jnp.asarray(b.value).dtype, jnp.floating)
-                if a_f != b_f:
-                    return (DVal(a.value.astype(jnp.float64), a.null, a.dtype),
-                            DVal(b.value.astype(jnp.float64), b.null, b.dtype))
-                return a, b
-
             # translate left string codes into right code space first
             for pi, aux_i in str_trans.items():
                 trans = ctx.aux[aux_i]
                 lv = lpairs[pi]
                 codes = jnp.clip(lv.value, 0, trans.shape[0] - 1)
                 lpairs[pi] = DVal(trans[codes], lv.null, lv.dtype)
-            coerced = [coerce_pair(a, b) for a, b in zip(lpairs, rpairs)]
-            lpairs = [a for a, _ in coerced]
-            rpairs = [b for _, b in coerced]
-            # flatten build side; NULL keys never match (SQL semantics):
-            # build-side nulls collapse into the invalid sentinel, probe-
-            # side nulls get a distinct sentinel absent from the build
-            bvalid = ro.valid.reshape(-1)
-            bnull = None
-            for d in rpairs:
-                if d.null is not None:
-                    m = _broadcast_to_mask(d.null, ro.valid).reshape(-1)
-                    bnull = m if bnull is None else (bnull | m)
-            bkeys = _combine_keys(rpairs)
-            bkeys = jnp.where(bvalid if bnull is None else
-                              (bvalid & ~bnull), bkeys.reshape(-1), _I64_MAX)
-            order = jnp.argsort(bkeys)
-            skeys = bkeys[order]
-            pkeys = _combine_keys(lpairs)
+            # mixed-domain pairs compare in float64 (bind-checked exact)
+            for pi, spec in enumerate(enc_spec):
+                if spec == "f64":
+                    a, b = lpairs[pi], rpairs[pi]
+                    lpairs[pi] = DVal(a.value.astype(jnp.float64),
+                                      a.null, a.dtype)
+                    rpairs[pi] = DVal(b.value.astype(jnp.float64),
+                                      b.null, b.dtype)
+            # probe keys on the probe row shape; NULL keys get a sentinel
+            # absent from the build (NULL never matches — SQL semantics)
+            lpairs = [DVal(_broadcast_to_mask(d.value, lo.valid),
+                           _broadcast_to_mask(d.null, lo.valid)
+                           if d.null is not None else None, d.dtype)
+                      for d in lpairs]
             pnull = None
             for d in lpairs:
-                if d.null is not None:
-                    m = _broadcast_to_mask(d.null, lo.valid)
-                    pnull = m if pnull is None else (pnull | m)
+                pnull = _or_null(pnull, d.null)
+            pkeys = _combine_keys(lpairs)
             if pnull is not None:
-                pkeys = jnp.where(pnull, jnp.int64(_I64_MAX - 7), pkeys)
-            pos = jnp.searchsorted(skeys, pkeys)
-            posc = jnp.clip(pos, 0, skeys.shape[0] - 1)
-            found = (skeys[posc] == pkeys) & lo.valid
+                pkeys = jnp.where(pnull,
+                                  jnp.int64(_dj.PROBE_NULL_SENTINEL),
+                                  pkeys)
+
+            if artifact_mode:
+                packed = ctx.aux[art_aux]
+                skeys, order = packed[0], packed[1]
+                pass_flat = ro.valid.reshape(-1)
+                if build_filtered:
+                    # the artifact sorts the FULL snapshot; query filters
+                    # on the build side apply through this pass mask
+                    # instead of a re-sort
+                    counts, basec, cum = _dj.match_ranges(
+                        skeys, order, pass_flat, pkeys)
+
+                    def locate(b, r):
+                        return _dj.nth_match(b, r, cum, order)
+                else:
+                    counts, basec = _dj.match_ranges_dense(skeys, pkeys)
+
+                    def locate(b, r):
+                        return _dj.nth_match_dense(b, r, order)
+            else:
+                # derived build (semi/anti only): sort in-trace — the
+                # sentinel already excludes filtered/NULL/dead rows, so
+                # the dense range math applies
+                rpairs_b = [DVal(_broadcast_to_mask(d.value, ro.valid),
+                                 _broadcast_to_mask(d.null, ro.valid)
+                                 if d.null is not None else None, d.dtype)
+                            for d in rpairs]
+                bnull = None
+                for d in rpairs_b:
+                    bnull = _or_null(bnull, d.null)
+                bkeys = _dj.encode_build_keys(
+                    [(d.value.reshape(-1),
+                      d.null.reshape(-1) if d.null is not None else None)
+                     for d in rpairs_b],
+                    ro.valid.reshape(-1),
+                    bnull.reshape(-1) if bnull is not None else None)
+                order = jnp.argsort(bkeys)
+                skeys = bkeys[order]
+                pass_flat = ro.valid.reshape(-1)
+                counts, basec = _dj.match_ranges_dense(skeys, pkeys)
+
+                def locate(b, r):
+                    return _dj.nth_match_dense(b, r, order)
+            found = counts > 0
             if how == "semi":
                 return RelOut(dict(lo.cols), lo.valid & found)
             if how == "anti":
                 return RelOut(dict(lo.cols), lo.valid & ~found)
-            cols: Dict[int, DVal] = dict(lo.cols)
-            for i in sorted(ro.cols.keys()):
-                src = ro.cols[i]
-                flat_v = _broadcast_to_mask(src.value, ro.valid).reshape(-1)
-                gv = flat_v[order][posc]
-                gnull = None
-                if src.null is not None:
-                    flat_n = _broadcast_to_mask(src.null, ro.valid).reshape(-1)
-                    gnull = flat_n[order][posc]
-                if how == "left":
-                    gnull = _or_null(gnull, ~found)
-                cols[nleft + i] = DVal(gv, gnull, src.dtype, src.dictionary)
-            valid = lo.valid & found if how == "inner" else lo.valid
-            out = RelOut(cols, valid)
+
+            if ctx.static[mode_si] == 0 and how in ("inner", "left"):
+                # unique build: at most ONE passing match per probe row —
+                # direct gather on the probe shape, no expansion overhead
+                bpos = locate(basec, jnp.int64(0))
+                cols: Dict[int, DVal] = dict(lo.cols)
+                for i in sorted(ro.cols.keys()):
+                    src = ro.cols[i]
+                    flat_v = _broadcast_to_mask(src.value, ro.valid) \
+                        .reshape(-1)
+                    gv = flat_v[bpos]
+                    gnull = None
+                    if src.null is not None:
+                        gnull = _broadcast_to_mask(src.null, ro.valid) \
+                            .reshape(-1)[bpos]
+                    if how == "left":
+                        gnull = _or_null(gnull, ~found)
+                    cols[nleft + i] = DVal(gv, gnull, src.dtype,
+                                           src.dictionary)
+                valid = lo.valid & found if how == "inner" else lo.valid
+                out = RelOut(cols, valid)
+            else:
+                # one-to-many expansion (and right/full NULL-extension of
+                # unmatched build rows): FLAT bucketed output
+                pvalid_flat = lo.valid.reshape(-1)
+                counts_f = jnp.where(pvalid_flat, counts.reshape(-1),
+                                     jnp.int64(0))
+                base_f = basec.reshape(-1)
+                bucket = ctx.static[bucket_si] \
+                    if ctx.static[mode_si] == 1 \
+                    else int(pvalid_flat.shape[0])
+                if how in ("left", "full"):
+                    # unmatched (or NULL-key) probe rows keep one slot
+                    counts_eff = jnp.where(pvalid_flat,
+                                           jnp.maximum(counts_f, 1),
+                                           jnp.int64(0))
+                else:
+                    counts_eff = counts_f
+                probe_of, rank, matched, slot_valid, total = _dj.expand(
+                    counts_f, counts_eff, bucket)
+                bpos = locate(base_f[probe_of], rank)
+                # filters only shrink the bound, so this can fire only on
+                # a probe/build mutation racing the bind — reroute to the
+                # exact host path rather than drop rows silently
+                ctx.overflow = ctx.overflow | (total > bucket)
+                ext = how in ("right", "full")
+                F = int(order.shape[0])
+
+                def flat_pair(dv, mask2d):
+                    v = _broadcast_to_mask(dv.value, mask2d).reshape(-1)
+                    nl = _broadcast_to_mask(dv.null, mask2d).reshape(-1) \
+                        if dv.null is not None else None
+                    return v, nl
+
+                cols = {}
+                for i in sorted(lo.cols.keys()):
+                    dv = lo.cols[i]
+                    if isinstance(dv.value, tuple):
+                        raise CompileError("array-plate column through "
+                                           "an expanding join: host path")
+                    v, nl = flat_pair(dv, lo.valid)
+                    gv = v[probe_of]
+                    gnull = nl[probe_of] if nl is not None else None
+                    if ext:  # build-extension slots: left side is NULL
+                        gv = jnp.concatenate(
+                            [gv, jnp.zeros((F,), gv.dtype)])
+                        gnull = jnp.concatenate(
+                            [gnull if gnull is not None
+                             else jnp.zeros((bucket,), jnp.bool_),
+                             jnp.ones((F,), jnp.bool_)])
+                    cols[i] = DVal(gv, gnull, dv.dtype, dv.dictionary)
+                ext_valid = None
+                if ext:
+                    # mark build rows consumed by a matched slot via
+                    # scatter; the rest NULL-extend (right/full outer)
+                    consumed = jnp.zeros((F,), jnp.bool_).at[
+                        jnp.where(matched, bpos, F)].set(True, mode="drop")
+                    ext_valid = pass_flat & ~consumed
+                for i in sorted(ro.cols.keys()):
+                    src = ro.cols[i]
+                    if isinstance(src.value, tuple):
+                        raise CompileError("array-plate column through "
+                                           "an expanding join: host path")
+                    v, nl = flat_pair(src, ro.valid)
+                    gv = v[bpos]
+                    gnull = nl[bpos] if nl is not None else None
+                    if how in ("left", "full"):
+                        gnull = _or_null(gnull, ~matched)
+                    if ext:
+                        gv = jnp.concatenate([gv, v])
+                        gnull = jnp.concatenate(
+                            [gnull if gnull is not None
+                             else jnp.zeros((bucket,), jnp.bool_),
+                             nl if nl is not None
+                             else jnp.zeros((F,), jnp.bool_)])
+                    cols[nleft + i] = DVal(gv, gnull, src.dtype,
+                                           src.dictionary)
+                valid = slot_valid
+                if ext:
+                    valid = jnp.concatenate([valid, ext_valid])
+                out = RelOut(cols, valid)
             if residual_run is not None:
                 rt = Runtime(out.cols, ctx.params, ctx.aux_slice(builder))
                 p = residual_run(rt)
@@ -1264,6 +1677,22 @@ class Compiler:
             return out
 
         return run_join, out_scope
+
+    def _resolve_join_source(self, plan: ast.Plan, ordinal: int,
+                             rel_lo: int, rel_hi: int):
+        """Resolve a join-side scope ordinal to (_RelationInput, TableInfo,
+        base ordinal) — the leaf whose device plates the build artifact /
+        expansion bound read outside the trace.  None when the column is
+        derived, spans a nested join, or the side references the same
+        base table more than once (ambiguous)."""
+        got = self._resolve_build_source(plan, ordinal)
+        if got is None:
+            return None
+        info, ci = got
+        rels = [r for r in self.relations[rel_lo:rel_hi] if r.info is info]
+        if len(rels) != 1:
+            return None
+        return rels[0], info, ci
 
     def _resolve_build_source(self, plan: ast.Plan, ordinal: int
                               ) -> Optional[Tuple[object, int]]:
@@ -1952,7 +2381,9 @@ class Compiler:
                 pairs.append((dv.value, dv.null))
             notes[ctx.static] = {"passes": note["passes"],
                                  "strategies": frozenset(note["strategies"])}
-            return gvalid, tuple(pairs), overflow
+            # nested data-dependent overflows (join expansion past its
+            # bucket) ride the same flag: the executor reruns on host
+            return gvalid, tuple(pairs), overflow | ctx.overflow
 
         self._agg_pre_emit = run_pre
         self._agg_main_emit = run_main
@@ -2051,6 +2482,11 @@ class _TraceCtx:
         self.aux = aux
         self.params = params
         self.static = static
+        # trace-time side channel: nested nodes (the expanding join) OR
+        # their data-dependent overflow flags here; the region root folds
+        # it into the compiled output's third slot so the executor can
+        # reroute to the exact host path
+        self.overflow = jnp.asarray(False)
 
     def aux_slice(self, builder) -> List:
         off = getattr(builder, "_aux_offset", 0)
@@ -2190,15 +2626,13 @@ def _extreme(np_dtype, positive: bool):
 def _key_bits(v):
     """Exact int64 representation of a grouping/join key: floats BITCAST
     (a plain cast truncated 2.1 and 2.9 both to 2, collapsing float
-    groups), with ±0.0 normalized so they group together."""
-    arr = jnp.asarray(v)
-    if jnp.issubdtype(arr.dtype, jnp.floating):
-        arr = jnp.where(arr == 0, jnp.zeros((), dtype=arr.dtype), arr)
-        if arr.dtype == jnp.float64:
-            return jax.lax.bitcast_convert_type(arr, jnp.int64)
-        return jax.lax.bitcast_convert_type(
-            arr.astype(jnp.float32), jnp.int32).astype(jnp.int64)
-    return arr.astype(jnp.int64)
+    groups), with ±0.0 normalized so they group together.  Single
+    implementation in ops/join.py — the cached build artifact and the
+    bind-time expansion bound encode keys OUTSIDE the trace, and the
+    domains must never drift."""
+    from snappydata_tpu.ops.join import key_bits
+
+    return key_bits(v)
 
 
 def _combine_keys(dvals: List[DVal]):
@@ -2207,24 +2641,11 @@ def _combine_keys(dvals: List[DVal]):
     exact bit pattern are ~2⁻⁶⁴). Multiple: mixed via a 64-bit hash with
     the null flag folded in exactly (documented collision risk ~ n²·2⁻⁶⁴;
     exact multi-key via packing/sort lands with the generic hash table).
-    NULL keys hash to their own group per SQL GROUP BY semantics."""
-    if len(dvals) == 1:
-        d = dvals[0]
-        bits = _key_bits(d.value)
-        if d.null is not None:
-            bits = jnp.where(d.null, _I64_MAX - 1, bits)
-        return bits
-    acc = jnp.zeros(jnp.shape(dvals[0].value), dtype=jnp.uint64)
-    for d in dvals:
-        k = _key_bits(d.value).astype(jnp.uint64)
-        k = (k ^ (k >> 30)) * jnp.uint64(0xbf58476d1ce4e5b9)
-        k = (k ^ (k >> 27)) * jnp.uint64(0x94d049bb133111eb)
-        k = k ^ (k >> 31)
-        acc = acc * jnp.uint64(0x100000001b3) + k
-        if d.null is not None:
-            # exact: a NULL key differs from every value in its own bit
-            acc = acc * jnp.uint64(2) + d.null.astype(jnp.uint64)
-    return acc.astype(jnp.int64)
+    NULL keys hash to their own group per SQL GROUP BY semantics.
+    Delegates to ops/join.py (see _key_bits)."""
+    from snappydata_tpu.ops.join import combine_key_arrays
+
+    return combine_key_arrays([(d.value, d.null) for d in dvals])
 
 
 def _broadcast_to_mask(v, mask):
@@ -2429,8 +2850,11 @@ class Executor:
         global_broker().register_executor(self)
 
     def clear_cache(self):
+        from snappydata_tpu.ops.join import clear_join_caches
+
         self._plan_cache.clear()
         clear_gidx_cache()
+        clear_join_caches()
 
     def compiled_partial(self, node: ast.Plan) -> Optional[CompiledPlan]:
         """Compile an analyzed/tokenized partial-aggregate plan in

@@ -1430,11 +1430,21 @@ def _eval_aggregate(plan: ast.Aggregate, params, executor):
 
 def _agg_one(e: ast.Expr, key, groups, idx, cols, nulls, params, n):
     """Evaluate one select-list expression for one group (host, exact)."""
+    import pandas as pd
+
     if isinstance(e, ast.Alias):
         return _agg_one(e.child, key, groups, idx, cols, nulls, params, n)
     for gi, g in enumerate(groups):
         if e == g:
-            return key[gi]
+            v = key[gi]
+            # pandas groupby(dropna=False) hands a NULL group key back
+            # as NaN/NaT — restore SQL NULL or the key loses its null
+            # mask downstream (a NULL-extended string key would render
+            # as nan and sort as the string "nan", breaking NULLS FIRST)
+            if v is not None and not isinstance(v, (tuple, list)) \
+                    and pd.isna(v):
+                return None
+            return v
     if isinstance(e, ast.Func) and e.name in ast.AGG_FUNCS:
         if e.name == "count" and not e.args:
             return len(idx)

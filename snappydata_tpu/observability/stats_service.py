@@ -89,6 +89,48 @@ def scan_snapshot() -> dict:
     }
 
 
+def join_snapshot() -> dict:
+    """Join-engine stats: live knobs + the device/host path counters for
+    REST `/status/api/v1/join` and the dashboard's Join section.
+    join_device_joins counts binds that stayed on device,
+    join_host_fallbacks the reroutes to the pandas host join — itemized
+    BY REASON STRING so a perf cliff is diagnosable from the dashboard;
+    join_build_sorts vs join_build_cache_hits shows whether repeated
+    joins skip the build argsort; join_expand_factor is expanded output
+    rows per probe row on the one-to-many path."""
+    from snappydata_tpu import config
+    from snappydata_tpu.ops.join import join_build_cache_nbytes
+
+    snap = global_registry().snapshot()
+    c = snap["counters"]
+    props = config.global_properties()
+    hits = c.get("join_build_cache_hits", 0)
+    misses = c.get("join_build_cache_misses", 0)
+    out_rows = c.get("join_expand_out_rows", 0)
+    in_rows = c.get("join_expand_probe_rows", 0)
+    return {
+        "device_join": props.get("device_join"),
+        "join_expand_max_bytes": props.get("join_expand_max_bytes"),
+        "join_build_cache_bytes": props.get("join_build_cache_bytes"),
+        "join_device_joins": c.get("join_device_joins", 0),
+        "join_host_fallbacks": c.get("join_host_fallbacks", 0),
+        "join_fallback_reasons": {
+            k[len("join_fallback_"):]: v for k, v in sorted(c.items())
+            if k.startswith("join_fallback_")},
+        "join_build_sorts": c.get("join_build_sorts", 0),
+        "join_build_cache_hits": hits,
+        "join_build_cache_misses": misses,
+        "join_build_cache_hit_rate":
+            round(hits / (hits + misses), 3) if hits + misses else None,
+        "join_build_cache_nbytes": join_build_cache_nbytes(),
+        "join_trans_cache_hits": c.get("join_trans_cache_hits", 0),
+        "join_expand_out_rows": out_rows,
+        "join_expand_probe_rows": in_rows,
+        "join_expand_factor":
+            round(out_rows / in_rows, 3) if in_rows else None,
+    }
+
+
 class TableStatsService:
     def __init__(self, catalog, interval_s: Optional[float] = None,
                  registry=None):

@@ -163,21 +163,31 @@ class ResourceBroker:
             host[nm] = host.get(nm, 0) + _host_table_bytes(data)
         device = device_cache_bytes_by_table(tables)
         from snappydata_tpu.engine.executor import gidx_cache_nbytes
+        from snappydata_tpu.ops.join import join_build_cache_nbytes
 
         gidx_bytes = gidx_cache_nbytes()
+        join_bytes = join_build_cache_nbytes()
         with self._cond:
             queries = {qid: int(ctx.estimate_bytes)
                        for qid, ctx in self._active.items()}
+        # this walk IS the measurement — refresh the gauge cache so a
+        # metrics scrape right after a ledger read can't serve a value
+        # staler than the ledger it's compared against
+        host_total = sum(host.values())
+        device_total = sum(device.values()) + gidx_bytes + join_bytes
+        self._measured_cache = (time.monotonic(), host_total, device_total)
         return {
             "host": host,
             "device": device,
             "spill_file_bytes": hoststore.spill_file_bytes(),
-            "host_total": sum(host.values()),
+            "host_total": host_total,
             # group-index cache entries are device arrays too (valid +
             # gidx + matmul one-hot, up to gidx_cache_bytes) — reclaimed
-            # with plan caches by the degradation ladder (clear_cache)
+            # with plan caches by the degradation ladder (clear_cache);
+            # same story for the join build-artifact cache
             "gidx_cache_bytes": gidx_bytes,
-            "device_total": sum(device.values()) + gidx_bytes,
+            "join_build_cache_bytes": join_bytes,
+            "device_total": device_total,
             "queries": queries,
             "inflight_bytes": int(self._inflight_bytes),
         }
@@ -193,11 +203,12 @@ class ResourceBroker:
         from snappydata_tpu.storage.device import device_cache_bytes_by_table
 
         from snappydata_tpu.engine.executor import gidx_cache_nbytes
+        from snappydata_tpu.ops.join import join_build_cache_nbytes
 
         tables = self._iter_tables()
         host = sum(_host_table_bytes(d) for _, d in tables)
         device = sum(device_cache_bytes_by_table(tables).values()) \
-            + gidx_cache_nbytes()
+            + gidx_cache_nbytes() + join_build_cache_nbytes()
         self._measured_cache = (time.monotonic(), host, device)
         return host, device
 

@@ -359,13 +359,20 @@ class SnappySession:
         if broker.accounting_enabled():
             estimate = resource.estimate_statement_bytes(self.catalog, stmt)
             tile = self._tile_budget()
-            if tile > 0 and not params \
-                    and self._tilable_agg_shape(stmt.plan) is not None:
+            shaped = self._tilable_agg_shape(stmt.plan) \
+                if tile > 0 and not params else None
+            if shaped is not None:
                 # the engine streams this shape tile-by-tile under
                 # scan_tile_bytes: peak memory is ~one tile, not the
                 # full decoded table — charging the full table would
-                # make every out-of-core aggregate un-admittable
-                estimate = min(estimate, tile)
+                # make every out-of-core aggregate un-admittable.  Join
+                # build sides stay FULLY device-resident across tiles,
+                # so they are charged on top; and when the builds alone
+                # exceed the tile budget the tile pass declines and the
+                # query runs untiled — admit it at full cost
+                bb = self._join_build_side_bytes(shaped[4], shaped[5])
+                if bb is not None and bb < tile:
+                    estimate = min(estimate, tile + bb)
         try:
             # admit INSIDE the try: release() also clears a watched
             # (jobserver-submitted) context when admission fails
@@ -670,8 +677,14 @@ class SnappySession:
     def _tilable_agg_shape(self, plan: ast.Plan):
         """Shared shape probe for the tile pass and the governor's
         admission estimate: ([Sort|Limit]* [Filter(having)]
-        Aggregate(single column table), no subqueries/windows).
-        Returns (outer, having, node, info, exprs) or None."""
+        Aggregate(column table [joined to build tables])), no
+        subqueries/windows.  Joins are tilable on the PROBE side only:
+        the leftmost leaf relation streams in windows while every build
+        side binds fully each tile (its cached join artifact stays
+        device-resident); right/full outer joins would re-emit their
+        NULL-extended build rows per tile, so they never tile.
+        Returns (outer, having, node, info, exprs, build_infos) or
+        None."""
         outer: List[ast.Plan] = []
         node = plan
         while isinstance(node, (ast.Sort, ast.Limit)):
@@ -689,13 +702,16 @@ class SnappySession:
 
         rels: List[str] = []
         exprs: List[ast.Expr] = []
+        join_hows: List[str] = []
 
         def rec(p):
             if isinstance(p, (ast.WindowedRelation, ast.WindowProject,
-                              ast.Values, ast.Join, ast.Union,
+                              ast.Values, ast.Union,
                               ast.SetOp, ast.Distinct)):
                 rels.append("__unsupported__")
                 return
+            if isinstance(p, ast.Join):
+                join_hows.append(p.how)
             if isinstance(p, ast.UnresolvedRelation):
                 rels.append(p.name)
             import dataclasses as _dc
@@ -712,17 +728,71 @@ class SnappySession:
         rec(node)
         if having is not None:
             exprs.append(having)
-        if len(set(rels)) != 1 or "__unsupported__" in rels:
+        if not rels or "__unsupported__" in rels:
+            return None
+        if any(h in ("right", "full") for h in join_hows):
             return None
         for e in exprs:
             for sub in ast.walk(e):
                 if isinstance(sub, (ast.ScalarSubquery, ast.InSubquery,
                                     ast.ExistsSubquery, ast.WindowFunc)):
                     return None
-        info = self.catalog.lookup_table(rels[0])
+        # probe = leftmost leaf (children() order is (left, right), so
+        # DFS leaf order puts the probe chain's base table first)
+        probe_name = rels[0]
+        if sum(1 for r in rels if r.lower() == probe_name.lower()) > 1:
+            return None  # self-join: a window would constrain BOTH sides
+        info = self.catalog.lookup_table(probe_name)
         if info is None or not isinstance(info.data, ColumnTableData):
             return None
-        return outer, having, node, info, exprs
+        build_infos = []
+        for rn in rels[1:]:
+            bi = self.catalog.lookup_table(rn)
+            if bi is None or bi.data is info.data:
+                return None
+            build_infos.append(bi)
+        return outer, having, node, info, exprs, build_infos
+
+    @staticmethod
+    def _decoded_col_width(f) -> Optional[int]:
+        """Decoded device bytes per row for one column (value plate +
+        null byte), or None for complex plates (which neither tile nor
+        budget-estimate yet).  Single source of truth for the tile
+        pass's unit math and the governor's build-side charge — the two
+        must not drift, or admission desynchronizes from the budget."""
+        if isinstance(f.dtype, (T.ArrayType, T.MapType, T.StructType)):
+            return None
+        per = 4 if f.dtype.name == "string" \
+            else np.dtype(f.dtype.device_dtype()).itemsize
+        return per + 1
+
+    def _join_build_side_bytes(self, exprs, build_infos):
+        """Decoded bytes a tilable join+aggregate's build sides pin on
+        device across EVERY tile (0 for single-relation shapes), or
+        None when a complex build plate makes the shape untilable.
+        Shared by the tile pass and the governor's admission estimate —
+        admitting the shape at one tile's cost without charging the
+        device-resident builds would under-admit by whole tables."""
+        if not build_infos:
+            return 0
+        from snappydata_tpu.storage.table_store import RowTableData
+
+        used = {c.name.lower() for e in exprs for c in ast.walk(e)
+                if isinstance(c, ast.Col)}
+        total = 0
+        for bi in build_infos:
+            rows = bi.data.count() if isinstance(bi.data, RowTableData) \
+                else bi.data.snapshot().total_rows()
+            w = 1
+            for f in bi.schema.fields:
+                cw = self._decoded_col_width(f)
+                if cw is None:
+                    return None
+                if f.name.lower() not in used:
+                    continue
+                w += cw
+            total += rows * w
+        return total
 
     def _maybe_tiled_aggregate(self, plan: ast.Plan,
                                user_params) -> Optional[Result]:
@@ -741,7 +811,7 @@ class SnappySession:
         shaped = self._tilable_agg_shape(plan)
         if shaped is None:
             return None
-        outer, having, node, info, exprs = shaped
+        outer, having, node, info, exprs, build_infos = shaped
         data = info.data
 
         from snappydata_tpu.storage.device import (scan_unit_count,
@@ -753,19 +823,25 @@ class SnappySession:
             return None
         used = {c.name.lower() for e in exprs for c in ast.walk(e)
                 if isinstance(c, ast.Col)}
+        # join build sides stay fully device-resident across every tile
+        # (that is the point — the cached build artifact is reused); they
+        # must fit the budget alongside one probe tile, and complex
+        # plates don't tile on either side yet
+        build_bytes = self._join_build_side_bytes(exprs, build_infos)
+        if build_bytes is None or build_bytes >= budget:
+            return None
         cap = data.capacity
         unit_bytes = cap  # shared validity mask
         for f in info.schema.fields:
             if f.name.lower() not in used:
                 continue
-            if isinstance(f.dtype, (T.ArrayType, T.MapType, T.StructType)):
+            cw = self._decoded_col_width(f)
+            if cw is None:
                 return None  # complex plates don't tile yet
-            per = 4 if f.dtype.name == "string" \
-                else np.dtype(f.dtype.device_dtype()).itemsize
-            unit_bytes += cap * (per + 1)
-        if unit_bytes * units <= budget:
+            unit_bytes += cap * cw
+        if unit_bytes * units <= budget - build_bytes:
             return None
-        tile_units = max(1, int(budget // unit_bytes))
+        tile_units = max(1, int((budget - build_bytes) // unit_bytes))
         if self.conf.batches_pow2_bucketing and tile_units > 1:
             tile_units = 1 << (tile_units.bit_length() - 1)
 

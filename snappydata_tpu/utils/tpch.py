@@ -252,6 +252,20 @@ GROUP BY l_orderkey, o_orderdate, o_shippriority
 ORDER BY revenue DESC, o_orderdate
 LIMIT 10"""
 
+# Q3-class bench shape for the device join engine: the one-to-many
+# orders->lineitem expansion (LEFT keeps the probe side as written — a
+# non-unique lineitem build that used to drop to the pandas host join),
+# revenue aggregated over the expanded pairs, grouped by a probe-side
+# dictionary key.  The filtered subquery keeps the host-path comparison
+# honest (both paths filter orders BEFORE joining).
+Q3C = """SELECT o_orderpriority,
+    count(l_orderkey) AS line_count,
+    sum(l_extendedprice * (1 - l_discount)) AS revenue
+FROM (SELECT * FROM orders WHERE o_orderdate < DATE '1995-03-15') o
+    LEFT JOIN lineitem ON o_orderkey = l_orderkey
+GROUP BY o_orderpriority
+ORDER BY o_orderpriority"""
+
 
 def load_tpch(session, sf: float = 0.001, seed: int = 0,
               all_tables: bool = False) -> None:
