@@ -578,6 +578,9 @@ class SnappyFlightServer(flight.FlightServerBase):
         table = reader.read_all()
         arrays, nulls = arrow_to_arrays(table)
         info = self.session.catalog.describe(target)
+        # same gate as every session write lane: acked rows put into a
+        # view's backing table would vanish at the view's next sync
+        self.session._reject_matview_write(info)
         from snappydata_tpu.storage.table_store import RowTableData
 
         # WAL-then-apply under the store's mutation lock (same invariant as
@@ -596,12 +599,16 @@ class SnappyFlightServer(flight.FlightServerBase):
             raw = _restore_none_arrays(arrays, nulls)
             self.session._journal_then(
                 info, "insert", raw, None,
-                lambda: info.data.insert_arrays(raw), sync_force=True)
+                lambda: self.session._fold_views(
+                    info, raw, None, info.data.insert_arrays(raw)),
+                sync_force=True)
         else:
             nmask = nulls if any(m is not None for m in nulls) else None
             self.session._journal_then(
                 info, "insert", arrays, nmask,
-                lambda: info.data.insert_arrays(arrays, nulls=nmask),
+                lambda: self.session._fold_views(
+                    info, arrays, nmask,
+                    info.data.insert_arrays(arrays, nulls=nmask)),
                 sync_force=True)
 
     # -- ops --------------------------------------------------------------
@@ -872,6 +879,7 @@ class SnappyFlightServer(flight.FlightServerBase):
         from snappydata_tpu.storage.table_store import RowTableData
 
         info = self.session.catalog.describe(table)
+        self.session._reject_matview_write(info)  # views have no replicas
         arrays = [np.asarray(c)[mask] for c in result.columns]
         nulls = [np.asarray(nm)[mask] if nm is not None else None
                  for nm in result.nulls]
@@ -886,11 +894,15 @@ class SnappyFlightServer(flight.FlightServerBase):
             raw = _restore_none_arrays(arrays, nulls)
             self.session._journal_then(
                 info, "insert", raw, None,
-                lambda: info.data.insert_arrays(raw), sync_force=True)
+                lambda: self.session._fold_views(
+                    info, raw, None, info.data.insert_arrays(raw)),
+                sync_force=True)
         else:
             self.session._journal_then(
                 info, "insert", arrays, nmask,
-                lambda: info.data.insert_arrays(arrays, nulls=nmask),
+                lambda: self.session._fold_views(
+                    info, arrays, nmask,
+                    info.data.insert_arrays(arrays, nulls=nmask)),
                 sync_force=True)
         # remove promoted rows from the shadow so a LATER promotion of
         # other buckets can't double-promote these

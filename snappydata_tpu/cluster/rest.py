@@ -118,6 +118,20 @@ def _render_dashboard(svc) -> str:
     rows_jn = "".join(
         f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
         for k, v in jn.items())
+    from snappydata_tpu.views import view_snapshot
+
+    mv = view_snapshot(svc.session.catalog)
+    rows_mv = "".join(
+        f"<tr><td>{esc(str(v['name']))}</td>"
+        f"<td>{esc(str(v['base_table']))}</td><td>{v['groups']:,}</td>"
+        f"<td>{v['state_bytes']:,}</td>"
+        f"<td>{'STALE' if v['stale'] else 'fresh'}</td>"
+        f"<td>{v['delta_folds']}</td><td>{v['rows_folded']:,}</td>"
+        f"<td>{v['full_refreshes']}</td></tr>"
+        for v in mv["views"])
+    rows_mvc = "".join(
+        f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
+        for k, v in mv.items() if k != "views")
     recent = list(reversed(svc.session.recent_queries()))[:25]
     rows_q = "".join(
         f"<tr><td>{esc(str(q['sql']))[:120]}</td><td>{q['ms']}</td>"
@@ -149,6 +163,11 @@ text-align:left}}h2{{margin-top:1.5em}}</style></head><body>
 <table>{rows_agg}</table>
 <h2>Join engine (device path / build cache / expansion)</h2>
 <table>{rows_jn}</table>
+<h2>Materialized views ({len(mv["views"])})</h2>
+<table><tr><th>view</th><th>base</th><th>groups</th><th>state bytes</th>
+<th>freshness</th><th>delta folds</th><th>rows folded</th>
+<th>full refreshes</th></tr>{rows_mv}</table>
+<table>{rows_mvc}</table>
 <h2>Counters</h2><table>{counters}</table>
 <h2>Recent queries ({len(recent)})</h2>
 <table><tr><th>sql</th><th>ms</th><th>rows</th><th>user</th></tr>{rows_q}
@@ -236,6 +255,16 @@ class RestService:
                         join_snapshot
 
                     self._send(join_snapshot())
+                elif path == "/status/api/v1/views":
+                    # materialized-view stats: per-view state size /
+                    # staleness / fold counters + the global fold totals
+                    # proving O(delta) maintenance (view definitions leak
+                    # SQL text → same auth as /queries)
+                    if self._principal_session() is None:
+                        return
+                    from snappydata_tpu.views import view_snapshot
+
+                    self._send(view_snapshot(svc.session.catalog))
                 elif path == "/status/api/v1/streaming":
                     # streaming query progress (ref: the structured-
                     # streaming UI tab / StreamingQueryManager.active);

@@ -164,9 +164,11 @@ class ResourceBroker:
         device = device_cache_bytes_by_table(tables)
         from snappydata_tpu.engine.executor import gidx_cache_nbytes
         from snappydata_tpu.ops.join import join_build_cache_nbytes
+        from snappydata_tpu.views.matview import matview_state_nbytes
 
         gidx_bytes = gidx_cache_nbytes()
         join_bytes = join_build_cache_nbytes()
+        view_bytes = matview_state_nbytes()
         with self._cond:
             queries = {qid: int(ctx.estimate_bytes)
                        for qid, ctx in self._active.items()}
@@ -174,7 +176,8 @@ class ResourceBroker:
         # metrics scrape right after a ledger read can't serve a value
         # staler than the ledger it's compared against
         host_total = sum(host.values())
-        device_total = sum(device.values()) + gidx_bytes + join_bytes
+        device_total = sum(device.values()) + gidx_bytes + join_bytes \
+            + view_bytes
         self._measured_cache = (time.monotonic(), host_total, device_total)
         return {
             "host": host,
@@ -184,9 +187,12 @@ class ResourceBroker:
             # group-index cache entries are device arrays too (valid +
             # gidx + matmul one-hot, up to gidx_cache_bytes) — reclaimed
             # with plan caches by the degradation ladder (clear_cache);
-            # same story for the join build-artifact cache
+            # same story for the join build-artifact cache and the
+            # materialized-view [G] accumulator state (evicted to STALE
+            # under pressure, rebuilt by re-aggregation at next read)
             "gidx_cache_bytes": gidx_bytes,
             "join_build_cache_bytes": join_bytes,
+            "matview_state_bytes": view_bytes,
             "device_total": device_total,
             "queries": queries,
             "inflight_bytes": int(self._inflight_bytes),
@@ -204,11 +210,13 @@ class ResourceBroker:
 
         from snappydata_tpu.engine.executor import gidx_cache_nbytes
         from snappydata_tpu.ops.join import join_build_cache_nbytes
+        from snappydata_tpu.views.matview import matview_state_nbytes
 
         tables = self._iter_tables()
         host = sum(_host_table_bytes(d) for _, d in tables)
         device = sum(device_cache_bytes_by_table(tables).values()) \
-            + gidx_cache_nbytes() + join_build_cache_nbytes()
+            + gidx_cache_nbytes() + join_build_cache_nbytes() \
+            + matview_state_nbytes()
         self._measured_cache = (time.monotonic(), host, device)
         return host, device
 
@@ -366,6 +374,16 @@ class ResourceBroker:
             except Exception:
                 pass
         reg.inc("governor_degrade_plan_evictions")
+        host, device = self.measured_bytes()
+        if host + device <= target_bytes:
+            return
+        # materialized-view [G] states are caches too: evictable to
+        # STALE (rebuilt by one re-aggregation at next read) — cheaper
+        # than spilling hot table batches every scan re-decodes
+        from snappydata_tpu.views.matview import evict_all_states
+
+        if evict_all_states():
+            reg.inc("governor_degrade_view_evictions")
         host, device = self.measured_bytes()
         if host + device <= target_bytes:
             return

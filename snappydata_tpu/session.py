@@ -284,6 +284,26 @@ class SnappySession:
                     self.catalog._view_ddl = {}
                 self.catalog._view_ddl[_norm(stmt.name)] = sql_text
                 ds.save_catalog(self.catalog)
+            elif isinstance(stmt, ast.CreateMaterializedView):
+                if not hasattr(self.catalog, "_matview_ddl"):
+                    self.catalog._matview_ddl = {}
+                self.catalog._matview_ddl.setdefault(_norm(stmt.name),
+                                                     sql_text)
+                ds.save_catalog(self.catalog)
+                # first durable image of the fresh state (watermark =
+                # everything journaled so far, which the initial refresh
+                # just aggregated)
+                mv = getattr(self.catalog, "_matviews", {}).get(
+                    _norm(stmt.name))
+                if mv is not None:
+                    with ds.mutation_lock:
+                        ds.checkpoint_matview(mv, mv.wal_seq,
+                                              catalog=self.catalog)
+            elif isinstance(stmt, ast.DropMaterializedView):
+                getattr(self.catalog, "_matview_ddl", {}).pop(
+                    _norm(stmt.name), None)
+                ds.drop_matview_state(_norm(stmt.name))
+                ds.save_catalog(self.catalog)
             elif isinstance(stmt, ast.DropView):
                 getattr(self.catalog, "_view_ddl", {}).pop(
                     _norm(stmt.name), None)
@@ -386,6 +406,10 @@ class SnappySession:
     def execute_statement(self, stmt: ast.Statement, user_params=()) -> Result:
         self._authorize(stmt)
         if isinstance(stmt, ast.Query):
+            # materialized views referenced by the query re-merge their
+            # maintained [G] state into the backing rows when dirty —
+            # O(G), never a base-table rescan unless the view is stale
+            self._sync_referenced_matviews(stmt.plan)
             # HAC surface: WITH ERROR and/or error functions route
             # through stratified estimation (ref hac_contracts.md:38-82)
             if stmt.with_error is not None or \
@@ -420,6 +444,11 @@ class SnappySession:
         if isinstance(stmt, ast.CreateTable):
             return self._create_table(stmt)
         if isinstance(stmt, ast.DropTable):
+            pre = self.catalog.lookup_table(stmt.name)
+            if pre is not None and pre.options.get("materialized_view"):
+                raise ValueError(
+                    f"{stmt.name} is a materialized view — use DROP "
+                    "MATERIALIZED VIEW")
             dropped = self.catalog.drop_table(stmt.name, stmt.if_exists)
             if dropped:
                 # cascade: policies/indexes of the dropped table must not
@@ -453,6 +482,20 @@ class SnappySession:
                            if d["base_table"] == tname]:
                     defs.pop(nm)
                     getattr(self.catalog, "_topks", {}).pop(nm, None)
+                # materialized views over the dropped table go with it
+                # (like policies/indexes — a namesake recreate must not
+                # resurrect folds against a different table); their DDL
+                # and durable state go too, or recovery replays orphans
+                mvs = getattr(self.catalog, "_matviews", {})
+                for vn in [v for v, m in mvs.items()
+                           if m.base_table == tname]:
+                    mv = mvs.pop(vn)
+                    mv.dispose()
+                    self.catalog.drop_table(vn, if_exists=True)
+                    getattr(self.catalog, "_matview_ddl", {}).pop(vn,
+                                                                  None)
+                    if self.disk_store is not None:
+                        self.disk_store.drop_matview_state(vn)
                 # sample maintainers of/over the dropped table
                 maints = getattr(self.catalog, "_sample_maintainers", {})
                 for nm in [n for n, m in maints.items()
@@ -464,7 +507,18 @@ class SnappySession:
                         pass
             return _status()
         if isinstance(stmt, ast.TruncateTable):
-            self.catalog.describe(stmt.name).data.truncate()
+            info = self.catalog.describe(stmt.name)
+            if info.options.get("materialized_view"):
+                raise ValueError(
+                    f"{stmt.name} is a materialized view; it is "
+                    "maintained automatically (DROP MATERIALIZED VIEW to "
+                    "remove it)")
+            info.data.truncate()
+            from snappydata_tpu.views import matview as _mv
+
+            _mv.on_truncate(self.catalog, info.name,
+                            self.disk_store.current_wal_seq()
+                            if self.disk_store else 0)
             return _status()
         if isinstance(stmt, ast.CreateFunction):
             # UDF bodies are python code: same gate as EXEC PYTHON
@@ -505,6 +559,23 @@ class SnappySession:
             return _status()
         if isinstance(stmt, ast.DropView):
             self.catalog.drop_view(stmt.name, stmt.if_exists)
+            return _status()
+        if isinstance(stmt, ast.CreateMaterializedView):
+            return self._create_matview(stmt)
+        if isinstance(stmt, ast.DropMaterializedView):
+            return self._drop_matview(stmt)
+        if isinstance(stmt, ast.RefreshMaterializedView):
+            from snappydata_tpu.catalog.catalog import _norm
+            from snappydata_tpu.views import matviews
+
+            # _norm, not .lower(): REFRESH app.mv must find the view
+            # CREATE registered under the schema-stripped name
+            mv = matviews(self.catalog).get(_norm(stmt.name))
+            if mv is None:
+                raise ValueError(
+                    f"materialized view not found: {stmt.name}")
+            mv.refresh_full(self)
+            mv.sync(self)
             return _status()
         if isinstance(stmt, ast.InsertInto):
             n = self._insert(stmt, user_params)
@@ -597,6 +668,129 @@ class SnappySession:
             self.catalog.describe(entry[0]).data.drop_index(stmt.name)
             return _status()
         raise ValueError(f"unsupported statement {type(stmt).__name__}")
+
+    # ------------------------------------------------------------------
+    # Materialized views (views/matview.py — delta-folded aggregates)
+    # ------------------------------------------------------------------
+
+    def _sync_referenced_matviews(self, plan: ast.Plan) -> None:
+        self._sync_matviews_by_name(_referenced_tables(plan))
+
+    def _sync_expr_matviews(self, exprs) -> None:
+        """Sync matviews read through subqueries inside expressions (the
+        UPDATE/DELETE WHERE path — plans go through
+        _sync_referenced_matviews; a stale view read through a WHERE
+        subquery would otherwise see pre-fold backing rows)."""
+        names = []
+        for e in exprs:
+            if e is not None:
+                names.extend(_expr_subquery_tables(e))
+        if names:
+            self._sync_matviews_by_name(names)
+
+    def _sync_matviews_by_name(self, names) -> None:
+        mvs = getattr(self.catalog, "_matviews", None)
+        if not mvs or getattr(self, "_in_mv_sync", False):
+            return
+        from snappydata_tpu.catalog.catalog import _norm
+
+        names = {_norm(n) for n in names}
+        hit = [mvs[n] for n in names if n in mvs]
+        if not hit:
+            return
+        from snappydata_tpu.observability.metrics import global_registry
+
+        self._in_mv_sync = True
+        try:
+            for mv in hit:
+                mv.sync(self)
+                global_registry().inc("view_reads")
+        finally:
+            self._in_mv_sync = False
+
+    def _create_matview(self, stmt: ast.CreateMaterializedView) -> Result:
+        from snappydata_tpu.catalog.catalog import _norm
+        from snappydata_tpu.views import matview as _mv
+        from snappydata_tpu.views.matview import MaterializedView
+
+        name = _norm(stmt.name)
+        if not hasattr(self.catalog, "_matviews"):
+            self.catalog._matviews = {}
+        if name in self.catalog._matviews:
+            if stmt.if_not_exists:
+                return _status()
+            raise ValueError(
+                f"materialized view already exists: {stmt.name}")
+        if self.catalog.lookup_table(name) is not None or \
+                self.catalog.lookup_view(name) is not None:
+            raise ValueError(f"table or view already exists: {stmt.name}")
+        mv = MaterializedView.define(self, name, stmt.query, "")
+        # backing table: queryable through the normal engine (filters,
+        # joins, sorts over the view all work); writes are refused
+        self.catalog.create_table(name, mv.output_schema, "column",
+                                  {"materialized_view": "true"})
+        self.catalog._matviews[name] = mv
+        self.catalog.generation += 1
+        _mv.ledger_catalog(self.catalog)
+        base_info = self.catalog.lookup_table(mv.base_table)
+        if base_info is not None:
+            _mv.register_unmanaged_write_guard(self.catalog, base_info)
+        if not getattr(self, "_mv_recovering", False):
+            try:
+                mv.refresh_full(self)
+                mv.sync(self)
+            except BaseException:
+                # a failed initial refresh (timeout, admission reject,
+                # injected fault) must not leave a half-created view
+                # that blocks a retried CREATE
+                self.catalog._matviews.pop(name, None)
+                mv.dispose()
+                self.catalog.drop_table(name, if_exists=True)
+                self.catalog.generation += 1
+                raise
+        return _status()
+
+    def _drop_matview(self, stmt: ast.DropMaterializedView) -> Result:
+        from snappydata_tpu.catalog.catalog import _norm
+
+        name = _norm(stmt.name)
+        mvs = getattr(self.catalog, "_matviews", {})
+        mv = mvs.get(name)
+        if mv is None:
+            if stmt.if_exists:
+                return _status()
+            raise ValueError(f"materialized view not found: {stmt.name}")
+        mvs.pop(name)
+        mv.dispose()   # frees the broker-ledgered state bytes
+        self.catalog.drop_table(name, if_exists=True)
+        self.catalog.generation += 1
+        return _status()
+
+    def _reject_matview_write(self, info) -> None:
+        if info.options.get("materialized_view"):
+            raise ValueError(
+                f"{info.name} is a materialized view; it is maintained "
+                "automatically from its base table")
+
+    def _fold_views(self, info, arrays, nulls, out):
+        """Post-apply ingest hook: fold the delta into every dependent
+        view (runs inside the journal mutation scope, so checkpoints see
+        view state consistent with table state)."""
+        from snappydata_tpu.views import matview as _mv
+
+        _mv.fold_ingest(self.catalog, info.name, arrays, nulls)
+        return out
+
+    def _fold_row_put(self, info, arrays, nulls=None) -> None:
+        """View maintenance for a row-table PUT: a keyed upsert may have
+        REPLACED rows whose old image is not visible here, so dependent
+        views go stale; a keyless put is a plain insert and folds."""
+        from snappydata_tpu.views import matview as _mv
+
+        if info.key_columns:
+            _mv.mark_stale(self.catalog, info.name, "keyed put")
+        else:
+            _mv.fold_ingest(self.catalog, info.name, arrays, nulls)
 
     def _explain(self, plan: ast.Plan) -> Result:
         """EXPLAIN: optimized + resolved plan tree, one node per line
@@ -1309,34 +1503,46 @@ class SnappySession:
         replica promotion) set it, scoped to exactly THIS record's seq
         so one put never waits on (or fails for) other sessions'
         records."""
+        from snappydata_tpu.views import matview as _mv
+
         ds = self.disk_store
         if ds is None:
-            return apply_fn()
+            with _mv.managed_base_write():
+                return apply_fn()
         with ds.mutation_lock:
             seq = ds.wal_append(info.name, kind, arrays=arrays,
                                 nulls=nulls)
-            out = apply_fn()
+            with _mv.managed_base_write():
+                out = apply_fn()
         ds.wal_sync(seq, force=sync_force)
         return out
 
     def insert(self, table: str, *rows) -> int:
         self._require(table, "insert")
         info = self.catalog.describe(table)
+        self._reject_matview_write(info)
         arrays, nulls = _rows_to_arrays(info.schema, rows)
         if isinstance(info.data, RowTableData):
             raw = _restore_none_arrays(arrays, nulls)
-            return self._journal_then(info, "insert", raw, None,
-                                      lambda: info.data.insert_arrays(raw))
+            return self._journal_then(
+                info, "insert", raw, None,
+                lambda: self._fold_views(info, raw, None,
+                                         info.data.insert_arrays(raw)))
         return self._journal_then(
             info, "insert", arrays, nulls,
-            lambda: info.data.insert_arrays(arrays, nulls=nulls))
+            lambda: self._fold_views(
+                info, arrays, nulls,
+                info.data.insert_arrays(arrays, nulls=nulls)))
 
     def insert_arrays(self, table: str, arrays: Sequence[np.ndarray]) -> int:
         self._require(table, "insert")
         info = self.catalog.describe(table)
+        self._reject_matview_write(info)
         arrays = [np.asarray(a) for a in arrays]
-        return self._journal_then(info, "insert", arrays, None,
-                                  lambda: info.data.insert_arrays(arrays))
+        return self._journal_then(
+            info, "insert", arrays, None,
+            lambda: self._fold_views(info, arrays, None,
+                                     info.data.insert_arrays(arrays)))
 
     def put(self, table: str, *rows) -> int:
         self._require(table, "insert")
@@ -1349,11 +1555,14 @@ class SnappySession:
         self._require(table, "insert")
         self._require(table, "update")
         info = self.catalog.describe(table)
+        self._reject_matview_write(info)
         arrays = [np.asarray(a) for a in arrays]
 
         def apply():
             if isinstance(info.data, RowTableData):
-                return info.data.put_arrays(arrays)
+                out = info.data.put_arrays(arrays)
+                self._fold_row_put(info, arrays)
+                return out
             return self._column_put(info, arrays)
 
         return self._journal_then(info, "put", arrays, None, apply)
@@ -1364,6 +1573,7 @@ class SnappySession:
         path; WAL kind 'delete_keys')."""
         self._require(table, "delete")
         info = self.catalog.describe(table)
+        self._reject_matview_write(info)
         key_arrays = [np.asarray(a) for a in key_arrays]
         keys = {tuple(c[i] for c in key_arrays)
                 for i in range(len(key_arrays[0]))}
@@ -1377,8 +1587,15 @@ class SnappySession:
                     hits[r] = True
             return hits
 
+        from snappydata_tpu.views import matview as _mv
+
         def apply():
-            return info.data.delete(pred)
+            wrapped, captured = _mv.wrap_delete_predicate(
+                self.catalog, info.name, pred)
+            out = info.data.delete(wrapped)
+            if captured:
+                _mv.fold_deleted(self.catalog, info.name, captured)
+            return out
 
         if self.disk_store is None:
             return apply()
@@ -1431,6 +1648,17 @@ class SnappySession:
         info = self.catalog.describe(stmt.table)
         if info.provider == "sample":
             raise ValueError("ALTER TABLE is not supported on sample tables")
+        if info.options.get("materialized_view"):
+            raise ValueError(
+                f"{stmt.table} is a materialized view; its schema follows "
+                "the view definition")
+        from snappydata_tpu.views import matview as _mview
+
+        # schema change invalidates the compiled maintenance programs:
+        # dependent views re-derive them at the stale-exit refresh
+        for mv in _mview.matviews_on(self.catalog, info.name):
+            mv.mark_stale("alter table")
+            mv.invalidate_scratch()
         if stmt.add:
             cd = stmt.column
             if any(f.name.lower() == cd.name.lower()
@@ -1479,6 +1707,10 @@ class SnappySession:
                 return _status()  # no-op, do NOT re-append (review finding)
             from snappydata_tpu.engine.result import to_host_domain
 
+            # CTAS reads like a query: referenced matviews must re-merge
+            # their maintained state first or the snapshot copies stale
+            # pre-fold backing rows (review finding)
+            self._sync_referenced_matviews(stmt.as_select)
             # CTAS ingests into host plates: exact-decimal columns must
             # leave the scaled-int domain first (else 24.05 stores 2405)
             result = to_host_domain(self._run_query(stmt.as_select))
@@ -1567,6 +1799,9 @@ class SnappySession:
                              ast.DropPolicy, ast.CreateIndex,
                              ast.DropIndex, ast.ExecCode, ast.SetConf,
                              ast.CreateView, ast.DropView,
+                             ast.CreateMaterializedView,
+                             ast.DropMaterializedView,
+                             ast.RefreshMaterializedView,
                              ast.CreateFunction, ast.DropFunction,
                              ast.DeployStmt, ast.UndeployStmt)):
             raise PermissionError(
@@ -2206,7 +2441,12 @@ class SnappySession:
 
     def _insert(self, stmt: ast.InsertInto, user_params) -> int:
         info = self.catalog.describe(stmt.table)
+        self._reject_matview_write(info)
         target_schema = info.schema
+        if not isinstance(stmt.source, ast.Values):
+            # INSERT INTO t SELECT ... FROM some_matview must read a
+            # synced view
+            self._sync_referenced_matviews(stmt.source)
         if isinstance(stmt.source, ast.Values):
             resolved, _ = self.analyzer.analyze_plan(stmt.source)
             src = hosteval.eval_values(resolved, user_params)
@@ -2252,24 +2492,39 @@ class SnappySession:
             arr, nmask = _coerce(src.columns[i], src.nulls[i], f.dtype)
             arrays.append(arr)
             null_masks.append(nmask)
+        from snappydata_tpu.views import matview as _mv
+
         if stmt.overwrite:
             info.data.truncate()
+            _mv.on_truncate(self.catalog, info.name,
+                            self.disk_store.current_wal_seq()
+                            if self.disk_store else 0)
         if stmt.put:
             if isinstance(info.data, RowTableData):
-                return info.data.put_arrays(
-                    _restore_none_arrays(arrays, null_masks))
-            return self._column_put(info, arrays)
+                raw = _restore_none_arrays(arrays, null_masks)
+                out = info.data.put_arrays(raw)
+                self._fold_row_put(info, raw)
+                return out
+            return self._column_put(info, arrays, null_masks)
         if isinstance(info.data, RowTableData):
-            return info.data.insert_arrays(
-                _restore_none_arrays(arrays, null_masks))
-        return info.data.insert_arrays(arrays, nulls=null_masks)
+            raw = _restore_none_arrays(arrays, null_masks)
+            out = info.data.insert_arrays(raw)
+            _mv.fold_ingest(self.catalog, info.name, raw, None)
+            return out
+        out = info.data.insert_arrays(arrays, nulls=null_masks)
+        _mv.fold_ingest(self.catalog, info.name, arrays, null_masks)
+        return out
 
-    def _column_put(self, info, arrays) -> int:
+    def _column_put(self, info, arrays, nulls=None) -> int:
         """PUT INTO a column table: upsert join on key_columns (ref:
         ColumnPutIntoExec = update-matched + insert-rest)."""
+        from snappydata_tpu.views import matview as _mv
+
         keys = info.key_columns
         if not keys:
-            return info.data.insert_arrays(arrays)
+            out = info.data.insert_arrays(arrays)
+            _mv.fold_ingest(self.catalog, info.name, arrays, nulls)
+            return out
         key_idx = [info.schema.index(k) for k in keys]
         incoming = {tuple(np.asarray(arrays[i])[r] for i in key_idx): r
                     for r in range(len(np.asarray(arrays[0])))}
@@ -2285,9 +2540,17 @@ class SnappySession:
             return np.asarray(cols[info.schema.fields[i].name])
 
         # delete matched, then insert everything (same visible effect as
-        # update+insert under the single-statement snapshot)
-        info.data.delete(pred)
-        return info.data.insert_arrays(arrays)
+        # update+insert under the single-statement snapshot).  Dependent
+        # views see the put as subtract-matched + fold-incoming — exact
+        # for sum/count families, stale for min/max (via fold_deleted)
+        wrapped, captured = _mv.wrap_delete_predicate(
+            self.catalog, info.name, pred)
+        info.data.delete(wrapped)
+        if captured:
+            _mv.fold_deleted(self.catalog, info.name, captured)
+        out = info.data.insert_arrays(arrays)
+        _mv.fold_ingest(self.catalog, info.name, arrays, nulls)
+        return out
 
     def _resolve_where(self, table_info, where, user_params):
         from snappydata_tpu.sql.analyzer import (Scope, ScopeEntry,
@@ -2320,6 +2583,9 @@ class SnappySession:
 
     def _update(self, stmt: ast.UpdateStmt, user_params) -> int:
         info = self.catalog.describe(stmt.table)
+        self._reject_matview_write(info)
+        self._sync_expr_matviews(
+            [stmt.where] + [e for _, e in stmt.assignments])
         # '?' positions follow SQL text order: SET expressions, then WHERE
         counter = [0]
         assignments = [(name, self._assign_expr_params(e, counter))
@@ -2333,16 +2599,32 @@ class SnappySession:
             resolved = self._resolve_where(info, e, user_params)
             assigns[name] = self._host_value_fn(info, resolved, user_params)
         pred = self._host_pred_fn(info, where, user_params)
-        return info.data.update(pred, assigns)
+        touched = info.data.update(pred, assigns)
+        if touched:
+            from snappydata_tpu.views import matview as _mv
+
+            # the old image is gone by the time we see the update: any
+            # dependent view re-aggregates at its next read
+            _mv.mark_stale(self.catalog, info.name, "update")
+        return touched
 
     def _delete(self, stmt: ast.DeleteStmt, user_params) -> int:
         info = self.catalog.describe(stmt.table)
+        self._reject_matview_write(info)
+        self._sync_expr_matviews([stmt.where])
         raw_where = self._assign_expr_params(stmt.where, [0]) \
             if stmt.where is not None else None
         where = self._resolve_where(info, raw_where, user_params) \
             if raw_where is not None else ast.Lit(True, T.BOOLEAN)
         pred = self._host_pred_fn(info, where, user_params)
-        return info.data.delete(pred)
+        from snappydata_tpu.views import matview as _mv
+
+        wrapped, captured = _mv.wrap_delete_predicate(
+            self.catalog, info.name, pred)
+        out = info.data.delete(wrapped)
+        if captured:
+            _mv.fold_deleted(self.catalog, info.name, captured)
+        return out
 
     def _host_pred_fn(self, info, resolved_where, user_params):
         names = info.schema.names()

@@ -643,6 +643,31 @@ class DropView(Statement):
 
 
 @dataclasses.dataclass(frozen=True)
+class CreateMaterializedView(Statement):
+    """CREATE MATERIALIZED VIEW name AS <single-relation group-by
+    aggregate> — stored aggregate state maintained by delta-folding the
+    view's partial program over every ingest batch (views/matview.py)."""
+
+    name: str
+    query: Plan = None
+    if_not_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class DropMaterializedView(Statement):
+    name: str
+    if_exists: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class RefreshMaterializedView(Statement):
+    """REFRESH MATERIALIZED VIEW name — force a full re-aggregation of
+    the base table (clears staleness; also the recovery fallback)."""
+
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
 class CreatePolicy(Statement):
     """CREATE POLICY name ON table USING (pred) — row-level security
     filter injected into every scan of the table (ref: RowLevelSecurity

@@ -32,7 +32,7 @@ KEYWORDS = {
     "primary", "key", "options", "external", "sample", "stream", "policy",
     "index", "alter", "add", "column", "deploy", "undeploy", "grant",
     "revoke", "with", "to", "exec", "scala", "over", "explain",
-    "function", "returns",
+    "function", "returns", "materialized", "refresh",
 }
 
 _TWO_CHAR_OPS = {"<=", ">=", "<>", "!=", "||"}

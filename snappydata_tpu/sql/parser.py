@@ -156,6 +156,12 @@ class Parser:
         if low == "values":
             plan = self.values_clause()
             return self._finishing(ast.Query(plan))
+        if low == "refresh":
+            self.next()
+            self.expect_kw("materialized")
+            self.expect_kw("view")
+            return self._finishing(
+                ast.RefreshMaterializedView(self.qualified_name()))
         if low == "deploy":
             return self._finishing(self.deploy_stmt())
         if low == "undeploy":
@@ -918,6 +924,17 @@ class Parser:
             self.expect_kw("replace")
             or_replace = True
         temporary = self.accept_kw("temporary")
+        if self.accept_kw("materialized"):
+            self.expect_kw("view")
+            if_not_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("not")
+                self.expect_kw("exists")
+                if_not_exists = True
+            name = self.qualified_name()
+            self.expect_kw("as")
+            return ast.CreateMaterializedView(name, self.query_expr(),
+                                              if_not_exists=if_not_exists)
         if self.accept_kw("view"):
             name = self.qualified_name()
             self.expect_kw("as")
@@ -1075,6 +1092,14 @@ class Parser:
 
     def drop_stmt(self) -> ast.Statement:
         self.expect_kw("drop")
+        if self.accept_kw("materialized"):
+            self.expect_kw("view")
+            if_exists = False
+            if self.accept_kw("if"):
+                self.expect_kw("exists")
+                if_exists = True
+            return ast.DropMaterializedView(self.qualified_name(),
+                                            if_exists)
         kind = "table"
         for k in ("view", "policy", "index", "function"):
             if self.accept_kw(k):

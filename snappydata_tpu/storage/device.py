@@ -253,12 +253,14 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                       and config.global_properties().device_decode)
             dd_rle: list = []      # (batch row, EncodedColumn)
             dd_bits: list = []
+            dd_vd: list = []       # VALUE_DICT: uint8 codes + value dict
             for i, v in enumerate(views):
                 col = v.batch.columns[ci]
                 device_decodable = (
                     use_dd and not v.deltas
                     and col.encoding in (Encoding.RUN_LENGTH,
-                                         Encoding.BOOLEAN_BITSET))
+                                         Encoding.BOOLEAN_BITSET,
+                                         Encoding.VALUE_DICT))
                 nm = v.null_mask(ci)  # delta-aware (updates can set/clear)
                 if nm is not None:
                     null_mask[i] = nm
@@ -282,9 +284,17 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                         bits = bitmask.unpack(col.data, col.num_rows)
                         smin[i] = float(bits.min())
                         smax[i] = float(bits.max())
+                    elif col.encoding == Encoding.VALUE_DICT and \
+                            len(col.dictionary):
+                        smin[i] = float(np.min(col.dictionary))
+                        smax[i] = float(np.max(col.dictionary))
                 if device_decodable:
-                    (dd_rle if col.encoding == Encoding.RUN_LENGTH
-                     else dd_bits).append((i, col))
+                    if col.encoding == Encoding.RUN_LENGTH:
+                        dd_rle.append((i, col))
+                    elif col.encoding == Encoding.VALUE_DICT:
+                        dd_vd.append((i, col))
+                    else:
+                        dd_bits.append((i, col))
                     continue
                 decoded = v.decoded_column(ci)
                 stacked[i] = T.decimal_to_unscaled(f.dtype, decoded) \
@@ -326,12 +336,13 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                         if dec_exact else vals
                     smin[len(views) + j] = float(stat_src.min())
                     smax[len(views) + j] = float(stat_src.max())
-            if dd_rle or dd_bits:
+            if dd_rle or dd_bits or dd_vd:
                 # only the NON-device-decoded rows cross the link as
                 # decoded plates: upload them compactly and assemble the
                 # full [b, cap] plate on device (HBM-side scatter copies,
                 # not PCIe transfer)
-                dd_set = {i for i, _ in dd_rle} | {i for i, _ in dd_bits}
+                dd_set = {i for i, _ in dd_rle} | {i for i, _ in dd_bits} \
+                    | {i for i, _ in dd_vd}
                 keep = [i for i in range(b) if i not in dd_set]
                 placed = jnp.zeros((b, cap), dtype=dt)
                 nonzero_keep = [i for i in keep if i < b_actual]
@@ -354,6 +365,14 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                     dec = bitset_views_to_plate([c for _, c in dd_bits],
                                                 cap)
                     placed = placed.at[idxs].set(dec.astype(dt))
+                if dd_vd:
+                    from snappydata_tpu.storage.device_decode import \
+                        valdict_views_to_plate
+
+                    idxs = np.array([i for i, _ in dd_vd])
+                    dec = valdict_views_to_plate([c for _, c in dd_vd],
+                                                 cap, dt)
+                    placed = placed.at[idxs].set(dec)
             else:
                 placed = _place(stacked)
             cache[key] = (placed, smin, smax,

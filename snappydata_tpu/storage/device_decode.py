@@ -12,6 +12,12 @@ equivalents are vectorized XLA programs applied at cold bind:
   from cap×itemsize to 2×R×itemsize (R = #runs).
 * BOOLEAN_BITSET: upload the packed bits (uint8 [cap/8]) and unpack with
   shift/mask ops — an 8× transfer reduction.
+* VALUE_DICT: low-cardinality numeric columns upload uint8 codes [cap]
+  plus the tiny value dictionary [D] and gather on device — an
+  itemsize× (≥4×) transfer reduction. This is the encoding the default
+  TPC-H scan engages (l_quantity/l_discount/l_tax are 50/11/9 distinct
+  f64 values), so the bench's device_decode counters are nonzero on the
+  stock workload.
 
 Dictionary string columns need no device decode: their int32 codes ARE
 the on-device representation (group-by/join run on codes). Batches with
@@ -94,6 +100,31 @@ def rle_views_to_plate(rle_cols, cap: int, dt) -> jnp.ndarray:
         _counters["bytes_decoded_equiv"] += int(cap * vals.dtype.itemsize)
         _counters["batches_device_decoded"] += 1
     return _rle_expand(jnp.asarray(vals), jnp.asarray(ends), cap)
+
+
+@jax.jit
+def _valdict_expand(codes: jnp.ndarray, dicts: jnp.ndarray):
+    """codes: [N, cap] uint8; dicts: [N, D] (D padded per call).  Lane j
+    of row i takes dicts[i, codes[i, j]] — a per-batch device gather."""
+    return jnp.take_along_axis(dicts, codes.astype(jnp.int32), axis=1)
+
+
+def valdict_views_to_plate(vd_cols, cap: int, dt) -> jnp.ndarray:
+    """Stack N value-dict columns into decoded plates [N, cap]: the
+    uint8 codes and the (padded) dictionaries cross the link, the
+    values-gather runs in-trace."""
+    d_max = max(1, max(len(c.dictionary) for c in vd_cols))
+    n = len(vd_cols)
+    codes = np.zeros((n, cap), dtype=np.uint8)
+    dicts = np.zeros((n, d_max), dtype=dt)
+    for i, c in enumerate(vd_cols):
+        codes[i, :c.data.shape[0]] = c.data
+        d = np.asarray(c.dictionary, dtype=dt)
+        dicts[i, :d.shape[0]] = d
+        _counters["bytes_encoded"] += int(c.data.nbytes + d.nbytes)
+        _counters["bytes_decoded_equiv"] += int(cap * dicts.dtype.itemsize)
+        _counters["batches_device_decoded"] += 1
+    return _valdict_expand(jnp.asarray(codes), jnp.asarray(dicts))
 
 
 def bitset_views_to_plate(bit_cols, cap: int) -> jnp.ndarray:

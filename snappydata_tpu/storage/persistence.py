@@ -547,6 +547,12 @@ class DiskStore:
     def save_catalog(self, catalog) -> None:
         tables = []
         for info in catalog.list_tables():
+            if info.options.get("materialized_view"):
+                # materialized-view backing tables rebuild from the view
+                # STATE checkpoint (views/<name>.state) + DDL — persisting
+                # them as ordinary tables would collide with the DDL
+                # replay recreating them
+                continue
             tables.append({
                 "name": info.name, "provider": info.provider,
                 "schema": schema_to_json(info.schema),
@@ -561,6 +567,7 @@ class DiskStore:
         # views persist as their DDL text, re-executed on recovery (the
         # reference stores view text in its metastore the same way)
         views = dict(getattr(catalog, "_view_ddl", {}))
+        matviews = dict(getattr(catalog, "_matview_ddl", {}))
         topks = dict(getattr(catalog, "_topk_defs", {}))
         aux = dict(getattr(catalog, "_aux_ddl", {}))  # policies/indexes
         grants = [[user, table, sorted(privs)] for (user, table), privs
@@ -568,9 +575,52 @@ class DiskStore:
         tmp = os.path.join(self.path, "catalog.json.tmp")
         with open(tmp, "w") as fh:
             json.dump({"version": 1, "tables": tables, "views": views,
-                       "topks": topks, "aux_ddl": aux,
+                       "matviews": matviews, "topks": topks,
+                       "aux_ddl": aux,
                        "grants": grants}, fh, indent=1)
         self._durable_replace(tmp, os.path.join(self.path, "catalog.json"))
+
+    # -- materialized-view state ------------------------------------------
+
+    @staticmethod
+    def _live_row_count_of(data) -> int:
+        if hasattr(data, "snapshot"):          # column table: manifest sum
+            return int(data.snapshot().total_rows())
+        return int(data.count())               # row table
+
+    def _views_dir(self) -> str:
+        return os.path.join(self.path, "views")
+
+    def _view_state_path(self, name: str) -> str:
+        return os.path.join(self._views_dir(), f"{name}.state")
+
+    def checkpoint_matview(self, mv, wal_seq: int, catalog=None) -> None:
+        """Persist one view's [G] partial state with its WAL fence: a
+        CRC-framed record (same framing/salvage machinery as the WAL),
+        durable-replaced so a crash mid-write keeps the previous state
+        authoritative.  Caller holds mutation_lock — the state is
+        consistent with everything journaled up to `wal_seq`.  With a
+        catalog, the base table's live row count rides the header so
+        recovery can detect a base that lost unjournaled rows (state
+        claiming rows the WAL can never replay degrades to STALE)."""
+        mv.wal_seq = wal_seq
+        base_rows = None
+        if catalog is not None:
+            base = catalog.lookup_table(mv.base_table)
+            if base is not None:
+                base_rows = self._live_row_count_of(base.data)
+        header, arrays = mv.state_record(base_rows=base_rows)
+        os.makedirs(self._views_dir(), exist_ok=True)
+        tmp = os.path.join(self._views_dir(), f"{mv.name}.tmp")
+        with open(tmp, "wb") as fh:
+            write_record(fh, header, arrays)
+        self._durable_replace(tmp, self._view_state_path(mv.name))
+
+    def drop_matview_state(self, name: str) -> None:
+        try:
+            os.remove(self._view_state_path(name))
+        except FileNotFoundError:
+            pass
 
     # -- checkpoint ------------------------------------------------------
 
@@ -666,8 +716,14 @@ class DiskStore:
             seq = self.current_wal_seq()
             folded = {}
             for info in catalog.list_tables():
+                if info.options.get("materialized_view"):
+                    continue   # rebuilt from the view state below
                 self.checkpoint_table(info, seq)
                 folded[info.name] = seq
+            from snappydata_tpu.views.matview import matviews
+
+            for mv in matviews(catalog).values():
+                self.checkpoint_matview(mv, seq, catalog=catalog)
             self._rotate_wal(folded)
 
     def _write_batch(self, fpath: str, batch: ColumnBatch,
@@ -1186,6 +1242,62 @@ class DiskStore:
                     session.sql(ddl)
                 except Exception:
                     pending_views[name] = ddl
+        # materialized views restore BEFORE WAL replay so the tail past
+        # each view's checkpointed high-watermark re-folds exactly once:
+        # a loaded state at fence W skips records <= W (already folded at
+        # checkpoint time); a missing/damaged state or a fence that does
+        # not match the base table's means the cheap path is gone — the
+        # view comes up STALE and re-aggregates at its first read
+        matview_ddl = dict(meta.get("matviews") or {})
+        if matview_ddl:
+            session._mv_recovering = True
+            try:
+                with _no_journal(session):
+                    for name, ddl in matview_ddl.items():
+                        try:
+                            session.sql(ddl)
+                        except Exception:
+                            continue
+                        mv = getattr(catalog, "_matviews", {}).get(name)
+                        if mv is None:
+                            continue
+                        loaded = False
+                        ckpt_base_rows = None
+                        spath = self._view_state_path(name)
+                        if os.path.exists(spath):
+                            try:
+                                salvage_file(
+                                    spath,
+                                    counter="batch_corrupt_records")
+                                with open(spath, "rb") as fh:
+                                    for header, arrays in \
+                                            read_records(fh):
+                                        mv.load_state(header, arrays)
+                                        ckpt_base_rows = header.get(
+                                            "base_rows")
+                                        loaded = True
+                            except Exception:
+                                loaded = False
+                        base_fence = folded.get(mv.base_table, 0)
+                        base = catalog.lookup_table(mv.base_table)
+                        if not loaded:
+                            mv.stale = True
+                        elif mv.wal_seq != base_fence:
+                            mv.mark_stale("recovery fence mismatch")
+                        elif (ckpt_base_rows is not None
+                              and base is not None
+                              and self._live_row_count_of(base.data)
+                              != ckpt_base_rows):
+                            # the restored base holds a different row
+                            # set than the one the state aggregated —
+                            # unjournaled writes (raw data-layer loads)
+                            # are gone and the WAL can never replay
+                            # them; serving the state would be wrong
+                            mv.mark_stale(
+                                "recovery base-rows mismatch")
+            finally:
+                session._mv_recovering = False
+            catalog._matview_ddl = matview_ddl
         self._replay_wal(catalog, session, folded)
         with _no_journal(session):
             for name, ddl in pending_views.items():
@@ -1411,8 +1523,12 @@ class DiskStore:
         if not getattr(self, "_wal_clean", False):
             salvage_file(wal)
             self._wal_clean = True
-        # replay must not re-journal (records already ARE the journal)
-        with _no_journal(session):
+        # replay must not re-journal (records already ARE the journal);
+        # the managed scope keeps the unmanaged-write guard from marking
+        # views stale for the replay's own data-layer applies
+        from snappydata_tpu.views import matview as _mv_guard
+
+        with _no_journal(session), _mv_guard.managed_base_write():
             self._replay_wal_inner(catalog, session, folded, wal)
 
     def _replay_wal_inner(self, catalog, session, folded: Dict[str, int],
@@ -1446,6 +1562,8 @@ class DiskStore:
                         # way on replay — same end state, keep going
                         pass
                     continue
+                from snappydata_tpu.views import matview as _mv
+
                 ncols = header["ncols"]
                 cols, nulls = arrays[:ncols], arrays[ncols:]
                 if kind == "delete_keys":
@@ -1462,19 +1580,35 @@ class DiskStore:
                                 hits[r] = True
                         return hits
 
-                    info.data.delete(pred)
+                    wrapped, captured = _mv.wrap_delete_predicate(
+                        catalog, table, pred)
+                    info.data.delete(wrapped)
+                    if captured:
+                        _mv.replay_fold_deleted(catalog, table, captured,
+                                                seq)
                     continue
                 any_nulls = any(nm is not None for nm in nulls)
                 if isinstance(info.data, RowTableData):
                     if kind == "put":
                         info.data.put_arrays(cols)
+                        if info.key_columns:
+                            _mv.mark_stale(catalog, table, "replay put")
+                        else:
+                            _mv.replay_fold(catalog, table, cols, None,
+                                            seq)
                     else:
                         info.data.insert_arrays(cols)
+                        _mv.replay_fold(catalog, table, cols, None, seq)
                 elif kind == "put":
+                    # _column_put subtracts/folds through the live hooks;
+                    # replayed records sit past every fence by the replay
+                    # filter, so those folds are exactly the tail folds
                     session._column_put(info, cols)
                 else:
                     info.data.insert_arrays(
                         cols, nulls=nulls if any_nulls else None)
+                    _mv.replay_fold(catalog, table, cols,
+                                    nulls if any_nulls else None, seq)
 
 
 def _json_safe(v):

@@ -739,11 +739,15 @@ class ColumnTableData:
         codes — merge correctly), then map through the table dictionary."""
         return LazyBatchColumns(self, view)
 
-    def _row_buffer_dict(self) -> Optional[Dict[str, np.ndarray]]:
+    def _row_buffer_dict(self) -> Optional["_RowBufferCols"]:
         if self._row_buffer.count == 0:
             return None
-        return {f.name: self._row_buffer._cols[i][:self._row_buffer.count]
-                for i, f in enumerate(self.schema.fields)}
+        out = _RowBufferCols(
+            {f.name: self._row_buffer._cols[i][:self._row_buffer.count]
+             for i, f in enumerate(self.schema.fields)})
+        out._rb = self._row_buffer
+        out._schema = self.schema
+        return out
 
     def _to_device_domain(self, col_idx: int, values,
                           like: np.ndarray
@@ -809,8 +813,61 @@ class LazyBatchColumns:
             self._cache[name] = got
         return got
 
+    def null_mask(self, name: str) -> Optional[np.ndarray]:
+        """Delta-aware SQL-NULL mask for one column (the delete-capture
+        path needs it: view subtraction must skip the same values the
+        original fold skipped)."""
+        return self._view.null_mask(self._data.schema.index(name))
+
+    def live_mask(self) -> np.ndarray:
+        """Rows a DELETE can actually remove (excludes capacity padding
+        and already-deleted rows) — the delete-capture path must
+        intersect with this or a re-matching predicate would subtract
+        dead/padded rows from dependent views a second time."""
+        return self._view.live_mask()
+
     def keys(self):
         return self._data.schema.names()
+
+
+class _RowBufferCols(dict):
+    """Row-buffer column mapping for mutation predicates, carrying the
+    buffer's null masks so delete-capture sees SQL NULLs exactly."""
+
+    _rb = None
+    _schema = None
+
+    def null_mask(self, name: str) -> Optional[np.ndarray]:
+        if self._rb is None:
+            return None
+        i = self._schema.index(name)
+        m = self._rb._nulls[i]
+        return m[:self._rb.count] if m is not None else None
+
+    def live_mask(self) -> Optional[np.ndarray]:
+        if self._rb is None:
+            return None
+        return self._rb._valid[:self._rb.count]
+
+
+class _LiveRowCols(dict):
+    """Row-table column mapping for delete predicates, carrying the
+    live-row mask so delete-capture skips already-deleted rows, and the
+    SQL-NULL masks so captured subtraction skips exactly the values the
+    original fold skipped (None coerces to NaN/garbage in the typed
+    arrays — without the mask a view would subtract a phantom non-null
+    contribution)."""
+
+    _live = None
+    _nulls = None
+
+    def live_mask(self) -> Optional[np.ndarray]:
+        return self._live
+
+    def null_mask(self, name: str) -> Optional[np.ndarray]:
+        if self._nulls is None:
+            return None
+        return self._nulls.get(name)
 
 
 class RowTableData:
@@ -954,8 +1011,25 @@ class RowTableData:
         with self._lock:
             if not self._live:
                 return 0
-            cols = {f.name: np.array(c, dtype=f.dtype.np_dtype)
-                    for f, c in zip(self.schema.fields, self._cols)}
+            typed, nmasks = {}, {}
+            for f, c in zip(self.schema.fields, self._cols):
+                if any(v is None for v in c):
+                    m = np.fromiter((v is None for v in c),
+                                    dtype=np.bool_, count=len(c))
+                    nmasks[f.name] = m
+                    dt = f.dtype.np_dtype
+                    if dt != np.dtype(object):
+                        # NaN keeps float predicate semantics (NULL
+                        # never compares equal); other dtypes can't
+                        # hold a sentinel, so 0-fill + the mask above.
+                        # Object (string) columns keep embedded None.
+                        fill = (np.nan if np.issubdtype(dt, np.floating)
+                                else 0)
+                        c = [fill if v is None else v for v in c]
+                typed[f.name] = np.array(c, dtype=f.dtype.np_dtype)
+            cols = _LiveRowCols(typed)
+            cols._live = np.array(self._live)
+            cols._nulls = nmasks or None
             hit = np.asarray(predicate(cols)) & np.array(self._live)
             for ordinal in np.flatnonzero(hit):
                 self._live[ordinal] = False

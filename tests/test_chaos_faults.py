@@ -319,6 +319,96 @@ def test_chaos_cluster_schedule(tmp_path):
 
 
 # -----------------------------------------------------------------------
+# materialized-view maintenance under chaos
+# -----------------------------------------------------------------------
+
+@pytest.mark.views
+def test_chaos_matview_no_double_fold(tmp_path):
+    """Seeded insert/delete/checkpoint schedule with torn-write / raise
+    faults on wal.append, wal.group_commit and checkpoint.write while a
+    materialized view delta-folds every mutation.  After EVERY
+    crash-recovery (and at the end), the maintained view state must
+    equal a cold re-aggregation of the recovered base table — the PR 2
+    invariant extended to view state: a WAL record past the view's
+    checkpoint fence folds exactly once (replay), one at/below it never
+    re-folds (no double-fold), and a record whose ack was lost never
+    folds at all."""
+    from snappydata_tpu.views import matviews
+
+    seed = 20260803
+    rng = random.Random(seed)
+    fault.reseed(seed)
+    d = str(tmp_path)
+    s = SnappySession(catalog=Catalog(), data_dir=d, recover=False)
+    s.sql("CREATE TABLE t (k BIGINT, v DOUBLE) USING column")
+    s.sql("CREATE MATERIALIZED VIEW mv AS SELECT k, sum(v) AS sv, "
+          "count(*) AS c FROM t GROUP BY k")
+
+    def view_equals_cold_aggregate(sess):
+        got = sess.sql("SELECT * FROM mv ORDER BY k").rows()
+        cold = sess.sql("SELECT k, sum(v), count(*) FROM t GROUP BY k "
+                        "ORDER BY k").rows()
+        assert len(got) == len(cold), (got, cold)
+        for g, c in zip(got, cold):
+            assert g[0] == c[0] and g[2] == c[2], (g, c)
+            assert abs(g[1] - c[1]) <= 1e-9 * max(abs(c[1]), 1.0), (g, c)
+
+    recoveries = 0
+    injected_before = global_registry().counter("fault_injected")
+    for i in range(80):
+        r = rng.random()
+        if r < 0.12:
+            fault.arm("wal.append", "torn_write",
+                      param=rng.randint(1, 40), count=1)
+        elif r < 0.2:
+            fault.arm("wal.group_commit", "raise", count=1)
+        elif r < 0.27:
+            fault.arm("checkpoint.write", "torn_write",
+                      param=rng.randint(1, 60), count=1)
+        try:
+            if rng.random() < 0.2 and i > 5:
+                s.sql(f"DELETE FROM t WHERE k = {rng.randint(0, 7)}")
+            else:
+                s.sql(f"INSERT INTO t VALUES ({i % 8}, {i}.25)")
+        except Exception:
+            fault.clear()
+            try:
+                s.disk_store.close()
+            except Exception:
+                pass
+            s = SnappySession(data_dir=d, recover=True)
+            recoveries += 1
+            view_equals_cold_aggregate(s)
+        if rng.random() < 0.15:
+            try:
+                s.checkpoint()
+            except Exception:
+                fault.clear()
+                try:
+                    s.disk_store.close()
+                except Exception:
+                    pass
+                s = SnappySession(data_dir=d, recover=True)
+                recoveries += 1
+                view_equals_cold_aggregate(s)
+    fault.clear()
+    view_equals_cold_aggregate(s)
+    assert recoveries >= 3, f"schedule only crashed {recoveries} times"
+    assert global_registry().counter("fault_injected") > injected_before
+    s.disk_store.close()
+    # final recovery + idempotence: two boots, identical fresh view state
+    s2 = SnappySession(data_dir=d, recover=True)
+    view_equals_cold_aggregate(s2)
+    rows = s2.sql("SELECT * FROM mv ORDER BY k").rows()
+    assert "mv" in matviews(s2.catalog)
+    s2.disk_store.close()
+    s3 = SnappySession(data_dir=d, recover=True)
+    assert s3.sql("SELECT * FROM mv ORDER BY k").rows() == rows
+    view_equals_cold_aggregate(s3)
+    s3.disk_store.close()
+
+
+# -----------------------------------------------------------------------
 # long randomized battery (slow tier)
 # -----------------------------------------------------------------------
 

@@ -75,6 +75,105 @@ def test_deltas_fall_back_to_host_decode():
     s.stop()
 
 
+def _valdict_session(n=60_000):
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE vd_t (k BIGINT, qty DOUBLE, price DOUBLE) "
+          "USING column")
+    rng = np.random.default_rng(7)
+    k = np.arange(n, dtype=np.int64)
+    qty = rng.integers(1, 51, n).astype(np.float64)   # 50 distinct
+    price = np.round(rng.uniform(900.0, 105_000.0, n), 2)  # high-card
+    s.insert_arrays("vd_t", [k, qty, price])
+    data = s.catalog.describe("vd_t").data
+    data.force_rollover()
+    return s, k, qty, price, data
+
+
+def test_value_dict_encodes_low_cardinality_numerics():
+    s, k, qty, price, data = _valdict_session()
+    m = data.snapshot()
+    cols = m.views[0].batch.columns
+    assert cols[1].encoding == Encoding.VALUE_DICT
+    assert cols[1].data.dtype == np.uint8
+    assert sorted(cols[1].dictionary.tolist()) == \
+        sorted(set(qty[:cols[1].num_rows].tolist()))
+    assert cols[2].encoding == Encoding.PLAIN, "high-card stays plain"
+    # ≥4x at-rest shrink vs the plain plate
+    assert cols[1].nbytes * 4 <= cols[1].num_rows * 8
+    s.stop()
+
+
+def test_value_dict_decodes_on_device_and_matches():
+    s, k, qty, price, _ = _valdict_session()
+    device_decode.reset_counters()
+    r = s.sql("SELECT qty, count(*), sum(price) FROM vd_t GROUP BY qty "
+              "ORDER BY qty")
+    c = device_decode.counters()
+    assert c["batches_device_decoded"] >= 1
+    assert c["bytes_encoded"] < c["bytes_decoded_equiv"] / 4
+    for q, cnt, sp in r.rows():
+        mm = qty == q
+        assert cnt == int(mm.sum())
+        assert sp == pytest.approx(float(price[mm].sum()), rel=1e-9)
+    # stats-based batch skipping over the dictionary min/max
+    r2 = s.sql("SELECT count(*) FROM vd_t WHERE qty = 17.0")
+    assert r2.rows()[0][0] == int((qty == 17.0).sum())
+    s.stop()
+
+
+def test_value_dict_update_delta_falls_back_to_host():
+    s, k, qty, price, _ = _valdict_session()
+    s.sql("UPDATE vd_t SET qty = 999.0 WHERE k < 25")
+    r = s.sql("SELECT count(*) FROM vd_t WHERE qty = 999.0")
+    assert r.rows()[0][0] == 25
+    r2 = s.sql("SELECT sum(qty) FROM vd_t")
+    expect = float(qty[25:].sum()) + 25 * 999.0
+    assert r2.rows()[0][0] == pytest.approx(expect)
+    s.stop()
+
+
+def test_value_dict_sample_miss_repair_and_nan_guard():
+    from snappydata_tpu import types as T
+    from snappydata_tpu.storage.encoding import (decode_to_numpy,
+                                                 encode_column)
+
+    rng = np.random.default_rng(11)
+    # one rare value the stride sample will miss → repair pass catches it
+    v = rng.integers(0, 200, 100_000).astype(np.float64)
+    v[54_321] = 777.0
+    c = encode_column(v, T.DOUBLE)
+    assert c.encoding == Encoding.VALUE_DICT
+    assert (decode_to_numpy(c) == v).all()
+    # NaN is not code-assignable: stays PLAIN
+    vn = np.where(rng.random(10_000) < 0.5, np.nan, 1.0)
+    assert encode_column(vn, T.DOUBLE).encoding == Encoding.PLAIN
+    # >256 distinct: stays PLAIN
+    vh = rng.integers(0, 5000, 100_000).astype(np.float64)
+    assert encode_column(vh, T.DOUBLE).encoding == Encoding.PLAIN
+
+
+def test_value_dict_persists_and_recovers(tmp_path):
+    d = str(tmp_path / "vd_store")
+    s = SnappySession(catalog=Catalog(), data_dir=d, recover=False)
+    s.sql("CREATE TABLE vd_p (k BIGINT, qty DOUBLE) USING column")
+    rng = np.random.default_rng(13)
+    qty = rng.integers(1, 21, 30_000).astype(np.float64)
+    s.insert_arrays("vd_p", [np.arange(30_000, dtype=np.int64), qty])
+    s.catalog.describe("vd_p").data.force_rollover()
+    s.disk_store.checkpoint(s.catalog)
+    s.stop()
+    s.disk_store.close()
+
+    s2 = SnappySession(data_dir=d, recover=True)
+    m = s2.catalog.describe("vd_p").data.snapshot()
+    assert m.views[0].batch.columns[1].encoding == Encoding.VALUE_DICT
+    r = s2.sql("SELECT sum(qty), count(*) FROM vd_p").rows()
+    assert r[0][1] == 30_000
+    assert r[0][0] == pytest.approx(float(qty.sum()))
+    s2.stop()
+    s2.disk_store.close()
+
+
 def test_disabled_flag_matches():
     old = config.global_properties().device_decode
     try:
