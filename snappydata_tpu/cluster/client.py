@@ -185,12 +185,19 @@ class SnappyClient:
 
         return self._request(once, retry)
 
-    def sql(self, sql: str, params: Sequence = ()) -> pa.Table:
-        """Query → Arrow table (record-batch paged by Flight)."""
+    def sql(self, sql: str, params: Sequence = (),
+            prepared: bool = False) -> pa.Table:
+        """Query → Arrow table (record-batch paged by Flight).
+        `prepared` routes through the server's serving executor —
+        repeated statements skip parse/plan on the server and concurrent
+        requests of one shape fuse into a single device dispatch."""
         def once():
             conn = self._client()
-            ticket = flight.Ticket(json.dumps(self._with_token(
-                {"sql": sql, "params": list(params)})).encode("utf-8"))
+            body = {"sql": sql, "params": list(params)}
+            if prepared:
+                body["prepared"] = True
+            ticket = flight.Ticket(json.dumps(
+                self._with_token(body)).encode("utf-8"))
             return conn.do_get(ticket).read_all()
 
         return self._request(once, retry=True)

@@ -521,7 +521,11 @@ class SnappyFlightServer(flight.FlightServerBase):
         sess = self._session_for(req)
         # scan-shaped queries (project/filter, no aggregate/sort)
         # stream per scan unit — peak host rows bounded by one column
-        # batch even for a SELECT * over an oversized table
+        # batch even for a SELECT * over an oversized table.  This wins
+        # over the `prepared` flag too: a full-table export must NEVER
+        # materialize server-side just because the client asked for
+        # serving-path routing (the serving registry targets small/point
+        # results, not bulk scans)
         streamed = try_stream_scan(sess, req["sql"],
                                    tuple(req.get("params", ())),
                                    page_rows=int(req.get("page_rows",
@@ -529,6 +533,17 @@ class SnappyFlightServer(flight.FlightServerBase):
         if streamed is not None:
             schema, gen = streamed
             return flight.GeneratorStream(schema, gen())
+        if req.get("prepared"):
+            # serving front door: {"sql", "params", "prepared": true}
+            # routes through the prepared-plan registry — repeated
+            # tickets skip parse/plan, concurrent ones fuse into one
+            # vmapped dispatch, the governor admits per principal
+            result = sess.serving_sql(req["sql"],
+                                      tuple(req.get("params", ())))
+            table = result_to_arrow(result)
+            chunk = int(req.get("page_rows", 65536))
+            batches = table.to_batches(max_chunksize=max(1, chunk))
+            return flight.GeneratorStream(table.schema, iter(batches))
         result = sess.sql(req["sql"], params=tuple(req.get("params", ())))
         table = result_to_arrow(result)
         # page as record batches (ref: CachedDataFrame paged collect /

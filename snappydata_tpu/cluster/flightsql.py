@@ -285,7 +285,21 @@ class FlightSqlHandler:
         return flight.FlightInfo(schema, descriptor, [endpoint], -1, -1)
 
     def _query_schema(self, sess, sql: str, params) -> "pa.Schema":
-        schema = sess.query_schema(sql)
+        # already-prepared shapes answer from the serving registry's
+        # cached schema; everything else analyzes WITHOUT registering —
+        # GetFlightInfo of ad-hoc literal-bearing SQL must not churn
+        # real prepared handles out of the registry LRU
+        schema = None
+        try:
+            from snappydata_tpu.serving import registry_for
+
+            handle = registry_for(sess.catalog).peek(sess, sql)
+            if handle is not None:
+                schema = handle.schema
+        except Exception:
+            schema = None
+        if schema is None:
+            schema = sess.query_schema(sql)
         return _widen_decimal_schema(pa.schema(
             [pa.field(fl.name, _ARROW_OF(fl.dtype), fl.nullable)
              for fl in schema.fields]))
@@ -311,8 +325,11 @@ class FlightSqlHandler:
             if st is None:
                 raise flight.FlightServerError(
                     "unknown prepared statement handle")
-            result = sess.sql(st["sql"],
-                              params=tuple(st.get("params", ())))
+            # serving registry: wire-level prepares get compile-once too
+            # — the second execute of a handle is a serving_prepared_hits
+            # hit, and concurrent executes fuse into one device dispatch
+            result = sess.serving_sql(st["sql"],
+                                      params=tuple(st.get("params", ())))
             table = _widen_decimal_table(result_to_arrow(result))
         elif kind in ("CommandGetCatalogs", "CommandGetDbSchemas",
                       "CommandGetTables"):
@@ -340,10 +357,23 @@ class FlightSqlHandler:
                 self._next_handle += 1
                 handle = f"ps{self._next_handle}".encode("utf-8")
                 self._prepared[handle] = {"sql": sql, "params": ()}
-            schema = self._query_schema(sess, sql, ()) \
-                if sql.lstrip().lower().startswith(("select", "with",
-                                                    "values")) \
-                else pa.schema([])
+            if sql.lstrip().lower().startswith(("select", "with",
+                                                "values")):
+                # an explicit wire-level prepare IS the registry's
+                # reason to exist: build the compile-once entry now so
+                # the first execute is already a serving hit
+                try:
+                    from snappydata_tpu.serving import ServingError
+
+                    try:
+                        sess.prepare(sql)
+                    except ServingError:
+                        pass
+                except Exception:   # schema path reports real errors
+                    pass
+                schema = self._query_schema(sess, sql, ())
+            else:
+                schema = pa.schema([])
             result = encode_fields([
                 (1, handle), (2, schema.serialize().to_pybytes())])
             return [pack_any("ActionCreatePreparedStatementResult",

@@ -132,6 +132,17 @@ def _render_dashboard(svc) -> str:
     rows_mvc = "".join(
         f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
         for k, v in mv.items() if k != "views")
+    from snappydata_tpu.serving import serving_snapshot
+
+    sv = serving_snapshot(svc.session.catalog)
+    rows_sv = "".join(
+        f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
+        for k, v in sv.items() if k != "handles")
+    rows_svh = "".join(
+        f"<tr><td>{esc(str(h['sql']))}</td><td>{h['params']}</td>"
+        f"<td>{h['executes']}</td>"
+        f"<td>{esc(str(h['passthrough'] or 'compiled'))}</td></tr>"
+        for h in sv.get("handles", ()))
     recent = list(reversed(svc.session.recent_queries()))[:25]
     rows_q = "".join(
         f"<tr><td>{esc(str(q['sql']))[:120]}</td><td>{q['ms']}</td>"
@@ -163,6 +174,10 @@ text-align:left}}h2{{margin-top:1.5em}}</style></head><body>
 <table>{rows_agg}</table>
 <h2>Join engine (device path / build cache / expansion)</h2>
 <table>{rows_jn}</table>
+<h2>Serving path (prepared statements / micro-batched dispatch)</h2>
+<table>{rows_sv}</table>
+<table><tr><th>prepared sql</th><th>params</th><th>executes</th>
+<th>mode</th></tr>{rows_svh}</table>
 <h2>Materialized views ({len(mv["views"])})</h2>
 <table><tr><th>view</th><th>base</th><th>groups</th><th>state bytes</th>
 <th>freshness</th><th>delta folds</th><th>rows folded</th>
@@ -255,6 +270,16 @@ class RestService:
                         join_snapshot
 
                     self._send(join_snapshot())
+                elif path == "/status/api/v1/serving":
+                    # prepared-statement serving stats: registry
+                    # population + compile-once and batched-dispatch
+                    # evidence counters (handle SQL text leaks literals →
+                    # same auth as /queries)
+                    if self._principal_session() is None:
+                        return
+                    from snappydata_tpu.serving import serving_snapshot
+
+                    self._send(serving_snapshot(svc.session.catalog))
                 elif path == "/status/api/v1/views":
                     # materialized-view stats: per-view state size /
                     # staleness / fold counters + the global fold totals
@@ -419,6 +444,37 @@ class RestService:
                         body["sql"], tuple(body.get("params", ())),
                         session=sess)
                     self._send({"jobId": job_id, "status": "STARTED"})
+                elif path == "/sql":
+                    # synchronous query POST, routed through the serving
+                    # executor: repeated statements hit the prepared-plan
+                    # registry (compile-once) and concurrent requests of
+                    # one shape fuse into a single device dispatch; the
+                    # governor admits per request under the caller's
+                    # principal
+                    sess = self._principal_session()
+                    if sess is None:
+                        return
+                    try:
+                        result = sess.serving_sql(
+                            body["sql"], tuple(body.get("params", ())))
+                        # JSON over HTTP is the small-result surface:
+                        # cap the payload but SAY so — a silently
+                        # truncated result reads as a complete one
+                        # (bulk reads belong on Flight, which streams)
+                        cap = 10000
+                        payload = {
+                            "names": result.names,
+                            "rows": [[_j(v) for v in r]
+                                     for r in result.rows()[:cap]],
+                            "total_rows": result.num_rows,
+                        }
+                        if result.num_rows > cap:
+                            payload["truncated"] = True
+                        self._send(payload)
+                    except (KeyError, TypeError) as e:
+                        self._send({"error": f"bad request: {e}"}, 400)
+                    except Exception as e:      # noqa: BLE001
+                        self._send({"error": str(e)}, 400)
                 elif path.startswith("/queries/") and \
                         path.endswith("/cancel"):
                     # cooperative cancel: flags the query's context; the

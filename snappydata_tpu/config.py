@@ -214,6 +214,23 @@ class Properties:
     # through fault.arm()/REST POST /faults.
     faults: str = ""
 
+    # Prepared-statement serving path (serving/ — compile-once
+    # parameterized plans + adaptive micro-batched dispatch; ref: the
+    # reference ships prepared statements through its thrift/DRDA layer
+    # because per-query parse+plan dominates short queries).
+    # serving_batch_max caps how many concurrent executions of one
+    # prepared plan fuse into a single vmapped device dispatch (<=1
+    # disables batching — every execute goes straight through);
+    # serving_batch_wait_us is how long a LONE request waits for
+    # batchmates before dispatching solo (requests arriving while a
+    # dispatch is in flight pile up and batch with no added wait).
+    serving_batch_max: int = 16
+    serving_batch_wait_us: float = 200.0
+    # Registry LRU cap: prepared plans beyond this evict coldest-first
+    # (serving_handle_evictions); an evicted statement transparently
+    # re-prepares on next use.
+    serving_max_handles: int = 512
+
     # Streaming (ref: SnappySinkCallback.scala:49-360)
     sink_state_table: str = "snappysys_internal____sink_state_table"
     sink_max_retries: int = 3

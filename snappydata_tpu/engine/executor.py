@@ -240,6 +240,9 @@ class CompiledPlan:
         self._jitted: Dict[tuple, Callable] = {}
         self._jitted_pre: Dict[tuple, Callable] = {}
         self._jitted_main: Dict[tuple, Callable] = {}
+        # vmapped variants for the serving micro-batcher, keyed
+        # (static sizes, padded batch size)
+        self._jitted_vmap: Dict[tuple, Callable] = {}
 
     def _bind(self, params: Tuple):
         from snappydata_tpu.observability.metrics import global_registry
@@ -364,6 +367,66 @@ class CompiledPlan:
         instead of round-tripping each tile through the host."""
         _tables, outs = self._run_device(params)
         return outs
+
+    def execute_batched(self, params_list: Sequence[Tuple]):
+        """Fused dispatch over a stack of bind vectors (the serving
+        micro-batcher): bind the relations ONCE, stack each parameter
+        position (and each aux build) along a new leading axis, and run
+        ONE `jax.vmap`-over-the-parameter-axis dispatch for the whole
+        batch — then ONE bulk device→host transfer.  Returns (tables,
+        outs) with every leaf of `outs` carrying a leading batch axis;
+        slice request i with `(outs[0][i], [(v[i], ...)], outs[2][i])`
+        and feed it to `_assemble`.
+
+        Batch skipping is intentionally OFF here (different bind values
+        could keep different batch subsets — the in-trace predicate
+        still filters, skipping is only a pruning optimization), and the
+        gidx split-phase cache is bypassed (its key is per-params).
+        Raises ValueError when per-request aux builds don't stack (e.g.
+        value-dependent LUT shapes) and CompileError on bind-check
+        failure — callers fall back to per-request execution."""
+        from snappydata_tpu.observability.metrics import global_registry
+
+        reg = global_registry()
+        for check in self.bind_checks:
+            check()
+        tables = [r.bind() for r in self.relations]
+        arrays: List = []
+        for r, dt in zip(self.relations, tables):
+            for ci in r.used:
+                arrays.append((dt.columns[ci], dt.nulls.get(ci)))
+            arrays.append(dt.valid)
+        naux = len(self.aux_builders)
+        per_req_aux = [[np.asarray(b(p)) for b in self.aux_builders]
+                       for p in params_list]
+        # np.stack raises ValueError on ragged shapes — the caller's cue
+        # that this plan's aux builds are value-dependent and can't fuse
+        aux = tuple(jnp.asarray(np.stack([a[j] for a in per_req_aux]))
+                    for j in range(naux))
+        static = tuple(p() for p in self.static_providers)
+        nparams = len(params_list[0])
+        pvals = tuple(
+            jnp.asarray(np.stack([_param_scalar(p[k])
+                                  for p in params_list]))
+            for k in range(nparams))
+        key = (static, len(params_list))
+        fn = self._jitted_vmap.get(key)
+        if fn is None:
+            reg.inc("serving_vmap_compiles")
+            fn = jax.jit(jax.vmap(functools.partial(self.traced, static),
+                                  in_axes=(None, 0, 0)))
+            self._jitted_vmap[key] = fn
+        outs = fn(tuple(arrays), aux, pvals)
+        note = self.agg_notes.get(static) if self.agg_notes else None
+        if note is not None:
+            reg.inc("agg_reduce_passes", note["passes"])
+            for s in note["strategies"]:
+                reg.inc("agg_strategy_" + s)
+        # the whole batch comes home in ONE transfer — the amortization
+        # the micro-batcher buys (vs one device_get per request)
+        outs = jax.device_get(outs)
+        reg.inc("serving_bulk_transfers")
+        return tables, outs
 
     def tile_merge_ok(self) -> bool:
         """Bind-time check that a partial-raw compile's group-index space
@@ -2671,15 +2734,17 @@ def _collect_sargs(cond: ast.Expr, rel: _RelationInput) -> None:
         if not (isinstance(c, ast.BinOp) and c.op in flip):
             continue
         col, lit, op = None, None, c.op
+        # '?' Params skip batches like tokenized literals — the getter
+        # reads the bind value at execution time either way
         if isinstance(c.left, ast.Col) and isinstance(
-                c.right, (ast.Lit, ast.ParamLiteral)):
+                c.right, (ast.Lit, ast.ParamLiteral, ast.Param)):
             col, lit = c.left, c.right
         elif isinstance(c.right, ast.Col) and isinstance(
-                c.left, (ast.Lit, ast.ParamLiteral)):
+                c.left, (ast.Lit, ast.ParamLiteral, ast.Param)):
             col, lit, op = c.right, c.left, flip[c.op]
         if col is None or col.dtype is None or not T.is_numeric(col.dtype):
             continue
-        if isinstance(lit, ast.ParamLiteral):
+        if isinstance(lit, (ast.ParamLiteral, ast.Param)):
             get = (lambda params, p=lit.pos: params[p])
         else:
             get = (lambda params, v=lit.value: v)
@@ -2838,9 +2903,15 @@ def _split_equi(cond: Optional[ast.Expr], nleft: int):
 
 class Executor:
     def __init__(self, catalog, props=None):
+        import collections
+
         self.catalog = catalog
         self.props = props or config.global_properties()
-        self._plan_cache: Dict = {}
+        # LRU: hitting plan_cache_size evicts the COLDEST entry only
+        # (plan_cache_evictions) — the old clear-the-world wipe dropped
+        # every hot dashboard/prepared plan on one unlucky miss
+        self._plan_cache: "collections.OrderedDict" = \
+            collections.OrderedDict()
         self._depth = 0
         # plan caches are the first thing the resource broker evicts
         # under memory pressure (weak registration — executors die with
@@ -2856,6 +2927,57 @@ class Executor:
         clear_gidx_cache()
         clear_join_caches()
 
+    # -- plan-cache LRU ----------------------------------------------------
+    # concurrent sessions (Flight threads, jobserver workers) share one
+    # executor; individual OrderedDict ops are GIL-atomic, and the
+    # move_to_end/popitem races that remain are benign (a concurrently
+    # evicted key just recompiles) — guarded with try/except instead of
+    # a lock on the hot path
+
+    def _cache_get(self, key):
+        hit = self._plan_cache.get(key)
+        if hit is not None:
+            try:
+                self._plan_cache.move_to_end(key)
+            except KeyError:
+                pass
+        return hit
+
+    def _cache_put(self, key, value) -> None:
+        from snappydata_tpu.observability.metrics import global_registry
+
+        while len(self._plan_cache) >= self.props.plan_cache_size:
+            try:
+                self._plan_cache.popitem(last=False)
+                global_registry().inc("plan_cache_evictions")
+            except KeyError:
+                break
+        self._plan_cache[key] = value
+
+    def compiled_core(self, node: ast.Plan,
+                      key_str: Optional[str] = None
+                      ) -> Optional[CompiledPlan]:
+        """CompiledPlan for a device-region node via the plan cache, or
+        None when the node has no device lowering (the caller keeps the
+        host/engine path).  The serving subsystem uses this to hold the
+        compiled program for a prepared handle — fused batch dispatches
+        go straight to it without re-walking the plan per execute."""
+        from snappydata_tpu.observability.metrics import global_registry
+
+        key = (key_str if key_str is not None
+               else _plan_key(node, self.catalog), self.catalog.generation)
+        compiled = self._cache_get(key)
+        if compiled is None:
+            reg = global_registry()
+            try:
+                with reg.time("plan_compile"):
+                    compiled = Compiler(self.catalog,
+                                        self.props).compile(node)
+            except CompileError:
+                return None
+            self._cache_put(key, compiled)
+        return compiled
+
     def compiled_partial(self, node: ast.Plan) -> Optional[CompiledPlan]:
         """Compile an analyzed/tokenized partial-aggregate plan in
         partial-raw mode for the tiled scan's on-device merge.  Plan-
@@ -2865,7 +2987,7 @@ class Executor:
 
         key = ("__partial_raw__", _plan_key(node, self.catalog),
                self.catalog.generation)
-        hit = self._plan_cache.get(key)
+        hit = self._cache_get(key)
         if hit is None:
             reg = global_registry()
             try:
@@ -2874,45 +2996,30 @@ class Executor:
                                    partial_raw=True).compile(node)
             except CompileError:
                 hit = False
-            if len(self._plan_cache) >= self.props.plan_cache_size:
-                self._plan_cache.clear()
-            self._plan_cache[key] = hit
+            self._cache_put(key, hit)
         return hit or None
 
-    def execute(self, plan: ast.Plan, params: Tuple = ()) -> Result:
+    def execute(self, plan: ast.Plan, params: Tuple = (),
+                plan_key: Optional[str] = None) -> Result:
         from snappydata_tpu.observability.metrics import global_registry
 
         check_current()  # cancellation point: every (sub)plan execution
         if self._depth:  # nested calls (unions, host fallback) count once
-            return self._execute_with_host_ops(plan, params)
+            return self._execute_with_host_ops(plan, params, plan_key)
         reg = global_registry()
         reg.inc("queries")
         self._depth += 1
         try:
             with reg.time("query"):
-                result = self._execute_with_host_ops(plan, params)
+                result = self._execute_with_host_ops(plan, params, plan_key)
         finally:
             self._depth -= 1
         reg.inc("rows_returned", result.num_rows)
         return result
 
-    def _execute_with_host_ops(self, plan: ast.Plan, params: Tuple) -> Result:
-        host_ops: List = []
-        node = plan
-        while True:
-            if isinstance(node, (ast.Sort, ast.Limit, ast.Distinct)):
-                host_ops.append(node)
-                node = node.children()[0]
-                continue
-            if isinstance(node, ast.Filter) and _is_result_level(node.child):
-                host_ops.append(node)
-                node = node.child
-                continue
-            if isinstance(node, ast.Project) and _is_result_level(node.child):
-                host_ops.append(node)
-                node = node.child
-                continue
-            break
+    def _execute_with_host_ops(self, plan: ast.Plan, params: Tuple,
+                               plan_key: Optional[str] = None) -> Result:
+        host_ops, node = peel_host_ops(plan)
 
         # executeTake early-stop (ref: CachedDataFrame.executeTake:766):
         # a bare LIMIT over a scan chain decodes batches incrementally and
@@ -2923,7 +3030,7 @@ class Executor:
             if taken is not None:
                 return taken
 
-        result = self._execute_core(node, params)
+        result = self._execute_core(node, params, plan_key)
 
         for op in reversed(host_ops):
             result = self._apply_host_op(op, result, params)
@@ -2931,7 +3038,8 @@ class Executor:
 
     # -- core -------------------------------------------------------------
 
-    def _execute_core(self, node: ast.Plan, params: Tuple) -> Result:
+    def _execute_core(self, node: ast.Plan, params: Tuple,
+                      plan_key: Optional[str] = None) -> Result:
         if isinstance(node, ast.Values):
             return hosteval.eval_values(node, params)
         if isinstance(node, ast.Union):
@@ -2950,8 +3058,9 @@ class Executor:
         if fast is not None:
             return fast
 
-        key = (_plan_key(node, self.catalog), self.catalog.generation)
-        compiled = self._plan_cache.get(key)
+        key = (plan_key if plan_key is not None
+               else _plan_key(node, self.catalog), self.catalog.generation)
+        compiled = self._cache_get(key)
         if compiled is None:
             reg.inc("plan_cache_misses")
             try:
@@ -2961,9 +3070,7 @@ class Executor:
             except CompileError:
                 reg.inc("host_fallbacks")
                 return self._host_fallback(node, params)
-            if len(self._plan_cache) >= self.props.plan_cache_size:
-                self._plan_cache.clear()
-            self._plan_cache[key] = compiled
+            self._cache_put(key, compiled)
         else:
             reg.inc("plan_cache_hits")
         try:
@@ -3125,11 +3232,16 @@ class Executor:
         def flatten(e) -> bool:
             if isinstance(e, ast.BinOp) and e.op == "and":
                 return flatten(e.left) and flatten(e.right)
+            # prepared-statement '?' Params qualify exactly like tokenized
+            # literals (found on the serving point-lookup profile: a
+            # prepared `WHERE pk = ?` paid a full device scan + transfer
+            # per execute instead of this O(1) index probe)
             if isinstance(e, ast.BinOp) and e.op == "=" \
                     and isinstance(e.left, ast.Col) \
-                    and isinstance(e.right, (ast.Lit, ast.ParamLiteral)):
-                v = params[e.right.pos] \
-                    if isinstance(e.right, ast.ParamLiteral) else e.right.value
+                    and isinstance(e.right, (ast.Lit, ast.ParamLiteral,
+                                             ast.Param)):
+                v = e.right.value if isinstance(e.right, ast.Lit) \
+                    else params[e.right.pos]
                 name = e.left.name.lower()
                 if name in pairs and pairs[name] != v:
                     return False  # contradictory k=1 AND k=2: engine path
@@ -3251,6 +3363,30 @@ class Executor:
         raise CompileError(f"unknown host op {type(op).__name__}")
 
 
+def peel_host_ops(plan: ast.Plan) -> Tuple[List, ast.Plan]:
+    """Split a plan into (host_ops outermost-first, device-region core).
+    Shared by the executor's dispatch and the serving subsystem's
+    prepared handles — both must agree on what the core node is, or a
+    caller-supplied plan key would label the wrong node."""
+    host_ops: List = []
+    node = plan
+    while True:
+        if isinstance(node, (ast.Sort, ast.Limit, ast.Distinct)):
+            host_ops.append(node)
+            node = node.children()[0]
+            continue
+        if isinstance(node, ast.Filter) and _is_result_level(node.child):
+            host_ops.append(node)
+            node = node.child
+            continue
+        if isinstance(node, ast.Project) and _is_result_level(node.child):
+            host_ops.append(node)
+            node = node.child
+            continue
+        break
+    return host_ops, node
+
+
 def _is_result_level(child: ast.Plan) -> bool:
     """True when `child` produces a (small) materialized result whose
     parent ops should run on host: anything above an Aggregate."""
@@ -3265,5 +3401,11 @@ def _is_result_level(child: ast.Plan) -> bool:
 
 def _plan_key(plan: ast.Plan, catalog) -> str:
     """Structural cache key: the tokenized plan repr is stable because
-    literals are ParamLiteral positions, not values."""
+    literals are ParamLiteral positions, not values.  The repr walk is
+    O(plan) per call — hot callers (the serving subsystem's prepared
+    executes) compute it once and pass it back in; `plan_key_builds`
+    counts the walks so a per-execute regression is CI-guardable."""
+    from snappydata_tpu.observability.metrics import global_registry
+
+    global_registry().inc("plan_key_builds")
     return repr(plan)

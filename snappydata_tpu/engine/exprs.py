@@ -316,14 +316,20 @@ class ExprBuilder:
     # -- literals ----------------------------------------------------------
 
     def _param_value(self, e, params):
-        if isinstance(e, ast.ParamLiteral):
+        if isinstance(e, (ast.ParamLiteral, ast.Param)):
             return params[e.pos]
         if isinstance(e, ast.Lit):
             return e.value
         raise CompileError("expected literal")
 
     def _is_literalish(self, e) -> bool:
-        return isinstance(e, (ast.Lit, ast.ParamLiteral))
+        # prepared-statement '?' Params qualify: every consumer reads the
+        # value through a bind-time `lambda params:` closure, exactly
+        # like tokenized ParamLiterals.  (Serving sweep finding: a string
+        # `?` used to fall through to the numeric param slot — value 0 —
+        # so `WHERE name = ?` silently compared dictionary code 0 and
+        # returned the wrong rows.)
+        return isinstance(e, (ast.Lit, ast.ParamLiteral, ast.Param))
 
     # -- main emit ---------------------------------------------------------
 
@@ -1182,11 +1188,13 @@ class ExprBuilder:
                 x.astype(_float_dtype())))
         if name == "round":
             digits = 0
-            if len(e.args) == 2 and isinstance(e.args[1],
-                                               (ast.Lit, ast.ParamLiteral)):
+            if len(e.args) == 2 and isinstance(
+                    e.args[1], (ast.Lit, ast.ParamLiteral, ast.Param)):
                 if isinstance(e.args[1], ast.Lit):
                     digits = int(e.args[1].value)
                 else:
+                    # tokenized literal or prepared '?': traced scalar
+                    # (a '?' here used to silently round to 0 digits)
                     digits_pos = e.args[1].pos
                     digits = None
             # negative digits: divide by the exact integer power (0.001 is

@@ -41,6 +41,22 @@ class Timer:
         }
 
 
+class _TimeCtx:
+    __slots__ = ("registry", "name", "t0")
+
+    def __init__(self, registry, name):
+        self.registry = registry
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        self.registry.record_time(self.name, time.time() - self.t0)
+        return False
+
+
 class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
@@ -57,18 +73,10 @@ class MetricsRegistry:
             self._gauges[name] = fn
 
     def time(self, name: str):
-        registry = self
-
-        class _Ctx:
-            def __enter__(self):
-                self.t0 = time.time()
-                return self
-
-            def __exit__(self, *exc):
-                registry.record_time(name, time.time() - self.t0)
-                return False
-
-        return _Ctx()
+        # one prebuilt context class: defining it per call cost ~20µs of
+        # __build_class__ on every timed query (visible on the serving
+        # short-query profile)
+        return _TimeCtx(self, name)
 
     def record_time(self, name: str, seconds: float) -> None:
         with self._lock:

@@ -164,18 +164,20 @@ class ResourceBroker:
         device = device_cache_bytes_by_table(tables)
         from snappydata_tpu.engine.executor import gidx_cache_nbytes
         from snappydata_tpu.ops.join import join_build_cache_nbytes
+        from snappydata_tpu.serving import serving_registry_nbytes
         from snappydata_tpu.views.matview import matview_state_nbytes
 
         gidx_bytes = gidx_cache_nbytes()
         join_bytes = join_build_cache_nbytes()
         view_bytes = matview_state_nbytes()
+        serving_bytes = serving_registry_nbytes()
         with self._cond:
             queries = {qid: int(ctx.estimate_bytes)
                        for qid, ctx in self._active.items()}
         # this walk IS the measurement — refresh the gauge cache so a
         # metrics scrape right after a ledger read can't serve a value
         # staler than the ledger it's compared against
-        host_total = sum(host.values())
+        host_total = sum(host.values()) + serving_bytes
         device_total = sum(device.values()) + gidx_bytes + join_bytes \
             + view_bytes
         self._measured_cache = (time.monotonic(), host_total, device_total)
@@ -184,6 +186,10 @@ class ResourceBroker:
             "device": device,
             "spill_file_bytes": hoststore.spill_file_bytes(),
             "host_total": host_total,
+            # prepared-plan registry (serving/): analyzed+tokenized plan
+            # shapes held for compile-once executes — LRU-capped by
+            # serving_max_handles, evicted entries re-prepare on next use
+            "serving_registry_bytes": serving_bytes,
             # group-index cache entries are device arrays too (valid +
             # gidx + matmul one-hot, up to gidx_cache_bytes) — reclaimed
             # with plan caches by the degradation ladder (clear_cache);
@@ -210,10 +216,12 @@ class ResourceBroker:
 
         from snappydata_tpu.engine.executor import gidx_cache_nbytes
         from snappydata_tpu.ops.join import join_build_cache_nbytes
+        from snappydata_tpu.serving import serving_registry_nbytes
         from snappydata_tpu.views.matview import matview_state_nbytes
 
         tables = self._iter_tables()
-        host = sum(_host_table_bytes(d) for _, d in tables)
+        host = sum(_host_table_bytes(d) for _, d in tables) \
+            + serving_registry_nbytes()
         device = sum(device_cache_bytes_by_table(tables).values()) \
             + gidx_cache_nbytes() + join_build_cache_nbytes() \
             + matview_state_nbytes()
@@ -371,6 +379,15 @@ class ResourceBroker:
         for ex in list(self._executors):
             try:
                 ex.clear_cache()
+            except Exception:
+                pass
+        # prepared-plan registries are caches too: evicted statements
+        # transparently re-prepare on next execute
+        from snappydata_tpu.serving.prepared import _REGISTRIES
+
+        for r in list(_REGISTRIES):
+            try:
+                r.clear()
             except Exception:
                 pass
         reg.inc("governor_degrade_plan_evictions")

@@ -227,6 +227,39 @@ class SnappySession:
                     broker.release(query_ctx)
         return self._sql_statement(stmt, sql_text, tuple(params))
 
+    def prepare(self, sql_text: str):
+        """Compile-once prepared statement (ref: the thrift/DRDA layer's
+        prepared statements; serving/prepared.py): parse + analyze +
+        tokenize + compile happen ONCE, every `handle.execute(binds)`
+        feeds the `?` values straight into the jitted program as runtime
+        arguments — and concurrent executes of one handle fuse into a
+        single vmapped device dispatch (serving_batch_max)."""
+        from snappydata_tpu.serving import registry_for
+
+        return registry_for(self.catalog).prepare(self, sql_text)
+
+    def serving_sql(self, sql_text: str, params: Sequence[Any] = (),
+                    query_ctx=None) -> Result:
+        """Front-door query entry: route through the prepared-statement
+        serving registry (compile-once + micro-batched dispatch), falling
+        back to the plain sql() pipeline for statements the registry
+        can't hold (DDL/DML and friends)."""
+        from snappydata_tpu.serving import ServingError
+
+        try:
+            handle = self.prepare(sql_text)
+        except ServingError:
+            return self.sql(sql_text, params, query_ctx=query_ctx)
+        return handle.execute(tuple(params), query_ctx=query_ctx)
+
+    def _named_prepared(self) -> Dict:
+        """SQL-level PREPARE name registry, keyed (user, name) on the
+        shared catalog so network front doors can PREPARE in one request
+        and EXECUTE in the next."""
+        if not hasattr(self.catalog, "_named_prepared"):
+            self.catalog._named_prepared = {}
+        return self.catalog._named_prepared
+
     def _sql_statement(self, stmt: ast.Statement, sql_text: str,
                        params) -> Result:
         ds = self.disk_store
@@ -666,6 +699,34 @@ class SnappySession:
                     return _status()
                 raise ValueError(f"index not found: {stmt.name}")
             self.catalog.describe(entry[0]).data.drop_index(stmt.name)
+            return _status()
+        if isinstance(stmt, ast.PrepareStmt):
+            # registers the shared compile-once entry AND the (user, name)
+            # alias; authorization against the query's tables happens in
+            # registry.prepare (and again per EXECUTE — grants can change
+            # under a held handle)
+            self.prepare(stmt.query_sql)
+            self._named_prepared()[(self.user, stmt.name.lower())] = \
+                stmt.query_sql
+            return _status()
+        if isinstance(stmt, ast.ExecuteStmt):
+            from snappydata_tpu.serving import ServingError
+
+            sql_text = self._named_prepared().get(
+                (self.user, stmt.name.lower()))
+            if sql_text is None:
+                raise ServingError(
+                    f"no prepared statement named {stmt.name!r} "
+                    f"for user {self.user!r} (PREPARE it first)")
+            return self.prepare(sql_text).execute(tuple(stmt.args))
+        if isinstance(stmt, ast.DeallocateStmt):
+            from snappydata_tpu.serving import ServingError
+
+            if self._named_prepared().pop(
+                    (self.user, stmt.name.lower()), None) is None:
+                raise ServingError(
+                    f"no prepared statement named {stmt.name!r} "
+                    f"for user {self.user!r}")
             return _status()
         raise ValueError(f"unsupported statement {type(stmt).__name__}")
 

@@ -668,6 +668,33 @@ class RefreshMaterializedView(Statement):
 
 
 @dataclasses.dataclass(frozen=True)
+class PrepareStmt(Statement):
+    """PREPARE name AS <query> — register the query's SQL under a
+    per-(user, name) handle in the serving registry (serving/).  The
+    query's `?` placeholders become EXECUTE-time bind parameters of ONE
+    compiled plan."""
+
+    name: str
+    query_sql: str
+
+
+@dataclasses.dataclass(frozen=True)
+class ExecuteStmt(Statement):
+    """EXECUTE name [(v1, v2, ...)] — run a PREPAREd statement with
+    literal bind values."""
+
+    name: str
+    args: tuple = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class DeallocateStmt(Statement):
+    """DEALLOCATE [PREPARE] name — drop a named prepared statement."""
+
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
 class CreatePolicy(Statement):
     """CREATE POLICY name ON table USING (pred) — row-level security
     filter injected into every scan of the table (ref: RowLevelSecurity

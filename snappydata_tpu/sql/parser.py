@@ -175,7 +175,80 @@ class Parser:
                 raise SQLSyntaxError(
                     f"LIST expects PACKAGES or JARS, found {what.value!r}")
             return self._finishing(ast.ListDeployed(what.value.lower()))
+        # PREPARE / EXECUTE / DEALLOCATE are statement-leading words, not
+        # reserved keywords (they stay usable as column/table names)
+        word = t.value.lower() if t.kind == "IDENT" else ""
+        if word == "prepare":
+            self.next()
+            name = self.ident()
+            self.expect_kw("as")
+            start = self.peek().pos
+            # validate the query at PREPARE time (clear syntax errors now,
+            # not at first EXECUTE)
+            if self.at_kw("with"):
+                self.with_query()
+            else:
+                self.query_expr()
+            self._finish()
+            return ast.PrepareStmt(
+                name, self.sql[start:].strip().rstrip(";").strip())
+        if word == "execute":
+            self.next()
+            name = self.ident()
+            args = []
+            if self.accept_op("("):
+                if not self.at_op(")"):
+                    while True:
+                        args.append(self._exec_literal())
+                        if not self.accept_op(","):
+                            break
+                self.expect_op(")")
+            return self._finishing(ast.ExecuteStmt(name, tuple(args)))
+        if word == "deallocate":
+            self.next()
+            nt = self.peek()
+            if nt.kind == "IDENT" and nt.value.lower() == "prepare":
+                self.next()             # optional noise word
+            return self._finishing(ast.DeallocateStmt(self.qualified_name()))
         raise SQLSyntaxError(f"cannot parse statement starting at {t.value!r}")
+
+    def _exec_literal(self):
+        """One EXECUTE bind value: NULL/TRUE/FALSE, [signed] number,
+        'string', DATE 'yyyy-mm-dd', TIMESTAMP '...'."""
+        neg = False
+        signed = False
+        while self.at_op("-") or self.at_op("+"):
+            signed = True
+            neg ^= self.next().value == "-"
+        t = self.next()
+        if t.kind == "NUM":
+            v = float(t.value) if any(c in t.value for c in ".eE") \
+                else int(t.value)
+            return -v if neg else v
+        if signed:   # a sign on a non-number is malformed, not ignorable
+            raise SQLSyntaxError(
+                f"EXECUTE: +/- applies only to numeric binds "
+                f"(at {t.pos})")
+        if t.kind == "STR":
+            return t.value
+        kw = t.value.lower()
+        if t.kind == "KW":
+            if kw == "null":
+                return None
+            if kw == "true":
+                return True
+            if kw == "false":
+                return False
+            if kw in ("date", "timestamp"):
+                s = self.next()
+                if s.kind != "STR":
+                    raise SQLSyntaxError(
+                        f"{kw.upper()} expects a quoted string at {s.pos}")
+                return _date_to_days(s.value) if kw == "date" \
+                    else _ts_to_micros(s.value)
+        raise SQLSyntaxError(
+            f"EXECUTE expects literal bind values, found {t.value!r} "
+            f"at {t.pos}")
 
     def deploy_stmt(self) -> ast.Statement:
         """DEPLOY PACKAGE name 'coords' [REPOS 'r'] [PATH 'p'] |

@@ -1,0 +1,605 @@
+"""Prepared-statement serving subsystem (serving/).
+
+Covers: session.prepare / SQL PREPARE-EXECUTE-DEALLOCATE grammar,
+compile-once evidence (zero plan compiles / plan-key walks / cache
+misses across repeated executes), micro-batched dispatch correctness
+under racing threads with distinct bind values and principals,
+cancellation inside a fused batch, value equivalence batched vs
+unbatched, the LRU plan cache + registry eviction, the broker ledger
+line, the REST/FlightSQL front doors, and the bench --check qps guard.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import config
+from snappydata_tpu import types as T
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.observability.metrics import global_registry
+from snappydata_tpu.serving import ServingError
+
+pytestmark = pytest.mark.serving
+
+
+def _counter(name):
+    return global_registry().counter(name)
+
+
+def _compile_count():
+    return global_registry().snapshot()["timers"].get(
+        "plan_compile", {}).get("count", 0)
+
+
+@pytest.fixture()
+def serving_session():
+    from snappydata_tpu import SnappySession
+
+    s = SnappySession(catalog=Catalog())
+    rng = np.random.default_rng(7)
+    s.create_table("accounts",
+                   [("id", T.LONG), ("balance", T.DOUBLE),
+                    ("name", T.STRING)],
+                   provider="row", key_columns=("id",))
+    n = 5000
+    s.insert_arrays("accounts", [
+        np.arange(n, dtype=np.int64), rng.random(n) * 100.0,
+        np.array([f"u{i}" for i in range(n)], dtype=object)])
+    region = rng.integers(0, 16, 20000).astype(np.int64)
+    amount = rng.random(20000)
+    s.create_table("txns", [("region_id", T.LONG), ("amount", T.DOUBLE)],
+                   provider="column")
+    s.insert_arrays("txns", [region, amount])
+    s._region = region
+    s._amount = amount
+    yield s
+    s.stop()
+
+
+AGG_SQL = "SELECT count(*), sum(amount) FROM txns WHERE region_id = ?"
+
+
+def _agg_expect(s, r):
+    m = s._region == r
+    return int(m.sum()), float(s._amount[m].sum())
+
+
+# ---------------------------------------------------------------------
+# basics: handle API + SQL grammar
+# ---------------------------------------------------------------------
+
+def test_prepare_execute_point_and_agg(serving_session):
+    s = serving_session
+    ph = s.prepare("SELECT balance, name FROM accounts WHERE id = ?")
+    row = ph.execute((17,)).rows()
+    naive = s.sql("SELECT balance, name FROM accounts WHERE id = 17").rows()
+    assert row == naive
+    ah = s.prepare(AGG_SQL)
+    for r in (0, 3, 15):
+        cnt, sm = _agg_expect(s, r)
+        got = ah.execute((r,)).rows()[0]
+        assert got[0] == cnt
+        assert abs(got[1] - sm) <= 1e-9 * max(sm, 1.0)
+
+
+def test_prepare_arity_and_non_query_errors(serving_session):
+    s = serving_session
+    h = s.prepare(AGG_SQL)
+    with pytest.raises(ServingError):
+        h.execute(())
+    with pytest.raises(ServingError):
+        h.execute((1, 2))
+    with pytest.raises(ServingError):
+        s.prepare("INSERT INTO txns VALUES (1, 2.0)")
+
+
+def test_sql_prepare_execute_deallocate(serving_session):
+    s = serving_session
+    s.sql("PREPARE get_bal AS SELECT balance FROM accounts WHERE id = ?")
+    got = s.sql("EXECUTE get_bal (23)").rows()
+    assert got == s.sql("SELECT balance FROM accounts WHERE id = 23").rows()
+    # literal kinds: string, negative number, NULL-free reuse
+    s.sql("PREPARE by_name AS SELECT id FROM accounts WHERE name = ?")
+    assert s.sql("EXECUTE by_name ('u7')").rows() == [(7,)]
+    s.sql("DEALLOCATE get_bal")
+    with pytest.raises(ServingError):
+        s.sql("EXECUTE get_bal (23)")
+    with pytest.raises(ServingError):
+        s.sql("EXECUTE never_prepared (1)")
+    # DEALLOCATE PREPARE noise word + unknown name errors
+    with pytest.raises(ServingError):
+        s.sql("DEALLOCATE PREPARE get_bal")
+
+
+def test_prepared_with_order_by_limit(serving_session):
+    s = serving_session
+    h = s.prepare("SELECT region_id, sum(amount) AS sa FROM txns "
+                  "WHERE region_id < ? GROUP BY region_id "
+                  "ORDER BY sa DESC LIMIT 3")
+    got = h.execute((9,)).rows()
+    naive = s.sql("SELECT region_id, sum(amount) AS sa FROM txns "
+                  "WHERE region_id < 9 GROUP BY region_id "
+                  "ORDER BY sa DESC LIMIT 3").rows()
+    assert [(g[0], round(g[1], 9)) for g in got] == \
+        [(x[0], round(x[1], 9)) for x in naive]
+
+
+def test_prepared_passthrough_subquery(serving_session):
+    s = serving_session
+    h = s.prepare("SELECT count(*) FROM txns WHERE region_id = "
+                  "(SELECT min(region_id) FROM txns)")
+    before = _counter("serving_passthrough")
+    got = h.execute(()).rows()
+    assert _counter("serving_passthrough") > before
+    assert got == s.sql("SELECT count(*) FROM txns WHERE region_id = "
+                        "(SELECT min(region_id) FROM txns)").rows()
+
+
+def test_round_digits_bind(serving_session):
+    """round(col, ?) honors the bind value (a '?' digits arg used to
+    silently round to 0 digits on the device path)."""
+    s = serving_session
+    h = s.prepare("SELECT sum(round(amount, ?)) FROM txns "
+                  "WHERE region_id = 0")
+    for d in (0, 2, 3):
+        exp = s.sql(f"SELECT sum(round(amount, {d})) FROM txns "
+                    f"WHERE region_id = 0").rows()
+        got = h.execute((d,)).rows()
+        assert abs(got[0][0] - exp[0][0]) <= 1e-9, (d, got, exp)
+
+
+def test_passthrough_arity_checked(serving_session):
+    s = serving_session
+    h = s.prepare("SELECT count(*) FROM txns WHERE region_id = ? AND "
+                  "amount < (SELECT max(amount) FROM txns)")
+    assert h._entry.passthrough == "subquery"
+    assert h.param_count == 1
+    with pytest.raises(ServingError):
+        h.execute(())
+    with pytest.raises(ServingError):
+        h.execute((1, 2))
+    got = h.execute((3,)).rows()
+    assert got == s.sql("SELECT count(*) FROM txns WHERE region_id = 3 "
+                        "AND amount < (SELECT max(amount) FROM txns)"
+                        ).rows()
+
+
+def test_execute_sign_on_non_numeric_rejected(serving_session):
+    from snappydata_tpu.sql.lexer import SQLSyntaxError
+
+    s = serving_session
+    s.sql("PREPARE sgn AS SELECT count(*) FROM accounts WHERE name = ?")
+    with pytest.raises(SQLSyntaxError):
+        s.sql("EXECUTE sgn (-'u1')")
+
+
+def test_flightinfo_peek_does_not_churn_registry(serving_session):
+    """Metadata-only schema lookups (FlightSQL GetFlightInfo for ad-hoc
+    SQL) must not register entries — only real prepares do."""
+    from snappydata_tpu.serving import registry_for
+
+    s = serving_session
+    reg = registry_for(s.catalog)
+    n0 = len(reg._entries)
+    assert reg.peek(s, "SELECT count(*) FROM txns WHERE region_id = 1") \
+        is None
+    assert len(reg._entries) == n0
+
+
+# ---------------------------------------------------------------------
+# compile-once: zero recompiles / re-tokenizations per execute
+# ---------------------------------------------------------------------
+
+def test_compile_once_counters(serving_session):
+    s = serving_session
+    ph = s.prepare("SELECT balance FROM accounts WHERE id = ?")
+    ah = s.prepare(AGG_SQL)
+    ph.execute((1,))
+    ah.execute((1,))
+    compiles0 = _compile_count()
+    keys0 = _counter("plan_key_builds")
+    misses0 = _counter("plan_cache_misses")
+    hits0 = _counter("serving_prepared_hits")
+    for i in range(20):
+        ph.execute((i,))
+        ah.execute((i % 16,))
+    # the serving fast path re-parses NOTHING: no plan compiles, no
+    # plan-repr walks, no plan-cache misses across 40 executes
+    assert _compile_count() == compiles0
+    assert _counter("plan_key_builds") == keys0
+    assert _counter("plan_cache_misses") == misses0
+    assert _counter("serving_prepared_hits") >= hits0 + 40
+
+
+def test_point_lookup_zero_transfers(serving_session):
+    """A prepared point lookup answers from the index: no device
+    dispatch, no host<->device transfer (the serving profile found the
+    engine's per-execute path paying a full scan per execute because
+    `?` Params didn't qualify for the point fast lane)."""
+    import jax
+
+    s = serving_session
+    ph = s.prepare("SELECT balance FROM accounts WHERE id = ?")
+    ph.execute((0,))
+    p0 = _counter("point_lookups")
+    with jax.transfer_guard("disallow"):
+        for i in range(10):
+            assert ph.execute((i,)).num_rows == 1
+    assert _counter("point_lookups") == p0 + 10
+
+
+def test_one_bulk_transfer_per_fused_dispatch(serving_session):
+    s = serving_session
+    ah = s.prepare(AGG_SQL)
+    entry = ah._entry
+    compiled = entry.compiled_for(s)
+    t0 = _counter("serving_bulk_transfers")
+    params = [entry.lit_params + (r,) for r in range(4)]
+    tables, outs = compiled.execute_batched(params)
+    # one device_get for the whole batch — 1/B transfers per request
+    assert _counter("serving_bulk_transfers") == t0 + 1
+    for i, p in enumerate(params):
+        res = entry.assemble_batched(s, outs, tables, i, p)
+        cnt, sm = _agg_expect(s, i)
+        assert res.rows()[0][0] == cnt
+        assert abs(res.rows()[0][1] - sm) <= 1e-9 * max(sm, 1.0)
+
+
+def test_reprepare_on_ddl(serving_session):
+    s = serving_session
+    h = s.prepare(AGG_SQL)
+    h.execute((1,))
+    r0 = _counter("serving_reprepares")
+    s.sql("ALTER TABLE txns ADD COLUMN note STRING")
+    cnt, sm = _agg_expect(s, 1)
+    got = h.execute((1,)).rows()[0]
+    assert got[0] == cnt and abs(got[1] - sm) <= 1e-9 * max(sm, 1.0)
+    assert _counter("serving_reprepares") > r0
+
+
+# ---------------------------------------------------------------------
+# micro-batched dispatch under racing threads
+# ---------------------------------------------------------------------
+
+def _race(handles_params, wait_us=30000.0):
+    """Run each (callable, params) on its own thread near-simultaneously
+    with a wide coalescing window; returns [(result|None, error|None)]."""
+    props = config.global_properties()
+    saved = props.serving_batch_wait_us
+    props.serving_batch_wait_us = wait_us
+    out = [(None, None)] * len(handles_params)
+    barrier = threading.Barrier(len(handles_params))
+
+    def run(i, fn, params):
+        try:
+            barrier.wait()
+            out[i] = (fn(params), None)
+        except Exception as e:  # noqa: BLE001
+            out[i] = (None, e)
+
+    try:
+        ts = [threading.Thread(target=run, args=(i, fn, p))
+              for i, (fn, p) in enumerate(handles_params)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        props.serving_batch_wait_us = saved
+    return out
+
+
+def test_batched_racing_threads_each_get_own_rows(serving_session):
+    s = serving_session
+    # concurrency must be seen before a lone leader opens its window:
+    # prime it with one fused pair
+    ah = s.prepare(AGG_SQL)
+    d0 = _counter("serving_batched_dispatches")
+    binds = [0, 3, 3, 7, 11, 15]
+    results = _race([(ah.execute, (r,)) for r in binds])
+    for r, (res, err) in zip(binds, results):
+        assert err is None, err
+        cnt, sm = _agg_expect(s, r)
+        got = res.rows()[0]
+        assert got[0] == cnt, (r, got)
+        assert abs(got[1] - sm) <= 1e-9 * max(sm, 1.0), (r, got)
+    assert _counter("serving_batched_dispatches") > d0
+
+
+def test_batched_distinct_principals_share_a_dispatch(serving_session):
+    s = serving_session
+    s.sql("GRANT SELECT ON txns TO u1")
+    s.sql("GRANT SELECT ON txns TO u2")
+    s1 = s.for_user("u1")
+    s2 = s.for_user("u2")
+    h1 = s1.prepare(AGG_SQL)
+    h2 = s2.prepare(AGG_SQL)
+    # one shared registry entry: the second principal's prepare is a hit
+    assert h1._entry is h2._entry
+    d0 = _counter("serving_batched_dispatches")
+    results = _race([(h1.execute, (2,)), (h2.execute, (5,)),
+                     (h1.execute, (9,)), (h2.execute, (13,))])
+    for r, (res, err) in zip((2, 5, 9, 13), results):
+        assert err is None, err
+        cnt, sm = _agg_expect(s, r)
+        assert res.rows()[0][0] == cnt
+        assert abs(res.rows()[0][1] - sm) <= 1e-9 * max(sm, 1.0)
+    assert _counter("serving_batched_dispatches") > d0
+    # an unauthorized principal is refused — at PREPARE for a fresh
+    # statement, and at EXECUTE on a shared already-compiled entry
+    s3 = s.for_user("intruder")
+    with pytest.raises(PermissionError):
+        s3.prepare("SELECT count(*) FROM accounts WHERE id = ?")
+    with pytest.raises(PermissionError):
+        s3.prepare(AGG_SQL).execute((1,))   # registry hit: fails at run
+
+
+def test_cancel_inside_fused_batch_spares_batchmates(serving_session):
+    """Deterministic version of the race: three requests are already
+    collected into one batch, the middle one's context is cancelled —
+    the dispatch gate drops it (its own CancelException), its batchmates
+    still fuse into one device dispatch and get THEIR rows."""
+    from snappydata_tpu import resource
+    from snappydata_tpu.resource.context import CancelException
+    from snappydata_tpu.serving.batcher import MicroBatcher, _Request
+
+    s = serving_session
+    ah = s.prepare(AGG_SQL)
+    entry = ah._entry
+    assert entry.batchable(s)
+    reqs = [_Request(s, entry.lit_params + (r,),
+                     resource.new_query(AGG_SQL, "admin"))
+            for r in (1, 4, 8)]
+    reqs[1].ctx.cancel("test cancel")
+    d0 = _counter("serving_batched_dispatches")
+    f0 = _counter("serving_batch_requests")
+    MicroBatcher()._dispatch(entry, reqs)
+    assert isinstance(reqs[1].error, CancelException)
+    assert reqs[1].result is None
+    for i, r in ((0, 1), (2, 8)):
+        assert reqs[i].error is None
+        cnt, sm = _agg_expect(s, r)
+        got = reqs[i].result.rows()[0]
+        assert got[0] == cnt
+        assert abs(got[1] - sm) <= 1e-9 * max(sm, 1.0)
+    # the two survivors shared ONE fused dispatch
+    assert _counter("serving_batched_dispatches") == d0 + 1
+    assert _counter("serving_batch_requests") == f0 + 2
+
+
+def test_timeout_inside_fused_batch(serving_session):
+    """A request whose statement deadline expired before dispatch raises
+    its own timeout; batchmates are unaffected."""
+    from snappydata_tpu import resource
+    from snappydata_tpu.resource.context import CancelException
+    from snappydata_tpu.serving.batcher import MicroBatcher, _Request
+
+    s = serving_session
+    ah = s.prepare(AGG_SQL)
+    entry = ah._entry
+    late = resource.new_query(AGG_SQL, "admin")
+    late.deadline = time.monotonic() - 1.0
+    reqs = [_Request(s, entry.lit_params + (2,), late),
+            _Request(s, entry.lit_params + (6,),
+                     resource.new_query(AGG_SQL, "admin"))]
+    MicroBatcher()._dispatch(entry, reqs)
+    assert isinstance(reqs[0].error, CancelException)
+    assert "timeout" in str(reqs[0].error)
+    cnt, _sm = _agg_expect(s, 6)
+    assert reqs[1].result.rows()[0][0] == cnt
+
+
+def test_overflowing_batch_serves_every_request(serving_session):
+    """More compatible waiters than serving_batch_max: the leader must
+    ride its own batch and the overflow requests are served by follow-up
+    batches — nobody comes back with neither result nor error."""
+    s = serving_session
+    ah = s.prepare(AGG_SQL)
+    props = config.global_properties()
+    saved = props.serving_batch_max
+    props.serving_batch_max = 2
+    try:
+        binds = [1, 2, 3, 4, 5, 6, 7]
+        results = _race([(ah.execute, (r,)) for r in binds])
+    finally:
+        props.serving_batch_max = saved
+    for r, (res, err) in zip(binds, results):
+        assert err is None, err
+        assert res is not None, r
+        cnt, _sm = _agg_expect(s, r)
+        assert res.rows()[0][0] == cnt, (r, res.rows())
+
+
+def test_failed_reprepare_surfaces_real_error_every_time(serving_session):
+    """A DDL that breaks a prepared statement (DROP TABLE) must produce
+    the real analysis error on EVERY subsequent execute — a failed
+    rebuild publishes nothing, so the handle can't wedge half-built."""
+    from snappydata_tpu.sql.analyzer import AnalysisError
+
+    s = serving_session
+    s.create_table("tmp_serve", [("k", T.LONG), ("v", T.DOUBLE)],
+                   provider="column")
+    s.insert_arrays("tmp_serve", [np.arange(10, dtype=np.int64),
+                                  np.ones(10)])
+    h = s.prepare("SELECT sum(v) FROM tmp_serve WHERE k = ?")
+    assert h.execute((3,)).rows() == [(1.0,)]
+    s.sql("DROP TABLE tmp_serve")
+    for _ in range(2):       # the SAME clear error, not a wedged crash
+        with pytest.raises((AnalysisError, ValueError)):
+            h.execute((3,))
+
+
+def test_batched_values_match_unbatched(serving_session):
+    """Direct fused dispatch vs the unbatched engine path, all 16
+    regions in one batch — value-identical."""
+    s = serving_session
+    ah = s.prepare(AGG_SQL)
+    entry = ah._entry
+    compiled = entry.compiled_for(s)
+    params = [entry.lit_params + (r,) for r in range(16)]
+    tables, outs = compiled.execute_batched(params)
+    for i, p in enumerate(params):
+        res = entry.assemble_batched(s, outs, tables, i, p)
+        ref = s.executor.execute(entry.tokenized, p)
+        assert res.rows() == ref.rows(), i
+
+
+def test_warm_batches_primes_vmap_variants(serving_session):
+    s = serving_session
+    h = s.prepare("SELECT sum(amount) FROM txns WHERE region_id = ?")
+    v0 = _counter("serving_vmap_compiles")
+    n = h.warm_batches((0,))
+    assert n > 0
+    assert _counter("serving_vmap_compiles") >= v0 + n
+    # warmed: re-warming compiles nothing new
+    v1 = _counter("serving_vmap_compiles")
+    h.warm_batches((5,))
+    assert _counter("serving_vmap_compiles") == v1
+
+
+# ---------------------------------------------------------------------
+# plan-cache LRU + registry LRU + ledger
+# ---------------------------------------------------------------------
+
+def test_plan_cache_lru_keeps_hot_entries():
+    from snappydata_tpu import SnappySession
+
+    props = config.Properties(plan_cache_size=3)
+    s = SnappySession(catalog=Catalog(), conf=props)
+    s.create_table("t", [("k", T.LONG), ("v", T.DOUBLE)],
+                   provider="column")
+    s.insert_arrays("t", [np.arange(100, dtype=np.int64),
+                          np.ones(100)])
+    # structurally DISTINCT shapes (literals tokenize away, so varying a
+    # literal would share one cache entry)
+    queries = ["SELECT sum(v) FROM t GROUP BY k",
+               "SELECT min(v) FROM t GROUP BY k",
+               "SELECT count(*) FROM t GROUP BY k"]
+    for q in queries:
+        s.sql(q)
+    ev0 = _counter("plan_cache_evictions")
+    s.sql(queries[0])               # touch: q0 is now the hottest
+    s.sql("SELECT max(v) FROM t GROUP BY k")  # evicts ONE (the coldest)
+    assert _counter("plan_cache_evictions") > ev0
+    assert len(s.executor._plan_cache) <= 3
+    h0 = _counter("plan_cache_hits")
+    s.sql(queries[0])               # the hot entry survived the miss
+    assert _counter("plan_cache_hits") > h0
+    s.stop()
+
+
+def test_registry_lru_and_ledger(serving_session):
+    from snappydata_tpu import resource
+
+    s = serving_session
+    props = config.global_properties()
+    saved = props.serving_max_handles
+    props.serving_max_handles = 2
+    try:
+        e0 = _counter("serving_handle_evictions")
+        for i in (1, 2, 3):
+            s.prepare(f"SELECT count(*) FROM txns WHERE region_id < {i}")
+        assert _counter("serving_handle_evictions") > e0
+        reg = s.catalog._serving_registry
+        assert len(reg._entries) <= 2
+        led = resource.global_broker().ledger()
+        assert led["serving_registry_bytes"] > 0
+    finally:
+        props.serving_max_handles = saved
+
+
+# ---------------------------------------------------------------------
+# front doors
+# ---------------------------------------------------------------------
+
+def test_rest_sql_and_serving_endpoint(serving_session):
+    import json
+    import urllib.request
+
+    from snappydata_tpu.cluster.rest import RestService
+    from snappydata_tpu.observability import TableStatsService
+
+    s = serving_session
+    svc = RestService(s, TableStatsService(s.catalog)).start()
+    try:
+        base = f"http://{svc.host}:{svc.port}"
+        body = json.dumps({"sql": AGG_SQL, "params": [3]}).encode()
+        for _ in range(2):
+            req = urllib.request.Request(
+                base + "/sql", data=body,
+                headers={"Content-Type": "application/json"})
+            got = json.loads(urllib.request.urlopen(req).read())
+        cnt, sm = _agg_expect(s, 3)
+        assert got["rows"][0][0] == cnt
+        assert abs(got["rows"][0][1] - sm) <= 1e-9 * max(sm, 1.0)
+        snap = json.loads(urllib.request.urlopen(
+            base + "/status/api/v1/serving").read())
+        assert snap["serving_prepared_hits"] > 0
+        assert any(h["sql"].startswith("SELECT count(*)")
+                   for h in snap["handles"])
+        html = urllib.request.urlopen(base + "/dashboard").read().decode()
+        assert "Serving path" in html
+    finally:
+        svc.stop()
+
+
+def test_flightsql_prepared_second_execute_is_serving_hit(serving_session):
+    flight = pytest.importorskip("pyarrow.flight")  # noqa: F841
+    from snappydata_tpu.cluster.flight_server import SnappyFlightServer
+    from snappydata_tpu.cluster.flightsql import FlightSqlClient
+
+    s = serving_session
+    srv = SnappyFlightServer(s, port=0)
+    th = threading.Thread(target=srv.serve, daemon=True)
+    th.start()
+    srv.wait_ready()
+    client = FlightSqlClient(f"127.0.0.1:{srv.actual_port}")
+    try:
+        ps = client.prepare(AGG_SQL)
+        t1 = ps.execute([5])
+        h0 = _counter("serving_prepared_hits")
+        t2 = ps.execute([5])
+        assert _counter("serving_prepared_hits") > h0
+        assert t1.to_pydict() == t2.to_pydict()
+        cnt, _sm = _agg_expect(s, 5)
+        assert t1.to_pydict()["count()"] == [cnt]
+        ps.close()
+    finally:
+        client.close()
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------
+# bench --check qps guard
+# ---------------------------------------------------------------------
+
+def _rec(qps=None, value=1e6, load_s=10.0):
+    d = {"load_s": load_s}
+    if qps is not None:
+        d["qps"] = {"prepared_qps": qps}
+    return {"value": value, "detail": d}
+
+
+def test_bench_check_qps_guard():
+    import importlib.util
+    import os
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(os.path.dirname(__file__), "..",
+                                  "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    # in-tolerance: no failure
+    assert bench.check_regression(_rec(qps=900), _rec(qps=1000)) == []
+    # beyond tolerance: trips with a qps message
+    fails = bench.check_regression(_rec(qps=400), _rec(qps=1000))
+    assert any("prepared_qps" in f for f in fails)
+    # records predating the qps section stay comparable
+    assert bench.check_regression(_rec(qps=None), _rec(qps=1000)) == []
+    assert bench.check_regression(_rec(qps=400), _rec(qps=None)) == []
+    # env-overridable tolerance plumbing
+    fails = bench.check_regression(_rec(qps=700), _rec(qps=1000),
+                                   qps_tol=0.2)
+    assert any("prepared_qps" in f for f in fails)
