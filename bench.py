@@ -340,6 +340,27 @@ def main() -> None:
               flush=True)
         qps = {"qps_error": str(e)}
 
+    # availability trajectory: QPS/p99 through a scripted kill/rejoin
+    # window (steady → degraded → recovered), value-asserted throughout
+    resilience = None
+    try:
+        resilience = _resilience_bench()
+        print(f"bench: resilience qps steady "
+              f"{resilience['steady']['qps']} → degraded "
+              f"{resilience['degraded']['qps']} → recovered "
+              f"{resilience['recovered']['qps']} (p99 "
+              f"{resilience['steady']['p99_ms']}/"
+              f"{resilience['degraded']['p99_ms']}/"
+              f"{resilience['recovered']['p99_ms']}ms, "
+              f"{resilience['rejoin_clean_buckets']} clean + "
+              f"{resilience['rejoin_copied_buckets']} copied buckets on "
+              f"rejoin, {resilience['degraded_buckets_after_rejoin']} "
+              f"degraded after)", file=sys.stderr, flush=True)
+    except Exception as e:
+        print(f"bench: resilience bench failed: {e}", file=sys.stderr,
+              flush=True)
+        resilience = {"resilience_error": str(e)}
+
     ingest_rows_per_s = sink_events_per_s = durable_ingest = None
     try:   # secondary benches must not kill the headline numbers
         ingest_rows_per_s = _ingest_bench()
@@ -411,6 +432,14 @@ def main() -> None:
             # recompiles_after_warmup MUST be 0 (compile-once claim) and
             # plan_key_builds 0 (no per-execute re-tokenization)
             "qps": qps,
+            # availability-axis evidence: point-read qps + p99 through a
+            # scripted kill → rejoin window on a redundancy-1 cluster.
+            # steady/degraded/recovered give availability a TRAJECTORY
+            # next to rows/s and qps; every query in every phase is
+            # value-asserted, and degraded_buckets_after_rejoin MUST be
+            # 0 (the watermark resync restored redundancy without a
+            # manual restore_redundancy())
+            "resilience": resilience,
             "ingest_rows_per_s": ingest_rows_per_s,
             "sink_events_per_s": sink_events_per_s,
             # durable (WAL'd) ingest per wal_fsync_mode, with the fsync
@@ -738,6 +767,105 @@ def _qps_bench(n_clients: int = 8, point_rows: int = 50_000,
     }
     s.stop()
     return out
+
+
+def _resilience_bench(n_rows: int = 20_000, phase_s: float = 1.5) -> dict:
+    """Availability trajectory: point-read QPS and p99 through a
+    scripted kill → rejoin window on a 2-server cluster with
+    redundancy 1 — three measured phases:
+
+      steady     both members up;
+      degraded   one member hard-killed mid-phase (the first query pays
+                 the failover probe + replica promotion; replicas keep
+                 every answer complete);
+      recovered  the member restarted from its recovered data dir and
+                 re-admitted via rejoin_server (watermark delta resync)
+                 — redundancy restored, no manual restore_redundancy().
+
+    Every query in every phase is VALUE-asserted (v == k/2), so the
+    availability numbers can't hide wrong answers; `correct` reports
+    that every returned row checked out."""
+    import shutil
+    import tempfile
+
+    from snappydata_tpu import SnappySession
+    from snappydata_tpu.catalog import Catalog
+    from snappydata_tpu.cluster import LocatorNode, ServerNode
+    from snappydata_tpu.cluster.distributed import DistributedSession
+
+    tmp = tempfile.mkdtemp(prefix="snappy_resilience_")
+    locator = LocatorNode().start()
+    sessions = [SnappySession(catalog=Catalog(),
+                              data_dir=os.path.join(tmp, f"srv{i}"),
+                              recover=False) for i in range(2)]
+    servers = [ServerNode(locator.address, s).start() for s in sessions]
+    ds = DistributedSession(
+        server_addresses=[s.flight_address for s in servers],
+        locator=locator.address)
+    rng = np.random.default_rng(47)
+    try:
+        ds.sql("CREATE TABLE res_kv (k BIGINT, v DOUBLE) USING column "
+               "OPTIONS (partition_by 'k', redundancy '1')")
+        ks = np.arange(n_rows, dtype=np.int64)
+        ds.insert_arrays("res_kv", [ks, ks * 0.5])
+
+        def run_phase(seconds: float) -> dict:
+            lats = []
+            end = time.time() + seconds
+            while time.time() < end:
+                k = int(rng.integers(0, n_rows))
+                t0 = time.time()
+                rows = ds.sql(
+                    f"SELECT v FROM res_kv WHERE k = {k}").rows()
+                lats.append(time.time() - t0)
+                assert len(rows) == 1 and \
+                    abs(rows[0][0] - k * 0.5) <= 1e-9, (k, rows)
+            lats_ms = np.asarray(lats) * 1e3
+            return {"queries": len(lats),
+                    "qps": round(len(lats) / seconds, 1),
+                    "p50_ms": round(float(np.percentile(lats_ms, 50)), 2),
+                    "p99_ms": round(float(np.percentile(lats_ms, 99)), 2)}
+
+        ds.sql("SELECT count(*) FROM res_kv")   # warm compiles
+        steady = run_phase(phase_s)
+
+        # hard kill one member; the NEXT query pays the failover
+        servers[1].stop()
+        sessions[1].disk_store.close()
+        degraded = run_phase(phase_s)
+
+        # restart from the recovered data dir + automatic resync
+        sessions[1] = SnappySession(data_dir=os.path.join(tmp, "srv1"),
+                                    recover=True)
+        servers[1] = ServerNode(locator.address, sessions[1]).start()
+        rejoin = ds.rejoin_server(1, servers[1].flight_address)
+        recovered = run_phase(phase_s)
+
+        return {
+            "rows": n_rows,
+            "steady": steady,
+            "degraded": degraded,
+            "recovered": recovered,
+            "rejoin_clean_buckets": rejoin["clean_primary_buckets"]
+            + rejoin["clean_replica_buckets"],
+            "rejoin_copied_buckets": rejoin["copied_buckets"],
+            "degraded_buckets_after_rejoin": rejoin["degraded_buckets"],
+            "correct": True,   # every phase value-asserted above
+        }
+    finally:
+        ds.close()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        locator.stop()
+        for s in sessions:
+            try:
+                s.disk_store.close()
+            except Exception:
+                pass
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _decode_counters():

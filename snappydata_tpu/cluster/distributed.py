@@ -26,17 +26,22 @@ scatter + merge:
 from __future__ import annotations
 
 import dataclasses
+import queue as _queue
 import random as _random
+import threading
 import time as _time
+import uuid as _uuid
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from snappydata_tpu import config as _config
+from snappydata_tpu import reliability
 from snappydata_tpu import types as T
 from snappydata_tpu.catalog import Catalog
 from snappydata_tpu.cluster.retry import CircuitBreaker, ExponentialBackoff
 from snappydata_tpu.parallel.hashing import bucket_of_np
+from snappydata_tpu.resource.context import CancelException
 from snappydata_tpu.sql import ast
 from snappydata_tpu.sql.parser import parse
 from snappydata_tpu.engine.partial_agg import NotDecomposableError
@@ -75,6 +80,9 @@ class DistributedSession:
         from snappydata_tpu.cluster.client import SnappyClient
         from snappydata_tpu.session import SnappySession
 
+        # locator handle kept for membership-driven rejoin: a restarted
+        # member that re-registers is detected by poll_rejoins()
+        self._locator_addr = locator
         if server_addresses is None:
             from snappydata_tpu.cluster.locator import LocatorClient
 
@@ -118,6 +126,18 @@ class DistributedSession:
         self.breakers: List[CircuitBreaker] = [
             CircuitBreaker(props.breaker_failures, props.breaker_reset_s)
             for _ in range(n)]
+        # per-bucket mutation watermark (lead-routed writes only — like
+        # bucket placement itself, external direct writers bypass it):
+        # mark_server_failed snapshots it, so rejoin_server can tell
+        # which buckets a restarted member's recovered copy is still
+        # CURRENT for (delta resync) vs which need a fresh copy
+        self.bucket_seq: List[int] = [0] * num_buckets
+        self._death_snapshots: Dict[int, dict] = {}
+        # bounded concurrent hedged reads (hedge_max_concurrent)
+        self._hedge_lock = threading.Lock()
+        self._hedges_inflight = 0
+        self._rejoin_stop: Optional[threading.Event] = None
+        self._rejoin_lock = threading.Lock()
         # planning catalog: schemas only (no data) on the lead
         self.planner = SnappySession(catalog=Catalog())
 
@@ -145,6 +165,19 @@ class DistributedSession:
         if not self.alive[index]:
             return
         self.alive[index] = False
+        # death snapshot for the rejoin delta-resync: which buckets the
+        # member held (primary + replica) and the bucket-mutation
+        # watermark at the moment it died. On rejoin, a bucket whose
+        # watermark did not advance is provably still current on the
+        # member's recovered storage (zero-copy re-admission); one that
+        # did needs a fresh copy.
+        self._death_snapshots[index] = {
+            "seq": list(self.bucket_seq),
+            "owned": [b for b in range(self.num_buckets)
+                      if self.bucket_map[b] == index],
+            "replicas": [b for b in range(self.num_buckets)
+                         if self.replica_map[b] == index],
+        }
         from snappydata_tpu.observability.metrics import global_registry
 
         global_registry().inc("failover_member_failed")
@@ -287,13 +320,49 @@ class DistributedSession:
         return {"restored_buckets": restored,
                 "degraded_buckets": len(self.degraded_buckets())}
 
+    def _member_tables(self) -> List:
+        tables = [t for t in self.planner.catalog.list_tables()
+                  if not t.name.startswith("__")]  # skip lead-local
+        # colocation anchors before dependents
+        tables.sort(key=lambda t: t.colocate_with is not None)
+        return tables
+
+    @staticmethod
+    def _member_ddls(info) -> Tuple[str, Optional[str]]:
+        """(create_table_sql, replica_shadow_sql|None) for schema-syncing
+        a (re)joining member — IF NOT EXISTS, so a member that recovered
+        its own catalog keeps its data."""
+        ddl_cols = ", ".join(
+            f"{f.name} {_ddl_type(f.dtype)}"
+            + (" PRIMARY KEY" if f.name in info.key_columns else "")
+            for f in info.schema.fields)
+        opts = []
+        if info.partition_by:
+            opts.append(f"partition_by '{info.partition_by[0]}'")
+        if info.colocate_with:
+            opts.append(f"colocate_with '{info.colocate_with}'")
+        if info.redundancy:
+            opts.append(f"redundancy '{info.redundancy}'")
+        ddl = (f"CREATE TABLE IF NOT EXISTS {info.name} ({ddl_cols}) "
+               f"USING {info.provider}")
+        if opts:
+            ddl += f" OPTIONS ({', '.join(opts)})"
+        rddl = None
+        if info.partition_by and info.redundancy > 0:
+            rddl = (f"CREATE TABLE IF NOT EXISTS {info.name}__replica "
+                    f"({ddl_cols.replace(' PRIMARY KEY', '')}) "
+                    f"USING column")
+        return ddl, rddl
+
     def replace_server(self, index: int, address: str) -> None:
         """A restarted/replacement member rejoins at `index` EMPTY: its
         buckets were re-hosted on failover, so any stale on-disk rows it
         recovered must not double-count. It is truncated and starts
         receiving new writes; bucket placement stays with the survivors
         (rebalancing back is a manual op, like the reference's
-        rebalance)."""
+        rebalance). For a member restarted WITH its recovered data, use
+        rejoin_server() — it keeps the provably-current buckets and
+        resyncs only the delta."""
         from snappydata_tpu.cluster.client import SnappyClient
 
         try:
@@ -302,49 +371,390 @@ class DistributedSession:
             pass
         client = SnappyClient(address=address)
         seed_from = next((s for i, s in self._alive() if i != index), None)
-        tables = [t for t in self.planner.catalog.list_tables()
-                  if not t.name.startswith("__")]  # skip lead-local
-        # colocation anchors before dependents
-        tables.sort(key=lambda t: t.colocate_with is not None)
-        for info in tables:
+        for info in self._member_tables():
             # a replacement process starts with an empty catalog: give it
             # the schema, then make sure any recovered stale rows are gone
-            ddl_cols = ", ".join(
-                f"{f.name} {_ddl_type(f.dtype)}"
-                + (" PRIMARY KEY" if f.name in info.key_columns else "")
-                for f in info.schema.fields)
-            opts = []
-            if info.partition_by:
-                opts.append(f"partition_by '{info.partition_by[0]}'")
-            if info.colocate_with:
-                opts.append(f"colocate_with '{info.colocate_with}'")
-            if info.redundancy:
-                opts.append(f"redundancy '{info.redundancy}'")
-            ddl = (f"CREATE TABLE IF NOT EXISTS {info.name} ({ddl_cols}) "
-                   f"USING {info.provider}")
-            if opts:
-                ddl += f" OPTIONS ({', '.join(opts)})"
+            ddl, rddl = self._member_ddls(info)
             client.execute(ddl)
             client.execute(f"TRUNCATE TABLE {info.name}")
-            if info.partition_by and info.redundancy > 0:
-                client.execute(
-                    f"CREATE TABLE IF NOT EXISTS {info.name}__replica "
-                    f"({ddl_cols.replace(' PRIMARY KEY', '')}) "
-                    f"USING column")
+            if rddl is not None:
+                client.execute(rddl)
                 client.execute(f"TRUNCATE TABLE {info.name}__replica")
             if not info.partition_by and seed_from is not None:
                 # replicated tables must rejoin with the FULL copy, not
                 # just post-rejoin rows — re-seed from a surviving member
-                piece = seed_from.sql(f"SELECT * FROM {info.name}")
+                piece = seed_from.sql(f"SELECT * FROM {info.name}",
+                                      timeout_s=0)
                 if piece.num_rows:
                     client.insert(info.name, piece)
         self.servers[index] = client
         self.server_addresses[index] = address
         self.alive[index] = True
+        self._death_snapshots.pop(index, None)
         self.breakers[index].record_success()  # fresh member, fresh slate
         getattr(self, "_bcast_cache", {}).clear()
         getattr(self, "_shuf_cache", {}).clear()
         getattr(self, "_gather_cache", {}).clear()
+
+    def rejoin_server(self, index: int,
+                      address: Optional[str] = None) -> dict:
+        """Re-admit a RESTARTED member with its recovered data — the
+        automatic twin of the reference's membership-driven redundancy
+        recovery (ExecutorInitiator.scala:71-90), replacing the manual
+        replace_server + restore_redundancy pair.
+
+        Delta resync by WAL-seq-style watermark: mark_server_failed
+        snapshotted the per-bucket mutation counters at the moment of
+        death. A bucket whose counter did not advance is provably
+        unchanged through every LEAD-ROUTED write path since the death:
+
+        - clean ex-PRIMARY buckets: the member's recovered copy demotes
+          into its OWN replica shadow and the member becomes the bucket's
+          replica holder — ZERO network copy, instant redundancy. The
+          survivor keeps the primary role: its promoted copy is the
+          authoritative superset (a write that bypassed the lead —
+          direct per-server DML — is invisible to the watermark, so the
+          survivor's primary must never be reduced on the watermark's
+          word; an earlier demote-the-survivor design lost exactly such
+          an acked row in the end-to-end drive). rebalance() moves
+          primaries back when wanted;
+        - clean ex-REPLICA buckets re-register the member as replica
+          holder without any copy (its shadow rows are still valid);
+        - DIRTY buckets (mutated while the member was down) fall back
+          to a full bucket copy: stale recovered rows are purged
+          (journaled — recovery cannot resurrect them) and the member
+          becomes the replica holder for every still-degraded bucket
+          via replicate().
+
+        With no death snapshot (the lead itself restarted) everything
+        is dirty: full truncate + re-replication, still automatic.
+        Returns a summary; partial per-bucket failures degrade honestly
+        (counted, listed in `errors`) instead of claiming phantom
+        redundancy. degraded_buckets() is empty after a clean run.
+
+        Concurrency: rejoins serialize on a lock (overlapping polls
+        no-op), but like rebalance() the operation is not transactional
+        against concurrent lead-routed MUTATIONS — a write racing the
+        classification can leave a bucket replica-less until the next
+        rejoin/restore_redundancy pass (reads stay exact throughout:
+        the survivor primaries are never reduced)."""
+        with self._rejoin_lock:
+            if self.alive[index]:
+                return {"rejoined": False,
+                        "reason": "member already alive"}
+            return self._rejoin_locked(index, address)
+
+    def _rejoin_locked(self, index: int, address: Optional[str]) -> dict:
+        from snappydata_tpu.cluster.client import SnappyClient
+        from snappydata_tpu.observability.metrics import global_registry
+        address = address or self.server_addresses[index]
+        try:
+            self.servers[index].close()
+        except Exception:
+            pass
+        client = SnappyClient(address=address)
+        client.ping()
+        reg = global_registry()
+        snap = self._death_snapshots.get(index)
+        tables = self._member_tables()
+        part = [t for t in tables if t.partition_by]
+        red = [t for t in part if t.redundancy > 0]
+        errors: List[str] = []
+        nb = self.num_buckets
+
+        # 1. schema sync (IF NOT EXISTS keeps recovered data; a member
+        # that missed DDL while down gets the new tables here). All
+        # rejoin calls are deadline-EXEMPT (timeout_s=0) like the rest
+        # of the repair plane: an ambient client_timeout_s must not cut
+        # a resync mid-copy.
+        for info in tables:
+            ddl, rddl = self._member_ddls(info)
+            client.execute(ddl, timeout_s=0)
+            if rddl is not None:
+                client.execute(rddl, timeout_s=0)
+
+        # 2. replicated tables: no per-bucket watermark — reseed the
+        # full copy from a survivor (bounded: replicated tables are the
+        # small dimension side by design). With NO survivor to reseed
+        # from, the member's recovered copy is the only one and is KEPT
+        # (the only-copy rule again — truncating it would be loss, and
+        # it is no staler than the cluster, which held nothing newer).
+        seed_from = next((s for i, s in self._alive() if i != index), None)
+        for info in tables:
+            if info.partition_by:
+                continue
+            if seed_from is not None:
+                client.execute(f"TRUNCATE TABLE {info.name}", timeout_s=0)
+                piece = seed_from.sql(f"SELECT * FROM {info.name}",
+                                      timeout_s=0)
+                if piece.num_rows:
+                    client.insert(info.name, piece, timeout_s=0)
+
+        # LOST buckets still map to the member: no surviving copy
+        # existed at failover, so its recovered rows are the ONLY copy —
+        # they are NEVER purged (clean or dirty, verifiable or not;
+        # destroying the only copy would turn a recoverable outage into
+        # permanent data loss) and get fresh replication in step 6
+        lost = [b for b in range(nb) if self.bucket_map[b] == index]
+        nonred = [t for t in part if not t.redundancy]
+        moved_only_copy = 0
+
+        # 3. classify the member's recovered buckets by watermark
+        if snap is None:
+            reclaim_rep: List[int] = []
+            clean_demote: List[int] = []
+            # unverifiable recovered rows: full resync of everything
+            # except the lost buckets' only-copy rows. (Without a
+            # watermark, NON-redundant tables' re-homed rows cannot be
+            # distinguished from already-reseeded duplicates — the
+            # blank-slate semantics of replace_server apply; preserving
+            # them needs the snapshot path below.)
+            purge_p = sorted(set(range(nb)) - set(lost))
+            for info in part:
+                if lost:
+                    client.purge_buckets(
+                        {"table": info.name, "key": info.partition_by[0],
+                         "buckets": purge_p, "num_buckets": nb})
+                else:
+                    client.execute(f"TRUNCATE TABLE {info.name}",
+                                   timeout_s=0)
+            for info in red:
+                client.execute(f"TRUNCATE TABLE {info.name}__replica",
+                               timeout_s=0)
+        else:
+            clean = {b for b in range(nb)
+                     if self.bucket_seq[b] == snap["seq"][b]}
+            owned, replicas = snap["owned"], snap["replicas"]
+            rehomed = [b for b in owned if self.bucket_map[b] != index
+                       and self.alive[self.bucket_map[b]]]
+            # NON-redundant tables first: failover re-homed these
+            # buckets in the MAP only (no shadows exist, so no data
+            # moved) — the member's recovered pre-death rows are the
+            # ONLY copy, clean or dirty (post-death writes landed on
+            # the new primary; the union is the complete table). MOVE
+            # them to each bucket's current primary (copy-then-journaled
+            # -delete, restartable) instead of purging.
+            if nonred and rehomed:
+                regroup: Dict[int, List[int]] = {}
+                for b in rehomed:
+                    regroup.setdefault(self.bucket_map[b], []).append(b)
+                for p, bks in regroup.items():
+                    for info in nonred:
+                        client.move_buckets(
+                            {"table": info.name,
+                             "key": info.partition_by[0],
+                             "buckets": bks, "num_buckets": nb,
+                             "target": self.server_addresses[p]})
+                    moved_only_copy += len(bks)
+            clean_owned0 = [b for b in rehomed if b in clean]
+            # split by whether a replica holder already exists: claiming
+            # the role over an existing holder would ORPHAN that
+            # holder's physical shadow rows (hedged reads scan whole
+            # shadows and would over-read them) — those buckets purge
+            # the member's now-redundant copy instead
+            clean_demote = [b for b in clean_owned0
+                            if self.replica_map[b] is None]
+            # REDUNDANT tables: survivors hold every re-homed bucket's
+            # current rows (promotion/replication), so the member's
+            # stale/redundant copies purge (journaled) — never the lost
+            # buckets' only copies
+            purge_p = sorted(set(owned) - set(clean_demote) - set(lost))
+            for info in red:
+                if purge_p:
+                    client.purge_buckets(
+                        {"table": info.name, "key": info.partition_by[0],
+                         "buckets": purge_p, "num_buckets": nb})
+            # shadow hygiene: keep the clean, still-unassigned
+            # ex-replica buckets AND the clean_demote buckets (step 4
+            # demotes the member's recovered copy into its shadow — a
+            # re-run after a partial step-4 failure must find the prior
+            # demote's rows, not a purged hole); everything else purges
+            # (replicate()'s purge-before-copy would repair it anyway)
+            reclaim_rep = [b for b in replicas if b in clean
+                           and self.replica_map[b] is None
+                           and self.bucket_map[b] != index
+                           and self.alive[self.bucket_map[b]]]
+            purge_r = sorted(set(range(nb)) - set(reclaim_rep)
+                             - set(clean_demote))
+            for info in red:
+                client.purge_replica(
+                    {"table": info.name, "key": info.partition_by[0],
+                     "buckets": purge_r, "num_buckets": nb})
+
+        # 4. clean ex-primary buckets without a current replica holder:
+        # zero-copy redundancy for the REDUNDANT tables. The MEMBER
+        # demotes its own recovered copy into its local shadow and
+        # becomes the replica holder; the survivor's primary — the
+        # authoritative superset (it alone saw any non-lead-routed
+        # writes) — is never touched, so no acked row can lose
+        # visibility here. A failure here ABORTS the rejoin (the member
+        # stays dead): re-admitting with a half-moved primary would
+        # double-count under scatter. demote purges its own shadow
+        # slice first, so a re-run after a partial failure is
+        # idempotent.
+        reclaimed = 0
+        if clean_demote and red:
+            for info in red:
+                client.demote(
+                    {"table": info.name, "key": info.partition_by[0],
+                     "buckets": clean_demote, "num_buckets": nb})
+            for b in clean_demote:
+                self.replica_map[b] = index
+            reclaimed = len(clean_demote)
+
+        # 5. clean ex-replica buckets: shadow rows still valid — the
+        # member is their replica holder again, no copy
+        for b in reclaim_rep:
+            self.replica_map[b] = index
+
+        # 6. every remaining degraded bucket gets the rejoined member as
+        # its replica holder via a real copy (the dirty-bucket resync)
+        self.servers[index] = client
+        self.server_addresses[index] = address
+        copied = 0
+        if red:
+            need: Dict[int, List[int]] = {}
+            for b in range(nb):
+                p = self.bucket_map[b]
+                if self.replica_map[b] is None and p != index \
+                        and self.alive[p]:
+                    need.setdefault(p, []).append(b)
+            for p, bks in need.items():
+                ok = True
+                for info in red:
+                    try:
+                        self.servers[p].replicate(
+                            {"table": info.name,
+                             "key": info.partition_by[0],
+                             "buckets": bks, "num_buckets": nb,
+                             "target": address})
+                    except Exception as e:
+                        ok = False
+                        errors.append(
+                            f"replicate {info.name} from "
+                            f"{self.server_addresses[p]}: {e}")
+                        break
+                if ok:
+                    for b in bks:
+                        self.replica_map[b] = index
+                    copied += len(bks)
+                else:
+                    reg.inc("failover_redundancy_degraded", len(bks))
+            # LOST buckets: the member's recovered copy is the only one
+            # — replicate it OUT to a survivor so the next death cannot
+            # lose it (the member is about to become their live primary)
+            lost_deg = [b for b in lost if self.replica_map[b] is None]
+            tgt = next((i for i, _ in self._alive() if i != index), None)
+            if lost_deg and tgt is not None:
+                ok = True
+                for info in red:
+                    try:
+                        client.replicate(
+                            {"table": info.name,
+                             "key": info.partition_by[0],
+                             "buckets": lost_deg, "num_buckets": nb,
+                             "target": self.server_addresses[tgt]})
+                    except Exception as e:
+                        ok = False
+                        errors.append(f"replicate lost buckets of "
+                                      f"{info.name} to "
+                                      f"{self.server_addresses[tgt]}: {e}")
+                        break
+                if ok:
+                    for b in lost_deg:
+                        self.replica_map[b] = tgt
+                    copied += len(lost_deg)
+                else:
+                    reg.inc("failover_redundancy_degraded",
+                            len(lost_deg))
+
+        # 7. re-admit
+        self.alive[index] = True
+        self._death_snapshots.pop(index, None)
+        self.breakers[index].record_success()
+        getattr(self, "_bcast_cache", {}).clear()
+        getattr(self, "_shuf_cache", {}).clear()
+        getattr(self, "_gather_cache", {}).clear()
+        reg.inc("member_rejoins")
+        reg.inc("rejoin_clean_buckets", reclaimed + len(reclaim_rep))
+        reg.inc("rejoin_copied_buckets", copied)
+        if errors:
+            import sys as _sys
+
+            reg.inc("rejoin_partial_errors", len(errors))
+            print(f"warning: rejoin of {address} completed with "
+                  f"{len(errors)} partial errors (redundancy degraded "
+                  f"honestly; re-run rejoin or POST /redundancy/restore)"
+                  f": {errors[:3]}", file=_sys.stderr)
+        return {"rejoined": True, "address": address,
+                "clean_primary_buckets": reclaimed,
+                "clean_replica_buckets": len(reclaim_rep),
+                "copied_buckets": copied,
+                # non-redundant tables' only-copy rows relocated to the
+                # buckets' current primaries (nothing else had them)
+                "moved_only_copy_buckets": moved_only_copy,
+                "degraded_buckets": len(self.degraded_buckets()),
+                "errors": errors}
+
+    def poll_rejoins(self) -> List[dict]:
+        """Membership-driven automatic rejoin: a dead member whose
+        address reappears in the locator's view (same address, or a
+        single new server address matching the single dead slot — a
+        restart usually binds a fresh port) is resynced and re-admitted
+        via rejoin_server(). Call periodically, or let
+        start_auto_rejoin() run it on a cadence."""
+        if self._locator_addr is None or all(self.alive):
+            return []
+        from snappydata_tpu.cluster.locator import LocatorClient
+
+        lc = LocatorClient(self._locator_addr, "dist-rejoin", "client")
+        try:
+            members = lc.members()
+        except (ConnectionError, OSError):
+            return []
+        finally:
+            lc.close()
+        available = {f"{m.host}:{m.port}" for m in members
+                     if m.role == "server" and m.port}
+        out = []
+        dead = [i for i in range(len(self.servers)) if not self.alive[i]]
+        for i in list(dead):
+            if self.server_addresses[i] in available:
+                try:
+                    out.append(self.rejoin_server(i))
+                except (ConnectionError, OSError):
+                    # the locator still lists the member's STALE
+                    # registration (the heartbeat sweep hasn't removed
+                    # it yet) but nothing answers there — not back yet,
+                    # next poll retries; keep evaluating other members
+                    continue
+                dead.remove(i)
+        known = {self.server_addresses[i]
+                 for i in range(len(self.servers)) if self.alive[i]}
+        known |= {self.server_addresses[i] for i in dead}
+        unknown = sorted(available - known)
+        if len(unknown) == 1 and len(dead) == 1:
+            try:
+                out.append(self.rejoin_server(dead[0], unknown[0]))
+            except (ConnectionError, OSError):
+                pass   # registered but not answering yet: next poll
+        return out
+
+    def start_auto_rejoin(self, interval_s: float = 2.0) -> None:
+        """Background locator watch: restarted members rejoin without an
+        operator in the loop (stopped by close())."""
+        if self._rejoin_stop is not None:
+            return
+        stop = self._rejoin_stop = threading.Event()
+
+        def loop():
+            while not stop.wait(interval_s):
+                try:
+                    self.poll_rejoins()
+                except Exception:
+                    pass   # next tick retries; rejoin errors are counted
+
+        threading.Thread(target=loop, daemon=True).start()
 
     def flush_wals(self) -> dict:
         """Cluster-wide durability barrier: force every alive member to
@@ -458,19 +868,38 @@ class DistributedSession:
             br.record_failure()
             return False
 
-    def _fan(self, fn, retries: Optional[int] = None):
+    @staticmethod
+    def _check_deadline() -> None:
+        """The ambient request deadline (reliability.deadline_scope —
+        armed by sql(timeout_s)/query_timeout_s/client_timeout_s): once
+        it expires the caller has given up, so the fan-out must stop
+        NOW with the typed XCL52 error, not start another failover
+        round or backoff sleep."""
+        rem = reliability.remaining()
+        if rem is not None and rem <= 0:
+            raise CancelException(
+                "distributed request exceeded its deadline")
+
+    def _fan(self, fn, retries: Optional[int] = None, hedge=None):
         """Run fn(server) on every ALIVE server (read path — fn must be
         idempotent); a member failure triggers failover (replica
         promotion) and a full restart so results are complete, not
         partial. Restarts are bounded (`failover_retries`) and separated
         by exponential backoff with seeded jitter — a cascading outage
-        must not turn the lead into a hot retry loop."""
+        must not turn the lead into a hot retry loop. The ambient
+        request deadline bounds the WHOLE loop (checked between
+        attempts, capping backoff sleeps, and riding every per-server
+        call as a Flight timeout), so a slow member can stall a scatter
+        by at most deadline + one probe interval. `hedge` (read paths
+        only) maps a slow primary's index to a replica-holder fallback —
+        see _call_with_hedge."""
         from snappydata_tpu.observability.metrics import global_registry
 
         if retries is None:
             retries = _config.global_properties().failover_retries
         failed_addrs: List[str] = []
         for attempt in range(retries + 1):
+            self._check_deadline()
             if not self._alive():
                 # fanning over ZERO members must fail loudly, not return
                 # an empty gather that surfaces as an opaque Arrow error
@@ -484,7 +913,11 @@ class DistributedSession:
             failed = None
             for si, srv in self._alive():
                 try:
-                    out.append(fn(srv))
+                    out.append(self._call_with_hedge(si, srv, fn, hedge))
+                except CancelException:
+                    # deadline expiry is the CALLER's state, not the
+                    # member's — no probe, no failover, straight out
+                    raise
                 except Exception:
                     if self._probe(si):
                         raise  # server alive: statement error, no failover
@@ -507,7 +940,158 @@ class DistributedSession:
                     f"alive)", failed_addresses=failed_addrs,
                     attempts=attempt + 1)
             global_registry().inc("failover_retries")
-            self._backoff.sleep(attempt, metric="failover_backoff")
+            d = self._backoff.delay(attempt)
+            rem = reliability.remaining()
+            if rem is not None:
+                self._check_deadline()
+                d = min(d, rem)   # never sleep past the caller's deadline
+            global_registry().record_time("failover_backoff", d)
+            _time.sleep(d)
+
+    # -- hedged replica reads ------------------------------------------
+
+    def _call_with_hedge(self, si: int, srv, fn, hedge):
+        """Tail-latency hedging (OFF by default — `hedge_reads`): run
+        fn(primary) in a worker; if it is still running after
+        hedge_after_ms, issue the equivalent fragment to the shard's
+        replica holder (`hedge(si)` → (replica_index, thunk), built by
+        _hedge_builder over the __replica shadows) and return whichever
+        answers FIRST. Bounded by hedge_max_concurrent; both workers
+        re-enter the caller's deadline scope (contextvars do not cross
+        threads). When both fail, the PRIMARY's error propagates so
+        _fan's probe/failover logic targets the right member."""
+        props = _config.global_properties()
+        if hedge is None or not props.hedge_reads:
+            return fn(srv)
+        deadline = reliability.current_deadline()
+        q: "_queue.Queue" = _queue.Queue()
+
+        def run(tag, thunk):
+            try:
+                with reliability.deadline_scope(deadline):
+                    q.put((tag, True, thunk()))
+            except BaseException as e:   # noqa: BLE001 — ferried to caller
+                q.put((tag, False, e))
+
+        threading.Thread(target=run, args=("primary", lambda: fn(srv)),
+                         daemon=True).start()
+        wait_s = max(props.hedge_after_ms, 0.0) / 1e3
+        rem = reliability.remaining()
+        if rem is not None:
+            wait_s = min(wait_s, max(rem, 0.0))
+        try:
+            tag, ok, val = q.get(timeout=wait_s)
+        except _queue.Empty:
+            tag = None
+        if tag is not None:
+            if ok:
+                return val
+            raise val
+        # primary slower than the hedge threshold: fire the replica read
+        # — unless the caller's deadline already expired, in which case
+        # spawning a doomed replica query (slot + thread + server work
+        # for a result nobody reads) helps no one
+        self._check_deadline()
+        from snappydata_tpu.observability.metrics import global_registry
+
+        launched = False
+        with self._hedge_lock:
+            if self._hedges_inflight < max(1, props.hedge_max_concurrent):
+                self._hedges_inflight += 1
+                launched = True
+        h = None
+        if launched:
+            try:
+                h = hedge(si)
+            except Exception:
+                h = None
+            if h is None:
+                with self._hedge_lock:
+                    self._hedges_inflight -= 1
+                launched = False
+        if launched:
+            _ri, thunk = h
+            global_registry().inc("hedged_reads_fired")
+
+            def run_hedge():
+                try:
+                    run("hedge", thunk)
+                finally:
+                    with self._hedge_lock:
+                        self._hedges_inflight -= 1
+
+            threading.Thread(target=run_hedge, daemon=True).start()
+        errors: Dict[str, BaseException] = {}
+        expected = 2 if launched else 1
+        while True:
+            rem = reliability.remaining()
+            if rem is not None and rem <= 0:
+                self._check_deadline()
+            try:
+                tag, ok, val = q.get(
+                    timeout=0.25 if rem is None else max(0.001,
+                                                         min(rem, 0.25)))
+            except _queue.Empty:
+                continue
+            if ok:
+                if tag == "hedge":
+                    global_registry().inc("hedged_reads_won")
+                return val
+            errors[tag] = val
+            if len(errors) >= expected:
+                raise errors.get("primary", val)
+
+    def _hedge_replica_of(self, si: int) -> Optional[int]:
+        """The single alive member whose __replica shadows mirror
+        EXACTLY the buckets primary on `si` — only then is `SELECT ...
+        FROM t__replica` on it equivalent to `SELECT ... FROM t` on si
+        (a shadow hosting extra buckets would answer extra rows). Holds
+        for the default placement (member i's full shard mirrors on
+        i+1) and degrades safely to no-hedge after asymmetric
+        failovers."""
+        owned = [b for b in range(self.num_buckets)
+                 if self.bucket_map[b] == si]
+        if not owned:
+            return None
+        rs = {self.replica_map[b] for b in owned}
+        if len(rs) != 1:
+            return None
+        r = rs.pop()
+        if r is None or r == si or not self.alive[r]:
+            return None
+        hosted = {b for b in range(self.num_buckets)
+                  if self.replica_map[b] == r}
+        if hosted != set(owned):
+            return None
+        return r
+
+    def _hedge_builder(self, node: ast.Plan):
+        """A `hedge(si)` factory for scatter fragments over `node`, or
+        None when hedging is off / impossible: every partitioned table
+        in the fragment must carry redundancy (its __replica shadow IS
+        the hedge target; replicated tables are whole everywhere and
+        stay unrenamed)."""
+        props = _config.global_properties()
+        if not props.hedge_reads or len(self.servers) < 2:
+            return None
+        infos = self._plan_infos(node)
+        parts = [t for t in infos.values() if t.partition_by]
+        if not parts or any(t.redundancy <= 0 for t in parts):
+            return None
+        mapping = {t.name: f"{t.name}__replica" for t in parts}
+
+        def build(si: int):
+            r = self._hedge_replica_of(si)
+            if r is None:
+                return None
+            try:
+                exec_fn = self._partial_exec(
+                    _rename_tables(node, mapping))
+            except Exception:
+                return None
+            return r, (lambda: exec_fn(self.servers[r]))
+
+        return build
 
     def _fan_mutation(self, fn):
         """Run fn(server) ONCE per alive server (mutations are NOT
@@ -535,8 +1119,49 @@ class DistributedSession:
 
     # ------------------------------------------------------------------
 
-    def sql(self, sql_text: str):
+    def sql(self, sql_text: str, timeout_s: Optional[float] = None):
+        """Same .sql() surface as SnappySession, plus a per-request
+        `timeout_s`: the whole statement — fan-out, failover retries,
+        backoff sleeps and every per-member Flight call — must finish
+        inside it or the caller gets CancelException (SQLSTATE XCL52).
+        Defaults to query_timeout_s, then client_timeout_s; the budget
+        installs ONCE at the top-level statement and every nested
+        call/exchange spends from the same shrinking remainder."""
+        budget = timeout_s
+        if budget is None:
+            props = _config.global_properties()
+            budget = float(self.planner.conf.query_timeout_s or 0.0) or \
+                float(props.client_timeout_s or 0.0)
+        if budget and budget > 0 and reliability.current_deadline() is None:
+            with reliability.deadline_scope(
+                    _time.monotonic() + float(budget)):
+                return self._sql_inner(sql_text)
+        return self._sql_inner(sql_text)
+
+    def _bump_buckets(self, buckets) -> None:
+        for b in buckets:
+            self.bucket_seq[int(b)] += 1
+
+    def _sql_inner(self, sql_text: str):
         stmt = parse(sql_text)
+        if isinstance(stmt, (ast.CreateTable, ast.DropTable,
+                             ast.TruncateTable, ast.AlterTable,
+                             ast.UpdateStmt, ast.DeleteStmt)):
+            # table-wide mutations/DDL touch arbitrary rows: advance the
+            # watermark on EVERY bucket (conservative — a rejoin after
+            # this treats all recovered buckets as needing resync;
+            # routed inserts advance only the buckets they hit). Bump
+            # BEFORE and AFTER: a member death MID-statement snapshots
+            # the watermark between the two, and applies that land after
+            # the snapshot must read as post-death mutations.
+            self._bump_buckets(range(self.num_buckets))
+            try:
+                return self._sql_dispatch(stmt, sql_text)
+            finally:
+                self._bump_buckets(range(self.num_buckets))
+        return self._sql_dispatch(stmt, sql_text)
+
+    def _sql_dispatch(self, stmt, sql_text: str):
         if isinstance(stmt, ast.Query):
             from snappydata_tpu.aqp.error_estimation import (
                 execute_error_query_distributed, query_has_error_surface)
@@ -707,13 +1332,17 @@ class DistributedSession:
                     cols[nm] = pa.array(vals, mask=mask)
             return pa.table(cols)
 
-        def send(srv, table_arrow, target=table):
-            import pyarrow.flight as flight
+        # every send is stamped with a DETERMINISTIC statement id
+        # (load id + target + row-selection tag): a _fan restart or a
+        # lost-ack retry re-sends the identical piece under the SAME id,
+        # and the server's dedup window applies it at most once — the
+        # replicated-table full-restart used to double-apply on
+        # survivors that had already acked
+        load_id = _uuid.uuid4().hex[:16]
 
-            descriptor = flight.FlightDescriptor.for_path(target)
-            writer, _ = srv._client().do_put(descriptor, table_arrow.schema)
-            writer.write_table(table_arrow)
-            writer.close()
+        def send(srv, table_arrow, target=table, tag="all"):
+            srv.insert(target, table_arrow,
+                       stmt_id=f"{load_id}:{target}:{tag}")
 
         if not info.partition_by:
             arrow = to_arrow()
@@ -721,12 +1350,38 @@ class DistributedSession:
             return n
         key_ci = info.schema.index(info.partition_by[0])
         buckets = bucket_of_np(arrays[key_ci], self.num_buckets)
+        # advance the per-bucket mutation watermark BEFORE sending
+        # (pessimistic: a failed load still dirties the buckets it may
+        # have partially reached — rejoin then resyncs them) AND after
+        # (the finally below): a member death MID-LOAD snapshots the
+        # watermark between first delivery and redelivery, and the rows
+        # landing after that snapshot must read as post-death mutations
+        # or a rejoin would wrongly treat the dead member's copy as
+        # current (found by the seeded chaos schedule: k=227 vanished)
+        self._bump_buckets(np.unique(buckets))
+        try:
+            return self._routed_insert(info, table, arrays, buckets,
+                                       to_arrow, send, n)
+        finally:
+            self._bump_buckets(np.unique(buckets))
+
+    def _routed_insert(self, info, table, arrays, buckets, to_arrow,
+                       send, n: int) -> int:
         has_replicas = info.redundancy > 0 and len(self.servers) > 1
         done = np.zeros(n, dtype=bool)
         # where each row's replica copy LANDED (-1 = nowhere yet); used
         # both for progress and for the promotion-dedup below
         rep_sent_to = np.full(n, -1, dtype=np.int64)
         load_failed_addrs: List[str] = []
+        import hashlib as _hashlib
+
+        def _sel_tag(sel_arr):
+            # selection-identity tag: identical re-sends (same rows,
+            # same target) dedup; a post-failover re-route is a new
+            # selection and a new id
+            return _hashlib.sha1(np.ascontiguousarray(
+                sel_arr).tobytes()).hexdigest()[:12]
+
         for _attempt in range(4):  # survives members dying MID-LOAD
             owner = np.asarray(self.bucket_map)[buckets]
             rep = np.asarray(
@@ -741,7 +1396,7 @@ class DistributedSession:
                 sel = np.flatnonzero((owner == si) & ~done)
                 if sel.size:
                     try:
-                        send(srv, to_arrow(sel))
+                        send(srv, to_arrow(sel), tag=f"p{_sel_tag(sel)}")
                         done[sel] = True
                     except Exception:
                         failed = si
@@ -754,7 +1409,8 @@ class DistributedSession:
                     if rsel.size:
                         try:
                             send(srv, to_arrow(rsel),
-                                 target=f"{table}__replica")
+                                 target=f"{table}__replica",
+                                 tag=f"r{_sel_tag(rsel)}")
                             rep_sent_to[rsel] = si
                         except Exception:
                             failed = si
@@ -1538,7 +2194,8 @@ class DistributedSession:
     def _scatter_concat(self, node: ast.Plan, outer: List):
         import pyarrow as pa
 
-        pieces = self._fan(self._partial_exec(node))
+        pieces = self._fan(self._partial_exec(node),
+                           hedge=self._hedge_builder(node))
         merged = pa.concat_tables(pieces)
         result = _arrow_to_result(merged, self.planner)
         return _apply_outer(result, outer, self.planner)
@@ -1556,7 +2213,8 @@ class DistributedSession:
 
         import pyarrow as pa
 
-        pieces = self._fan(self._partial_exec(partial_plan))
+        pieces = self._fan(self._partial_exec(partial_plan),
+                           hedge=self._hedge_builder(partial_plan))
         merged = pa.concat_tables(pieces)
 
         scratch = self._load_partials(merged, len(groups), n_slots)
@@ -1610,7 +2268,8 @@ class DistributedSession:
         plain = _dc.replace(agg, grouping_sets=None)
         partial_plan, merged_select, n_slots, merge_having = \
             decompose_aggregate(plain, having)
-        pieces = self._fan(self._partial_exec(partial_plan))
+        pieces = self._fan(self._partial_exec(partial_plan),
+                           hedge=self._hedge_builder(partial_plan))
         merged = pa.concat_tables(pieces)
         scratch = self._load_partials(merged, len(agg.group_exprs), n_slots)
         merge_plan: ast.Plan = ast.Aggregate(
@@ -1905,6 +2564,9 @@ class DistributedSession:
         return self.planner.execute_statement(ast.Query(renamed))
 
     def close(self) -> None:
+        if self._rejoin_stop is not None:
+            self._rejoin_stop.set()
+            self._rejoin_stop = None
         for name in list(getattr(self, "_gather_cache", {})):
             try:
                 self.planner.sql(f"DROP TABLE IF EXISTS __gather_{name}")

@@ -471,6 +471,27 @@ class SnappyFlightServer(flight.FlightServerBase):
 
     # -- queries ----------------------------------------------------------
 
+    @staticmethod
+    def _deadline_ctx(body: Optional[dict], sess, sql_text: str):
+        """Deadline propagation (reliability layer): a request body
+        carrying `timeout_s` — the CALLER's remaining budget — becomes a
+        QueryContext deadline, so the engine's cooperative checks stop
+        server-side work within one tile of the caller giving up instead
+        of computing a result nobody will read. Returns None when the
+        request carries no budget."""
+        budget = (body or {}).get("timeout_s")
+        try:
+            budget = float(budget) if budget is not None else 0.0
+        except (TypeError, ValueError):
+            budget = 0.0
+        if budget <= 0:
+            return None
+        from snappydata_tpu import resource
+
+        ctx = resource.new_query(sql_text, user=sess.user)
+        ctx.set_deadline_in(budget)
+        return ctx
+
     def do_get(self, context, ticket: flight.Ticket):
         from snappydata_tpu.cluster.flightsql import unpack_any
         from snappydata_tpu.fault import failpoints
@@ -488,13 +509,24 @@ class SnappyFlightServer(flight.FlightServerBase):
             # logical plan through the normal session pipeline — shapes
             # the single-block SQL renderer can't express run distributed
             # this way (ref: SparkSQLExecuteImpl.scala:75-109)
+            from snappydata_tpu import resource
             from snappydata_tpu.sql import ast as _ast
             from snappydata_tpu.sql.plan_json import from_json
 
             sess = self._session_for(req)
             plan = from_json(req["plan"])
-            result = sess.execute_statement(
-                _ast.Query(plan), tuple(req.get("params", ())))
+            ctx = self._deadline_ctx(req, sess, "<shipped plan>")
+            if ctx is not None:
+                # propagated deadline: the caller's remaining budget —
+                # cooperative checks stop this fragment when the caller
+                # has already given up (its client-side cutoff fired)
+                ctx.start()
+                with resource.query_scope(ctx):
+                    result = sess.execute_statement(
+                        _ast.Query(plan), tuple(req.get("params", ())))
+            else:
+                result = sess.execute_statement(
+                    _ast.Query(plan), tuple(req.get("params", ())))
             table = result_to_arrow(result)
             chunk = int(req.get("page_rows", 65536))
             batches = table.to_batches(max_chunksize=max(1, chunk))
@@ -533,18 +565,21 @@ class SnappyFlightServer(flight.FlightServerBase):
         if streamed is not None:
             schema, gen = streamed
             return flight.GeneratorStream(schema, gen())
+        ctx = self._deadline_ctx(req, sess, req.get("sql", ""))
         if req.get("prepared"):
             # serving front door: {"sql", "params", "prepared": true}
             # routes through the prepared-plan registry — repeated
             # tickets skip parse/plan, concurrent ones fuse into one
             # vmapped dispatch, the governor admits per principal
             result = sess.serving_sql(req["sql"],
-                                      tuple(req.get("params", ())))
+                                      tuple(req.get("params", ())),
+                                      query_ctx=ctx)
             table = result_to_arrow(result)
             chunk = int(req.get("page_rows", 65536))
             batches = table.to_batches(max_chunksize=max(1, chunk))
             return flight.GeneratorStream(table.schema, iter(batches))
-        result = sess.sql(req["sql"], params=tuple(req.get("params", ())))
+        result = sess.sql(req["sql"], params=tuple(req.get("params", ())),
+                          query_ctx=ctx)
         table = result_to_arrow(result)
         # page as record batches (ref: CachedDataFrame paged collect /
         # GfxdHeapDataOutputStream result pages) — clients start consuming
@@ -590,41 +625,67 @@ class SnappyFlightServer(flight.FlightServerBase):
             target = body["table"]
         sess = self._session_for(body)   # raises if auth on and no token
         sess._require(target, "insert")
-        table = reader.read_all()
-        arrays, nulls = arrow_to_arrays(table)
-        info = self.session.catalog.describe(target)
-        # same gate as every session write lane: acked rows put into a
-        # view's backing table would vanish at the view's next sync
-        self.session._reject_matview_write(info)
-        from snappydata_tpu.storage.table_store import RowTableData
+        from snappydata_tpu import reliability
+        from snappydata_tpu.observability.metrics import global_registry
 
-        # WAL-then-apply under the store's mutation lock (same invariant as
-        # session mutations: journal first so a concurrent checkpoint can't
-        # fold un-journaled rows, and carry null masks so recovery doesn't
-        # turn bulk-ingested NULLs into zeros).
-        # sync_force: the put RESPONSE is a durability ack the lead's
-        # fan-out (and its replica bookkeeping) relies on — the covering
-        # WAL fsync is forced even when this server runs
-        # wal_fsync_mode=interval. Relaxed acks are a local-session
-        # policy, never a network one. Scoped to THIS put's record so
-        # one client's ack never waits on other sessions' records.
-        if isinstance(info.data, RowTableData):
-            from snappydata_tpu.session import _restore_none_arrays
+        stmt_id = (body or {}).get("stmt_id")
+        dedup = reliability.dedup_for(self.session.catalog) \
+            if stmt_id else None
+        if dedup is not None and dedup.begin(stmt_id) is not None:
+            # lost-ack retry: the first send applied (and fsynced — acks
+            # gate on the covering WAL sync) but its response was lost.
+            # Drain the stream and ack WITHOUT re-applying.
+            reader.read_all()
+            global_registry().inc("mutation_dedup_hits")
+            return
+        try:
+            table = reader.read_all()
+            arrays, nulls = arrow_to_arrays(table)
+            info = self.session.catalog.describe(target)
+            # same gate as every session write lane: acked rows put into
+            # a view's backing table would vanish at the view's next sync
+            self.session._reject_matview_write(info)
+            from snappydata_tpu.storage.table_store import RowTableData
 
-            raw = _restore_none_arrays(arrays, nulls)
-            self.session._journal_then(
-                info, "insert", raw, None,
-                lambda: self.session._fold_views(
-                    info, raw, None, info.data.insert_arrays(raw)),
-                sync_force=True)
-        else:
-            nmask = nulls if any(m is not None for m in nulls) else None
-            self.session._journal_then(
-                info, "insert", arrays, nmask,
-                lambda: self.session._fold_views(
-                    info, arrays, nmask,
-                    info.data.insert_arrays(arrays, nulls=nmask)),
-                sync_force=True)
+            # WAL-then-apply under the store's mutation lock (same
+            # invariant as session mutations: journal first so a
+            # concurrent checkpoint can't fold un-journaled rows, and
+            # carry null masks so recovery doesn't turn bulk-ingested
+            # NULLs into zeros). stmt_scope threads the client's
+            # statement id into the WAL header — recovery replay re-seeds
+            # the dedup window from it, so a retry racing a server
+            # RESTART still dedups.
+            # sync_force: the put RESPONSE is a durability ack the lead's
+            # fan-out (and its replica bookkeeping) relies on — the
+            # covering WAL fsync is forced even when this server runs
+            # wal_fsync_mode=interval. Relaxed acks are a local-session
+            # policy, never a network one. Scoped to THIS put's record so
+            # one client's ack never waits on other sessions' records.
+            with reliability.stmt_scope(stmt_id):
+                if isinstance(info.data, RowTableData):
+                    from snappydata_tpu.session import _restore_none_arrays
+
+                    raw = _restore_none_arrays(arrays, nulls)
+                    n = self.session._journal_then(
+                        info, "insert", raw, None,
+                        lambda: self.session._fold_views(
+                            info, raw, None, info.data.insert_arrays(raw)),
+                        sync_force=True)
+                else:
+                    nmask = nulls if any(m is not None for m in nulls) \
+                        else None
+                    n = self.session._journal_then(
+                        info, "insert", arrays, nmask,
+                        lambda: self.session._fold_views(
+                            info, arrays, nmask,
+                            info.data.insert_arrays(arrays, nulls=nmask)),
+                        sync_force=True)
+        except BaseException:
+            if dedup is not None:
+                dedup.abort(stmt_id)   # nothing applied: a retry may run
+            raise
+        if dedup is not None:
+            dedup.commit(stmt_id, {"rows": [[int(n or 0)]]})
 
     # -- ops --------------------------------------------------------------
 
@@ -650,11 +711,38 @@ class SnappyFlightServer(flight.FlightServerBase):
         body = json.loads(action.body.to_pybytes().decode("utf-8")) \
             if action.body else {}
         if name == "sql":
-            result = self._session_for(body).sql(
-                body["sql"], params=tuple(body.get("params", ())))
-            payload = {"names": result.names,
-                       "rows": [[_json_val(v) for v in r]
-                                for r in result.rows()[:1000]]}
+            from snappydata_tpu import reliability
+            from snappydata_tpu.observability.metrics import \
+                global_registry
+
+            sess = self._session_for(body)
+            stmt_id = body.get("stmt_id")
+            dedup = reliability.dedup_for(self.session.catalog) \
+                if stmt_id else None
+            if dedup is not None:
+                prior = dedup.begin(stmt_id)
+                if prior is not None:
+                    # lost-ack retry of an applied mutation: return the
+                    # recorded result, apply nothing
+                    global_registry().inc("mutation_dedup_hits")
+                    yield flight.Result(json.dumps(
+                        dict(prior, deduped=True)).encode("utf-8"))
+                    return
+            try:
+                ctx = self._deadline_ctx(body, sess, body["sql"])
+                with reliability.stmt_scope(stmt_id):
+                    result = sess.sql(
+                        body["sql"], params=tuple(body.get("params", ())),
+                        query_ctx=ctx)
+                payload = {"names": result.names,
+                           "rows": [[_json_val(v) for v in r]
+                                    for r in result.rows()[:1000]]}
+            except BaseException:
+                if dedup is not None:
+                    dedup.abort(stmt_id)
+                raise
+            if dedup is not None:
+                dedup.commit(stmt_id, payload)
             yield flight.Result(json.dumps(payload).encode("utf-8"))
         elif name == "login":
             # credential → ephemeral session token (ref: per-connection
@@ -751,6 +839,27 @@ class SnappyFlightServer(flight.FlightServerBase):
             # re-replication idempotent after a failed/rolled-back copy)
             sess = self._session_for(body)
             n = self._purge_replica(
+                sess, body["table"], body["key"],
+                frozenset(body["buckets"]), int(body["num_buckets"]))
+            yield flight.Result(json.dumps({"rows": n}).encode("utf-8"))
+        elif name == "purge_buckets":
+            # rejoin resync: journaled delete of the given buckets' rows
+            # from the local PRIMARY copy (a restarted member's stale
+            # rows of re-homed buckets must go before re-admission —
+            # they would double-count under scatter otherwise)
+            sess = self._session_for(body)
+            n = self._purge_primary(
+                sess, body["table"], body["key"],
+                frozenset(body["buckets"]), int(body["num_buckets"]))
+            yield flight.Result(json.dumps({"rows": n}).encode("utf-8"))
+        elif name == "demote":
+            # rejoin zero-copy redundancy restore: the inverse of
+            # promote — this server's PRIMARY rows of the given buckets
+            # move into its local replica shadow, because the restarted
+            # member's recovered copy (provably current by WAL-seq
+            # watermark) is taking the primary role back
+            sess = self._session_for(body)
+            n = self._demote_to_replica(
                 sess, body["table"], body["key"],
                 frozenset(body["buckets"]), int(body["num_buckets"]))
             yield flight.Result(json.dumps({"rows": n}).encode("utf-8"))
@@ -985,6 +1094,51 @@ class SnappyFlightServer(flight.FlightServerBase):
         moved_vals = np.asarray(result.columns[ki])[mask]
         self.session.delete_keys(table, [key.lower()],
                                  [np.unique(moved_vals)])
+        return int(mask.sum())
+
+    def _purge_primary(self, sess, table: str, key: str,
+                       buckets: frozenset, num_buckets: int) -> int:
+        """Journaled delete of `buckets` rows from the local primary copy
+        (delete_keys WALs the operation — recovery must never resurrect
+        rows the rejoin resync removed)."""
+        result, mask = self._bucket_rows(sess, table, key, buckets,
+                                         num_buckets)
+        if mask is None:
+            return 0
+        ki = [c.lower() for c in result.names].index(key.lower())
+        vals = np.asarray(result.columns[ki])[mask]
+        self.session.delete_keys(table, [key.lower()], [np.unique(vals)])
+        return int(mask.sum())
+
+    def _demote_to_replica(self, sess, table: str, key: str,
+                           buckets: frozenset, num_buckets: int) -> int:
+        """Move local PRIMARY rows of `buckets` into the local replica
+        shadow: purge the shadow's slice of those buckets first (a
+        crashed earlier demote may have left its copy — re-running must
+        not duplicate it), then copy-into-shadow (journaled,
+        fsync-forced — the shadow row must be durable before the
+        primary copy goes away), then a journaled delete of the primary
+        rows. A crash mid-sequence leaves the bucket in BOTH places
+        (the shadow is invisible to queries and the next run's purge
+        repairs it) — never in neither."""
+        result, mask = self._bucket_rows(sess, table, key, buckets,
+                                         num_buckets)
+        if mask is None:
+            return 0
+        self._purge_replica(sess, table, key, buckets, num_buckets)
+        replica = f"{table}__replica"
+        rinfo = self.session.catalog.describe(replica)
+        arrays = [np.asarray(c)[mask] for c in result.columns]
+        nulls = [np.asarray(nm)[mask] if nm is not None else None
+                 for nm in result.nulls]
+        nmask = nulls if any(m is not None for m in nulls) else None
+        self.session._journal_then(
+            rinfo, "insert", arrays, nmask,
+            lambda: rinfo.data.insert_arrays(arrays, nulls=nmask),
+            sync_force=True)
+        ki = [c.lower() for c in result.names].index(key.lower())
+        vals = np.asarray(result.columns[ki])[mask]
+        self.session.delete_keys(table, [key.lower()], [np.unique(vals)])
         return int(mask.sum())
 
     def _purge_replica(self, sess, table: str, key: str,

@@ -188,9 +188,35 @@ def _dispatch(state: _State, req: dict) -> dict:
     return {"ok": False, "error": f"unknown op {op}"}
 
 
+# members whose heartbeat loop GAVE UP (persistent protocol mismatch
+# after retries): exposed as the `heartbeats_stopped` gauge so an
+# operator can alarm on it — a silently-stopped heartbeat is how a
+# healthy member gets swept out of the view
+_HB_STOPPED: set = set()
+_HB_GAUGE_REGISTERED = False
+
+
+def _register_hb_gauge() -> None:
+    global _HB_GAUGE_REGISTERED
+    if not _HB_GAUGE_REGISTERED:
+        from snappydata_tpu.observability.metrics import global_registry
+
+        global_registry().gauge("heartbeats_stopped",
+                                lambda: float(len(_HB_STOPPED)))
+        _HB_GAUGE_REGISTERED = True
+
+
 class LocatorClient:
     """A member's handle to the locator (persistent connection +
     heartbeat thread)."""
+
+    # consecutive protocol-shaped (RuntimeError) heartbeat failures
+    # tolerated with capped-backoff retries before the loop gives up —
+    # a locator restart mid-upgrade answers the handshake wrong for a
+    # few beats; a REAL version mismatch persists past the cap and
+    # still stops loudly (gauge + error log)
+    HEARTBEAT_GIVEUP = 5
+    HEARTBEAT_BACKOFF_MAX_S = 30.0
 
     def __init__(self, address: str, member_id: str, role: str,
                  host: str = "127.0.0.1", port: int = 0,
@@ -267,10 +293,38 @@ class LocatorClient:
         and the `member_heartbeat_failures` counter (a heartbeat thread
         that dies printing to stderr is how a member gets silently swept
         out — the metric is what an operator alarms on); transient
-        connection errors re-register and keep beating."""
+        connection errors re-register and keep beating.
+
+        Protocol-shaped failures (RuntimeError — e.g. a locator restart
+        mid-upgrade answering the version handshake wrong for a beat or
+        two) used to STOP the loop on the first hit and the member got
+        swept out of the view; now they retry with capped exponential
+        backoff and only HEARTBEAT_GIVEUP consecutive failures stop the
+        loop — visibly, on the `heartbeats_stopped` gauge."""
         from snappydata_tpu.observability.metrics import global_registry
 
+        _register_hb_gauge()
+
+        def giveup(e) -> bool:
+            _log.error("member %s: %s; stopping heartbeats after %d "
+                       "protocol retries", self.member_id, e,
+                       self.HEARTBEAT_GIVEUP)
+            _HB_STOPPED.add(self.member_id)
+            global_registry().inc("member_heartbeats_stopped")
+            return True
+
+        def backoff_wait(fails: int) -> bool:
+            """Capped-backoff sleep; True when the client was closed."""
+            delay = min(interval_s * (2 ** max(0, fails - 1)),
+                        self.HEARTBEAT_BACKOFF_MAX_S)
+            _log.warning("member %s: transient heartbeat protocol "
+                         "failure %d/%d; retrying in %.2fs",
+                         self.member_id, fails, self.HEARTBEAT_GIVEUP,
+                         delay)
+            return self._stop.wait(delay)
+
         def loop():
+            proto_fails = 0
             while not self._stop.wait(interval_s):
                 try:
                     failpoints.hit("locator.heartbeat")
@@ -279,23 +333,29 @@ class LocatorClient:
                     if resp.get("rejoin"):
                         self.register()
                     self.last_view = resp.get("view", self.last_view)
+                    proto_fails = 0
+                    _HB_STOPPED.discard(self.member_id)
                 except RuntimeError as e:
-                    # protocol mismatch after a locator upgrade: say so
-                    # loudly and stop — silent sweep-out helps nobody
                     global_registry().inc("member_heartbeat_failures")
-                    _log.error("member %s: %s; stopping heartbeats",
-                               self.member_id, e)
-                    return
+                    proto_fails += 1
+                    if proto_fails >= self.HEARTBEAT_GIVEUP and giveup(e):
+                        return
+                    if backoff_wait(proto_fails):
+                        return
                 except (ConnectionError, OSError) as e:
                     global_registry().inc("member_heartbeat_failures")
                     _log.warning("member %s: heartbeat failed (%s); "
                                  "re-registering", self.member_id, e)
                     try:
                         self.register()
+                        proto_fails = 0
                     except RuntimeError as e2:
-                        _log.error("member %s: %s; stopping heartbeats",
-                                   self.member_id, e2)
-                        return
+                        proto_fails += 1
+                        if proto_fails >= self.HEARTBEAT_GIVEUP \
+                                and giveup(e2):
+                            return
+                        if backoff_wait(proto_fails):
+                            return
                     except (ConnectionError, OSError):
                         pass   # locator still down: retry next tick
 
@@ -318,6 +378,7 @@ class LocatorClient:
 
     def close(self) -> None:
         self._stop.set()
+        _HB_STOPPED.discard(self.member_id)  # deliberate shutdown ≠ alarm
         try:
             self._request({"op": "deregister", "member_id": self.member_id})
         except (ConnectionError, OSError):

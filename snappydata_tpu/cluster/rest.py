@@ -25,7 +25,8 @@ class JobRegistry:
         self._jobs: Dict[str, dict] = {}
         self._lock = threading.Lock()
 
-    def submit_sql(self, sql: str, params=(), session=None) -> str:
+    def submit_sql(self, sql: str, params=(), session=None,
+                   timeout_s=None) -> str:
         from snappydata_tpu import resource
 
         job_id = uuid.uuid4().hex[:12]
@@ -36,6 +37,10 @@ class JobRegistry:
         # even before the worker thread reaches admission
         ctx = resource.global_broker().watch(
             resource.new_query(sql, user=sess.user))
+        if timeout_s:
+            # per-request deadline counts from SUBMISSION (queue time
+            # included, like query_timeout_s)
+            ctx.set_deadline_in(float(timeout_s))
         with self._lock:
             self._jobs[job_id] = {"status": "RUNNING", "sql": sql,
                                   "queryId": ctx.query_id}
@@ -101,8 +106,12 @@ def _render_dashboard(svc) -> str:
         f"<tr><td>{esc(str(k))}</td><td>{v}</td></tr>"
         for k, v in sorted(snap["counters"].items()))
     from snappydata_tpu.observability.stats_service import (
-        durability_snapshot, join_snapshot, scan_snapshot)
+        durability_snapshot, ha_snapshot, join_snapshot, scan_snapshot)
 
+    ha = ha_snapshot(svc.session.catalog, svc.distributed)
+    rows_ha = "".join(
+        f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
+        for k, v in ha.items())
     wal = durability_snapshot()
     rows_w = "".join(
         f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
@@ -169,6 +178,8 @@ text-align:left}}h2{{margin-top:1.5em}}</style></head><body>
 <h2>Streaming queries ({len(streams)})</h2>
 <table><tr><th>query</th><th>table</th><th>active</th><th>batches</th>
 <th>rows</th><th>rows/s</th><th>last error</th></tr>{rows_s}</table>
+<h2>High availability (deadlines / hedges / dedup / rejoin)</h2>
+<table>{rows_ha}</table>
 <h2>Durability (WAL group commit)</h2><table>{rows_w}</table>
 <h2>Aggregation engine (reduction strategy / tiled scans)</h2>
 <table>{rows_agg}</table>
@@ -246,6 +257,17 @@ class RestService:
                                 "tables": svc.stats_service.current()})
                 elif path == "/status/api/v1/tables":
                     self._send(svc.stats_service.current())
+                elif path == "/status/api/v1/ha":
+                    # end-to-end reliability stats: failovers, hedged
+                    # reads, mutation-retry dedup, rejoins, deadline
+                    # expiries, heartbeat health — plus live membership
+                    # and bucket-redundancy state when this lead holds a
+                    # cluster view
+                    from snappydata_tpu.observability.stats_service import \
+                        ha_snapshot
+
+                    self._send(ha_snapshot(svc.session.catalog,
+                                           svc.distributed))
                 elif path == "/status/api/v1/wal":
                     # group-commit write-path stats: fsync mode/knobs +
                     # wal_fsync_count / wal_group_commit_batches /
@@ -442,7 +464,7 @@ class RestService:
                         return
                     job_id = svc.jobs.submit_sql(
                         body["sql"], tuple(body.get("params", ())),
-                        session=sess)
+                        session=sess, timeout_s=body.get("timeout_s"))
                     self._send({"jobId": job_id, "status": "STARTED"})
                 elif path == "/sql":
                     # synchronous query POST, routed through the serving
@@ -455,8 +477,21 @@ class RestService:
                     if sess is None:
                         return
                     try:
+                        # per-request deadline: `timeout_s` in the body
+                        # arms the QueryContext, so a stalled query stops
+                        # cooperatively (XCL52) instead of holding the
+                        # HTTP worker past the caller's patience
+                        ctx = None
+                        t = body.get("timeout_s")
+                        if t:
+                            from snappydata_tpu import resource
+
+                            ctx = resource.new_query(body["sql"],
+                                                     user=sess.user)
+                            ctx.set_deadline_in(float(t))
                         result = sess.serving_sql(
-                            body["sql"], tuple(body.get("params", ())))
+                            body["sql"], tuple(body.get("params", ())),
+                            query_ctx=ctx)
                         # JSON over HTTP is the small-result surface:
                         # cap the payload but SAY so — a silently
                         # truncated result reads as a complete one

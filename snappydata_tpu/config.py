@@ -203,6 +203,30 @@ class Properties:
     retry_jitter: float = 0.5
     breaker_failures: int = 3
     breaker_reset_s: float = 5.0
+
+    # End-to-end request reliability (reliability.py + cluster/).
+    # client_timeout_s: default per-request deadline on SnappyClient /
+    # DistributedSession calls (0 = none). The deadline rides the Flight
+    # call options (client-enforced: a hung-but-connected member cannot
+    # hold the caller past it — expiry surfaces as SQLSTATE XCL52) AND
+    # the request body (the remote QueryContext stops work cooperatively
+    # when the caller has given up), and it SHRINKS as a scatter's
+    # fan-out progresses — one slow member spends the remainder, not a
+    # fresh budget.
+    client_timeout_s: float = 0.0
+    # Hedged replica reads (OFF by default): when a scatter shard's
+    # primary is slower than hedge_after_ms, the same fragment is issued
+    # to the shard's replica holder (over the __replica shadows) and the
+    # FIRST answer wins; at most hedge_max_concurrent hedges run at
+    # once. Counted: hedged_reads_fired / hedged_reads_won.
+    hedge_reads: bool = False
+    hedge_after_ms: float = 50.0
+    hedge_max_concurrent: int = 4
+    # Server-side at-most-once window for client-stamped mutation ids:
+    # lost-ack mutation retries return the remembered result instead of
+    # double-applying. Ids persist in WAL record headers, so the window
+    # survives crash recovery. Entries are bounded FIFO.
+    mutation_dedup_entries: int = 8192
     # Seed for the fault-injection registry's probabilistic arming and
     # the backoff jitter RNG — chaos schedules replay deterministically
     # (env twin: SNAPPY_TPU_FAULT_SEED).

@@ -131,6 +131,65 @@ def join_snapshot() -> dict:
     }
 
 
+def ha_snapshot(catalog=None, distributed=None) -> dict:
+    """End-to-end request-reliability stats for `/status/api/v1/ha` and
+    the dashboard's High-availability section: failovers, hedged reads,
+    mutation-retry dedup, member rejoins, deadline expiries and the
+    heartbeat health an operator alarms on — every reliability claim as
+    an observable number. `distributed` (the lead's cluster view, when
+    one exists) adds live membership and bucket-redundancy state."""
+    from snappydata_tpu import config
+
+    snap = global_registry().snapshot()
+    c = snap["counters"]
+    g = snap["gauges"]
+    props = config.global_properties()
+    out = {
+        # knobs (what the policy IS, next to what it did)
+        "client_timeout_s": props.get("client_timeout_s"),
+        "query_timeout_s": props.get("query_timeout_s"),
+        "hedge_reads": props.get("hedge_reads"),
+        "hedge_after_ms": props.get("hedge_after_ms"),
+        "mutation_dedup_entries_max": props.get("mutation_dedup_entries"),
+        # failover plane
+        "failover_member_failed": c.get("failover_member_failed", 0),
+        "failover_retries": c.get("failover_retries", 0),
+        "failover_redundancy_degraded":
+            c.get("failover_redundancy_degraded", 0),
+        "failover_redundancy_restored":
+            c.get("failover_redundancy_restored", 0),
+        "breaker_open": c.get("breaker_open", 0),
+        # idempotent mutation retry (the lost-ack evidence pair)
+        "mutation_retries": c.get("mutation_retries", 0),
+        "mutation_dedup_hits": c.get("mutation_dedup_hits", 0),
+        # hedged replica reads
+        "hedged_reads_fired": c.get("hedged_reads_fired", 0),
+        "hedged_reads_won": c.get("hedged_reads_won", 0),
+        # member rejoin with resync
+        "member_rejoins": c.get("member_rejoins", 0),
+        "rejoin_clean_buckets": c.get("rejoin_clean_buckets", 0),
+        "rejoin_copied_buckets": c.get("rejoin_copied_buckets", 0),
+        "rejoin_partial_errors": c.get("rejoin_partial_errors", 0),
+        # deadlines (client-side cutoffs + server-side cooperative stops)
+        "deadline_exceeded": c.get("client_deadline_exceeded", 0),
+        "governor_timeouts": c.get("governor_timeouts", 0),
+        # membership health
+        "member_heartbeat_failures": c.get("member_heartbeat_failures", 0),
+        "heartbeats_stopped": g.get("heartbeats_stopped", 0.0) or 0.0,
+    }
+    if catalog is not None:
+        dedup = getattr(catalog, "_mutation_dedup", None)
+        out["mutation_dedup_entries"] = len(dedup) if dedup else 0
+    if distributed is not None:
+        try:
+            out["members_total"] = len(distributed.alive)
+            out["alive_members"] = sum(distributed.alive)
+            out["degraded_buckets"] = len(distributed.degraded_buckets())
+        except Exception:
+            pass
+    return out
+
+
 class TableStatsService:
     def __init__(self, catalog, interval_s: Optional[float] = None,
                  registry=None):

@@ -95,6 +95,23 @@ class QueryContext:
         if self.deadline is None and timeout_s and timeout_s > 0:
             self.deadline = time.monotonic() + float(timeout_s)
 
+    def set_deadline_in(self, budget_s: float) -> None:
+        """Arm the deadline `budget_s` seconds from now — deadline
+        PROPAGATION: a remote caller's remaining budget rides the
+        request body and becomes this context's deadline, so the
+        cooperative checks stop server-side work when the caller has
+        already given up (the network front doors call this before
+        handing the context to sql()/serving_sql())."""
+        if budget_s and budget_s > 0:
+            self.deadline = time.monotonic() + float(budget_s)
+
+    def remaining_s(self) -> Optional[float]:
+        """Seconds left before the deadline (None = no deadline; may be
+        negative when already expired)."""
+        if self.deadline is None:
+            return None
+        return self.deadline - time.monotonic()
+
     def check(self) -> None:
         """Cooperative checkpoint — called at batch/tile boundaries.
         Raises CancelException when this query was cancelled or ran past

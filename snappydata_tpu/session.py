@@ -275,9 +275,17 @@ class SnappySession:
             table = getattr(stmt, "table", None) or stmt.name
             from snappydata_tpu.catalog.catalog import _norm
 
+            # a network front door's client-stamped statement id rides
+            # the record header: recovery replay re-seeds the mutation
+            # dedup window from it (reliability.py), so a lost-ack retry
+            # that lands after a server restart still dedups
+            from snappydata_tpu.reliability import current_stmt_id
+
+            sid = current_stmt_id()
             with ds.mutation_lock:
                 seq = ds.wal_append(_norm(table), "sql", sql=sql_text,
-                                    params=tuple(params))
+                                    params=tuple(params),
+                                    extra={"stmt_id": sid} if sid else None)
                 result = self.execute_statement(stmt, tuple(params))
             # ack gate (group commit): the record may still sit in the
             # commit buffer — wal_sync blocks until the covering fsync,
@@ -1570,9 +1578,13 @@ class SnappySession:
         if ds is None:
             with _mv.managed_base_write():
                 return apply_fn()
+        from snappydata_tpu.reliability import current_stmt_id
+
+        sid = current_stmt_id()
         with ds.mutation_lock:
             seq = ds.wal_append(info.name, kind, arrays=arrays,
-                                nulls=nulls)
+                                nulls=nulls,
+                                extra={"stmt_id": sid} if sid else None)
             with _mv.managed_base_write():
                 out = apply_fn()
         ds.wal_sync(seq, force=sync_force)
@@ -1660,10 +1672,14 @@ class SnappySession:
 
         if self.disk_store is None:
             return apply()
+        from snappydata_tpu.reliability import current_stmt_id
+
+        extra = {"key_columns": list(key_columns)}
+        if current_stmt_id():
+            extra["stmt_id"] = current_stmt_id()
         with self.disk_store.mutation_lock:
             seq = self.disk_store.wal_append(
-                info.name, "delete_keys", arrays=key_arrays,
-                extra={"key_columns": list(key_columns)})
+                info.name, "delete_keys", arrays=key_arrays, extra=extra)
             out = apply()
         self.disk_store.wal_sync(seq)   # ack after the covering fsync
         return out

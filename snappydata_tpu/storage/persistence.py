@@ -1540,6 +1540,21 @@ class DiskStore:
             for header, _ in read_records(fh):
                 if header["kind"] == "drop":
                     last_drop[header["table"]] = header["seq"]
+        def reseed_dedup(header, n_rows):
+            # a client-stamped statement id in the record header means
+            # this mutation was acked (or at least journaled) before the
+            # crash: re-seed the at-most-once window so a lost-ack retry
+            # arriving AFTER recovery returns the recorded result
+            # instead of double-applying (reliability.MutationDedup)
+            sid = header.get("stmt_id")
+            if not sid:
+                return
+            from snappydata_tpu.reliability import dedup_for
+
+            dedup_for(catalog).record(
+                sid, {"names": ["count"], "rows": [[int(n_rows)]],
+                      "replayed": True})
+
         with open(wal, "rb") as fh:
             for header, arrays in read_records(fh):
                 table = header.get("table")
@@ -1549,18 +1564,27 @@ class DiskStore:
                     continue
                 if seq <= folded.get(table, 0) or \
                         seq < last_drop.get(table, 0):
+                    # already folded into a checkpoint — the mutation
+                    # still APPLIED, so its dedup id must survive too
+                    reseed_dedup(header, 0)
                     continue
                 info = catalog.lookup_table(table)
                 if info is None:
                     continue  # table dropped for good
                 if kind == "sql":
+                    n = 0
                     try:
-                        session.sql(header["sql"],
-                                    params=tuple(header.get("params", ())))
+                        res = session.sql(header["sql"],
+                                          params=tuple(
+                                              header.get("params", ())))
+                        if res.num_rows and res.columns:
+                            v = res.rows()[0][0]
+                            n = int(v) if isinstance(v, (int, float)) else 0
                     except Exception:
                         # a statement that failed originally fails the same
                         # way on replay — same end state, keep going
                         pass
+                    reseed_dedup(header, n)
                     continue
                 from snappydata_tpu.views import matview as _mv
 
@@ -1582,11 +1606,14 @@ class DiskStore:
 
                     wrapped, captured = _mv.wrap_delete_predicate(
                         catalog, table, pred)
-                    info.data.delete(wrapped)
+                    deleted = info.data.delete(wrapped)
                     if captured:
                         _mv.replay_fold_deleted(catalog, table, captured,
                                                 seq)
+                    reseed_dedup(header, deleted)
                     continue
+                reseed_dedup(header,
+                             int(cols[0].shape[0]) if cols else 0)
                 any_nulls = any(nm is not None for nm in nulls)
                 if isinstance(info.data, RowTableData):
                     if kind == "put":

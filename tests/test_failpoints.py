@@ -405,6 +405,8 @@ def test_fan_error_carries_failed_addresses_and_attempts():
     ds.num_buckets = 4
     ds.bucket_map = [0, 1, 0, 1]
     ds.replica_map = [None] * 4
+    ds.bucket_seq = [0] * 4
+    ds._death_snapshots = {}
     ds._backoff = ExponentialBackoff(0.001, 0.002, jitter=0.0)
     ds.breakers = [CircuitBreaker(1, 99.0) for _ in range(2)]
 

@@ -1,0 +1,672 @@
+"""End-to-end request reliability (the HA tentpole): deadlines that cut
+stalled scatters (SQLSTATE XCL52), idempotent mutation retry through the
+WAL-persisted dedup window, hedged replica reads, member rejoin with
+watermark delta-resync, heartbeat hardening, and the /status/api/v1/ha
+observability surface — plus a seeded kill-a-server schedule running
+UNDER the prepared-statement serving path.
+
+Invariants (the acceptance battery):
+
+  - a failpoint-latency-stalled member cannot hold a scatter past its
+    deadline; the caller gets XCL52 within deadline + one probe
+    interval;
+  - hedged reads (when enabled) return correct first-answer results
+    with hedged_reads_fired > 0;
+  - a mutation whose ack is lost retries TRANSPARENTLY and never
+    double-applies — including across ≥5 seeded crash-recover rounds
+    (the dedup window is rebuilt from WAL headers);
+  - a killed-and-restarted member is resynced and re-admitted
+    automatically: degraded_buckets() empties without a manual
+    restore_redundancy(), clean buckets move ZERO bytes;
+  - under the serving path, killing a member mid-stream leaves every
+    in-flight request either value-correct or failed with a typed
+    RETRYABLE error; acked rows survive, nothing double-applies.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.ha
+
+from snappydata_tpu import SnappySession, config, fault, reliability
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.cluster import LocatorNode, ServerNode
+from snappydata_tpu.cluster.client import SnappyClient
+from snappydata_tpu.cluster.distributed import (DistributedError,
+                                                DistributedSession)
+from snappydata_tpu.observability.metrics import global_registry
+from snappydata_tpu.resource.context import CancelException
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    fault.clear()
+    yield
+    fault.clear()
+
+
+def _counter(name):
+    return global_registry().counter(name)
+
+
+def _cluster(tmp_path=None, n=2, redundancy=1, table=True):
+    locator = LocatorNode().start()
+    sessions = []
+    for i in range(n):
+        kw = {}
+        if tmp_path is not None:
+            kw = {"data_dir": str(tmp_path / f"srv{i}"), "recover": False}
+        sessions.append(SnappySession(catalog=Catalog(), **kw))
+    servers = [ServerNode(locator.address, s).start() for s in sessions]
+    ds = DistributedSession(
+        server_addresses=[s.flight_address for s in servers],
+        locator=locator.address)
+    if table:
+        ds.sql(f"CREATE TABLE t (k BIGINT, v DOUBLE) USING column "
+               f"OPTIONS (partition_by 'k', redundancy '{redundancy}')")
+    return locator, sessions, servers, ds
+
+
+def _teardown(locator, sessions, servers, ds):
+    ds.close()
+    for s in servers:
+        try:
+            s.stop()
+        except Exception:
+            pass
+    for s in sessions:
+        try:
+            if s.disk_store is not None:
+                s.disk_store.close()
+        except Exception:
+            pass
+    locator.stop()
+
+
+# -----------------------------------------------------------------------
+# deadline propagation
+# -----------------------------------------------------------------------
+
+def test_deadline_cuts_stalled_scatter():
+    """A latency-stalled member cannot hold a scatter query past its
+    deadline: the caller gets XCL52 well before the stall would have
+    released, the deadline counter ticks, and the cluster answers
+    normally afterwards (the stall was slowness, not death — no
+    spurious failover)."""
+    locator, sessions, servers, ds = _cluster(n=2, redundancy=0)
+    try:
+        n = 2000
+        ks = np.arange(n, dtype=np.int64)
+        ds.insert_arrays("t", [ks, ks * 1.0])
+        ds.sql("SELECT count(*) FROM t")   # warm compiles
+        before = _counter("client_deadline_exceeded")
+        fault.arm("flight.serve", "latency", param=5.0, count=1)
+        t0 = time.time()
+        with pytest.raises(CancelException) as ei:
+            ds.sql("SELECT count(*) FROM t", timeout_s=0.5)
+        elapsed = time.time() - t0
+        assert "XCL52" in str(ei.value)
+        # deadline + one (deadline-capped) probe interval, NOT the 5s
+        # stall — generous 3s bound absorbs container contention
+        assert elapsed < 3.0, elapsed
+        assert _counter("client_deadline_exceeded") > before
+        assert not reliability.is_retryable(ei.value)
+        fault.clear()
+        # slowness was not death: both members still alive and exact
+        assert all(ds.alive)
+        assert ds.sql("SELECT count(*), sum(v) FROM t").rows() == \
+            [(n, float(ks.sum()))]
+        # query_timeout_s (the session knob) arms the same deadline
+        # when no per-request timeout is given
+        try:
+            ds.planner.conf.query_timeout_s = 0.4
+            fault.arm("flight.serve", "latency", param=5.0, count=1)
+            t0 = time.time()
+            with pytest.raises(CancelException):
+                ds.sql("SELECT count(*) FROM t")
+            assert time.time() - t0 < 3.0
+        finally:
+            ds.planner.conf.query_timeout_s = 0.0
+    finally:
+        _teardown(locator, sessions, servers, ds)
+
+
+# -----------------------------------------------------------------------
+# hedged replica reads
+# -----------------------------------------------------------------------
+
+def test_hedged_read_takes_first_answer():
+    """With hedge_reads on, a stalled primary's fragment re-issues to
+    its replica holder over the __replica shadows and the first answer
+    wins — value-asserted, well before the stall releases."""
+    props = config.global_properties()
+    locator, sessions, servers, ds = _cluster(n=3, redundancy=1)
+    try:
+        n = 3000
+        ks = np.arange(n, dtype=np.int64)
+        ds.insert_arrays("t", [ks, ks * 1.0])
+        ds.sql("SELECT count(*), sum(v) FROM t")   # warm compiles
+        props.set("hedge_reads", True)
+        props.set("hedge_after_ms", 40.0)
+        fired0 = _counter("hedged_reads_fired")
+        fault.arm("flight.serve", "latency", param=4.0, count=1)
+        t0 = time.time()
+        rows = ds.sql("SELECT count(*), sum(v) FROM t",
+                      timeout_s=15.0).rows()
+        elapsed = time.time() - t0
+        fault.clear()
+        assert rows == [(n, float(ks.sum()))]
+        assert elapsed < 3.5, elapsed   # never waited out the 4s stall
+        assert _counter("hedged_reads_fired") > fired0
+    finally:
+        props.set("hedge_reads", False)
+        _teardown(locator, sessions, servers, ds)
+
+
+@pytest.mark.slow
+def test_hedge_ineligible_without_redundancy():
+    """No replicas → no hedge target: the builder declines and reads
+    stay exact (a hedge over non-mirroring shadows would answer wrong
+    rows — declining IS the correctness property)."""
+    props = config.global_properties()
+    locator, sessions, servers, ds = _cluster(n=2, redundancy=0)
+    try:
+        ds.insert_arrays("t", [np.arange(100, dtype=np.int64),
+                               np.ones(100)])
+        props.set("hedge_reads", True)
+        fired0 = _counter("hedged_reads_fired")
+        assert ds.sql("SELECT count(*) FROM t").rows() == [(100,)]
+        assert _counter("hedged_reads_fired") == fired0
+    finally:
+        props.set("hedge_reads", False)
+        _teardown(locator, sessions, servers, ds)
+
+
+# -----------------------------------------------------------------------
+# idempotent mutation retry (lost-ack dedup)
+# -----------------------------------------------------------------------
+
+def test_mutation_lost_ack_retries_transparently():
+    """The PR 2 blind-retry trap, closed: a response dropped AFTER the
+    server applied used to raise ConnectionError to the caller (retrying
+    would have double-applied). The stamped statement id + server dedup
+    window turn it into a transparent retry that applies exactly once."""
+    locator = LocatorNode().start()
+    sess = SnappySession(catalog=Catalog())
+    node = ServerNode(locator.address, sess).start()
+    client = SnappyClient(address=node.flight_address)
+    try:
+        client.execute("CREATE TABLE mut (k BIGINT) USING column")
+        r0, d0 = _counter("mutation_retries"), _counter(
+            "mutation_dedup_hits")
+        fault.arm("flight.rpc", "drop", phase="after", count=1)
+        out = client.execute("INSERT INTO mut VALUES (7)")
+        fault.clear()
+        assert out.get("deduped"), out
+        assert _counter("mutation_retries") == r0 + 1
+        assert _counter("mutation_dedup_hits") == d0 + 1
+        got = client.sql("SELECT count(*) FROM mut").to_pydict()
+        assert list(got.values())[0] == [1]
+        # do_put lane too: a dropped put-ack retries and dedups
+        import pyarrow as pa
+
+        fault.arm("flight.rpc", "drop", phase="after", count=1)
+        client.insert("mut", pa.table({"k": np.array([8], np.int64)}))
+        fault.clear()
+        got = client.sql(
+            "SELECT count(*), count(DISTINCT k) FROM mut").to_pydict()
+        assert [v[0] for v in got.values()] == [2, 2]
+    finally:
+        node.stop()
+        locator.stop()
+
+
+def test_mutation_retry_pins_to_applied_server():
+    """A mutation retry must reconnect to the SAME member that may have
+    applied the first send — dedup windows are per-server, so a locator
+    failover to a different member would re-apply there. When the
+    member is gone the client surfaces the connection error (zero or
+    one applies, never two); idempotent reads still fail over."""
+    locator = LocatorNode().start()
+    sessions = [SnappySession(catalog=Catalog()) for _ in range(2)]
+    servers = [ServerNode(locator.address, s).start() for s in sessions]
+    for s in sessions:
+        s.sql("CREATE TABLE pin (k BIGINT) USING column")
+    client = SnappyClient(locator=locator.address)
+    try:
+        client.sql("SELECT count(*) FROM pin")   # connect somewhere
+        addr = client._conn_addr
+        victim = next(i for i, s in enumerate(servers)
+                      if s.flight_address == addr)
+        other = sessions[1 - victim]
+        servers[victim].stop()
+        with pytest.raises(ConnectionError):
+            client.execute("INSERT INTO pin VALUES (1)")
+        # at-most-once held: the OTHER member never saw the mutation
+        assert other.sql("SELECT count(*) FROM pin").rows() == [(0,)]
+        # idempotent reads are not pinned: the next query fails over
+        got = client.sql("SELECT count(*) FROM pin")
+        assert got.column(0).to_pylist() == [0]
+        assert client._conn_addr != addr
+    finally:
+        client.close()
+        for s in servers:
+            try:
+                s.stop()
+            except Exception:
+                pass
+        locator.stop()
+
+
+def test_mutation_dedup_survives_crash_recovery(tmp_path):
+    """≥5 seeded crash-recover rounds: a retry carrying the SAME
+    statement id that lands AFTER the server restarted still dedups —
+    the window is rebuilt from WAL record headers during replay. Final
+    rowcounts assert exactly-once end to end."""
+    locator = LocatorNode().start()
+    d = str(tmp_path / "srv")
+    sess = SnappySession(catalog=Catalog(), data_dir=d, recover=False)
+    node = ServerNode(locator.address, sess).start()
+    client = SnappyClient(address=node.flight_address)
+    client.execute("CREATE TABLE mut (k BIGINT) USING column")
+    try:
+        for i in range(5):
+            sid = f"ha-round-{i}"
+            client.execute(f"INSERT INTO mut VALUES ({100 + i})",
+                           stmt_id=sid)
+            # crash + recover the server
+            node.stop()
+            sess.disk_store.close()
+            sess = SnappySession(data_dir=d, recover=True)
+            node = ServerNode(locator.address, sess).start()
+            client = SnappyClient(address=node.flight_address)
+            d0 = _counter("mutation_dedup_hits")
+            out = client.execute(f"INSERT INTO mut VALUES ({100 + i})",
+                                 stmt_id=sid)
+            assert out.get("deduped"), (i, out)
+            assert _counter("mutation_dedup_hits") == d0 + 1
+        got = client.sql(
+            "SELECT count(*), count(DISTINCT k) FROM mut").to_pydict()
+        assert [v[0] for v in got.values()] == [5, 5]
+    finally:
+        node.stop()
+        try:
+            sess.disk_store.close()
+        except Exception:
+            pass
+        locator.stop()
+
+
+# -----------------------------------------------------------------------
+# member rejoin with watermark delta-resync
+# -----------------------------------------------------------------------
+
+def test_rejoin_resyncs_and_restores_redundancy(tmp_path):
+    """Kill a member, keep writing (dirtying SOME buckets), restart it
+    from its recovered data dir, and let the locator-driven poll rejoin
+    it: degraded_buckets() empties WITHOUT restore_redundancy(), clean
+    buckets reclaim zero-copy, dirty ones get fresh copies — and a
+    subsequent death of the OTHER member proves the restored redundancy
+    is real (no phantom replicas)."""
+    locator, sessions, servers, ds = _cluster(tmp_path, n=2, redundancy=1)
+    try:
+        n = 4000
+        ks = np.arange(n, dtype=np.int64)
+        rng = np.random.default_rng(7)
+        vs = np.round(rng.random(n) * 100, 3)
+        ds.insert_arrays("t", [ks, vs])
+        expected_sum = float(vs.sum())
+
+        servers[1].stop()
+        sessions[1].disk_store.close()
+        ds.mark_server_failed(1)
+        assert len(ds.degraded_buckets()) == ds.num_buckets
+        # writes while the member is down: a NARROW key range, so most
+        # buckets stay clean (watermark unchanged)
+        extra = np.arange(n, n + 64, dtype=np.int64)
+        ds.insert_arrays("t", [extra, np.ones(64)])
+        expected_sum += 64.0
+        total = n + 64
+
+        # restart with recovered data + membership-driven auto-rejoin
+        sessions[1] = SnappySession(data_dir=str(tmp_path / "srv1"),
+                                    recover=True)
+        servers[1] = ServerNode(locator.address, sessions[1]).start()
+        rj0 = _counter("member_rejoins")
+        out = ds.poll_rejoins()
+        assert out and out[0]["rejoined"], out
+        summary = out[0]
+        assert _counter("member_rejoins") == rj0 + 1
+        assert summary["errors"] == []
+        # delta resync: clean buckets moved ZERO bytes, dirty ones copied
+        assert summary["clean_primary_buckets"] > 0
+        assert summary["copied_buckets"] > 0
+        assert summary["clean_primary_buckets"] + \
+            summary["clean_replica_buckets"] + \
+            summary["copied_buckets"] <= 2 * ds.num_buckets
+        # THE acceptance bar: redundancy restored with no manual
+        # restore_redundancy()
+        assert ds.degraded_buckets() == []
+        rows = ds.sql("SELECT count(*), sum(v) FROM t").rows()
+        assert rows[0][0] == total
+        assert rows[0][1] == pytest.approx(expected_sum, rel=1e-9)
+        # value-asserted sample rows (not just aggregates)
+        got = ds.sql("SELECT v FROM t WHERE k = 1234").rows()
+        assert got == [(pytest.approx(float(vs[1234])),)]
+
+        # the restored redundancy is REAL: kill the other member — the
+        # rejoined one answers complete, exact results on its own
+        servers[0].stop()
+        sessions[0].disk_store.close()
+        ds.mark_server_failed(0)
+        rows = ds.sql("SELECT count(*), sum(v) FROM t").rows()
+        assert rows[0][0] == total
+        assert rows[0][1] == pytest.approx(expected_sum, rel=1e-9)
+    finally:
+        _teardown(locator, sessions, servers, ds)
+
+
+@pytest.mark.slow
+def test_rejoin_without_snapshot_full_resync(tmp_path):
+    """A lead with no death snapshot (it restarted too) cannot verify
+    any recovered bucket: rejoin degrades to full resync — still
+    automatic, still exact, still redundancy-restoring."""
+    locator, sessions, servers, ds = _cluster(tmp_path, n=2, redundancy=1)
+    try:
+        n = 1500
+        ks = np.arange(n, dtype=np.int64)
+        ds.insert_arrays("t", [ks, ks * 0.25])
+        servers[1].stop()
+        sessions[1].disk_store.close()
+        ds.mark_server_failed(1)
+        ds._death_snapshots.clear()   # lead restarted: watermark gone
+        sessions[1] = SnappySession(data_dir=str(tmp_path / "srv1"),
+                                    recover=True)
+        servers[1] = ServerNode(locator.address, sessions[1]).start()
+        out = ds.rejoin_server(1, servers[1].flight_address)
+        assert out["rejoined"] and out["errors"] == []
+        assert out["clean_primary_buckets"] == 0   # nothing verifiable
+        assert ds.degraded_buckets() == []
+        assert ds.sql("SELECT count(*), sum(v) FROM t").rows() == \
+            [(n, pytest.approx(float(ks.sum()) * 0.25))]
+    finally:
+        _teardown(locator, sessions, servers, ds)
+
+
+def test_rejoin_restores_lost_buckets(tmp_path):
+    """Redundancy 0: a member death LOSES its buckets (no surviving
+    copy). The restarted member's recovered rows are the ONLY copy —
+    rejoin must RESTORE them, never purge them (review finding: the
+    purge path used to journal the only copy away), with or without a
+    usable watermark snapshot."""
+    locator, sessions, servers, ds = _cluster(tmp_path, n=2,
+                                              redundancy=0)
+    try:
+        n = 1200
+        ks = np.arange(n, dtype=np.int64)
+        ds.insert_arrays("t", [ks, ks * 2.0])
+        servers[1].stop()
+        sessions[1].disk_store.close()
+        ds.mark_server_failed(1)
+        lost_now = ds.sql("SELECT count(*) FROM t").rows()[0][0]
+        assert lost_now < n   # buckets really were lost
+        sessions[1] = SnappySession(data_dir=str(tmp_path / "srv1"),
+                                    recover=True)
+        servers[1] = ServerNode(locator.address, sessions[1]).start()
+        out = ds.rejoin_server(1, servers[1].flight_address)
+        assert out["rejoined"], out
+        rows = ds.sql("SELECT count(*), sum(v) FROM t").rows()
+        assert rows == [(n, pytest.approx(float(ks.sum()) * 2.0))], rows
+        # same invariant with NO watermark snapshot (lead restarted):
+        # the full-resync path must still keep the only-copy buckets
+        servers[1].stop()
+        sessions[1].disk_store.close()
+        ds.mark_server_failed(1)
+        ds._death_snapshots.clear()
+        sessions[1] = SnappySession(data_dir=str(tmp_path / "srv1"),
+                                    recover=True)
+        servers[1] = ServerNode(locator.address, sessions[1]).start()
+        out = ds.rejoin_server(1, servers[1].flight_address)
+        assert out["rejoined"], out
+        rows = ds.sql("SELECT count(*), sum(v) FROM t").rows()
+        assert rows == [(n, pytest.approx(float(ks.sum()) * 2.0))], rows
+    finally:
+        _teardown(locator, sessions, servers, ds)
+
+
+# -----------------------------------------------------------------------
+# heartbeat hardening (satellite)
+# -----------------------------------------------------------------------
+
+def test_heartbeat_survives_transient_runtime_errors():
+    """Transient protocol-shaped failures (locator restart mid-upgrade)
+    retry with capped backoff instead of permanently stopping the
+    heartbeat loop — the member stays in the view and the
+    heartbeats_stopped gauge stays clean."""
+    from snappydata_tpu.cluster.locator import Locator, LocatorClient
+
+    loc = Locator().start()
+    lc = LocatorClient(loc.address, "hb-member", "server", port=1234)
+    try:
+        lc.register()
+        lc.start_heartbeats(interval_s=0.05)
+        hb0 = _counter("member_heartbeat_failures")
+        fault.arm("locator.heartbeat", "raise", exc="runtime", count=3)
+        deadline = time.time() + 5.0
+        while _counter("member_heartbeat_failures") < hb0 + 3 and \
+                time.time() < deadline:
+            time.sleep(0.02)
+        assert _counter("member_heartbeat_failures") >= hb0 + 3
+        # wait for a post-fault successful beat
+        time.sleep(0.5)
+        members = {m.member_id for m in lc.members()}
+        assert "hb-member" in members, "member was swept out"
+        snap = global_registry().snapshot()["gauges"]
+        assert (snap.get("heartbeats_stopped") or 0.0) == 0.0
+    finally:
+        lc.close()
+        loc.stop()
+
+
+@pytest.mark.slow
+def test_heartbeat_gives_up_visibly_on_persistent_mismatch():
+    """A REAL protocol mismatch persists past the retry cap: the loop
+    stops — but visibly, on the heartbeats_stopped gauge an operator
+    can alarm on (the old behavior stopped silently on the FIRST)."""
+    from snappydata_tpu.cluster.locator import Locator, LocatorClient
+
+    loc = Locator().start()
+    lc = LocatorClient(loc.address, "hb-doomed", "server", port=1235)
+    lc.HEARTBEAT_GIVEUP = 2          # keep the test fast
+    lc.HEARTBEAT_BACKOFF_MAX_S = 0.05
+    try:
+        lc.register()
+        s0 = _counter("member_heartbeats_stopped")
+        fault.arm("locator.heartbeat", "raise", exc="runtime", count=50)
+        lc.start_heartbeats(interval_s=0.02)
+        deadline = time.time() + 5.0
+        while _counter("member_heartbeats_stopped") < s0 + 1 and \
+                time.time() < deadline:
+            time.sleep(0.02)
+        fault.clear()
+        assert _counter("member_heartbeats_stopped") == s0 + 1
+        snap = global_registry().snapshot()["gauges"]
+        assert (snap.get("heartbeats_stopped") or 0.0) >= 1.0
+    finally:
+        lc.close()   # discards from the gauge: deliberate ≠ alarm
+        loc.stop()
+        snap = global_registry().snapshot()["gauges"]
+        assert (snap.get("heartbeats_stopped") or 0.0) == 0.0
+
+
+# -----------------------------------------------------------------------
+# observability surface
+# -----------------------------------------------------------------------
+
+def test_rest_ha_endpoint_and_dashboard():
+    from snappydata_tpu.cluster.rest import RestService
+    from snappydata_tpu.observability import TableStatsService
+
+    s = SnappySession(catalog=Catalog())
+    svc = RestService(s, TableStatsService(s.catalog), port=0).start()
+    try:
+        base = f"http://{svc.host}:{svc.port}"
+        with urllib.request.urlopen(f"{base}/status/api/v1/ha") as r:
+            ha = json.loads(r.read())
+        for key in ("mutation_retries", "mutation_dedup_hits",
+                    "hedged_reads_fired", "member_rejoins",
+                    "deadline_exceeded", "heartbeats_stopped",
+                    "hedge_reads", "client_timeout_s"):
+            assert key in ha, key
+        with urllib.request.urlopen(f"{base}/dashboard") as r:
+            html = r.read().decode()
+        assert "High availability" in html
+    finally:
+        svc.stop()
+
+
+@pytest.mark.slow
+def test_rest_sql_timeout_s():
+    """POST /sql honors a per-request timeout_s: a stalled statement
+    returns the XCL52 error body instead of holding the HTTP worker."""
+    from snappydata_tpu.cluster.rest import RestService
+    from snappydata_tpu.observability import TableStatsService
+
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE rt (k BIGINT) USING column")
+    s.insert_arrays("rt", [np.arange(50_000, dtype=np.int64)])
+    svc = RestService(s, TableStatsService(s.catalog), port=0).start()
+    try:
+        base = f"http://{svc.host}:{svc.port}"
+        body = json.dumps({"sql": "SELECT count(*) FROM rt",
+                           "timeout_s": 1e-7}).encode()
+        req = urllib.request.Request(
+            f"{base}/sql", data=body,
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req)
+        assert "XCL52" in ei.value.read().decode()
+        # sane budget: same statement completes
+        body = json.dumps({"sql": "SELECT count(*) FROM rt",
+                           "timeout_s": 30.0}).encode()
+        req = urllib.request.Request(
+            f"{base}/sql", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req) as r:
+            out = json.loads(r.read())
+        assert out["rows"] == [[50_000]]
+    finally:
+        svc.stop()
+
+
+# -----------------------------------------------------------------------
+# seeded kill-a-server schedule UNDER the serving path (satellite)
+# -----------------------------------------------------------------------
+
+@pytest.mark.chaos
+def test_chaos_kill_under_serving_path():
+    """PR 7's front door under PR 8's reliability layer: concurrent
+    prepared-statement readers (fused batches included) hammer the
+    cluster while a seeded fault storm runs and a member is hard-killed
+    mid-stream. Invariants:
+
+      - every COMPLETED request is value-correct (prepared point reads
+        checked row by row);
+      - every FAILED in-flight request failed with a typed RETRYABLE
+        error (reliability.is_retryable) — never a wrong answer, never
+        an unclassifiable error;
+      - killing a primary mid-scatter with redundancy 1 completes the
+        scatter with value-asserted rows;
+      - acked mutations all survive; nothing double-applies."""
+    seed = 20260804
+    rng = np.random.default_rng(seed)
+    fault.reseed(seed)
+    locator, sessions, servers, ds = _cluster(n=3, redundancy=1)
+    try:
+        # replicated serving table: any member answers point reads whole
+        ds.sql("CREATE TABLE kv (k BIGINT, v DOUBLE) USING column")
+        nk = 512
+        kk = np.arange(nk, dtype=np.int64)
+        ds.insert_arrays("kv", [kk, kk * 2.0])
+        acked = 0
+        ks = np.arange(1000, dtype=np.int64)
+        ds.insert_arrays("t", [ks, ks * 1.0])
+        acked += 1000
+
+        wrong, unexpected = [], []
+        stop = threading.Event()
+        completed = [0]
+
+        def reader(ci):
+            client = SnappyClient(address=servers[ci % 3].flight_address,
+                                  locator=locator.address)
+            r = np.random.default_rng(1000 + ci)
+            while not stop.is_set():
+                k = int(r.integers(0, nk))
+                try:
+                    tbl = client.sql("SELECT v FROM kv WHERE k = ?",
+                                     params=[k], prepared=True)
+                    vals = tbl.column(0).to_pylist()
+                    if vals != [k * 2.0]:
+                        wrong.append((k, vals))
+                    completed[0] += 1
+                except Exception as e:   # noqa: BLE001
+                    if not reliability.is_retryable(e):
+                        unexpected.append(repr(e))
+            client.close()
+
+        threads = [threading.Thread(target=reader, args=(ci,))
+                   for ci in range(4)]
+        for t in threads:
+            t.start()
+        # seeded fault storm over client RPC (connection-shaped only:
+        # the typed-retryable contract is exactly what we're asserting)
+        fault.arm("flight.rpc", "latency", param=0.002, p=0.3)
+        fault.arm("flight.rpc", "drop", p=0.1)
+        deadline = time.time() + 10.0
+        while completed[0] < 40 and time.time() < deadline:
+            time.sleep(0.01)
+        assert completed[0] >= 40, "storm starved every reader"
+        # mutations keep landing during the storm (acked == counted)
+        for i in range(6):
+            try:
+                ds.insert_arrays(
+                    "t", [np.arange(1000 + acked, 1008 + acked,
+                                    dtype=np.int64)[:8], np.ones(8)])
+                acked += 8
+            except Exception:
+                pass   # un-acked: excluded by design
+
+        # hard-kill a member mid-stream; readers keep going (failover)
+        victim = next(i for i in range(3) if ds.alive[i])
+        servers[victim].stop()
+        # the very next scatter pays the failover and must still be
+        # value-correct (replica promotion keeps it complete)
+        got = ds.sql("SELECT count(*), sum(v) FROM kv").rows()
+        assert got == [(nk, float(kk.sum()) * 2.0)], got
+        t_deadline = time.time() + 10.0
+        c0 = completed[0]
+        while completed[0] < c0 + 20 and time.time() < t_deadline:
+            time.sleep(0.01)
+        stop.set()
+        for t in threads:
+            t.join(timeout=15)
+        fault.clear()
+        assert not any(t.is_alive() for t in threads), \
+            "a reader hung through the kill"
+        assert wrong == [], f"wrong answers under chaos: {wrong[:3]}"
+        assert unexpected == [], \
+            f"non-retryable in-flight failures: {unexpected[:3]}"
+        assert completed[0] > c0, "no reader survived the kill"
+        # acked rows complete, nothing double-applied, values exact
+        rows = ds.sql(
+            "SELECT count(*), count(DISTINCT k) FROM t").rows()
+        assert rows[0][0] == acked and rows[0][1] == acked, (rows, acked)
+    finally:
+        fault.clear()
+        _teardown(locator, sessions, servers, ds)
