@@ -119,10 +119,19 @@ def _render_dashboard(svc) -> str:
         f"<tr><td>wal_group_flush_ms (mean/max)</td>"
         f"<td>{wal['wal_group_flush_ms']['mean_ms']} / "
         f"{wal['wal_group_flush_ms']['max_ms']}</td></tr>")
-    agg = scan_snapshot()
+    agg = scan_snapshot(svc.session.catalog)
+    enc_tables = agg.pop("tables", {})
     rows_agg = "".join(
         f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
         for k, v in agg.items())
+    rows_enc = "".join(
+        f"<tr><td>{esc(str(name))}</td><td>{t['rows']:,}</td>"
+        f"<td>{esc(str(t['encoding_mix']))}</td>"
+        f"<td>{t['at_rest_bytes']:,}</td><td>{t['decoded_bytes']:,}</td>"
+        f"<td>{esc(str(t['at_rest_ratio']))}</td>"
+        f"<td>{t['device_resident_bytes']:,}</td>"
+        f"<td>{esc(str(t['resident_bytes_per_row']))}</td></tr>"
+        for name, t in sorted(enc_tables.items()))
     jn = join_snapshot()
     rows_jn = "".join(
         f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
@@ -181,8 +190,12 @@ text-align:left}}h2{{margin-top:1.5em}}</style></head><body>
 <h2>High availability (deadlines / hedges / dedup / rejoin)</h2>
 <table>{rows_ha}</table>
 <h2>Durability (WAL group commit)</h2><table>{rows_w}</table>
-<h2>Aggregation engine (reduction strategy / tiled scans)</h2>
+<h2>Scan &amp; decode (compressed domain / Aggregation engine /
+tiled scans)</h2>
 <table>{rows_agg}</table>
+<table><tr><th>table</th><th>rows</th><th>encoding mix</th>
+<th>at-rest bytes</th><th>decoded bytes</th><th>at-rest ratio</th>
+<th>device resident</th><th>resident B/row</th></tr>{rows_enc}</table>
 <h2>Join engine (device path / build cache / expansion)</h2>
 <table>{rows_jn}</table>
 <h2>Serving path (prepared statements / micro-batched dispatch)</h2>
@@ -277,13 +290,16 @@ class RestService:
 
                     self._send(durability_snapshot())
                 elif path == "/status/api/v1/scan":
-                    # aggregation read-path stats: chosen reduction
-                    # strategies, fused-pass counts, group-index cache
-                    # hit rate, tiled-scan device merges + overlap
+                    # scan read-path stats: reduction strategies,
+                    # fused-pass counts, group-index cache hit rate,
+                    # tiled-scan device merges, and the compressed-domain
+                    # block (code/run predicates, dictionary batch
+                    # skipping, per-reason fallbacks, per-table encoding
+                    # mix + at-rest vs decoded bytes)
                     from snappydata_tpu.observability.stats_service import \
                         scan_snapshot
 
-                    self._send(scan_snapshot())
+                    self._send(scan_snapshot(svc.session.catalog))
                 elif path == "/status/api/v1/join":
                     # join-engine stats: device vs host-path counts (host
                     # fallbacks itemized by reason), build-artifact cache

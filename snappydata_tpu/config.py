@@ -90,6 +90,24 @@ class Properties:
     # capacity-row plates (ref: decode-at-scan generated code,
     # ColumnTableScan.scala:684 genCodeColumnBuffer)
     device_decode: bool = True
+    # Compressed-domain execution (storage/device.py code-domain binds +
+    # engine/exprs.py code-compare lanes): predicates and aggregate
+    # inputs evaluate directly over the ENCODED representation —
+    # VALUE_DICT columns stay resident as uint8/uint16 code plates plus
+    # tiny per-batch dictionaries (predicate literals translate to code
+    # thresholds through the sorted dictionary; value uses gather
+    # in-trace, fused into the consuming kernel), RLE columns stay as
+    # (run values, run ends) with per-run predicate evaluation, bitset
+    # columns stay packed. Decoded capacity-row plates are never
+    # materialized in HBM for such columns — the capacity lever.
+    #   auto  engage per column when its batches encode uniformly;
+    #         fall back silently on plain columns, counted
+    #         (compressed_fallback_*) when a compressible column can't
+    #   on    same engagement, but count EVERY ineligible column
+    #   off   always bind decoded plates (the pre-r06 behavior)
+    # The knob rides the compiled plan's STATIC key like
+    # agg_reduce_strategy: flipping it re-specializes, no cache flush.
+    scan_compressed_domain: str = "auto"
     # Pallas compensated-f32 kernel for global float SUM/AVG instead of
     # the emulated-f64 segment reduction on TPU (ops/pallas_reduce.py).
     # Default OFF until measured on hardware; bench.py reports the

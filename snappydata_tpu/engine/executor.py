@@ -82,12 +82,19 @@ class _RelationInput:
         self.info = info
         self.used = used
         self.sargs: List[Tuple[int, str, Callable]] = []
+        # string-equality conjuncts (col ordinal, literal-getter): an
+        # equality literal absent from the table dictionary can't match
+        # any row — the binder skips EVERY batch (batches_skipped_dict)
+        self.str_sargs: List[Tuple[int, Callable]] = []
         # artifact-backed join builds: the cached sorted-key order
         # indexes the FULL flat plate layout, so bind-time batch
         # skipping (which gathers a subset of batches) must not reshape
         # this relation's arrays — the in-trace pass mask applies the
         # filter instead
         self.no_skip = False
+        # join relations bind decoded plates: cached build artifacts and
+        # probe-key encodes read flat [B*cap] value layouts directly
+        self.allow_code = True
 
     def bind(self):
         from snappydata_tpu.storage.device import build_device_table
@@ -95,11 +102,12 @@ class _RelationInput:
 
         if isinstance(self.info.data, RowTableData):
             return _row_table_device(self.info, self.used)
-        return build_device_table(self.info.data, None, self.used)
+        return build_device_table(self.info.data, None, self.used,
+                                  code_ok=self.allow_code)
 
     def keep_mask(self, dt, params) -> Optional[np.ndarray]:
         """bool [B] of batches that can contain matches; None = keep all."""
-        if not self.sargs or self.no_skip:
+        if (not self.sargs and not self.str_sargs) or self.no_skip:
             return None
         keep = None
         for ci, op, get_lit in self.sargs:
@@ -122,7 +130,61 @@ class _RelationInput:
                 continue
             k = k | np.isnan(smin)
             keep = k if keep is None else (keep & k)
+        keep = self._dict_keep(dt, params, keep)
         return keep
+
+    def _dict_keep(self, dt, params, keep) -> Optional[np.ndarray]:
+        """Dictionary-domain batch skipping (satellite of the
+        compressed-domain path, but active on decoded binds too): an
+        equality literal missing from a batch's sorted VALUE_DICT
+        dictionary — or from a string column's table dictionary — can't
+        match a row of that batch, even when it sits inside the
+        min/max range.  Counted as batches_skipped_dict, on top of
+        whatever the stats skipper already removed."""
+        from snappydata_tpu.observability.metrics import global_registry
+
+        extra = None
+        for ci, op, get_lit in self.sargs:
+            if op != "=":
+                continue
+            dom = dt.dict_domains.get(ci)
+            if dom is None:
+                continue
+            try:
+                v = float(get_lit(params))
+            except (TypeError, ValueError):
+                continue
+            host, sizes = dom
+            present = np.ones(host.shape[0], dtype=np.bool_)
+            for i in range(host.shape[0]):
+                sz = int(sizes[i])
+                if sz == 0:
+                    continue   # no dictionary for this batch: keep
+                p = int(np.searchsorted(host[i, :sz], v))
+                present[i] = p < sz and host[i, p] == v
+            extra = present if extra is None else (extra & present)
+        for ci, get_lit in self.str_sargs:
+            d = dt.dictionaries.get(ci)
+            if d is None or not len(d):
+                continue
+            try:
+                v = get_lit(params)
+            except Exception:
+                continue
+            if v is None:
+                continue
+            if not bool(np.any(d == v)):
+                # absent from the table-wide dictionary: no batch of
+                # this relation can match the conjunct
+                extra = np.zeros(dt.num_batches, dtype=np.bool_)
+        if extra is None:
+            return keep
+        base = keep if keep is not None \
+            else np.ones(dt.num_batches, dtype=np.bool_)
+        newly = int((base & ~extra).sum())
+        if newly:
+            global_registry().inc("batches_skipped_dict", newly)
+        return base & extra
 
 
 def _row_table_device(info, used):
@@ -243,6 +305,35 @@ class CompiledPlan:
         # vmapped variants for the serving micro-batcher, keyed
         # (static sizes, padded batch size)
         self._jitted_vmap: Dict[tuple, Callable] = {}
+        # compressed-domain trace notes per (static, phase): how many
+        # predicates lowered to the code/run lanes in that trace —
+        # tallied once at trace time, re-counted per execution
+        self._code_notes: Dict[tuple, dict] = {}
+
+    def _noted_call(self, static, phase: str, fn, args):
+        """Dispatch `fn` with the compressed-domain trace tally
+        installed: a (re)trace fills a fresh note dict; cached
+        executions leave it empty and keep the stored note."""
+        from snappydata_tpu.engine.exprs import _compressed_notes
+
+        fresh: dict = {}
+        tok = _compressed_notes.set(fresh)
+        try:
+            return fn(*args)
+        finally:
+            _compressed_notes.reset(tok)
+            if fresh or (static, phase) not in self._code_notes:
+                self._code_notes[(static, phase)] = fresh
+
+    def _count_compressed(self, reg, static, phases) -> None:
+        for ph in phases:
+            note = self._code_notes.get((static, ph))
+            if not note:
+                continue
+            if note.get("code_preds"):
+                reg.inc("code_domain_predicates", note["code_preds"])
+            if note.get("run_preds"):
+                reg.inc("rle_run_predicates", note["run_preds"])
 
     def _bind(self, params: Tuple):
         from snappydata_tpu.observability.metrics import global_registry
@@ -281,9 +372,15 @@ class CompiledPlan:
                 col = dt.columns[ci]
                 nl = dt.nulls.get(ci)
                 if take_idx is not None:
-                    if isinstance(col, tuple):  # array column plates
-                        col = tuple(jnp.take(c, take_idx, axis=0)
-                                    for c in col)
+                    if isinstance(col, tuple):
+                        # array-column plates AND compressed-domain
+                        # plates (CodePlate/RlePlate/BitPlate): gather
+                        # every field along the batch axis, preserving
+                        # the NamedTuple type the trace branches on
+                        parts = [jnp.take(c, take_idx, axis=0)
+                                 for c in col]
+                        col = type(col)(*parts) \
+                            if hasattr(col, "_fields") else tuple(parts)
                     else:
                         col = jnp.take(col, take_idx, axis=0)
                     nl = jnp.take(nl, take_idx, axis=0) \
@@ -293,9 +390,20 @@ class CompiledPlan:
             if take_idx is not None:
                 valid = jnp.take(valid, take_idx, axis=0) & pad_mask
             arrays.append(valid)
-        aux = [jnp.asarray(b(params)) for b in self.aux_builders]
+        # EXPLICIT device placement (jax.device_put, not jnp.asarray) for
+        # the small per-execution uploads — literal scalars and aux LUTs.
+        # With the column plates cached on device, a warm query then runs
+        # under jax.transfer_guard("disallow"): the compressed-domain
+        # tests' proof that no decoded plate ever crosses the link.
+        def _up(x):
+            # join-artifact aux builds already return device arrays —
+            # re-wrapping them through numpy would pull them to host
+            return x if isinstance(x, jnp.ndarray) \
+                else jax.device_put(np.asarray(x))
+
+        aux = [_up(b(params)) for b in self.aux_builders]
         static = tuple(p() for p in self.static_providers)
-        pvals = tuple(_param_scalar(v) for v in params)
+        pvals = tuple(jax.device_put(_param_scalar(v)) for v in params)
         return tables, arrays, aux, static, pvals
 
     def _run_device(self, params: Tuple):
@@ -321,13 +429,15 @@ class CompiledPlan:
                 pkey = None
         if use_pre and pkey is not None:
             pre = _pre_cache_get(self, static, pkey, tables)
-            if pre is None:
+            ran_pre = pre is None
+            if ran_pre:
                 reg.inc("gidx_cache_misses")
                 fnp = self._jitted_pre.get(static)
                 if fnp is None:
                     fnp = jax.jit(functools.partial(self.traced_pre, static))
                     self._jitted_pre[static] = fnp
-                pre = fnp(tuple(arrays), tuple(aux), pvals)
+                pre = self._noted_call(
+                    static, "pre", fnp, (tuple(arrays), tuple(aux), pvals))
                 _pre_cache_put(self, static, pkey, tables, pre)
             else:
                 reg.inc("gidx_cache_hits")
@@ -335,13 +445,21 @@ class CompiledPlan:
             if fn is None:
                 fn = jax.jit(functools.partial(self.traced_main, static))
                 self._jitted_main[static] = fn
-            outs = fn(tuple(arrays), tuple(aux), pvals, pre)
+            outs = self._noted_call(
+                static, "main", fn, (tuple(arrays), tuple(aux), pvals, pre))
+            # a gidx-cache hit SKIPPED the pre pass — its code predicates
+            # didn't run this execution (review finding: they were
+            # re-counted in proportion to the hit rate)
+            self._count_compressed(
+                reg, static, ("pre", "main") if ran_pre else ("main",))
         else:
             fn = self._jitted.get(static)
             if fn is None:
                 fn = jax.jit(functools.partial(self.traced, static))
                 self._jitted[static] = fn
-            outs = fn(tuple(arrays), tuple(aux), pvals)
+            outs = self._noted_call(
+                static, "single", fn, (tuple(arrays), tuple(aux), pvals))
+            self._count_compressed(reg, static, ("single",))
         note = self.agg_notes.get(static) if self.agg_notes else None
         if note is not None:
             reg.inc("agg_reduce_passes", note["passes"])
@@ -416,7 +534,8 @@ class CompiledPlan:
             fn = jax.jit(jax.vmap(functools.partial(self.traced, static),
                                   in_axes=(None, 0, 0)))
             self._jitted_vmap[key] = fn
-        outs = fn(tuple(arrays), aux, pvals)
+        outs = self._noted_call(key, "vmap", fn, (tuple(arrays), aux, pvals))
+        self._count_compressed(reg, key, ("vmap",))
         note = self.agg_notes.get(static) if self.agg_notes else None
         if note is not None:
             reg.inc("agg_reduce_passes", note["passes"])
@@ -568,6 +687,14 @@ def clear_gidx_cache() -> None:
 # the single source of truth for strategy names lives in ops/reduction —
 # the token index mapping below must stay aligned with resolve_strategy
 from snappydata_tpu.ops.reduction import STRATEGIES as _STRATEGY_NAMES  # noqa: E402
+
+
+def _compressed_token() -> int:
+    """scan_compressed_domain as a small int on the STATIC key."""
+    s = str(config.global_properties().get(
+        "scan_compressed_domain", "auto") or "auto").lower()
+    return ("off", "auto", "on").index(s) if s in ("off", "auto", "on") \
+        else 1
 
 
 def _strategy_token(props) -> int:
@@ -740,6 +867,10 @@ class Compiler:
     def compile(self, plan: ast.Plan) -> CompiledPlan:
         is_agg = isinstance(plan, ast.Aggregate)
         _validate_array_usage(plan)
+        # scan_compressed_domain rides the compiled plan's STATIC key —
+        # flipping the knob re-specializes (and re-binds the matching
+        # plate kind) without any plan-cache flush
+        self._add_static(_compressed_token)
         # column pruning: per-relation needed ordinals, DFS leaf order
         # (HBM-bandwidth saver; ref analogue: Catalyst column pruning into
         # ColumnTableScan's per-column decoders)
@@ -751,19 +882,42 @@ class Compiler:
         n_rel = len(self.relations)
 
         def make_ctx(static, arrays, aux, params) -> "_TraceCtx":
+            from snappydata_tpu.storage.device_decode import (
+                BitPlate, CodePlate, RlePlate, bit_values, code_values,
+                rle_values)
+
             # unpack per-relation arrays
             rel_runtimes = []
             pos = 0
             for r in self.relations:
-                cols = {}
+                entries = []
                 for ci in r.used:
-                    f = r.info.schema.fields[ci]
-                    col_arr, null_arr = arrays[pos]
-                    cols[ci] = DVal(col_arr, null_arr, f.dtype,
-                                    _dict_provider(r.info, ci))
+                    entries.append(arrays[pos])
                     pos += 1
                 valid = arrays[pos]
                 pos += 1
+                cap = int(jnp.shape(valid)[1])
+                cols = {}
+                for ci, (col_arr, null_arr) in zip(r.used, entries):
+                    f = r.info.schema.fields[ci]
+                    if isinstance(col_arr, CodePlate):
+                        # compressed-domain column: value is the LAZY
+                        # in-trace dictionary gather (fused/DCE'd by
+                        # XLA); comparisons take the code lane
+                        dv = DVal(code_values(col_arr), null_arr,
+                                  f.dtype, _dict_provider(r.info, ci))
+                        dv.cplate = col_arr
+                    elif isinstance(col_arr, RlePlate):
+                        dv = DVal(rle_values(col_arr, cap), null_arr,
+                                  f.dtype, _dict_provider(r.info, ci))
+                        dv.rplate = col_arr
+                    elif isinstance(col_arr, BitPlate):
+                        dv = DVal(bit_values(col_arr, cap), null_arr,
+                                  f.dtype, _dict_provider(r.info, ci))
+                    else:
+                        dv = DVal(col_arr, null_arr, f.dtype,
+                                  _dict_provider(r.info, ci))
+                    cols[ci] = dv
                 rel_runtimes.append((cols, valid))
             return _TraceCtx(rel_runtimes, aux, params, static)
 
@@ -1249,6 +1403,12 @@ class Compiler:
         rel_hi = len(self.relations)
         nleft = len(lscope)
         how = plan.how
+        # join relations bind DECODED plates: build artifacts and probe
+        # key encodes read flat [B*cap] value layouts outside the trace
+        # (counted compressed_fallback_join_key when a compressible
+        # column decodes because of this)
+        for r in self.relations[rel_lo:rel_hi]:
+            r.allow_code = False
 
         equi, residual = _split_equi(plan.condition, nleft)
         if not equi:
@@ -2742,12 +2902,21 @@ def _collect_sargs(cond: ast.Expr, rel: _RelationInput) -> None:
         elif isinstance(c.right, ast.Col) and isinstance(
                 c.left, (ast.Lit, ast.ParamLiteral, ast.Param)):
             col, lit, op = c.right, c.left, flip[c.op]
-        if col is None or col.dtype is None or not T.is_numeric(col.dtype):
+        if col is None or col.dtype is None:
             continue
         if isinstance(lit, (ast.ParamLiteral, ast.Param)):
             get = (lambda params, p=lit.pos: params[p])
         else:
             get = (lambda params, v=lit.value: v)
+        if col.dtype.name == "string":
+            # string equality skips via the table dictionary (an absent
+            # literal matches nothing anywhere) — `?` binds included,
+            # read through the same getter at execution time
+            if op == "=":
+                rel.str_sargs.append((col.index, get))
+            continue
+        if not T.is_numeric(col.dtype):
+            continue
         rel.sargs.append((col.index, op, get))
 
 

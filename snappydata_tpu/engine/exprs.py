@@ -76,10 +76,73 @@ class DVal:
     null: object = None           # traced bool array or None
     dtype: T.DataType = None
     dictionary: Optional[np.ndarray] = None   # static host dict for strings
+    # compressed-domain residency (base-table columns bound encoded):
+    # cplate is a device_decode.CodePlate (VALUE_DICT codes + sorted
+    # per-batch dictionaries), rplate a device_decode.RlePlate (run
+    # values + ends).  When set, `value` is the LAZY in-trace decode —
+    # XLA fuses (and dead-code-eliminates) it — and comparisons against
+    # scalars take the code/run lanes below instead of touching values.
+    cplate: object = None
+    rplate: object = None
 
     @property
     def is_string(self) -> bool:
         return self.dtype is not None and self.dtype.name == "string"
+
+
+# per-trace tally of compressed-domain lowerings: the executor installs a
+# dict here around a compiled plan's FIRST trace per static key, stores
+# the result on the plan, and bumps the code_domain_predicates /
+# rle_run_predicates counters by it on every subsequent execution
+import contextvars as _contextvars  # noqa: E402
+
+_compressed_notes: _contextvars.ContextVar = _contextvars.ContextVar(
+    "compressed_notes", default=None)
+
+
+def _note_compressed(kind: str) -> None:
+    d = _compressed_notes.get()
+    if d is not None:
+        d[kind] = d.get(kind, 0) + 1
+
+
+def _compressed_cmp(op: str, col: DVal, lit: DVal) -> Optional[DVal]:
+    """Code/run-domain lowering of `col OP scalar-literal` when the
+    column is resident in the compressed domain.  Value-domain
+    equivalence is exact: code thresholds translate through the sorted
+    dictionary in the promoted compare dtype (device_decode.code_cmp_mask)
+    and run predicates evaluate the very values the expansion would
+    yield.  Returns None when the shape doesn't qualify (derived values,
+    non-scalar or string literal) — the generic value compare runs."""
+    if col.cplate is None and col.rplate is None:
+        return None
+    if lit.cplate is not None or lit.rplate is not None:
+        return None
+    if lit.dtype is not None and lit.dtype.name == "string":
+        return None
+    # an EXACT decimal literal carries its SCALED int64 value — comparing
+    # that against raw dictionary/run values would be off by 10^scale;
+    # the generic lane unscales it correctly (float-valued decimal-typed
+    # literals, e.g. substituted scalar subqueries, stay eligible)
+    if _dec_scale(lit) is not None:
+        return None
+    if lit.null is not None or jnp.ndim(lit.value) != 0:
+        return None
+    from snappydata_tpu.storage.device_decode import (code_cmp_mask,
+                                                      rle_cmp_mask)
+
+    if col.cplate is not None:
+        m = code_cmp_mask(op, col.cplate, lit.value)
+        _note_compressed("code_preds")
+    else:
+        fns = {"=": lambda a, b: a == b, "!=": lambda a, b: a != b,
+               "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+               ">": lambda a, b: a > b, ">=": lambda a, b: a >= b}
+        cap = jnp.shape(col.value)[1]
+        m = rle_cmp_mask(lambda vals, v, _f=fns[op]: _f(vals, v),
+                         col.rplate, lit.value, cap)
+        _note_compressed("run_preds")
+    return DVal(m, _or_null(col.null, lit.null), T.BOOLEAN)
 
 
 def _no_string_operands(dvals, name: str) -> None:
@@ -645,6 +708,14 @@ class ExprBuilder:
 
         def run_bin(rt: Runtime) -> DVal:
             a, b = left(rt), right(rt)
+            if is_cmp:
+                # compressed-domain lane: a code/run-resident column vs a
+                # scalar literal compares on codes/runs, never on values
+                cm = _compressed_cmp(op, a, b)
+                if cm is None:
+                    cm = _compressed_cmp(_FLIP_CMP[op], b, a)
+                if cm is not None:
+                    return cm
             if _dec_scale(a) is not None or _dec_scale(b) is not None:
                 out = _dec_binop(op, fn, a, b, is_cmp)
                 if out is not None:

@@ -52,23 +52,32 @@ def durability_snapshot() -> dict:
     }
 
 
-def scan_snapshot() -> dict:
-    """Aggregation-engine / tiled-scan stats: live knobs + the read-path
-    counters for REST `/status/api/v1/scan` and the dashboard's
-    Aggregation section.  agg_reduce_passes counts fused reduction
-    dispatches (O(1) in slot count by construction — the CI perf guard
-    asserts it), agg_strategy_* which strategy the backend-aware table
-    picked, gidx_cache_* whether repeated queries skipped group-index
-    recomputation, and scan_tile_* whether tile partials merged on
-    device and overlapped bind with compute."""
+def scan_snapshot(catalog=None) -> dict:
+    """Aggregation-engine / tiled-scan / compressed-domain stats: live
+    knobs + the read-path counters for REST `/status/api/v1/scan` and
+    the dashboard's Scan sections.  agg_reduce_passes counts fused
+    reduction dispatches (O(1) in slot count by construction — the CI
+    perf guard asserts it), agg_strategy_* which strategy the
+    backend-aware table picked, gidx_cache_* whether repeated queries
+    skipped group-index recomputation, scan_tile_* whether tile partials
+    merged on device, and the compressed-domain block reports how much
+    of the scan path ran over ENCODED batches: code_domain_predicates /
+    rle_run_predicates (predicates served on codes/runs),
+    batches_code_bound (columns resident encoded — the capacity lever),
+    batches_skipped_dict (equality literals that missed a sorted
+    dictionary), and every decode-first reroute itemized by reason
+    (compressed_fallback_*).  With `catalog`, per-table encoding mix and
+    at-rest vs decoded bytes ride along."""
     from snappydata_tpu import config
+    from snappydata_tpu.storage import device_decode
 
     snap = global_registry().snapshot()
     c = snap["counters"]
     props = config.global_properties()
     hits = c.get("gidx_cache_hits", 0)
     misses = c.get("gidx_cache_misses", 0)
-    return {
+    dd = device_decode.counters()
+    out = {
         "agg_reduce_strategy": props.get("agg_reduce_strategy"),
         "gidx_cache_bytes": props.get("gidx_cache_bytes"),
         "scan_tile_bytes": props.get("scan_tile_bytes"),
@@ -86,7 +95,78 @@ def scan_snapshot() -> dict:
         "scan_tile_host_merges": c.get("scan_tile_host_merges", 0),
         "scan_tile_prefetch_overlap":
             c.get("scan_tile_prefetch_overlap", 0),
+        # --- compressed-domain execution -------------------------------
+        "scan_compressed_domain": props.get("scan_compressed_domain"),
+        "code_domain_predicates": c.get("code_domain_predicates", 0),
+        "rle_run_predicates": c.get("rle_run_predicates", 0),
+        "batches_skipped_dict": c.get("batches_skipped_dict", 0),
+        "batches_code_bound": dd.get("batches_code_bound", 0),
+        "batches_device_decoded": dd.get("batches_device_decoded", 0),
+        "bytes_encoded": dd.get("bytes_encoded", 0),
+        "bytes_decoded_equiv": dd.get("bytes_decoded_equiv", 0),
+        "compressed_fallbacks": c.get("compressed_fallbacks", 0),
+        "compressed_fallback_reasons": {
+            k[len("compressed_fallback_"):]: v for k, v in sorted(c.items())
+            if k.startswith("compressed_fallback_")},
     }
+    if catalog is not None:
+        try:
+            out["tables"] = encoding_mix(catalog)
+        except Exception:   # a racing DROP must not kill the dashboard
+            out["tables"] = {}
+    return out
+
+
+def encoding_mix(catalog) -> Dict[str, dict]:
+    """Per-table encoding mix and at-rest vs fully-decoded bytes — the
+    capacity story behind compressed-domain execution.  decoded_bytes is
+    what the live rows would occupy as dense device-dtype plates;
+    at_rest_bytes is what the encoded batches actually hold; the
+    device-resident bytes (cached plates, compressed or not) come from
+    the device cache ledger."""
+    from snappydata_tpu.storage.device import device_cache_bytes_by_table
+
+    out: Dict[str, dict] = {}
+    tables = [(info.name, info.data) for info in catalog.list_tables()
+              if not isinstance(info.data, RowTableData)]
+    resident = device_cache_bytes_by_table(tables)
+    for info in catalog.list_tables():
+        if isinstance(info.data, RowTableData):
+            continue
+        try:
+            m = info.data.snapshot()
+        except Exception:
+            continue
+        mix: Dict[str, int] = {}
+        at_rest = 0
+        decoded = 0
+        for v in m.views:
+            for f, col in zip(info.schema.fields, v.batch.columns):
+                mix[col.encoding.name] = mix.get(col.encoding.name, 0) + 1
+                at_rest += col.nbytes
+                try:
+                    width = 4 if f.dtype.name == "string" \
+                        else max(1, col.data.dtype.itemsize) \
+                        if col.encoding.name == "PLAIN" \
+                        else f.dtype.device_dtype().itemsize
+                except Exception:
+                    width = 8
+                decoded += col.num_rows * width
+        rows = m.total_rows()
+        out[info.name] = {
+            "rows": rows,
+            "batches": len(m.views),
+            "encoding_mix": mix,
+            "at_rest_bytes": at_rest,
+            "decoded_bytes": decoded,
+            "at_rest_ratio": round(at_rest / decoded, 4) if decoded
+            else None,
+            "device_resident_bytes": resident.get(info.name, 0),
+            "resident_bytes_per_row":
+                round(resident.get(info.name, 0) / rows, 2) if rows
+                else None,
+        }
+    return out
 
 
 def join_snapshot() -> dict:

@@ -298,3 +298,178 @@ def grouped_reduce(ops: Sequence[Tuple[str, Optional[jnp.ndarray],
 def pallas_group_available() -> bool:
     """True when the TPU lowering path is usable on this backend."""
     return jax.default_backend() == "tpu"
+
+
+# ==========================================================================
+# Fused decode+filter+grouped-aggregate: the TPC-H Q1 shape over ENCODED
+# batches.  Value inputs arrive as VALUE_DICT code plates plus per-batch
+# dictionaries; each sum slot is a product of an optional PLAIN factor
+# and any number of CODE factors, decoded INSIDE the kernel from SMEM
+# dictionaries (so `sum(price * (1 - disc))` passes price plain and disc
+# codes with a HOST-transformed dictionary 1-dict — dictionary-space
+# preprocessing is O(D), row-space stays encoded).  Grid is
+# (batch, block) so dictionaries index by batch; the per-group per-lane
+# Kahan discipline matches grouped_reduce above.  All slots share one
+# row mask (the Q1 shape: one filter, null-free measure columns) — the
+# generic engine keeps per-slot null masks.
+# ==========================================================================
+
+_CBLOCK_ROWS = 512   # multiple of 32 (small-int tiles) and 8 (f32)
+
+
+@functools.lru_cache(maxsize=32)
+def _make_code_kernel(spec: Tuple, n_vmem: int, n_dict: int, G: int):
+    """spec: per slot ("count",) or ("sum", plain_idx_or_None,
+    ((code_vmem_idx, dict_idx), ...)) — VMEM indices point into the
+    [gidx, mask, *values] block list, dict indices into the SMEM list."""
+    steps = _CBLOCK_ROWS // _SUBLANES
+
+    def kernel(*refs):
+        gidx_ref = refs[0]
+        mask_ref = refs[1]
+        vmem = refs[:n_vmem]
+        dicts = refs[n_vmem:n_vmem + n_dict]
+        out_refs = refs[n_vmem + n_dict:]
+        b = pl.program_id(0)
+        s = pl.program_id(1)
+        shape = (G, _SUBLANES, _LANES)
+
+        @pl.when((b == 0) & (s == 0))
+        def _init():
+            for r in out_refs:
+                r[...] = jnp.zeros(shape, jnp.float32)
+
+        garange = jax.lax.broadcasted_iota(jnp.int32, shape, 0)
+
+        def body(i, carry):
+            sl = pl.ds(i * _SUBLANES, _SUBLANES)
+            gblk = gidx_ref[0, sl, :]
+            mblk = mask_ref[0, sl, :]
+            sel = (gblk[None].astype(jnp.int32) == garange) & mblk[None]
+            new = []
+            oi = 0
+            for op in spec:
+                if op[0] == "count":
+                    new.append(carry[oi]
+                               + jnp.where(sel, 1.0, 0.0))
+                    oi += 1
+                    continue
+                _, plain_idx, factors = op
+                v = vmem[plain_idx][0, sl, :] if plain_idx is not None \
+                    else jnp.ones((_SUBLANES, _LANES), jnp.float32)
+                for cvi, dvi in factors:
+                    codes = vmem[cvi][0, sl, :].astype(jnp.int32)
+                    dref = dicts[dvi]
+                    dval = jnp.zeros((_SUBLANES, _LANES), jnp.float32)
+
+                    def dec(k, acc, _c=codes, _d=dref):
+                        return jnp.where(_c == k, _d[0, k], acc)
+
+                    dval = jax.lax.fori_loop(0, dref.shape[1], dec, dval)
+                    v = v * dval
+                sm, cp = carry[oi], carry[oi + 1]
+                vv = jnp.where(sel, v[None], 0.0)
+                y = vv - cp
+                t = sm + y
+                new.append(t)
+                new.append((t - sm) - y)
+                oi += 2
+            return tuple(new)
+
+        final = jax.lax.fori_loop(0, steps, body,
+                                  tuple(r[...] for r in out_refs))
+        for r, val in zip(out_refs, final):
+            r[...] = val
+
+    return kernel
+
+
+@functools.partial(jax.jit, static_argnames=("spec", "G", "dshapes",
+                                             "interpret"))
+def _grouped_code_call(vmem_ins, dict_ins, spec, G: int, dshapes,
+                       interpret: bool):
+    B, capr, _ = vmem_ins[0].shape
+    S = capr // _CBLOCK_ROWS
+    from jax.experimental.pallas import tpu as pltpu
+
+    blk = pl.BlockSpec((1, _CBLOCK_ROWS, _LANES), lambda b, s: (b, s, 0))
+    out_blk = pl.BlockSpec((G, _SUBLANES, _LANES), lambda b, s: (0, 0, 0))
+    n_out = sum(1 if op[0] == "count" else 2 for op in spec)
+    outs = pl.pallas_call(
+        _make_code_kernel(spec, len(vmem_ins), len(dict_ins), G),
+        grid=(B, S),
+        in_specs=[blk] * len(vmem_ins) + [
+            pl.BlockSpec((1, d), lambda b, s: (b, 0),
+                         memory_space=pltpu.SMEM) for d in dshapes],
+        out_specs=(out_blk,) * n_out,
+        out_shape=tuple(
+            jax.ShapeDtypeStruct((G, _SUBLANES, _LANES), jnp.float32)
+            for _ in range(n_out)),
+        interpret=interpret,
+    )(*vmem_ins, *dict_ins)
+    results = []
+    oi = 0
+    for op in spec:
+        if op[0] == "count":
+            results.append(jnp.sum(outs[oi].astype(jnp.int64),
+                                   axis=(1, 2)))
+            oi += 1
+        else:
+            s, c = outs[oi], outs[oi + 1]
+            oi += 2
+            results.append(jnp.sum(s.astype(jnp.float64), axis=(1, 2))
+                           - jnp.sum(c.astype(jnp.float64), axis=(1, 2)))
+    return tuple(results)
+
+
+def grouped_code_reduce(gidx, mask, slots, num_segments: int,
+                        interpret: Optional[bool] = None):
+    """Fused decode+filter+grouped reduction over code plates.
+
+    gidx: [B, cap] int group index (< num_segments <= MAX_GROUPS);
+    mask: [B, cap] bool shared row mask (valid & filter);
+    slots: sequence of ("count",) or ("sum", plain_or_None, factors)
+      with plain a [B, cap] float array and factors a sequence of
+      (codes [B, cap] uint8/uint16, dicts [B, D] float) pairs — the
+      slot value is plain * Π decode(codes_k).
+    Returns one [num_segments] array per slot: int64 for counts,
+    float64 for sums."""
+    assert 1 <= num_segments <= MAX_GROUPS, num_segments
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    gidx = jnp.asarray(gidx)
+    B, cap = gidx.shape
+    capr = cap // _LANES
+    pad_r = ((capr + _CBLOCK_ROWS - 1) // _CBLOCK_ROWS) * _CBLOCK_ROWS
+    pad_cap = pad_r * _LANES
+
+    def shape3(a, dtype):
+        a = jnp.asarray(a)
+        if pad_cap != cap:
+            a = jnp.pad(a, ((0, 0), (0, pad_cap - cap)))
+        return a.reshape(B, pad_r, _LANES).astype(dtype)
+
+    vmem: List = [shape3(gidx, jnp.int32), shape3(mask, jnp.bool_)]
+    dict_ins: List = []
+    spec = []
+    for slot in slots:
+        if slot[0] == "count":
+            spec.append(("count",))
+            continue
+        _, plain, factors = slot
+        pi = None
+        if plain is not None:
+            pi = len(vmem)
+            vmem.append(shape3(plain, jnp.float32))
+        fs = []
+        for codes, dicts in factors:
+            cvi = len(vmem)
+            vmem.append(shape3(codes, jnp.asarray(codes).dtype))
+            dvi = len(dict_ins)
+            dict_ins.append(jnp.asarray(dicts, dtype=jnp.float32))
+            fs.append((cvi, dvi))
+        spec.append(("sum", pi, tuple(fs)))
+    dshapes = tuple(int(d.shape[1]) for d in dict_ins)
+    return list(_grouped_code_call(tuple(vmem), tuple(dict_ins),
+                                   tuple(spec), int(num_segments),
+                                   dshapes, bool(interpret)))

@@ -138,3 +138,164 @@ def pallas_reduce_available() -> bool:
     if not _PALLAS:
         return False
     return jax.default_backend() == "tpu"
+
+
+# ==========================================================================
+# Fused decode+filter+aggregate: the TPC-H Q6 shape over ENCODED batches.
+#
+# Inputs stay in the compressed domain end to end: the filter columns are
+# VALUE_DICT code plates (uint8/uint16) compared against PER-BATCH code
+# thresholds (the host translates each literal through the batch's sorted
+# dictionary ONCE — out-of-dictionary literals become thresholds that
+# match nothing), and the discount factor decodes INSIDE the kernel from
+# the batch's tiny dictionary held in SMEM — a decoded plate never exists
+# in HBM, and per-row filter traffic is 1-2 bytes/column instead of 8.
+#
+# Grid is (batch, block): each grid step streams one [_FBLOCK_ROWS, 128]
+# block of one batch through VMEM, so per-batch dictionaries/thresholds
+# index naturally by the first grid axis.  Sums keep the same per-lane
+# Kahan discipline as _kahan_kernel; the count partial rides f32 (exact
+# below 2^24 per lane) and combines in int64 outside.
+#
+# CPU runs use the interpreter (correctness + the opt-in
+# SNAPPY_BENCH_PALLAS=1 bench lane); the real Mosaic lowering engages on
+# TPU.  Codes load as uint8/uint16 and widen in-register — block rows are
+# a multiple of 32 to satisfy the small-int tile shape.
+# ==========================================================================
+
+_FBLOCK_ROWS = 512   # multiple of 32 (int8 tiling) and of 8 (f32 tiling)
+
+
+def _fused_q6_kernel(qty_ref, disc_ref, ship_ref, price_ref, valid_ref,
+                     dict_ref, qhi_ref, dlo_ref, dhi_ref, slo_ref, shi_ref,
+                     sum_ref, comp_ref, cnt_ref):
+    b = pl.program_id(0)
+    s = pl.program_id(1)
+
+    @pl.when((b == 0) & (s == 0))
+    def _init():
+        zero = jnp.zeros((_SUBLANES, _LANES), jnp.float32)
+        sum_ref[...] = zero
+        comp_ref[...] = zero
+        cnt_ref[...] = zero
+
+    steps = _FBLOCK_ROWS // _SUBLANES
+    d_pad = dict_ref.shape[1]
+    qhi = qhi_ref[0, 0]
+    dlo = dlo_ref[0, 0]
+    dhi = dhi_ref[0, 0]
+    slo = slo_ref[0, 0]
+    shi = shi_ref[0, 0]
+
+    def body(i, carry):
+        sm, cp, ct = carry
+        sl = pl.ds(i * _SUBLANES, _SUBLANES)
+        q = qty_ref[0, sl, :].astype(jnp.int32)
+        d = disc_ref[0, sl, :].astype(jnp.int32)
+        sh = ship_ref[0, sl, :]
+        pz = price_ref[0, sl, :]
+        ok = (valid_ref[0, sl, :]
+              & (q < qhi) & (d >= dlo) & (d <= dhi)
+              & (sh >= slo) & (sh < shi))
+        # in-register dictionary decode: D selects (D is tiny — the
+        # VALUE_DICT acceptance rule caps it at rows/8, and Q6's
+        # discount dictionary is 11 entries)
+        dval = jnp.zeros_like(pz)
+
+        def dec(k, acc):
+            return jnp.where(d == k, dict_ref[0, k], acc)
+
+        dval = jax.lax.fori_loop(0, d_pad, dec, dval)
+        v = jnp.where(ok, pz * dval, 0.0)
+        y = v - cp
+        t = sm + y
+        return t, (t - sm) - y, ct + jnp.where(ok, 1.0, 0.0)
+
+    carry0 = (sum_ref[...], comp_ref[...], cnt_ref[...])
+    sm, cp, ct = jax.lax.fori_loop(0, steps, body, carry0)
+    sum_ref[...] = sm
+    comp_ref[...] = cp
+    cnt_ref[...] = ct
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def _fused_q6_call(qty, disc, ship, price, valid, dicts,
+                   qhi, dlo, dhi, slo, shi, interpret: bool = False):
+    B, capr, _ = price.shape
+    S = capr // _FBLOCK_ROWS
+    blk = pl.BlockSpec((1, _FBLOCK_ROWS, _LANES), lambda b, s: (b, s, 0))
+    from jax.experimental.pallas import tpu as pltpu
+
+    smem_dict = pl.BlockSpec((1, dicts.shape[1]), lambda b, s: (b, 0),
+                             memory_space=pltpu.SMEM)
+    smem_b = pl.BlockSpec((1, 1), lambda b, s: (b, 0),
+                          memory_space=pltpu.SMEM)
+    smem_g = pl.BlockSpec((1, 1), lambda b, s: (0, 0),
+                          memory_space=pltpu.SMEM)
+    out_blk = pl.BlockSpec((_SUBLANES, _LANES), lambda b, s: (0, 0))
+    sums, comps, cnts = pl.pallas_call(
+        _fused_q6_kernel,
+        grid=(B, S),
+        in_specs=[blk, blk, blk, blk, blk, smem_dict,
+                  smem_b, smem_b, smem_b, smem_g, smem_g],
+        out_specs=(out_blk, out_blk, out_blk),
+        out_shape=(
+            jax.ShapeDtypeStruct((_SUBLANES, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((_SUBLANES, _LANES), jnp.float32),
+            jax.ShapeDtypeStruct((_SUBLANES, _LANES), jnp.float32),
+        ),
+        interpret=interpret,
+    )(qty, disc, ship, price, valid, dicts, qhi, dlo, dhi, slo, shi)
+    total = (jnp.sum(sums.astype(jnp.float64))
+             - jnp.sum(comps.astype(jnp.float64)))
+    count = jnp.sum(cnts.astype(jnp.int64))
+    return total, count
+
+
+def fused_code_filter_sum(qty_codes, disc_codes, ship, price, valid,
+                          disc_dicts, qty_hi_codes, disc_lo_codes,
+                          disc_hi_codes, ship_lo, ship_hi,
+                          interpret=None):
+    """Fused decode+filter+SUM over encoded batches (the Q6 shape):
+
+        sum(price * disc), count(*)
+        WHERE qty_code < qty_hi_code[b]          (code domain)
+          AND disc_lo_code[b] <= disc_code <= disc_hi_code[b]
+          AND ship_lo <= ship < ship_hi          (value domain, int32)
+
+    qty_codes/disc_codes: [B, cap] uint8/uint16 code plates;
+    ship: [B, cap] int32; price: [B, cap] float; valid: [B, cap] bool;
+    disc_dicts: [B, D] per-batch sorted dictionaries (decode target);
+    *_codes thresholds: [B] int32, translated on HOST through each
+    batch's sorted dictionary (one searchsorted per batch — the
+    "translate the literal once" contract; a miss yields a threshold
+    that matches nothing).  Returns (float64 sum, int64 count)."""
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    B, cap = price.shape
+    capr = cap // _LANES
+    pad_r = ((capr + _FBLOCK_ROWS - 1) // _FBLOCK_ROWS) * _FBLOCK_ROWS
+    pad_cap = pad_r * _LANES
+
+    def shape3(a, dtype):
+        a = jnp.asarray(a)
+        if pad_cap != cap:
+            a = jnp.pad(a, ((0, 0), (0, pad_cap - cap)))
+        return a.reshape(B, pad_r, _LANES).astype(dtype)
+
+    qty = shape3(qty_codes, jnp.asarray(qty_codes).dtype)
+    disc = shape3(disc_codes, jnp.asarray(disc_codes).dtype)
+    sh = shape3(ship, jnp.int32)
+    pz = shape3(price, jnp.float32)
+    vd = shape3(valid, jnp.bool_)
+
+    def col_b(a):
+        return jnp.asarray(a, dtype=jnp.int32).reshape(B, 1)
+
+    return _fused_q6_call(
+        qty, disc, sh, pz, vd,
+        jnp.asarray(disc_dicts, dtype=jnp.float32),
+        col_b(qty_hi_codes), col_b(disc_lo_codes), col_b(disc_hi_codes),
+        jnp.asarray([[int(ship_lo)]], dtype=jnp.int32),
+        jnp.asarray([[int(ship_hi)]], dtype=jnp.int32),
+        interpret=bool(interpret))

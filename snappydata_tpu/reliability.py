@@ -207,6 +207,13 @@ def is_retryable(exc: BaseException) -> bool:
             return False
         if isinstance(exc, (_flight.FlightUnavailableError,)):
             return True
+        if isinstance(exc, _flight.FlightCancelledError):
+            # a transport-level CANCELLED from the SERVER side (hard
+            # kill mid-stream: "Server never sent a data message") is a
+            # connection-shaped death, safe to re-issue — deadline
+            # cancellations never reach here raw, they convert to
+            # CancelException (XCL52, handled above) first
+            return True
     except ImportError:          # pragma: no cover - pyarrow is baked in
         pass
     if isinstance(exc, (ConnectionError, TimeoutError)):

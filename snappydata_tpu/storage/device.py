@@ -93,23 +93,90 @@ class DeviceTable:
     num_batches: int           # padded
     capacity: int
     valid: jnp.ndarray         # bool [B, C]
-    columns: Dict[int, jnp.ndarray]          # col_idx -> [B, C] device dtype
+    # col_idx -> [B, C] decoded plate, OR a compressed-domain plate
+    # (device_decode.CodePlate/RlePlate/BitPlate) when the column stays
+    # resident encoded — consumers branch structurally
+    columns: Dict[int, jnp.ndarray]
     dictionaries: Dict[int, np.ndarray]      # string col -> host values
     stats_min: Dict[int, np.ndarray]         # numeric col -> host [B]
     stats_max: Dict[int, np.ndarray]
     total_rows: int
     nulls: Dict[int, Optional[jnp.ndarray]] = dataclasses.field(
         default_factory=dict)                # col_idx -> bool [B, C] or None
+    # col_idx -> (sorted host dicts [B, Dp] f64, sizes [B]) for every
+    # column with VALUE_DICT batches — the dictionary-domain batch
+    # skipper probes equality literals here at bind time (sizes[i] == 0
+    # means batch i carries no dictionary: always keep)
+    dict_domains: Dict[int, tuple] = dataclasses.field(default_factory=dict)
 
     def column(self, idx: int) -> jnp.ndarray:
         return self.columns[idx]
 
 
+def _compressed_mode(ctx, is_str: bool, dec_exact: bool, use_dd: bool,
+                     cols_enc, any_delta: bool, has_row_chunks: bool,
+                     code_ok: bool, count: bool = False) -> Optional[str]:
+    """Per-column compressed-domain decision: 'dict' | 'rle' | 'bitset'
+    when the column can stay resident encoded, None for a decoded bind.
+    With count=True (the cache-miss build), every decode-first reroute
+    of a compressible column is counted by reason
+    (compressed_fallback_*); under scan_compressed_domain='on' even
+    never-compressible columns count, so a misconfigured table is
+    diagnosable from the dashboard."""
+    from snappydata_tpu import config
+    from snappydata_tpu.storage.device_decode import compressed_fallback
+    from snappydata_tpu.storage.encoding import Encoding
+
+    knob = str(config.global_properties().get(
+        "scan_compressed_domain", "auto") or "auto").lower()
+    comp = {Encoding.VALUE_DICT: "dict", Encoding.RUN_LENGTH: "rle",
+            Encoding.BOOLEAN_BITSET: "bitset"}
+    encs = {c.encoding for c in cols_enc}
+    compressible = bool(encs & set(comp))
+    forced = knob == "on"
+    if is_str or not cols_enc:
+        return None   # string codes ARE the compressed domain already
+    if knob == "off" or knob not in ("on", "auto"):
+        if count and compressible:
+            compressed_fallback("disabled")
+        return None
+
+    def reject(reason: str, always: bool = False) -> None:
+        if count and (compressible or (forced and always)):
+            compressed_fallback(reason)
+
+    if dec_exact:
+        reject("decimal_exact")
+        return None
+    if not use_dd:
+        reject("device_decode_off")
+        return None
+    if ctx is not None:
+        reject("mesh")
+        return None
+    if not code_ok:
+        reject("join_key")
+        return None
+    if any_delta:
+        reject("deltas")
+        return None
+    if has_row_chunks:
+        reject("row_buffer")
+        return None
+    if len(encs) == 1 and next(iter(encs)) in comp:
+        return comp[next(iter(encs))]
+    reject("mixed_encoding" if compressible else "not_encoded",
+           always=True)
+    return None
+
+
 def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
-                       col_indices: Sequence[int]) -> DeviceTable:
+                       col_indices: Sequence[int],
+                       code_ok: bool = True) -> DeviceTable:
     """Materialize `col_indices` of a snapshot on device, with caching keyed
     on manifest version (so repeated queries over an unchanged table upload
-    nothing)."""
+    nothing).  `code_ok=False` (device-join relations, whose cached build
+    artifacts index flat decoded layouts) forces decoded plates."""
     from snappydata_tpu.parallel.mesh import MeshContext
 
     ctx = MeshContext.current()
@@ -182,6 +249,7 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
     stats_min: Dict[int, np.ndarray] = {}
     stats_max: Dict[int, np.ndarray] = {}
     nulls: Dict[int, Optional[jnp.ndarray]] = {}
+    dict_domains: Dict[int, tuple] = {}
     for ci in col_indices:
         f = schema.fields[ci]
         if isinstance(f.dtype, T.StructType) \
@@ -226,18 +294,73 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
         is_str = f.dtype.name == "string"
         if is_str:
             dicts[ci] = data.dictionary(ci)
-        key = ("col", ci)
-        if key not in cache:
-            from snappydata_tpu import config
-            from snappydata_tpu.storage.encoding import (Encoding,
-                                                         decode_validity)
+        from snappydata_tpu import config
+        from snappydata_tpu.storage.encoding import (Encoding,
+                                                     decode_validity)
 
-            dt = f.dtype.device_dtype()
-            # exact decimals: HOST plates are float64 (the SQL value
-            # domain — WAL, deltas, stats, hosteval all ride it); the
-            # DEVICE plate is the scaled int64 unscaled value, converted
-            # here at bind (types.DecimalType docstring)
-            dec_exact = f.dtype.name == "decimal" and dt.kind == "i"
+        dt = f.dtype.device_dtype()
+        # exact decimals: HOST plates are float64 (the SQL value
+        # domain — WAL, deltas, stats, hosteval all ride it); the
+        # DEVICE plate is the scaled int64 unscaled value, converted
+        # here at bind (types.DecimalType docstring)
+        dec_exact = f.dtype.name == "decimal" and dt.kind == "i"
+        use_dd_col = (ctx is None and not is_str and not dec_exact
+                      and config.global_properties().device_decode)
+        cols_enc = [v.batch.columns[ci] for v in views]
+        # only deltas that target THIS column disqualify its encoded
+        # form (update deltas replace values; deletes ride live_mask)
+        any_delta = any(any(d[0] == ci for d in v.deltas) for v in views)
+        cd_mode = _compressed_mode(ctx, is_str, dec_exact, use_dd_col,
+                                   cols_enc, any_delta, bool(row_chunks),
+                                   code_ok)
+        key = ("ccol", ci) if cd_mode else ("col", ci)
+        if key not in cache:
+            # itemized fallback counting happens exactly once per build
+            # (cache miss), decoded OR compressed — so every decode-first
+            # reroute of a compressible column shows up
+            _compressed_mode(ctx, is_str, dec_exact, use_dd_col,
+                             cols_enc, any_delta, bool(row_chunks),
+                             code_ok, count=True)
+        if cd_mode and key not in cache:
+            # compressed-domain bind: the column stays RESIDENT encoded;
+            # predicates run on codes/runs, values decode lazily
+            # in-trace (engine/exprs.py) — no decoded plate in HBM
+            from snappydata_tpu.storage import device_decode as _dd
+            from snappydata_tpu.storage import bitmask
+
+            null_mask = np.zeros((b, cap), dtype=np.bool_)
+            any_null = False
+            smin = np.full(b, np.nan)
+            smax = np.full(b, np.nan)
+            for i, (v, col) in enumerate(zip(views, cols_enc)):
+                nm = v.null_mask(ci)
+                if nm is not None:
+                    null_mask[i] = nm
+                    any_null = True
+                st = col.stats
+                if st is not None and st.min is not None:
+                    smin[i], smax[i] = float(st.min), float(st.max)
+                elif cd_mode == "dict" and len(col.dictionary):
+                    smin[i] = float(np.min(col.dictionary))
+                    smax[i] = float(np.max(col.dictionary))
+                elif cd_mode == "rle" and len(col.data):
+                    smin[i] = float(np.min(col.data))
+                    smax[i] = float(np.max(col.data))
+                elif cd_mode == "bitset" and col.num_rows:
+                    bits = bitmask.unpack(col.data, col.num_rows)
+                    smin[i] = float(bits.min())
+                    smax[i] = float(bits.max())
+            if cd_mode == "dict":
+                plate, host_dicts, dict_sizes = _dd.code_plates(
+                    cols_enc, b, cap, dt)
+                cache[("dictdom", ci)] = (host_dicts, dict_sizes)
+            elif cd_mode == "rle":
+                plate = _dd.rle_plates(cols_enc, b, cap, dt)
+            else:
+                plate = _dd.bit_plates(cols_enc, b, cap)
+            cache[key] = (plate, smin, smax,
+                          _place(null_mask) if any_null else None)
+        if key not in cache:
             stacked = np.zeros((b, cap), dtype=dt)
             null_mask = np.zeros((b, cap), dtype=np.bool_)
             any_null = False
@@ -249,8 +372,7 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
             # host decode — the shard placement happens on host arrays.
             # Encoded decimal forms are host-domain floats, so the exact
             # path keeps host decode + scaled conversion.
-            use_dd = (ctx is None and not is_str and not dec_exact
-                      and config.global_properties().device_decode)
+            use_dd = use_dd_col
             dd_rle: list = []      # (batch row, EncodedColumn)
             dd_bits: list = []
             dd_vd: list = []       # VALUE_DICT: uint8 codes + value dict
@@ -377,14 +499,47 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                 placed = _place(stacked)
             cache[key] = (placed, smin, smax,
                           _place(null_mask) if any_null else None)
+            if not is_str:
+                dom = _dict_domain(views, cols_enc, ci, b)
+                if dom is not None:
+                    cache[("dictdom", ci)] = dom
         columns[ci], stats_min[ci], stats_max[ci], nulls[ci] = cache[key]
+        dom = cache.get(("dictdom", ci))
+        if dom is not None:
+            dict_domains[ci] = dom
 
     if _cache_budget.enabled():
         _cache_budget.touch(data._device_cache, cache_key,
                             _entry_bytes(cache))
     return DeviceTable(schema, b, cap, cache["valid"], columns, dicts,
                        stats_min, stats_max,
-                       cache.get("nrows", manifest.total_rows()), nulls)
+                       cache.get("nrows", manifest.total_rows()), nulls,
+                       dict_domains)
+
+
+def _dict_domain(views, cols_enc, ci: int, b: int):
+    """(sorted host dicts [b, Dp] f64, sizes [b]) of a column's
+    VALUE_DICT batches — the dictionary-domain batch skipper's probe
+    surface.  Batches without a usable dictionary (other encodings, or
+    update deltas touching this column) report size 0 = always keep."""
+    from snappydata_tpu.storage.encoding import Encoding
+
+    vd = [(i, c) for i, (v, c) in enumerate(zip(views, cols_enc))
+          if c.encoding == Encoding.VALUE_DICT
+          and not any(d[0] == ci for d in v.deltas)
+          and c.dictionary is not None and len(c.dictionary)]
+    if not vd:
+        return None
+    d_pad = max(len(c.dictionary) for _, c in vd)
+    host = np.zeros((b, d_pad), dtype=np.float64)
+    sizes = np.zeros(b, dtype=np.int64)
+    for i, c in vd:
+        d = np.asarray(c.dictionary, dtype=np.float64)
+        host[i, :d.shape[0]] = d
+        if d.shape[0] < d_pad:
+            host[i, d.shape[0]:] = d[-1]
+        sizes[i] = d.shape[0]
+    return host, sizes
 
 
 def map_device_eligible(dt) -> bool:

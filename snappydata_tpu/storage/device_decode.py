@@ -12,12 +12,18 @@ equivalents are vectorized XLA programs applied at cold bind:
   from cap×itemsize to 2×R×itemsize (R = #runs).
 * BOOLEAN_BITSET: upload the packed bits (uint8 [cap/8]) and unpack with
   shift/mask ops — an 8× transfer reduction.
-* VALUE_DICT: low-cardinality numeric columns upload uint8 codes [cap]
-  plus the tiny value dictionary [D] and gather on device — an
+* VALUE_DICT: low-cardinality numeric columns upload uint8/uint16 codes
+  [cap] plus the tiny value dictionary [D] and gather on device — an
   itemsize× (≥4×) transfer reduction. This is the encoding the default
   TPC-H scan engages (l_quantity/l_discount/l_tax are 50/11/9 distinct
   f64 values), so the bench's device_decode counters are nonzero on the
   stock workload.
+
+Compressed-domain execution (r06) goes one step further: under
+`scan_compressed_domain` the plates THEMSELVES stay encoded in HBM
+(CodePlate/RlePlate/BitPlate below), predicates run on codes/runs, and
+values decode lazily in-trace only where consumed — see the builders
+and in-trace consumers at the bottom of this module.
 
 Dictionary string columns need no device decode: their int32 codes ARE
 the on-device representation (group-by/join run on codes). Batches with
@@ -33,16 +39,52 @@ decode).
 from __future__ import annotations
 
 import functools
-from typing import Dict
+from typing import Dict, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 # bind-transfer accounting (powers the bench/device-decode metric and the
-# tests' "compressed bytes actually crossed the link" assertion)
+# tests' "compressed bytes actually crossed the link" assertion).
+# batches_code_bound counts batches whose column stayed RESIDENT in the
+# compressed domain (no decoded plate in HBM at all — the r06
+# compressed-domain execution path), a subset of batches_device_decoded.
 _counters: Dict[str, int] = {"bytes_encoded": 0, "bytes_decoded_equiv": 0,
-                             "batches_device_decoded": 0}
+                             "batches_device_decoded": 0,
+                             "batches_code_bound": 0}
+
+
+# --- compressed-domain column plates --------------------------------------
+# A code-domain bind stores one of these in DeviceTable.columns[ci]
+# instead of a decoded [B, cap] plate.  They are NamedTuples, so they ride
+# the jit boundary as pytrees, survive the bind-time batch-skip gather
+# (field-wise jnp.take along axis 0), and make_ctx recognizes them
+# structurally at trace time — no side-channel metadata needed.
+
+class CodePlate(NamedTuple):
+    """VALUE_DICT column resident in the code domain.
+    codes: [B, cap] uint8/uint16 device array;
+    dicts: [B, D] device array, each row SORTED ascending and padded by
+    repeating its last value (keeps searchsorted semantics exact)."""
+
+    codes: object
+    dicts: object
+
+
+class RlePlate(NamedTuple):
+    """RUN_LENGTH column resident as runs.
+    values: [B, R] run values; ends: [B, R] int32 cumulative run end
+    offsets (padded runs repeat the last end)."""
+
+    values: object
+    ends: object
+
+
+class BitPlate(NamedTuple):
+    """BOOLEAN_BITSET column resident as packed bits [B, ceil(cap/8)]."""
+
+    packed: object
 
 
 def counters() -> Dict[str, int]:
@@ -109,13 +151,20 @@ def _valdict_expand(codes: jnp.ndarray, dicts: jnp.ndarray):
     return jnp.take_along_axis(dicts, codes.astype(jnp.int32), axis=1)
 
 
+def _valdict_code_dtype(vd_cols) -> np.dtype:
+    """Narrowest common code dtype across the stacked batches (uint16
+    VALUE_DICT widening: per-batch code dtypes can mix u8/u16)."""
+    return np.dtype(np.uint16) if any(
+        c.data.dtype.itemsize > 1 for c in vd_cols) else np.dtype(np.uint8)
+
+
 def valdict_views_to_plate(vd_cols, cap: int, dt) -> jnp.ndarray:
     """Stack N value-dict columns into decoded plates [N, cap]: the
-    uint8 codes and the (padded) dictionaries cross the link, the
+    uint8/uint16 codes and the (padded) dictionaries cross the link, the
     values-gather runs in-trace."""
     d_max = max(1, max(len(c.dictionary) for c in vd_cols))
     n = len(vd_cols)
-    codes = np.zeros((n, cap), dtype=np.uint8)
+    codes = np.zeros((n, cap), dtype=_valdict_code_dtype(vd_cols))
     dicts = np.zeros((n, d_max), dtype=dt)
     for i, c in enumerate(vd_cols):
         codes[i, :c.data.shape[0]] = c.data
@@ -139,3 +188,190 @@ def bitset_views_to_plate(bit_cols, cap: int) -> jnp.ndarray:
         _counters["bytes_decoded_equiv"] += int(cap)
         _counters["batches_device_decoded"] += 1
     return _bitset_expand(jnp.asarray(packed), cap)
+
+
+# ==========================================================================
+# Compressed-domain binds: the column STAYS encoded in HBM (CodePlate /
+# RlePlate / BitPlate in DeviceTable.columns) and every consumer either
+# works on the encoded form directly (code-threshold predicates, per-run
+# predicates) or decodes lazily IN-TRACE, where XLA fuses the expansion
+# into the consuming kernel — a decoded capacity-row plate never exists.
+# ==========================================================================
+
+def _next_pow2(n: int) -> int:
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+def compressed_fallback(reason: str, n: int = 1) -> None:
+    """Count a decode-first reroute (a column that did NOT bind in the
+    compressed domain), itemized by reason so every reroute is visible
+    on the scan dashboard: compressed_fallback_<reason> + total."""
+    from snappydata_tpu.observability.metrics import global_registry
+
+    reg = global_registry()
+    reg.inc("compressed_fallbacks", n)
+    reg.inc("compressed_fallback_" + reason, n)
+
+
+def code_plates(vd_cols, b: int, cap: int, dt):
+    """VALUE_DICT views → a resident CodePlate plus the HOST-side sorted
+    dictionary stack the bind-time sarg skipper reads.
+
+    Returns (CodePlate, host_dicts [b, Dp] float64, sizes [b] int64).
+    Dictionary rows pad by REPEATING the last value so each row stays
+    sorted — the property the in-trace searchsorted threshold
+    translation and the host membership probe both rely on."""
+    d_pad = _next_pow2(max(1, max(len(c.dictionary) for c in vd_cols)))
+    codes = np.zeros((b, cap), dtype=_valdict_code_dtype(vd_cols))
+    dicts = np.zeros((b, d_pad), dtype=dt)
+    host = np.zeros((b, d_pad), dtype=np.float64)
+    sizes = np.zeros(b, dtype=np.int64)
+    for i, c in enumerate(vd_cols):
+        codes[i, :c.data.shape[0]] = c.data
+        d = np.asarray(c.dictionary, dtype=dt)
+        dicts[i, :d.shape[0]] = d
+        if d.shape[0] and d.shape[0] < d_pad:
+            dicts[i, d.shape[0]:] = d[-1]
+        host[i, :d.shape[0]] = np.asarray(c.dictionary, dtype=np.float64)
+        if d.shape[0] and d.shape[0] < d_pad:
+            host[i, d.shape[0]:] = host[i, d.shape[0] - 1]
+        sizes[i] = d.shape[0]
+        _counters["bytes_encoded"] += int(c.data.nbytes + d.nbytes)
+        _counters["bytes_decoded_equiv"] += int(cap * d.dtype.itemsize)
+        _counters["batches_device_decoded"] += 1
+        _counters["batches_code_bound"] += 1
+    return (CodePlate(jnp.asarray(codes), jnp.asarray(dicts)),
+            host, sizes)
+
+
+def rle_plates(rle_cols, b: int, cap: int, dt) -> RlePlate:
+    """RUN_LENGTH views → a resident RlePlate (run values + cumulative
+    end offsets, O(runs) bytes in HBM instead of O(cap))."""
+    r_pad = _next_pow2(max(1, max(len(c.data) for c in rle_cols)))
+    vals = np.zeros((b, r_pad), dtype=dt)
+    ends = np.zeros((b, r_pad), dtype=np.int64)
+    for i, c in enumerate(rle_cols):
+        r = len(c.data)
+        vals[i, :r] = c.data
+        e = np.cumsum(c.runs, dtype=np.int64)
+        ends[i, :r] = e
+        if r and r < r_pad:
+            vals[i, r:] = vals[i, r - 1]
+            ends[i, r:] = e[-1]
+        _counters["bytes_encoded"] += int(
+            c.data.nbytes + np.asarray(c.runs).nbytes)
+        _counters["bytes_decoded_equiv"] += int(cap * vals.dtype.itemsize)
+        _counters["batches_device_decoded"] += 1
+        _counters["batches_code_bound"] += 1
+    return RlePlate(jnp.asarray(vals), jnp.asarray(ends))
+
+
+def bit_plates(bit_cols, b: int, cap: int) -> BitPlate:
+    """BOOLEAN_BITSET views → a resident BitPlate (8x fewer HBM bytes)."""
+    nbytes = (cap + 7) // 8
+    packed = np.zeros((b, nbytes), dtype=np.uint8)
+    for i, c in enumerate(bit_cols):
+        raw = np.asarray(c.data, dtype=np.uint8)
+        packed[i, :raw.shape[0]] = raw
+        _counters["bytes_encoded"] += int(raw.nbytes)
+        _counters["bytes_decoded_equiv"] += int(cap)
+        _counters["batches_device_decoded"] += 1
+        _counters["batches_code_bound"] += 1
+    return BitPlate(jnp.asarray(packed))
+
+
+# --- in-trace consumers ---------------------------------------------------
+
+def code_values(plate: CodePlate) -> jnp.ndarray:
+    """Lazy decode of a CodePlate: a per-batch dictionary gather that XLA
+    fuses into whatever consumes the values (the fused
+    decode+filter+aggregate form of the default scan)."""
+    return jnp.take_along_axis(plate.dicts,
+                               plate.codes.astype(jnp.int32), axis=1)
+
+
+def rle_values(plate: RlePlate, cap: int) -> jnp.ndarray:
+    """Lazy in-trace expansion of an RlePlate to [B, cap] values."""
+    return _rle_expand(plate.values, plate.ends, cap)
+
+
+def bit_values(plate: BitPlate, cap: int) -> jnp.ndarray:
+    """Lazy in-trace unpack of a BitPlate to [B, cap] bools."""
+    return _bitset_expand(plate.packed, cap)
+
+
+def code_cmp_mask(op: str, plate: CodePlate, lit) -> jnp.ndarray:
+    """Code-domain lowering of `column OP literal` over a CodePlate:
+    the literal translates to per-batch code thresholds through the
+    SORTED dictionaries (one searchsorted per batch, O(B log D)) and the
+    comparison runs on the small integer codes — the decoded plate never
+    materializes and per-row work touches 1-2 bytes, not 8.
+
+    Exactness: the dictionary and the literal are both promoted to
+    their common compare dtype first, so boundary behavior is
+    bit-identical to comparing the decoded values (f32 dictionaries vs
+    f64 literals compare in f64, exactly like the decoded plate would).
+    Out-of-dictionary equality literals yield a constant-false mask
+    (code -1 matches nothing); NaN literals follow IEEE semantics
+    (every comparison false except !=)."""
+    codes = plate.codes.astype(jnp.int32)
+    cd = jnp.result_type(plate.dicts.dtype, jnp.asarray(lit).dtype)
+    d = plate.dicts.astype(cd)
+    v = jnp.asarray(lit).astype(cd)
+    if op in ("=", "!="):
+        pos = jax.vmap(
+            lambda row: jnp.searchsorted(row, v, side="left"))(d)
+        posc = jnp.clip(pos, 0, d.shape[1] - 1).astype(jnp.int32)
+        hit = jnp.take_along_axis(d, posc[:, None], axis=1)[:, 0] == v
+        code_eq = jnp.where(hit, posc, -1)
+        return codes == code_eq[:, None] if op == "=" \
+            else codes != code_eq[:, None]
+    # values >= lit  <=>  code >= searchsorted(dict, lit, left); the
+    # right-side variants shift the threshold past equal values
+    side = "left" if op in (">=", "<") else "right"
+    pos = jax.vmap(
+        lambda row: jnp.searchsorted(row, v, side=side))(d)
+    pos = pos.astype(jnp.int32)
+    m = codes >= pos[:, None] if op in (">=", ">") \
+        else codes < pos[:, None]
+    if op in ("<", "<=") and jnp.issubdtype(cd, jnp.floating):
+        # x < NaN is False, but NaN sorts past every dictionary entry
+        # (threshold = D → all codes pass) — guard explicitly
+        m = m & ~jnp.isnan(v)
+    return m
+
+
+def rle_cmp_mask(fn, plate: RlePlate, lit, cap: int) -> jnp.ndarray:
+    """Run-arithmetic filter over an RlePlate: evaluate the predicate
+    per RUN (O(runs) compares) and expand the boolean run mask — the
+    full-width value plate is never produced."""
+    run_mask = fn(plate.values, lit)
+    return _rle_expand(run_mask, plate.ends, cap)
+
+
+def rle_run_lengths(ends: jnp.ndarray) -> jnp.ndarray:
+    """Per-run lengths from cumulative end offsets (padded runs repeat
+    the last end, so their length is exactly 0)."""
+    prev = jnp.concatenate(
+        [jnp.zeros_like(ends[:, :1]), ends[:, :-1]], axis=1)
+    return ends - prev
+
+
+def rle_masked_sum_count(plate: RlePlate, run_mask: jnp.ndarray):
+    """O(runs) filter+aggregate arithmetic: with a per-run boolean mask,
+    count = Σ len·mask and sum = Σ value·len·mask — multiply values by
+    run lengths instead of touching O(rows) lanes.  Valid only when the
+    surviving row set is run-aligned (no row-level deletes inside runs —
+    the code-domain bind already excludes delta-bearing batches).
+
+    Status: a TESTED building block (equivalence-asserted against the
+    expanded path in tests/test_compressed_domain.py), not yet on the
+    default aggregate path — the packed-family reduction consumes row
+    plates with row-level validity, so wiring this in needs a
+    run-alignment proof over the whole filter; the engine's WIRED run
+    arithmetic today is the per-run predicate lane (rle_cmp_mask)."""
+    lens = rle_run_lengths(plate.ends)
+    lm = jnp.where(run_mask, lens, 0)
+    count = jnp.sum(lm)
+    total = jnp.sum(plate.values.astype(jnp.float64) * lm)
+    return total, count

@@ -36,10 +36,14 @@ class Encoding(enum.IntEnum):
     RUN_LENGTH = 2
     BOOLEAN_BITSET = 3
     OBJECT = 4  # raw python objects (ARRAY columns; host-evaluated)
-    # low-cardinality NUMERIC columns: uint8 codes into a sorted value
-    # dictionary (ref IntDictionary/BigDictionary typeIds) — device binds
-    # ship the 1-byte codes + tiny dictionary and gather in-trace
-    # (device_decode.valdict_views_to_plate), an itemsize× link shrink
+    # low-cardinality NUMERIC columns: uint8 (≤256 distinct) or uint16
+    # (≤64K distinct, 8-byte values only — codes stay 4× smaller) codes
+    # into a SORTED value dictionary (ref IntDictionary/BigDictionary
+    # typeIds) — device binds ship the codes + tiny dictionary and
+    # either gather in-trace (device_decode.valdict_views_to_plate) or
+    # stay resident as a code plate under compressed-domain execution
+    # (device_decode.CodePlate), where predicates compare codes against
+    # literals translated through the sorted dictionary
     VALUE_DICT = 5
 
 
@@ -234,24 +238,38 @@ def encode_column(values: np.ndarray, dtype: T.DataType,
                          validity=packed_validity, stats=stats)
 
 
-# value-dict acceptance: ≥4x shrink (uint8 codes vs ≥4-byte values) with
-# at most this many distinct values. A SAMPLE probe rejects
-# high-cardinality columns in O(sample) so the ingest hot lane never pays
-# a full-column unique for columns that won't encode.
-_VALUE_DICT_MAX = 256
+# value-dict acceptance: codes must stay ≥4x smaller than the values
+# they replace — uint8 codes for any ≥4-byte value (≤256 distinct), and
+# uint16 codes (≤64K distinct) only for 8-byte values (f64/i64: 2-byte
+# codes keep the 4x shrink).  A SAMPLE probe rejects high-cardinality
+# columns in O(sample) so the ingest hot lane never pays a full-column
+# unique for columns that won't encode.
+_VALUE_DICT_MAX_U8 = 256
+_VALUE_DICT_MAX = 1 << 16
 _VALUE_DICT_SAMPLE = 4096
+
+
+def _value_dict_cap(itemsize: int) -> int:
+    """Distinct-value ceiling keeping the ≥4x code shrink."""
+    return _VALUE_DICT_MAX if itemsize >= 8 else _VALUE_DICT_MAX_U8
+
+
+def _value_dict_code_dtype(num_distinct: int) -> np.dtype:
+    return np.dtype(np.uint8 if num_distinct <= _VALUE_DICT_MAX_U8
+                    else np.uint16)
 
 
 def _try_value_dict(dev: np.ndarray, dtype: T.DataType, n: int,
                     packed_validity, stats) -> Optional["EncodedColumn"]:
     if dev.dtype.itemsize < 4 or dev.dtype.kind not in "iuf":
         return None   # sub-4-byte values wouldn't shrink 4x
+    cap = _value_dict_cap(dev.dtype.itemsize)
     sample = dev[::max(1, n // _VALUE_DICT_SAMPLE)]
     cand = np.unique(sample)
     # the dictionary must be SMALL relative to the rows (n ≥ 8·D) or the
     # dict bytes eat the shrink; the sample's distinct count is a lower
     # bound on D, so this also rejects early
-    if cand.size > _VALUE_DICT_MAX or n < 8 * cand.size:
+    if cand.size > cap or n < 8 * cand.size:
         return None
     if dev.dtype.kind == "f" and np.isnan(cand).any():
         return None   # NaN breaks searchsorted code assignment
@@ -265,13 +283,14 @@ def _try_value_dict(dev: np.ndarray, dtype: T.DataType, n: int,
         if not missed.any():
             return EncodedColumn(
                 Encoding.VALUE_DICT, dtype, n,
-                codes_c.astype(np.uint8), dictionary=cand,
+                codes_c.astype(_value_dict_code_dtype(cand.size)),
+                dictionary=cand,
                 validity=packed_validity, stats=stats)
         extra = np.unique(dev[missed])
         if dev.dtype.kind == "f" and np.isnan(extra).any():
             return None
         cand = np.union1d(cand, extra)
-        if cand.size > _VALUE_DICT_MAX or n < 8 * cand.size:
+        if cand.size > cap or n < 8 * cand.size:
             return None
     return None   # pragma: no cover - two passes always converge
 

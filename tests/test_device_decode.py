@@ -147,9 +147,20 @@ def test_value_dict_sample_miss_repair_and_nan_guard():
     # NaN is not code-assignable: stays PLAIN
     vn = np.where(rng.random(10_000) < 0.5, np.nan, 1.0)
     assert encode_column(vn, T.DOUBLE).encoding == Encoding.PLAIN
-    # >256 distinct: stays PLAIN
+    # >256 distinct 8-byte values: WIDENS to uint16 codes (still a 4x
+    # shrink) instead of falling back to PLAIN
     vh = rng.integers(0, 5000, 100_000).astype(np.float64)
-    assert encode_column(vh, T.DOUBLE).encoding == Encoding.PLAIN
+    ch = encode_column(vh, T.DOUBLE)
+    assert ch.encoding == Encoding.VALUE_DICT
+    assert ch.data.dtype == np.uint16
+    assert (decode_to_numpy(ch) == vh).all()
+    # ...but 4-byte values keep the uint8-only cap (uint16 codes would
+    # only halve them, below the 4x bar)
+    v4 = rng.integers(0, 5000, 100_000).astype(np.int32)
+    assert encode_column(v4, T.INT).encoding == Encoding.PLAIN
+    # dictionary too large relative to the rows (n < 8*D): stays PLAIN
+    vsmall = rng.integers(0, 5000, 20_000).astype(np.float64)
+    assert encode_column(vsmall, T.DOUBLE).encoding == Encoding.PLAIN
 
 
 def test_value_dict_persists_and_recovers(tmp_path):
