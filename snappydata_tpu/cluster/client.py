@@ -17,6 +17,7 @@ import pyarrow.flight as flight
 from snappydata_tpu import config, reliability
 from snappydata_tpu.cluster.retry import CircuitBreaker, ExponentialBackoff
 from snappydata_tpu.fault import failpoints
+from snappydata_tpu.observability import tracing
 from snappydata_tpu.resource.context import CancelException
 
 
@@ -293,9 +294,16 @@ class SnappyClient:
                 # the QueryContext deadline — cooperative server-side
                 # enforcement next to the hard client-side cutoff
                 payload.setdefault("timeout_s", eff)
+            tid = tracing.wire_id()
+            if tid is not None:
+                # trace propagation: the server opens its own trace
+                # under the SAME id, so client and server rings join
+                payload.setdefault("trace_id", tid)
             raw = json.dumps(payload).encode("utf-8")
-            results = list(conn.do_action(flight.Action(name, raw),
-                                          self._call_opts(eff)))
+            with tracing.span("flight_action", action=name,
+                              addr=self._conn_addr):
+                results = list(conn.do_action(flight.Action(name, raw),
+                                              self._call_opts(eff)))
             return json.loads(results[0].body.to_pybytes().decode("utf-8"))
 
         return self._request(once, retry, retry_metric=retry_metric,
@@ -317,11 +325,20 @@ class SnappyClient:
                 body["prepared"] = True
             if eff is not None:
                 body["timeout_s"] = eff
+            tid = tracing.wire_id()
+            if tid is not None:
+                body["trace_id"] = tid
             ticket = flight.Ticket(json.dumps(
                 self._with_token(body)).encode("utf-8"))
-            return conn.do_get(ticket, self._call_opts(eff)).read_all()
+            with tracing.span("flight_sql", addr=self._conn_addr):
+                return conn.do_get(ticket, self._call_opts(eff)).read_all()
 
-        return self._request(once, retry=True)
+        # the client IS a front door: with no ambient trace (a direct
+        # SnappyClient user) this mints the request's trace id; under
+        # the lead's scatter it joins the ambient trace instead
+        with tracing.request_scope(sql, user=self._user or "",
+                                   kind="client"):
+            return self._request(once, retry=True)
 
     # leading keywords whose statements MUTATE state: they are stamped
     # with a statement id so the server's dedup window makes a lost-ack
@@ -345,11 +362,13 @@ class SnappyClient:
         body = {"sql": sql, "params": list(params)}
         if stmt_id is not None:
             body["stmt_id"] = stmt_id
-        return self._action(
-            "sql", body, retry=True, timeout_s=timeout_s,
-            retry_metric="mutation_retries" if mutating
-            else "failover_retries",
-            pin_retry=mutating)
+        with tracing.request_scope(sql, user=self._user or "",
+                                   kind="client"):
+            return self._action(
+                "sql", body, retry=True, timeout_s=timeout_s,
+                retry_metric="mutation_retries" if mutating
+                else "failover_retries",
+                pin_retry=mutating)
 
     def insert(self, table: str, columns: dict,
                stmt_id: Optional[str] = None,
@@ -367,17 +386,25 @@ class SnappyClient:
             conn = self._client()   # may log in and mint self._token
             eff = self._effective_timeout(timeout_s)
             cmd = {"table": table, "stmt_id": stmt_id}
+            tid = tracing.wire_id()
+            if tid is not None:
+                cmd["trace_id"] = tid
             if self._token is not None:
                 cmd["token"] = self._token
             descriptor = flight.FlightDescriptor.for_command(
                 json.dumps(cmd).encode("utf-8"))
-            writer, _ = conn.do_put(descriptor, arrow.schema,
-                                    self._call_opts(eff))
-            writer.write_table(arrow)
-            writer.close()
+            with tracing.span("flight_put", table=table,
+                              addr=self._conn_addr):
+                writer, _ = conn.do_put(descriptor, arrow.schema,
+                                        self._call_opts(eff))
+                writer.write_table(arrow)
+                writer.close()
 
-        self._request(once, retry=True, retry_metric="mutation_retries",
-                      pin_retry=True)
+        with tracing.request_scope(f"<insert {table}>",
+                                   user=self._user or "", kind="client"):
+            self._request(once, retry=True,
+                          retry_metric="mutation_retries",
+                          pin_retry=True)
 
     def repartition(self, body: dict) -> dict:
         """Ask this server to hash-repartition its shard of body['table']
@@ -400,11 +427,17 @@ class SnappyClient:
                                      "params": list(params)})
             if eff is not None:
                 body["timeout_s"] = eff
-            return conn.do_get(flight.Ticket(
-                json.dumps(body).encode("utf-8")),
-                self._call_opts(eff)).read_all()
+            tid = tracing.wire_id()
+            if tid is not None:
+                body["trace_id"] = tid
+            with tracing.span("flight_plan", addr=self._conn_addr):
+                return conn.do_get(flight.Ticket(
+                    json.dumps(body).encode("utf-8")),
+                    self._call_opts(eff)).read_all()
 
-        return self._request(once, retry=True)
+        with tracing.request_scope("<shipped plan>",
+                                   user=self._user or "", kind="client"):
+            return self._request(once, retry=True)
 
     def move_buckets(self, body: dict) -> dict:
         """Rebalance: this server copies its primary rows of

@@ -40,6 +40,7 @@ from snappydata_tpu import reliability
 from snappydata_tpu import types as T
 from snappydata_tpu.catalog import Catalog
 from snappydata_tpu.cluster.retry import CircuitBreaker, ExponentialBackoff
+from snappydata_tpu.observability import tracing as _tracing
 from snappydata_tpu.parallel.hashing import bucket_of_np
 from snappydata_tpu.resource.context import CancelException
 from snappydata_tpu.sql import ast
@@ -53,10 +54,17 @@ class DistributedError(Exception):
     """Cluster-plane failure. `failed_addresses` names every member whose
     death contributed (in failure order, duplicates possible across
     retries) and `attempts` counts fan-out attempts made — so an operator
-    can tell one flaky member from a cluster-wide outage."""
+    can tell one flaky member from a cluster-wide outage.  `trace_id`
+    (when the request was traced) joins this client-visible failure
+    against the server-side trace ring (/status/api/v1/traces)."""
 
     def __init__(self, message: str = "",
                  failed_addresses: Sequence[str] = (), attempts: int = 0):
+        from snappydata_tpu.observability import tracing
+
+        self.trace_id = tracing.current_trace_id()
+        if self.trace_id:
+            message = f"{message} [trace {self.trace_id}]"
         super().__init__(message)
         self.failed_addresses = tuple(failed_addresses)
         self.attempts = attempts
@@ -913,7 +921,13 @@ class DistributedSession:
             failed = None
             for si, srv in self._alive():
                 try:
-                    out.append(self._call_with_hedge(si, srv, fn, hedge))
+                    # one span per fan-out leg: a distributed query's
+                    # trace shows where each member's time went
+                    with _tracing.span("member",
+                                       addr=self.server_addresses[si],
+                                       attempt=attempt):
+                        out.append(self._call_with_hedge(si, srv, fn,
+                                                         hedge))
                 except CancelException:
                     # deadline expiry is the CALLER's state, not the
                     # member's — no probe, no failover, straight out
@@ -926,6 +940,12 @@ class DistributedSession:
             if failed is None:
                 return out
             failed_addrs.append(self.server_addresses[failed])
+            # accumulate — a retry loop losing TWO members must show
+            # both in the trace, like DistributedError.failed_addresses
+            sp = _tracing.current_span()
+            if sp is not None:
+                sp.attrs.setdefault("failover_members", []).append(
+                    self.server_addresses[failed])
             self.mark_server_failed(failed)
             if sum(self.alive) == 0:
                 raise DistributedError(
@@ -964,11 +984,16 @@ class DistributedSession:
         if hedge is None or not props.hedge_reads:
             return fn(srv)
         deadline = reliability.current_deadline()
+        # workers re-enter the caller's trace like they re-enter its
+        # deadline (contextvars do not cross threads) — the hedge leg's
+        # spans land under the SAME member span as the primary's
+        trace, at_span = _tracing.current(), _tracing.current_span()
         q: "_queue.Queue" = _queue.Queue()
 
         def run(tag, thunk):
             try:
-                with reliability.deadline_scope(deadline):
+                with reliability.deadline_scope(deadline), \
+                        _tracing.attach(trace, at_span):
                     q.put((tag, True, thunk()))
             except BaseException as e:   # noqa: BLE001 — ferried to caller
                 q.put((tag, False, e))
@@ -1012,6 +1037,7 @@ class DistributedSession:
         if launched:
             _ri, thunk = h
             global_registry().inc("hedged_reads_fired")
+            _tracing.annotate("hedged", True)
 
             def run_hedge():
                 try:
@@ -1036,6 +1062,7 @@ class DistributedSession:
             if ok:
                 if tag == "hedge":
                     global_registry().inc("hedged_reads_won")
+                    _tracing.annotate("hedge_won", True)
                 return val
             errors[tag] = val
             if len(errors) >= expected:
@@ -1132,11 +1159,17 @@ class DistributedSession:
             props = _config.global_properties()
             budget = float(self.planner.conf.query_timeout_s or 0.0) or \
                 float(props.client_timeout_s or 0.0)
-        if budget and budget > 0 and reliability.current_deadline() is None:
-            with reliability.deadline_scope(
-                    _time.monotonic() + float(budget)):
-                return self._sql_inner(sql_text)
-        return self._sql_inner(sql_text)
+        # the lead is a front door: mint the request's trace id here so
+        # every fan-out leg, retry and hedge below stitches under it —
+        # the per-member SnappyClients ship it in their tickets/bodies
+        with _tracing.request_scope(sql_text, user=self.planner.user,
+                                    kind="lead"):
+            if budget and budget > 0 and \
+                    reliability.current_deadline() is None:
+                with reliability.deadline_scope(
+                        _time.monotonic() + float(budget)):
+                    return self._sql_inner(sql_text)
+            return self._sql_inner(sql_text)
 
     def _bump_buckets(self, buckets) -> None:
         for b in buckets:

@@ -389,6 +389,14 @@ class SnappyFlightServer(flight.FlightServerBase):
     def actual_port(self) -> int:
         return self.port
 
+    def _origin(self) -> str:
+        """This member's REAL bound address for trace origins — the
+        init-time `_location` may say port 0 (bind-assigned)."""
+        try:
+            return f"grpc://{self.host}:{self.port}"
+        except Exception:
+            return self._location
+
     def wait_ready(self, timeout: float = 10.0) -> None:
         """Block until the gRPC loop actually accepts connections. The port
         is bound at __init__, so a nonzero port does NOT mean serve() is
@@ -516,17 +524,28 @@ class SnappyFlightServer(flight.FlightServerBase):
             sess = self._session_for(req)
             plan = from_json(req["plan"])
             ctx = self._deadline_ctx(req, sess, "<shipped plan>")
-            if ctx is not None:
-                # propagated deadline: the caller's remaining budget —
-                # cooperative checks stop this fragment when the caller
-                # has already given up (its client-side cutoff fired)
-                ctx.start()
-                with resource.query_scope(ctx):
+            from snappydata_tpu.observability import tracing
+
+            # trace propagation: a traced caller's trace_id rides the
+            # ticket like its deadline — this fragment's spans record
+            # under the SAME id, joinable across the member rings
+            with tracing.request_scope("<shipped plan>", user=sess.user,
+                                       kind="server",
+                                       trace_id=req.get("trace_id"),
+                                       origin=self._origin()):
+                if ctx is not None:
+                    # propagated deadline: the caller's remaining budget
+                    # — cooperative checks stop this fragment when the
+                    # caller has already given up (its client-side
+                    # cutoff fired)
+                    ctx.start()
+                    with resource.query_scope(ctx):
+                        result = sess.execute_statement(
+                            _ast.Query(plan),
+                            tuple(req.get("params", ())))
+                else:
                     result = sess.execute_statement(
                         _ast.Query(plan), tuple(req.get("params", ())))
-            else:
-                result = sess.execute_statement(
-                    _ast.Query(plan), tuple(req.get("params", ())))
             table = result_to_arrow(result)
             chunk = int(req.get("page_rows", 65536))
             batches = table.to_batches(max_chunksize=max(1, chunk))
@@ -566,20 +585,28 @@ class SnappyFlightServer(flight.FlightServerBase):
             schema, gen = streamed
             return flight.GeneratorStream(schema, gen())
         ctx = self._deadline_ctx(req, sess, req.get("sql", ""))
-        if req.get("prepared"):
-            # serving front door: {"sql", "params", "prepared": true}
-            # routes through the prepared-plan registry — repeated
-            # tickets skip parse/plan, concurrent ones fuse into one
-            # vmapped dispatch, the governor admits per principal
-            result = sess.serving_sql(req["sql"],
-                                      tuple(req.get("params", ())),
-                                      query_ctx=ctx)
-            table = result_to_arrow(result)
-            chunk = int(req.get("page_rows", 65536))
-            batches = table.to_batches(max_chunksize=max(1, chunk))
-            return flight.GeneratorStream(table.schema, iter(batches))
-        result = sess.sql(req["sql"], params=tuple(req.get("params", ())),
-                          query_ctx=ctx)
+        from snappydata_tpu.observability import tracing
+
+        # the server opens its own trace under the caller's trace_id
+        # (or mints one for an untraced caller) BEFORE entering the
+        # session, so session.sql's scope joins it instead of minting
+        with tracing.request_scope(req.get("sql", ""), user=sess.user,
+                                   kind="server",
+                                   trace_id=req.get("trace_id"),
+                                   origin=self._origin()):
+            if req.get("prepared"):
+                # serving front door: {"sql", "params", "prepared":
+                # true} routes through the prepared-plan registry —
+                # repeated tickets skip parse/plan, concurrent ones fuse
+                # into one vmapped dispatch, the governor admits per
+                # principal
+                result = sess.serving_sql(req["sql"],
+                                          tuple(req.get("params", ())),
+                                          query_ctx=ctx)
+            else:
+                result = sess.sql(req["sql"],
+                                  params=tuple(req.get("params", ())),
+                                  query_ctx=ctx)
         table = result_to_arrow(result)
         # page as record batches (ref: CachedDataFrame paged collect /
         # GfxdHeapDataOutputStream result pages) — clients start consuming
@@ -661,7 +688,13 @@ class SnappyFlightServer(flight.FlightServerBase):
             # wal_fsync_mode=interval. Relaxed acks are a local-session
             # policy, never a network one. Scoped to THIS put's record so
             # one client's ack never waits on other sessions' records.
-            with reliability.stmt_scope(stmt_id):
+            from snappydata_tpu.observability import tracing
+
+            with tracing.request_scope(
+                    f"<put {target}>", user=sess.user, kind="server",
+                    trace_id=(body or {}).get("trace_id"),
+                    origin=self._origin()), \
+                    reliability.stmt_scope(stmt_id):
                 if isinstance(info.data, RowTableData):
                     from snappydata_tpu.session import _restore_none_arrays
 
@@ -730,7 +763,13 @@ class SnappyFlightServer(flight.FlightServerBase):
                     return
             try:
                 ctx = self._deadline_ctx(body, sess, body["sql"])
-                with reliability.stmt_scope(stmt_id):
+                from snappydata_tpu.observability import tracing
+
+                with tracing.request_scope(
+                        body["sql"], user=sess.user, kind="server",
+                        trace_id=body.get("trace_id"),
+                        origin=self._origin()), \
+                        reliability.stmt_scope(stmt_id):
                     result = sess.sql(
                         body["sql"], params=tuple(body.get("params", ())),
                         query_ctx=ctx)

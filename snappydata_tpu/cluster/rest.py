@@ -46,8 +46,15 @@ class JobRegistry:
                                   "queryId": ctx.query_id}
 
         def run():
+            from snappydata_tpu.observability import tracing
+
             try:
-                result = sess.sql(sql, params=params, query_ctx=ctx)
+                with tracing.request_scope(sql, user=sess.user,
+                                           kind="job") as tr:
+                    if tr is not None:
+                        with self._lock:
+                            self._jobs[job_id]["trace_id"] = tr.trace_id
+                    result = sess.sql(sql, params=params, query_ctx=ctx)
                 with self._lock:
                     self._jobs[job_id].update(
                         status="FINISHED",
@@ -166,6 +173,19 @@ def _render_dashboard(svc) -> str:
         f"<tr><td>{esc(str(q['sql']))[:120]}</td><td>{q['ms']}</td>"
         f"<td>{q['rows']}</td><td>{esc(str(q.get('user', '')))}</td></tr>"
         for q in recent)
+    from snappydata_tpu.observability.tracing import (ring,
+                                                      tracing_snapshot)
+
+    trc = tracing_snapshot()
+    rows_trc = "".join(
+        f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
+        for k, v in trc.items())
+    rows_trq = "".join(
+        f"<tr><td><code>{esc(str(t['trace_id']))}</code></td>"
+        f"<td>{esc(str(t['kind']))}</td>"
+        f"<td>{esc(str(t['sql']))[:100]}</td><td>{t['ms']}</td>"
+        f"<td>{t['spans']}</td><td>{esc(str(t['status']))}</td></tr>"
+        for t in ring().traces(15))
     streams = svc.session.streaming_queries()
     rows_s = "".join(
         f"<tr><td>{esc(str(q['name']))}</td><td>{esc(str(q['table']))}</td>"
@@ -207,6 +227,11 @@ tiled scans)</h2>
 <th>freshness</th><th>delta folds</th><th>rows folded</th>
 <th>full refreshes</th></tr>{rows_mv}</table>
 <table>{rows_mvc}</table>
+<h2>Tracing (trace ring / slow-query log)</h2>
+<table>{rows_trc}</table>
+<table><tr><th>trace id</th><th>kind</th><th>sql</th><th>ms</th>
+<th>spans</th><th>status</th></tr>{rows_trq}</table>
+<p>Detail: GET /status/api/v1/traces?trace_id=&lt;id&gt;</p>
 <h2>Counters</h2><table>{counters}</table>
 <h2>Recent queries ({len(recent)})</h2>
 <table><tr><th>sql</th><th>ms</th><th>rows</th><th>user</th></tr>{rows_q}
@@ -335,6 +360,35 @@ class RestService:
                     if self._principal_session() is None:
                         return
                     self._send(svc.session.streaming_queries())
+                elif path == "/status/api/v1/traces":
+                    # request-trace ring: recent completed traces
+                    # (summaries), `?trace_id=` for full span trees of
+                    # every local trace under that id, `?slow=1` for the
+                    # slow-query log. Trace SQL leaks literals → same
+                    # auth gate as /queries.
+                    if self._principal_session() is None:
+                        return
+                    from urllib.parse import parse_qs, urlparse
+
+                    from snappydata_tpu.observability.tracing import (
+                        ring, tracing_snapshot)
+
+                    q = parse_qs(urlparse(self.path).query)
+                    tid = q.get("trace_id", [None])[0]
+                    if tid:
+                        self._send({"trace_id": tid,
+                                    "traces": ring().get(tid)})
+                        return
+                    out = tracing_snapshot()
+                    if q.get("slow", ["0"])[0] in ("1", "true"):
+                        out["slow"] = ring().slow()
+                    else:
+                        try:
+                            limit = int(q.get("limit", ["50"])[0])
+                        except (TypeError, ValueError):
+                            limit = 50
+                        out["traces"] = ring().traces(limit)
+                    self._send(out)
                 elif path == "/status/api/v1/queries":
                     # query text leaks literals: same auth as /jobs
                     if self._principal_session() is None:
@@ -492,6 +546,9 @@ class RestService:
                     sess = self._principal_session()
                     if sess is None:
                         return
+                    from snappydata_tpu.observability import tracing
+
+                    trace_id = None
                     try:
                         # per-request deadline: `timeout_s` in the body
                         # arms the QueryContext, so a stalled query stops
@@ -505,9 +562,20 @@ class RestService:
                             ctx = resource.new_query(body["sql"],
                                                      user=sess.user)
                             ctx.set_deadline_in(float(t))
-                        result = sess.serving_sql(
-                            body["sql"], tuple(body.get("params", ())),
-                            query_ctx=ctx)
+                        # REST is a front door: mint (or join, if the
+                        # caller sent one) the request's trace id — it
+                        # comes back in the response, and on errors, so
+                        # a client-visible failure is joinable against
+                        # /status/api/v1/traces
+                        with tracing.request_scope(
+                                body.get("sql", ""), user=sess.user,
+                                kind="rest",
+                                trace_id=body.get("trace_id")) as tr:
+                            trace_id = tr.trace_id if tr else None
+                            result = sess.serving_sql(
+                                body["sql"],
+                                tuple(body.get("params", ())),
+                                query_ctx=ctx)
                         # JSON over HTTP is the small-result surface:
                         # cap the payload but SAY so — a silently
                         # truncated result reads as a complete one
@@ -521,11 +589,19 @@ class RestService:
                         }
                         if result.num_rows > cap:
                             payload["truncated"] = True
+                        if trace_id:
+                            payload["trace_id"] = trace_id
                         self._send(payload)
                     except (KeyError, TypeError) as e:
-                        self._send({"error": f"bad request: {e}"}, 400)
+                        err = {"error": f"bad request: {e}"}
+                        if trace_id:
+                            err["trace_id"] = trace_id
+                        self._send(err, 400)
                     except Exception as e:      # noqa: BLE001
-                        self._send({"error": str(e)}, 400)
+                        err = {"error": str(e)}
+                        if trace_id:
+                            err["trace_id"] = trace_id
+                        self._send(err, 400)
                 elif path.startswith("/queries/") and \
                         path.endswith("/cancel"):
                     # cooperative cancel: flags the query's context; the

@@ -273,6 +273,26 @@ class Properties:
     # re-prepares on next use.
     serving_max_handles: int = 512
 
+    # Observability: end-to-end request tracing (observability/
+    # tracing.py). Every request minted at a front door (REST POST /sql,
+    # Flight tickets, SnappyClient, DistributedSession, session.sql)
+    # gets a trace id that propagates like the request deadline — a
+    # contextvar locally, a trace_id body/ticket field across the wire —
+    # and a span tree over the real execution phases (parse/analyze/
+    # optimize, plan-cache verdict, jit compile, bind incl. batch-skip
+    # evidence, device execute, transfer, WAL sync, per-member fan-out
+    # legs, retries/hedges). Completed traces land in a bounded ring
+    # served by GET /status/api/v1/traces. tracing_enabled=False makes
+    # every tracing call a no-op contextvar read (the bench guards the
+    # enabled cost at <3% on the stock workload).
+    tracing_enabled: bool = True
+    # bounded in-process ring of completed traces
+    trace_ring_entries: int = 256
+    # slow-query log: any trace slower than this lands in a SEPARATE
+    # ring (full span tree preserved) + the slow_queries counter.
+    # 0 = disabled.
+    slow_query_ms: float = 0.0
+
     # Streaming (ref: SnappySinkCallback.scala:49-360)
     sink_state_table: str = "snappysys_internal____sink_state_table"
     sink_max_retries: int = 3

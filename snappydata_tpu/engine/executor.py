@@ -47,6 +47,7 @@ from snappydata_tpu.engine.exprs import (STRING_VALUE_FUNCS, CompileError,
                                          DVal, ExprBuilder, Runtime,
                                          _or_null)
 from snappydata_tpu.engine.result import Result, empty_result
+from snappydata_tpu.observability import tracing
 from snappydata_tpu.ops import pallas_group as _pg
 from snappydata_tpu.resource.context import check_current
 from snappydata_tpu.sql import ast
@@ -336,6 +337,24 @@ class CompiledPlan:
                 reg.inc("rle_run_predicates", note["run_preds"])
 
     def _bind(self, params: Tuple):
+        with tracing.span("bind") as sp:
+            if isinstance(sp, tracing._NoopSpan):
+                return self._bind_inner(params, sp)
+            # traced bind: also capture compressed-domain fallback
+            # evidence (the decode-first reroutes device.py counts by
+            # reason happen inside this bind)
+            from snappydata_tpu.observability.metrics import \
+                global_registry
+
+            reg = global_registry()
+            fb0 = reg.counter("compressed_fallbacks")
+            out = self._bind_inner(params, sp)
+            fb = reg.counter("compressed_fallbacks") - fb0
+            if fb:
+                sp.set("compressed_fallbacks", fb)
+            return out
+
+    def _bind_inner(self, params: Tuple, sp):
         from snappydata_tpu.observability.metrics import global_registry
 
         # one compiled dispatch is the atomic unit of work — the
@@ -360,6 +379,7 @@ class CompiledPlan:
                 kept = np.flatnonzero(keep)
                 reg.inc("column_batches_skipped",
                         int(dt.num_batches - len(kept)))
+                sp.add("batches_skipped", int(dt.num_batches - len(kept)))
                 b_new = batch_bucket(len(kept))
                 pad_valid = np.zeros(b_new, dtype=bool)
                 pad_valid[:len(kept)] = True
@@ -368,6 +388,7 @@ class CompiledPlan:
                 take_idx = jnp.asarray(idx)
                 pad_mask = jnp.asarray(pad_valid)[:, None]
             reg.inc("column_batches_seen", int(dt.num_batches))
+            sp.add("batches_seen", int(dt.num_batches))
             for ci in r.used:
                 col = dt.columns[ci]
                 nl = dt.nulls.get(ci)
@@ -433,20 +454,32 @@ class CompiledPlan:
             if ran_pre:
                 reg.inc("gidx_cache_misses")
                 fnp = self._jitted_pre.get(static)
-                if fnp is None:
+                first = fnp is None
+                if first:
                     fnp = jax.jit(functools.partial(self.traced_pre, static))
                     self._jitted_pre[static] = fnp
-                pre = self._noted_call(
-                    static, "pre", fnp, (tuple(arrays), tuple(aux), pvals))
+                # first call of a static key traces + XLA-compiles inside
+                # the dispatch — surfaced as its own span so a trace shows
+                # compile time apart from steady-state execution
+                with tracing.span("jit_compile" if first
+                                  else "device_execute", phase="pre"):
+                    pre = self._noted_call(
+                        static, "pre", fnp,
+                        (tuple(arrays), tuple(aux), pvals))
                 _pre_cache_put(self, static, pkey, tables, pre)
             else:
                 reg.inc("gidx_cache_hits")
+                tracing.annotate("gidx_cache", "hit")
             fn = self._jitted_main.get(static)
-            if fn is None:
+            first = fn is None
+            if first:
                 fn = jax.jit(functools.partial(self.traced_main, static))
                 self._jitted_main[static] = fn
-            outs = self._noted_call(
-                static, "main", fn, (tuple(arrays), tuple(aux), pvals, pre))
+            with tracing.span("jit_compile" if first
+                              else "device_execute", phase="main"):
+                outs = self._noted_call(
+                    static, "main", fn,
+                    (tuple(arrays), tuple(aux), pvals, pre))
             # a gidx-cache hit SKIPPED the pre pass — its code predicates
             # didn't run this execution (review finding: they were
             # re-counted in proportion to the hit rate)
@@ -454,11 +487,15 @@ class CompiledPlan:
                 reg, static, ("pre", "main") if ran_pre else ("main",))
         else:
             fn = self._jitted.get(static)
-            if fn is None:
+            first = fn is None
+            if first:
                 fn = jax.jit(functools.partial(self.traced, static))
                 self._jitted[static] = fn
-            outs = self._noted_call(
-                static, "single", fn, (tuple(arrays), tuple(aux), pvals))
+            with tracing.span("jit_compile" if first
+                              else "device_execute"):
+                outs = self._noted_call(
+                    static, "single", fn,
+                    (tuple(arrays), tuple(aux), pvals))
             self._count_compressed(reg, static, ("single",))
         note = self.agg_notes.get(static) if self.agg_notes else None
         if note is not None:
@@ -470,8 +507,11 @@ class CompiledPlan:
     def execute(self, params: Tuple) -> Result:
         tables, outs = self._run_device(params)
         # single bulk device→host transfer (per-array .asarray costs one
-        # round trip each — painful over a remote/tunneled TPU link)
-        outs = jax.device_get(outs)
+        # round trip each — painful over a remote/tunneled TPU link).
+        # The transfer span absorbs the wait on the async dispatch, so
+        # device_execute ≈ dispatch and transfer ≈ compute+copy.
+        with tracing.span("transfer"):
+            outs = jax.device_get(outs)
         if bool(np.asarray(outs[2])):
             raise CompileError(
                 "device overflow (group-by cardinality beyond max_groups, "
@@ -529,12 +569,16 @@ class CompiledPlan:
             for k in range(nparams))
         key = (static, len(params_list))
         fn = self._jitted_vmap.get(key)
-        if fn is None:
+        first = fn is None
+        if first:
             reg.inc("serving_vmap_compiles")
             fn = jax.jit(jax.vmap(functools.partial(self.traced, static),
                                   in_axes=(None, 0, 0)))
             self._jitted_vmap[key] = fn
-        outs = self._noted_call(key, "vmap", fn, (tuple(arrays), aux, pvals))
+        with tracing.span("jit_compile" if first else "device_execute",
+                          batched=len(params_list)):
+            outs = self._noted_call(key, "vmap", fn,
+                                    (tuple(arrays), aux, pvals))
         self._count_compressed(reg, key, ("vmap",))
         note = self.agg_notes.get(static) if self.agg_notes else None
         if note is not None:
@@ -543,7 +587,8 @@ class CompiledPlan:
                 reg.inc("agg_strategy_" + s)
         # the whole batch comes home in ONE transfer — the amortization
         # the micro-batcher buys (vs one device_get per request)
-        outs = jax.device_get(outs)
+        with tracing.span("transfer"):
+            outs = jax.device_get(outs)
         reg.inc("serving_bulk_transfers")
         return tables, outs
 
@@ -3139,7 +3184,7 @@ class Executor:
         if compiled is None:
             reg = global_registry()
             try:
-                with reg.time("plan_compile"):
+                with reg.time("plan_compile"), tracing.span("compile"):
                     compiled = Compiler(self.catalog,
                                         self.props).compile(node)
             except CompileError:
@@ -3232,21 +3277,26 @@ class Executor:
         compiled = self._cache_get(key)
         if compiled is None:
             reg.inc("plan_cache_misses")
+            tracing.annotate("plan_cache", "miss")
             try:
-                with reg.time("plan_compile"):
+                with reg.time("plan_compile"), tracing.span("compile"):
                     compiled = Compiler(self.catalog,
                                         self.props).compile(node)
-            except CompileError:
+            except CompileError as e:
                 reg.inc("host_fallbacks")
-                return self._host_fallback(node, params)
+                with tracing.span("host_fallback",
+                                  reason=str(e)[:120]):
+                    return self._host_fallback(node, params)
             self._cache_put(key, compiled)
         else:
             reg.inc("plan_cache_hits")
+            tracing.annotate("plan_cache", "hit")
         try:
             return compiled.execute(params)
-        except CompileError:
+        except CompileError as e:
             reg.inc("host_fallbacks")
-            return self._host_fallback(node, params)
+            with tracing.span("host_fallback", reason=str(e)[:120]):
+                return self._host_fallback(node, params)
 
     def _try_take(self, node: ast.Plan, n: int, params: Tuple
                   ) -> Optional[Result]:

@@ -15,6 +15,9 @@ from typing import Dict, Optional
 
 from snappydata_tpu import config
 from snappydata_tpu.observability.metrics import global_registry
+# tracing_snapshot lives with the trace ring; re-exported here so every
+# status surface reads off one module like the other *_snapshot helpers
+from snappydata_tpu.observability.tracing import tracing_snapshot  # noqa: F401,E501
 from snappydata_tpu.storage.table_store import RowTableData
 
 

@@ -43,12 +43,18 @@ class LowMemoryException(MemoryError):
 class CancelException(RuntimeError):
     """Query stopped cooperatively — explicit CANCEL, statement timeout,
     or a broker-initiated kill under memory pressure (ref: Derby/GemFireXD
-    SQLSTATE XCL52 'statement cancelled or timed out')."""
+    SQLSTATE XCL52 'statement cancelled or timed out').  `trace_id`
+    (when the request was traced) joins this client-visible failure
+    against the server-side trace ring."""
 
     sqlstate = "XCL52"
 
     def __init__(self, msg: str):
-        super().__init__(f"[{self.sqlstate}] {msg}")
+        from snappydata_tpu.observability import tracing  # lazy: cold path
+
+        self.trace_id = tracing.current_trace_id()
+        suffix = f" [trace {self.trace_id}]" if self.trace_id else ""
+        super().__init__(f"[{self.sqlstate}] {msg}{suffix}")
 
 
 class QueryContext:

@@ -444,6 +444,14 @@ class PreparedStatement:
         """Run with the given bind values.  Admission (fair-share per
         principal), statement timeouts and CANCEL all apply per request,
         exactly as for session.sql — including inside a fused batch."""
+        from snappydata_tpu.observability import tracing
+
+        with tracing.request_scope(self._entry.sql,
+                                   user=self._session.user,
+                                   kind="serving"):
+            return self._execute_governed(params, query_ctx)
+
+    def _execute_governed(self, params: Sequence = (), query_ctx=None):
         from snappydata_tpu import resource
 
         entry, sess = self._entry, self._session
@@ -471,6 +479,8 @@ class PreparedStatement:
     def _execute_inner(self, params: Tuple, ctx):
         from snappydata_tpu.engine.result import finalize_decimals
 
+        from snappydata_tpu.observability import tracing
+
         entry, sess = self._entry, self._session
         reg = global_registry()
         t0 = time.time()
@@ -478,8 +488,10 @@ class PreparedStatement:
         entry.revalidate(sess)
         if entry._used:
             reg.inc("serving_prepared_hits")
+            tracing.annotate("serving_registry", "hit")
         else:
             entry._used = True
+            tracing.annotate("serving_registry", "miss")
         entry.executes += 1
         if entry.passthrough is not None or sess.default_mesh is not None:
             # full session pipeline (subqueries, windows, AQP, tiling,
@@ -511,6 +523,8 @@ class PreparedStatement:
         return result
 
     def _dispatch(self, full: Tuple, ctx):
+        from snappydata_tpu.observability import tracing
+
         entry, sess = self._entry, self._session
         if entry.point_exec is not None:
             # prepare-time-extracted PK/index probe: no AST walk, no
@@ -522,11 +536,13 @@ class PreparedStatement:
                 reg = global_registry()
                 reg.inc("queries")
                 reg.inc("rows_returned", result.num_rows)
+                tracing.annotate("serving_lane", "point")
                 return result
         props = sess.conf
         if int(props.serving_batch_max or 1) > 1 and entry.batchable(sess):
             from snappydata_tpu.serving.batcher import global_batcher
 
+            tracing.annotate("serving_lane", "batched")
             return global_batcher().submit(entry, sess, full, ctx)
         # straight path: the executor keeps its point-lookup/index fast
         # lane and all engine counters; the prepared core key skips the

@@ -191,7 +191,21 @@ class SnappySession:
 
     def sql(self, sql_text: str, params: Sequence[Any] = (),
             query_ctx=None) -> Result:
-        stmt = parse(sql_text)
+        from snappydata_tpu.observability import tracing
+
+        # one trace per logical request: a nested call (tile partials,
+        # matview sync, subquery rewrites) finds the ambient trace and
+        # attaches spans instead of minting a second id
+        with tracing.request_scope(sql_text, user=self.user,
+                                   kind="session"):
+            return self._sql_traced(sql_text, params, query_ctx)
+
+    def _sql_traced(self, sql_text: str, params: Sequence[Any] = (),
+                    query_ctx=None) -> Result:
+        from snappydata_tpu.observability import tracing
+
+        with tracing.span("parse"):
+            stmt = parse(sql_text)
         if isinstance(stmt, ast.Query):
             # live query log feeding the dashboard / REST plan UI (ref:
             # SnappySQLListener capturing plan info for the SQL tab)
@@ -291,7 +305,10 @@ class SnappySession:
             # commit buffer — wal_sync blocks until the covering fsync,
             # OUTSIDE the mutation lock so concurrent committers coalesce
             # into one group fsync instead of serializing on it
-            ds.wal_sync(seq)
+            from snappydata_tpu.observability import tracing
+
+            with tracing.span("wal_sync"):
+                ds.wal_sync(seq)
             return result
         result = self.execute_statement(stmt, tuple(params))
         if ds is not None:
@@ -660,7 +677,7 @@ class SnappySession:
         if isinstance(stmt, ast.ListDeployed):
             return self._list_deployed(stmt.kind)
         if isinstance(stmt, ast.ExplainStmt):
-            return self._explain(stmt.query)
+            return self._explain(stmt.query, analyze=stmt.analyze)
         if isinstance(stmt, ast.CreatePolicy):
             info = self.catalog.describe(stmt.table)
             for node in ast.walk(stmt.using):
@@ -861,12 +878,21 @@ class SnappySession:
         else:
             _mv.fold_ingest(self.catalog, info.name, arrays, nulls)
 
-    def _explain(self, plan: ast.Plan) -> Result:
-        """EXPLAIN: optimized + resolved plan tree, one node per line
-        (ref: the plan info SnappySQLListener feeds the SQL UI)."""
+    def _explain(self, plan: ast.Plan, analyze: bool = False) -> Result:
+        """EXPLAIN [ANALYZE]: optimized + resolved plan tree, one node
+        per line (ref: the plan info SnappySQLListener feeds the SQL
+        UI).  ANALYZE additionally EXECUTES the query under a (forced)
+        request trace and annotates the tree with runtime stats read off
+        the engine's own counters — batches scanned vs skipped (min/max
+        stats vs dictionary probes), reduction strategy chosen,
+        code-domain vs decoded predicate lanes, join device/host
+        verdicts, host-fallback evidence — plus a runtime footer with
+        rows out, per-phase seconds from the trace's span tree, and the
+        trace id (joinable against /status/api/v1/traces)."""
         from snappydata_tpu.sql.optimizer import optimize
         from snappydata_tpu.sql.analyzer import _expr_name
 
+        run_stats = self._explain_execute(plan) if analyze else None
         plan = self._rewrite_stream_windows(plan)
         plan = self._decorrelate(plan)
         optimized = optimize(plan, self.catalog)
@@ -908,14 +934,159 @@ class SnappySession:
                 return f"Values ({len(p.rows)} rows)"
             return type(p).__name__
 
+        def count_nodes(p: ast.Plan, kinds: dict) -> None:
+            for K in (ast.Relation, ast.Aggregate, ast.Join):
+                if isinstance(p, K):
+                    kinds[K] = kinds.get(K, 0) + 1
+            for k in p.children():
+                count_nodes(k, kinds)
+
+        kinds: Dict = {}
+        if run_stats is not None:
+            count_nodes(resolved, kinds)
+
+        def annotate(p: ast.Plan) -> str:
+            """Runtime suffix for EXPLAIN ANALYZE.  The engine's
+            counters are plan-wide, so inline per-node annotation only
+            happens when the node is the plan's ONLY one of its kind
+            (the footer always carries the full numbers)."""
+            st = run_stats
+            if st is None:
+                return ""
+            if isinstance(p, ast.Relation) and kinds.get(ast.Relation) == 1:
+                info = self.catalog.lookup_table(p.name)
+                rows_in = 0
+                if info is not None:
+                    try:
+                        rows_in = info.data.count() if isinstance(
+                            info.data, RowTableData) else \
+                            info.data.snapshot().total_rows()
+                    except Exception:
+                        rows_in = 0
+                return (f" [rows={rows_in}"
+                        f" batches_seen={st['batches_seen']}"
+                        f" skipped_stats={st['batches_skipped_stats']}"
+                        f" skipped_dict={st['batches_skipped_dict']}"
+                        f" code_domain="
+                        f"{'yes' if st['code_domain_predicates'] else 'no'}]")
+            if isinstance(p, ast.Aggregate) and \
+                    kinds.get(ast.Aggregate) == 1:
+                strat = ",".join(st["strategies"]) or "host"
+                return (f" [strategy={strat}"
+                        f" rows_out={st['rows_out']}]")
+            if isinstance(p, ast.Join) and kinds.get(ast.Join) == 1:
+                if st["join_host_fallbacks"]:
+                    return " [path=host]"
+                if st["join_device_joins"]:
+                    return " [path=device]"
+            return ""
+
         def walk_plan(p: ast.Plan, depth: int) -> None:
-            lines.append("  " * depth + describe(p))
+            lines.append("  " * depth + describe(p) + annotate(p))
             for k in p.children():
                 walk_plan(k, depth + 1)
 
         walk_plan(resolved, 0)
+        if run_stats is not None:
+            st = run_stats
+            lines.append("== runtime (EXPLAIN ANALYZE) ==")
+            lines.append(
+                f"rows_out={st['rows_out']} "
+                f"elapsed_ms={st['elapsed_s'] * 1e3:.3f} "
+                f"trace_id={st['trace_id']}")
+            lines.append(
+                f"plan_cache={st['plan_cache']} "
+                f"host_fallbacks={st['host_fallbacks']} "
+                f"batches_seen={st['batches_seen']} "
+                f"skipped_stats={st['batches_skipped_stats']} "
+                f"skipped_dict={st['batches_skipped_dict']} "
+                f"code_domain_predicates={st['code_domain_predicates']} "
+                f"rle_run_predicates={st['rle_run_predicates']}")
+            if st["compressed_fallbacks"]:
+                lines.append("compressed_fallbacks=" +
+                             ",".join(f"{k}:{v}" for k, v in
+                                      st["compressed_fallbacks"].items()))
+            if st["host_fallback_reasons"]:
+                lines.append("host_fallback_reason=" +
+                             "; ".join(st["host_fallback_reasons"]))
+            lines.append("phases: " + " ".join(
+                f"{k}={v * 1e3:.3f}ms"
+                for k, v in sorted(st["phases"].items())))
         return Result(["plan"], [np.array(lines, dtype=object)],
                       [None], [T.STRING])
+
+    def _explain_execute(self, plan: ast.Plan) -> dict:
+        """EXPLAIN ANALYZE's execution pass: run the query under a
+        FORCED request trace (works with tracing_enabled=False) and
+        capture engine-counter deltas — the same counters the dashboard
+        reports, so the annotations are value-joinable against them."""
+        import time as _time
+
+        from snappydata_tpu.observability import tracing
+        from snappydata_tpu.observability.metrics import global_registry
+
+        reg = global_registry()
+        c0 = reg.counters_snapshot()
+        t0 = _time.perf_counter()
+        with tracing.request_scope("EXPLAIN ANALYZE", user=self.user,
+                                   kind="explain", force=True) as tr:
+            result = self._governed_query("EXPLAIN ANALYZE",
+                                          ast.Query(plan), ())
+        elapsed = _time.perf_counter() - t0
+        c1 = reg.counters_snapshot()
+
+        def d(key: str) -> int:
+            return c1.get(key, 0) - c0.get(key, 0)
+
+        seen_total = d("column_batches_seen")
+        skipped = d("column_batches_skipped")
+        dict_skipped = d("batches_skipped_dict")
+        # prefer THIS request's own bind-span evidence for the batch
+        # numbers — counter deltas are process-global, so concurrent
+        # traffic on a shared server would pollute them (the remaining
+        # delta-sourced fields — dict-skip split, strategies, cache
+        # verdicts — stay approximate under concurrency)
+        fallback_reasons = []
+        if tr is not None:
+            bind_seen = bind_skipped = 0
+            bound = False
+            stack = [tr.root]
+            while stack:
+                sp = stack.pop()
+                if sp.name == "host_fallback" and sp.attrs.get("reason"):
+                    fallback_reasons.append(sp.attrs["reason"])
+                if sp.name == "bind":
+                    bound = True
+                    bind_seen += sp.attrs.get("batches_seen", 0)
+                    bind_skipped += sp.attrs.get("batches_skipped", 0)
+                stack.extend(sp.children)
+            if bound:
+                seen_total, skipped = bind_seen, bind_skipped
+        return {
+            "rows_out": result.num_rows,
+            "elapsed_s": elapsed,
+            "trace_id": tr.trace_id if tr is not None else None,
+            "phases": tr.phase_seconds() if tr is not None else {},
+            "host_fallback_reasons": fallback_reasons,
+            "plan_cache": "hit" if d("plan_cache_hits") else
+                          ("miss" if d("plan_cache_misses") else "n/a"),
+            "host_fallbacks": d("host_fallbacks"),
+            "batches_seen": seen_total,
+            "batches_skipped_stats": max(0, skipped - dict_skipped),
+            "batches_skipped_dict": dict_skipped,
+            "code_domain_predicates": d("code_domain_predicates"),
+            "rle_run_predicates": d("rle_run_predicates"),
+            "join_device_joins": d("join_device_joins"),
+            "join_host_fallbacks": d("join_host_fallbacks"),
+            "strategies": [s for s in ("unroll", "scatter", "matmul",
+                                       "pallas")
+                           if d(f"agg_strategy_{s}")],
+            "compressed_fallbacks": {
+                k[len("compressed_fallback_"):]: c1.get(k, 0) - c0.get(k, 0)
+                for k in c1
+                if k.startswith("compressed_fallback_")
+                and c1.get(k, 0) - c0.get(k, 0)},
+        }
 
     # -- tiled scans: table ≫ HBM (SURVEY §5 "long-context" analogue) ----
 
@@ -1485,24 +1656,30 @@ class SnappySession:
         return self._run_query_inner(plan, user_params)
 
     def _run_query_inner(self, plan: ast.Plan, user_params=()) -> Result:
+        from snappydata_tpu.observability import tracing
+
         if getattr(self.catalog, "_sample_maintainers", None):
             self._refresh_samples()
         plan = self._rewrite_stream_windows(plan)
         tiled = self._maybe_tiled_aggregate(plan, user_params)
         if tiled is not None:
             return tiled
-        plan = self._decorrelate(plan)
-        plan = self._rewrite_subqueries(plan, user_params)
-        from snappydata_tpu.sql.optimizer import optimize
+        with tracing.span("optimize"):
+            plan = self._decorrelate(plan)
+            plan = self._rewrite_subqueries(plan, user_params)
+            from snappydata_tpu.sql.optimizer import optimize
 
-        plan = optimize(plan, self.catalog)
-        resolved, _ = self.analyzer.analyze_plan(plan)
-        if self.conf.tokenize and self.conf.plan_caching:
-            tokenized, lit_params = tokenize_plan(resolved)
-        else:
-            from snappydata_tpu.sql.analyzer import assign_param_positions
+            plan = optimize(plan, self.catalog)
+        with tracing.span("analyze"):
+            resolved, _ = self.analyzer.analyze_plan(plan)
+            if self.conf.tokenize and self.conf.plan_caching:
+                tokenized, lit_params = tokenize_plan(resolved)
+            else:
+                from snappydata_tpu.sql.analyzer import \
+                    assign_param_positions
 
-            tokenized, lit_params = assign_param_positions(resolved, 0), ()
+                tokenized, lit_params = \
+                    assign_param_positions(resolved, 0), ()
         params = tuple(lit_params) + tuple(user_params)
         if self.default_mesh is not None:
             from snappydata_tpu.parallel.mesh import MeshContext

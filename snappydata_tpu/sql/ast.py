@@ -730,10 +730,14 @@ class DropIndex(Statement):
 
 @dataclasses.dataclass(frozen=True)
 class ExplainStmt(Statement):
-    """EXPLAIN <query> — resolved/optimized plan tree (ref: plan info the
-    SnappySQLListener surfaces to the UI)."""
+    """EXPLAIN [ANALYZE] <query> — resolved/optimized plan tree (ref:
+    plan info the SnappySQLListener surfaces to the UI).  `analyze`
+    EXECUTES the query and annotates the tree with per-operator runtime
+    stats (batches scanned/skipped by stats vs dictionary, strategy
+    chosen, rows out, per-phase seconds from the request trace)."""
 
     query: object = None  # ast.Plan
+    analyze: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -136,8 +136,16 @@ class Parser:
             return self._finishing(self.grant_revoke_stmt(low))
         if low == "explain":
             self.next()
+            analyze = False
+            nxt = self.peek()
+            # ANALYZE is statement-position only, never reserved — a
+            # query can still select from a table named analyze
+            if nxt.kind in ("IDENT", "KW") and \
+                    nxt.value.lower() == "analyze":
+                self.next()
+                analyze = True
             plan = self.query_expr()
-            return self._finishing(ast.ExplainStmt(plan))
+            return self._finishing(ast.ExplainStmt(plan, analyze=analyze))
         if low == "exec":
             self.next()
             lang = self.peek()

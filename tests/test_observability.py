@@ -1,0 +1,465 @@
+"""End-to-end observability suite: request tracing (trace-id
+propagation across a real 2-server cluster), EXPLAIN ANALYZE
+value-asserted against the engine's own counters, log-bucketed
+histogram quantile correctness, slow-query log + ring bounds, the
+REST/dashboard surfaces, trace-aware error reporting, and the
+tracing-disabled overhead guard."""
+
+import json
+import time
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession, config
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.observability import tracing
+from snappydata_tpu.observability.metrics import (MetricsRegistry, Timer,
+                                                 global_registry)
+
+pytestmark = pytest.mark.observability
+
+
+@pytest.fixture(autouse=True)
+def _restore_knobs():
+    props = config.global_properties()
+    saved = (props.tracing_enabled, props.trace_ring_entries,
+             props.slow_query_ms)
+    yield props
+    (props.tracing_enabled, props.trace_ring_entries,
+     props.slow_query_ms) = saved
+
+
+def _mk_session(n: int = 1000) -> SnappySession:
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE t (g BIGINT, v DOUBLE) USING column")
+    s.insert_arrays("t", [np.arange(n, dtype=np.int64) % 4,
+                          np.arange(n, dtype=np.float64)])
+    return s
+
+
+# ----------------------------------------------------------------------
+# histogram timers
+# ----------------------------------------------------------------------
+
+def test_histogram_quantiles_uniform_distribution():
+    t = Timer()
+    for i in range(1, 1001):            # 1ms .. 1000ms uniform
+        t.record(i / 1000.0)
+    d = t.to_dict()
+    assert d["count"] == 1000
+    assert d["min_s"] == 0.001 and d["max_s"] == 1.0
+    # log-bucketed (4/octave) + intra-bucket interpolation: each
+    # quantile lands within 25% of the exact order statistic
+    for key, exact in (("p50_s", 0.500), ("p99_s", 0.990),
+                       ("p999_s", 0.999)):
+        assert abs(d[key] - exact) / exact < 0.25, (key, d[key], exact)
+    assert d["p50_s"] <= d["p99_s"] <= d["p999_s"]
+
+
+def test_histogram_quantiles_bimodal_tail():
+    """The histogram exists for exactly this: 100 fast requests + 1
+    outlier — the mean hides it, p99.9 must not."""
+    t = Timer()
+    for _ in range(100):
+        t.record(0.001)
+    t.record(1.0)
+    d = t.to_dict()
+    assert d["p50_s"] < 0.002
+    assert d["p999_s"] > 0.5            # the outlier is visible
+    assert d["mean_s"] < 0.02           # ... and the mean hid it
+    # constant distribution: p50 == p99 == the single value (clamped to
+    # observed min/max, so exact)
+    t2 = Timer()
+    for _ in range(50):
+        t2.record(0.25)
+    d2 = t2.to_dict()
+    assert d2["p50_s"] == d2["p99_s"] == d2["p999_s"] == 0.25
+
+
+def test_query_timer_surfaces_quantiles_in_snapshot():
+    s = _mk_session()
+    for _ in range(3):
+        s.sql("SELECT g, sum(v) FROM t GROUP BY g")
+    snap = global_registry().snapshot()
+    q = snap["timers"]["query"]
+    assert {"p50_s", "p99_s", "p999_s"} <= set(q)
+    assert 0 < q["p50_s"] <= q["p99_s"] <= q["p999_s"] <= q["max_s"]
+    s.stop()
+
+
+def test_snapshot_gauge_touching_registry_does_not_deadlock():
+    """Satellite regression: gauge callables used to run while HOLDING
+    the non-reentrant registry lock, so a gauge that reads the registry
+    (a ledger walk refreshing a gauge cache) self-deadlocked."""
+    r = MetricsRegistry()
+    r.inc("x", 7)
+    r.gauge("self_reader", lambda: float(r.counter("x")))
+    out = {}
+
+    def snap():
+        out["snap"] = r.snapshot()
+
+    th = threading.Thread(target=snap, daemon=True)
+    th.start()
+    th.join(timeout=5)
+    assert not th.is_alive(), "snapshot() deadlocked on a registry gauge"
+    assert out["snap"]["gauges"]["self_reader"] == 7.0
+
+
+def test_prometheus_exposition_types_histograms_collisions():
+    r = MetricsRegistry()
+    # distinct raw names, one sanitized form: must NOT silently overwrite
+    r.inc("a.b", 1)
+    r.inc("a_b", 2)
+    r.gauge("g1", lambda: 3.5)
+    for ms in (1, 2, 5, 10, 500):
+        r.record_time("lat", ms / 1000.0)
+    out = r.to_prometheus()
+    assert "# TYPE" in out and "# HELP" in out
+    assert "# TYPE snappy_tpu_a_b_total counter" in out
+    # the collision got a deterministic suffix; both values survive
+    values = sorted(int(ln.rsplit(" ", 1)[1]) for ln in out.splitlines()
+                    if ln.startswith("snappy_tpu_a_b") and
+                    ln.split(" ")[0].endswith("_total"))
+    assert values == [1, 2]
+    assert "# TYPE snappy_tpu_lat_seconds histogram" in out
+    assert 'snappy_tpu_lat_seconds_bucket{le="+Inf"} 5' in out
+    assert "snappy_tpu_lat_seconds_count 5" in out
+    # cumulative bucket counts are monotone
+    cums = [int(ln.rsplit(" ", 1)[1]) for ln in out.splitlines()
+            if ln.startswith("snappy_tpu_lat_seconds_bucket")]
+    assert cums == sorted(cums)
+    # quantiles ride as a sibling gauge family
+    assert 'snappy_tpu_lat_seconds_q{quantile="0.999"}' in out
+
+
+# ----------------------------------------------------------------------
+# trace ring / slow-query log / disabled overhead
+# ----------------------------------------------------------------------
+
+def test_trace_ring_bounded(_restore_knobs):
+    _restore_knobs.trace_ring_entries = 5
+    s = _mk_session()
+    tracing.ring().clear()
+    before = tracing.ring().recorded
+    for i in range(12):
+        s.sql(f"SELECT count(*) FROM t WHERE g = {i % 4}")
+    assert tracing.ring().recorded - before >= 12
+    assert len(tracing.ring().traces(100)) <= 5
+    s.stop()
+
+
+def test_slow_query_log_threshold(_restore_knobs):
+    s = _mk_session()
+    tracing.ring().clear()
+    _restore_knobs.slow_query_ms = 1e-4   # everything is "slow"
+    c0 = global_registry().counter("slow_queries")
+    s.sql("SELECT sum(v) FROM t")
+    slow = tracing.ring().slow()
+    assert slow and slow[0]["sql"].startswith("SELECT sum(v)")
+    # the slow entry keeps its FULL span tree
+    assert "root" in slow[0] and slow[0]["root"]["children"]
+    assert global_registry().counter("slow_queries") > c0
+    _restore_knobs.slow_query_ms = 1e9    # nothing is slow
+    n = len(tracing.ring().slow())
+    s.sql("SELECT sum(v) FROM t")
+    assert len(tracing.ring().slow()) == n
+    s.stop()
+
+
+def test_tracing_disabled_records_nothing_and_spans_are_cheap(
+        _restore_knobs):
+    _restore_knobs.tracing_enabled = False
+    s = _mk_session()
+    tracing.ring().clear()
+    s.sql("SELECT sum(v) FROM t")
+    assert tracing.ring().traces(100) == []
+    assert tracing.current() is None
+    # the overhead guard's substrate: an untraced span is one contextvar
+    # read, no allocation — 20k of them must stay well under 100ms
+    t0 = time.perf_counter()
+    for _ in range(20000):
+        with tracing.span("x"):
+            pass
+    assert time.perf_counter() - t0 < 0.5
+    s.stop()
+
+
+def test_trace_span_children_capped(_restore_knobs):
+    with tracing.request_scope("cap test", user="t", kind="session",
+                               force=True) as tr:
+        for _ in range(5000):
+            with tracing.span("tick"):
+                pass
+    assert len(tr.root.children) <= 256
+    assert tr.root.attrs["children_truncated"] > 0
+
+
+# ----------------------------------------------------------------------
+# EXPLAIN ANALYZE
+# ----------------------------------------------------------------------
+
+def _line(rows, needle):
+    for r in rows:
+        if needle in r[0]:
+            return r[0]
+    raise AssertionError(f"no line containing {needle!r} in "
+                         f"{[r[0] for r in rows]}")
+
+
+def _field(line, key) -> str:
+    for tok in line.replace("]", " ").replace("[", " ").split():
+        if tok.startswith(key + "="):
+            return tok.split("=", 1)[1]
+    raise AssertionError(f"{key}= not in {line!r}")
+
+
+def test_explain_analyze_counts_match_engine_counters():
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE big (k BIGINT, v DOUBLE) USING column")
+    n = 262144   # exactly 2 full default batches, k ascending
+    s.insert_arrays("big", [np.arange(n, dtype=np.int64),
+                            np.arange(n, dtype=np.float64)])
+    q = "SELECT count(*), sum(v) FROM big WHERE k >= 200000"
+    # expected counters from a DIRECT run of the same query
+    expect = s.sql(q).rows()[0]
+    c0 = global_registry().counters_snapshot()
+    s.sql(q)
+    c1 = global_registry().counters_snapshot()
+    seen = c1.get("column_batches_seen", 0) - \
+        c0.get("column_batches_seen", 0)
+    skipped = c1.get("column_batches_skipped", 0) - \
+        c0.get("column_batches_skipped", 0)
+    assert seen == 2 and skipped == 1   # min/max stats prune batch 0
+    rows = s.sql("EXPLAIN ANALYZE " + q).rows()
+    scan = _line(rows, "Scan big")
+    assert int(_field(scan, "batches_seen")) == seen
+    assert int(_field(scan, "skipped_stats")) == skipped
+    assert int(_field(scan, "rows")) == n
+    footer = _line(rows, "trace_id=")
+    assert int(_field(footer, "rows_out")) == 1
+    assert expect[0] == n - 200000      # the ANALYZE run really ran it
+    stats = _line(rows, "batches_seen=2")
+    assert _field(stats, "skipped_stats") == "1"
+    # phase breakdown + trace id present and joinable against the ring
+    phases = _line(rows, "phases:")
+    assert "bind=" in phases and "transfer=" in phases
+    tid = _field(footer, "trace_id")
+    assert tracing.ring().get(tid), "EXPLAIN ANALYZE trace not in ring"
+    s.stop()
+
+
+def test_explain_analyze_strategy_and_plain_explain():
+    s = _mk_session()
+    q = "SELECT g, count(*), sum(v) FROM t GROUP BY g"
+    rows = s.sql("EXPLAIN ANALYZE " + q).rows()
+    agg = _line(rows, "HashAggregate")
+    assert "strategy=" in agg
+    assert int(_field(agg, "rows_out")) == 4
+    scan = _line(rows, "Scan t")
+    assert "code_domain=" in scan
+    # plain EXPLAIN: no execution, no runtime footer
+    plain = s.sql("EXPLAIN " + q).rows()
+    assert not any("rows_out=" in r[0] for r in plain)
+    assert not any("batches_seen=" in r[0] for r in plain)
+    s.stop()
+
+
+def test_explain_analyze_works_with_tracing_disabled(_restore_knobs):
+    _restore_knobs.tracing_enabled = False
+    s = _mk_session()
+    rows = s.sql("EXPLAIN ANALYZE SELECT sum(v) FROM t").rows()
+    footer = _line(rows, "rows_out=")
+    assert int(_field(footer, "rows_out")) == 1
+    assert "phases:" in _line(rows, "phases:")
+    s.stop()
+
+
+# ----------------------------------------------------------------------
+# trace-aware errors
+# ----------------------------------------------------------------------
+
+def test_errors_carry_trace_id():
+    from snappydata_tpu.cluster.distributed import DistributedError
+    from snappydata_tpu.resource.context import CancelException
+
+    with tracing.request_scope("SELECT 1", user="t", kind="session",
+                               force=True) as tr:
+        ce = CancelException("deadline")
+        de = DistributedError("member lost")
+    assert ce.trace_id == tr.trace_id
+    assert f"[trace {tr.trace_id}]" in str(ce)
+    assert de.trace_id == tr.trace_id
+    assert f"[trace {tr.trace_id}]" in str(de)
+    # untraced: no id, message unchanged
+    ce2 = CancelException("deadline")
+    assert ce2.trace_id is None and "[trace" not in str(ce2)
+
+
+# ----------------------------------------------------------------------
+# cluster propagation: one trace id, client → fan-out legs → servers
+# ----------------------------------------------------------------------
+
+def test_trace_propagates_across_two_server_cluster():
+    from snappydata_tpu.cluster import LocatorNode, ServerNode
+    from snappydata_tpu.cluster.distributed import DistributedSession
+
+    locator = LocatorNode().start()
+    servers = [ServerNode(locator.address,
+                          SnappySession(catalog=Catalog())).start()
+               for _ in range(2)]
+    ds = DistributedSession(
+        server_addresses=[s.flight_address for s in servers])
+    try:
+        ds.sql("CREATE TABLE tx (k BIGINT, amt DOUBLE) USING column "
+               "OPTIONS (partition_by 'k')")
+        rng = np.random.default_rng(7)
+        k = rng.integers(0, 500, 4000).astype(np.int64)
+        amt = rng.random(4000)
+        ds.insert_arrays("tx", [k, amt])
+        tracing.ring().clear()
+        got = ds.sql("SELECT count(*), sum(amt) FROM tx").rows()[0]
+        assert got[0] == 4000
+        assert abs(got[1] - float(amt.sum())) < 1e-6
+        # the lead minted ONE id for the request ...
+        leads = [t for t in tracing.ring().traces(100)
+                 if t["kind"] == "lead" and t["sql"].startswith("SELECT")]
+        assert leads, "no lead trace recorded"
+        tid = leads[0]["trace_id"]
+        full = tracing.ring().get(tid)
+        lead = next(t for t in full if t["kind"] == "lead")
+        # ... with one fan-out leg span per member under it
+        members = [sp for sp in lead["root"]["children"]
+                   if sp["name"] == "member"]
+        addrs = {sp["attrs"]["addr"] for sp in members}
+        assert len(addrs) == 2, (addrs, lead)
+        # ... and BOTH servers opened their own trace under the SAME id
+        # (in-process test cluster: every member shares one ring, so the
+        # server traces are distinguished by their origin address)
+        origins = {t["origin"] for t in full if t["kind"] == "server"}
+        assert len(origins) == 2, full
+        # the member spans stitched the per-call flight spans too
+        assert any(c["name"].startswith("flight")
+                   for sp in members for c in sp.get("children", ()))
+    finally:
+        ds.close()
+        for s in servers:
+            s.stop()
+        locator.stop()
+
+
+# ----------------------------------------------------------------------
+# REST + dashboard surfaces
+# ----------------------------------------------------------------------
+
+def test_rest_traces_endpoint_and_dashboard():
+    from snappydata_tpu.cluster.rest import RestService
+    from snappydata_tpu.observability.stats_service import \
+        TableStatsService
+
+    s = _mk_session()
+    svc = RestService(s, TableStatsService(s.catalog), port=0).start()
+    base = f"http://{svc.host}:{svc.port}"
+    try:
+        # POST /sql mints a trace id and returns it
+        req = urllib.request.Request(
+            base + "/sql",
+            data=json.dumps({"sql": "SELECT sum(v) FROM t"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            body = json.loads(resp.read())
+        assert body["rows"] and "trace_id" in body
+        tid = body["trace_id"]
+        # the ring lists it ...
+        with urllib.request.urlopen(base + "/status/api/v1/traces",
+                                    timeout=5) as resp:
+            listing = json.loads(resp.read())
+        assert listing["tracing_enabled"] is True
+        assert any(t["trace_id"] == tid for t in listing["traces"])
+        # ... and serves the full span tree by id
+        with urllib.request.urlopen(
+                base + f"/status/api/v1/traces?trace_id={tid}",
+                timeout=5) as resp:
+            detail = json.loads(resp.read())
+        assert detail["traces"] and \
+            detail["traces"][0]["root"]["children"]
+        assert "phases_ms" in detail["traces"][0]
+        # slow view answers (empty is fine with the knob off)
+        with urllib.request.urlopen(
+                base + "/status/api/v1/traces?slow=1", timeout=5) as resp:
+            assert "slow" in json.loads(resp.read())
+        with urllib.request.urlopen(base + "/dashboard",
+                                    timeout=5) as resp:
+            html = resp.read().decode()
+        assert "Tracing" in html and tid in html
+        # /metrics/prometheus carries the histogram exposition
+        with urllib.request.urlopen(base + "/metrics/prometheus",
+                                    timeout=5) as resp:
+            prom = resp.read().decode()
+        assert "# TYPE snappy_tpu_query_seconds histogram" in prom
+        assert 'snappy_tpu_query_seconds_q{quantile="0.999"}' in prom
+    finally:
+        svc.stop()
+        s.stop()
+
+
+def test_rest_error_body_carries_trace_id():
+    from snappydata_tpu.cluster.rest import RestService
+    from snappydata_tpu.observability.stats_service import \
+        TableStatsService
+
+    s = _mk_session()
+    svc = RestService(s, TableStatsService(s.catalog), port=0).start()
+    try:
+        req = urllib.request.Request(
+            f"http://{svc.host}:{svc.port}/sql",
+            data=json.dumps(
+                {"sql": "SELECT nope FROM no_such_table"}).encode(),
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=5)
+        body = json.loads(ei.value.read())
+        assert "error" in body and body.get("trace_id")
+        # the failed request's trace landed in the ring, status=error
+        hits = tracing.ring().get(body["trace_id"])
+        assert hits and hits[0]["status"] == "error"
+    finally:
+        svc.stop()
+        s.stop()
+
+
+# ----------------------------------------------------------------------
+# serving-path + bench-guard logic
+# ----------------------------------------------------------------------
+
+def test_serving_trace_annotations():
+    s = _mk_session()
+    h = s.prepare("SELECT count(*) FROM t WHERE g = ?")
+    tracing.ring().clear()
+    h.execute((1,))
+    h.execute((2,))
+    traces = tracing.ring().traces(10)
+    kinds = [t["kind"] for t in traces]
+    assert kinds.count("serving") >= 2
+    tid = [t for t in traces if t["kind"] == "serving"][0]["trace_id"]
+    detail = tracing.ring().get(tid)[0]
+    assert detail["root"]["attrs"]["serving_registry"] == "hit"
+    s.stop()
+
+
+def test_bench_tracing_overhead_guard_logic():
+    import bench
+
+    base = {"value": 1e6, "detail": {}}
+    over = {"value": 1e6, "detail": {"tracing": {
+        "overhead_pct": 5.0, "geomean_on": 95.0, "geomean_off": 100.0}}}
+    fails = bench.check_regression(over, base)
+    assert any("tracing overhead" in f for f in fails)
+    ok = {"value": 1e6, "detail": {"tracing": {
+        "overhead_pct": 1.2, "geomean_on": 99.0, "geomean_off": 100.0}}}
+    assert not bench.check_regression(ok, base)
+    # records predating the tracing section stay comparable
+    assert not bench.check_regression(base, base)
