@@ -78,7 +78,8 @@ def check_regression(candidate: dict, baseline: dict,
                      load_tol: float = 1.0,
                      qps_tol: float = 0.5,
                      resident_tol: float = 0.25,
-                     trace_tol: float = 3.0) -> list:
+                     trace_tol: float = 3.0,
+                     htap_tol: float = 10.0) -> list:
     """Pure comparison used by `--check`: returns a list of human-readable
     failure strings (empty = no regression).  `candidate`/`baseline` are
     bench result records ({"value", "detail": {"load_s", ...}}).  The
@@ -150,6 +151,28 @@ def check_regression(candidate: dict, baseline: dict,
             f"tracing overhead {ov:.2f}% exceeds {trace_tol:.2f}% on the "
             f"stock workload geomean (on={trc.get('geomean_on')}, "
             f"off={trc.get('geomean_off')} rows/s)")
+    # --- HTAP axis (skipped on records predating it) --------------------
+    # concurrent scan+ingest is the MVCC claim: every snapshot read must
+    # be value-correct (mismatches are a hard fail, candidate-only), and
+    # the concurrent scan p50 may blow up at most htap_tol× over the
+    # serialized baseline's p50 — isolation can't silently regress into
+    # readers stalling behind the write path again (p99 stays unguarded:
+    # it legitimately absorbs a batch-bucket re-specialization)
+    ht = ((candidate.get("detail") or {}).get("htap")) or {}
+    if ht and "error" not in ht:
+        if ht.get("value_mismatches"):
+            fails.append(
+                f"htap snapshot reads diverged from the serialized "
+                f"replay ({ht['value_mismatches']} mismatches)")
+        new_p = (ht.get("concurrent") or {}).get("scan_p50_ms")
+        ser_p = (ht.get("serialized") or {}).get("scan_p50_ms")
+        if isinstance(new_p, (int, float)) and \
+                isinstance(ser_p, (int, float)) and ser_p > 0 \
+                and new_p > ser_p * htap_tol:
+            fails.append(
+                f"htap concurrent scan p50 {new_p}ms exceeds "
+                f"{htap_tol:.0f}x the serialized baseline ({ser_p}ms) — "
+                f"scans are stalling behind ingest again")
     return fails
 
 
@@ -194,7 +217,8 @@ def run_check(argv: list) -> int:
         qps_tol=float(os.environ.get("SNAPPY_BENCH_QPS_TOL", "0.5")),
         resident_tol=float(os.environ.get("SNAPPY_BENCH_RESIDENT_TOL",
                                           "0.25")),
-        trace_tol=float(os.environ.get("SNAPPY_BENCH_TRACE_TOL", "3.0")))
+        trace_tol=float(os.environ.get("SNAPPY_BENCH_TRACE_TOL", "3.0")),
+        htap_tol=float(os.environ.get("SNAPPY_BENCH_HTAP_TOL", "10.0")))
     rel = os.path.basename
     if fails:
         for f in fails:
@@ -506,6 +530,26 @@ def main() -> None:
               flush=True)
         resilience = {"resilience_error": str(e)}
 
+    # HTAP: concurrent scan+ingest on one table under MVCC snapshot
+    # pins vs the serialized schedule, value-asserted per scan
+    htap = None
+    try:
+        htap = _htap_bench()
+        print(f"bench: htap scan p50/p99 "
+              f"{htap['concurrent']['scan_p50_ms']}/"
+              f"{htap['concurrent']['scan_p99_ms']}ms concurrent vs "
+              f"{htap['serialized']['scan_p50_ms']}/"
+              f"{htap['serialized']['scan_p99_ms']}ms serialized, "
+              f"ingest {htap['concurrent']['ingest_rows_per_s']} vs "
+              f"{htap['serialized']['ingest_rows_per_s']} rows/s, "
+              f"{htap['value_mismatches']} value mismatches, "
+              f"{htap['retained_epoch_bytes_after']} retained bytes "
+              f"after drain", file=sys.stderr, flush=True)
+    except Exception as e:
+        print(f"bench: htap bench failed: {e}", file=sys.stderr,
+              flush=True)
+        htap = {"error": str(e)}
+
     ingest_rows_per_s = sink_events_per_s = durable_ingest = None
     try:   # secondary benches must not kill the headline numbers
         ingest_rows_per_s = _ingest_bench()
@@ -595,6 +639,14 @@ def main() -> None:
             # 0 (the watermark resync restored redundancy without a
             # manual restore_redundancy())
             "resilience": resilience,
+            # HTAP-axis evidence (MVCC snapshot isolation): scan p50/p99
+            # + ingest rows/s with both workloads hammering ONE table
+            # concurrently vs serialized; every concurrent scan reads a
+            # pinned epoch and is value-asserted (value_mismatches MUST
+            # be 0, guarded by --check along with a p99-blowup bound);
+            # retained_epoch_bytes_after proves retention drains once
+            # readers release
+            "htap": htap,
             "ingest_rows_per_s": ingest_rows_per_s,
             "sink_events_per_s": sink_events_per_s,
             # durable (WAL'd) ingest per wal_fsync_mode, with the fsync
@@ -925,6 +977,128 @@ def _qps_bench(n_clients: int = 8, point_rows: int = 50_000,
         # re-tokenization guard: plan-repr walks during the timed run
         # (the prepared path computes its key once at prepare)
         "plan_key_builds": delta("plan_key_builds"),
+    }
+    s.stop()
+    return out
+
+
+def _htap_bench(n_rows: int = 200_000, scans: int = 12,
+                batch_rows: int = 5000, ingest_batches: int = 24) -> dict:
+    """HTAP axis (MVCC snapshot isolation): an analytic scan stream and
+    sustained ingest hammer ONE column table, concurrently vs
+    serialized.  Every concurrent scan runs under a pinned snapshot
+    epoch and is value-asserted against the single-epoch invariant
+    (ingest batches are (0, 1.0)×batch_rows, so a consistent snapshot
+    must satisfy count == n_rows + m·batch_rows AND sum == base_sum +
+    (count − n_rows) — a scan mixing two epochs breaks the linkage).
+
+    The CONCURRENT phase runs first (scans race a bounded, paced ingest
+    budget — unbounded tight-loop ingest degenerates into measuring XLA
+    re-specialization as the batch axis doubles, not isolation); the
+    SERIALIZED phase then times the same scans alone and the same
+    ingest alone on the settled table.  --check guards
+    value_mismatches == 0 and the p50 blow-up (p99 is reported but
+    unguarded: it legitimately absorbs a batch-bucket re-specialization
+    when ingest crosses a shape boundary)."""
+    import threading
+
+    from snappydata_tpu import SnappySession
+    from snappydata_tpu.catalog import Catalog
+    from snappydata_tpu.storage import mvcc
+
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE htap (k INT, v DOUBLE) USING column")
+    ks = (np.arange(n_rows) % 16).astype(np.int32)
+    vs = (np.arange(n_rows) % 100).astype(np.float64)
+    s.catalog.describe("htap").data.insert_arrays([ks, vs])
+    base_sum = float(vs.sum())
+    scan_sql = "SELECT count(*), sum(v) FROM htap"
+    s.sql(scan_sql)   # warm the compiled plan
+    bk = np.zeros(batch_rows, dtype=np.int32)
+    bv = np.ones(batch_rows, dtype=np.float64)
+    mismatches = [0]
+
+    def one_scan(sess):
+        t0 = time.perf_counter()
+        cnt, sm = sess.sql(scan_sql).rows()[0]
+        dt = time.perf_counter() - t0
+        cnt, sm = int(cnt), float(sm)
+        extra = cnt - n_rows
+        if extra % batch_rows or abs(sm - (base_sum + extra)) > 1e-6 * max(
+                1.0, abs(sm)):
+            mismatches[0] += 1
+        return dt
+
+    def ingest_run(stop=None, pace_s=0.01):
+        """Paced ingest of the fixed budget; returns (rows, seconds of
+        actual ingest work — pacing sleeps excluded, so rows/s measures
+        the write path, not the pacing)."""
+        w = SnappySession(catalog=s.catalog)
+        work = 0.0
+        done = 0
+        for _ in range(ingest_batches):
+            if stop is not None and stop.is_set():
+                break
+            t0 = time.perf_counter()
+            w.insert_arrays("htap", [bk, bv])
+            work += time.perf_counter() - t0
+            done += batch_rows
+            if pace_s:
+                time.sleep(pace_s)
+        return done, work
+
+    def pcts(times):
+        times = sorted(times)
+        return (round(times[len(times) // 2] * 1e3, 3),
+                round(times[min(len(times) - 1,
+                               int(len(times) * 0.99))] * 1e3, 3))
+
+    # ---- concurrent: scans race the paced ingest budget ---------------
+    stop = threading.Event()
+    ing_out = {}
+
+    def ingest_thread():
+        rows, work = ingest_run(stop=stop)
+        ing_out["rows"], ing_out["work_s"] = rows, work
+
+    th = threading.Thread(target=ingest_thread, daemon=True)
+    th.start()
+    conc_times = [one_scan(s) for _ in range(scans)]
+    # signal BEFORE joining: a slow machine's paced ingest must stop at
+    # the scans' end, not keep running into the serialized baseline
+    # (which would inflate it and soften the p50 guard)
+    stop.set()
+    th.join(timeout=120)
+    p50c, p99c = pcts(conc_times)
+    concurrent = {
+        "scan_p50_ms": p50c, "scan_p99_ms": p99c,
+        "ingest_rows_per_s": round(
+            ing_out.get("rows", 0) / max(ing_out.get("work_s", 0), 1e-9),
+            1),
+        "ingested_rows": ing_out.get("rows", 0),
+    }
+    # ---- serialized baseline: same scans alone, same ingest alone -----
+    ser_times = [one_scan(s) for _ in range(scans)]
+    rows, work = ingest_run()
+    p50s, p99s = pcts(ser_times)
+    serialized = {
+        "scan_p50_ms": p50s, "scan_p99_ms": p99s,
+        "ingest_rows_per_s": round(rows / max(work, 1e-9), 1),
+        "ingested_rows": rows,
+    }
+    data = s.catalog.describe("htap").data
+    mvcc.trim_unpinned([("htap", data)])
+    retained_after = mvcc.retained_bytes_of(data)
+    out = {
+        "rows": n_rows,
+        "scans": scans,
+        "batch_rows": batch_rows,
+        "serialized": serialized,
+        "concurrent": concurrent,
+        "value_mismatches": mismatches[0],
+        # bounded-retention evidence: after readers drain (and the trim
+        # the degradation ladder would run), old epochs hold no bytes
+        "retained_epoch_bytes_after": int(retained_after),
     }
     s.stop()
     return out

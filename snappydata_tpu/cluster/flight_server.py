@@ -263,7 +263,11 @@ def iter_table_chunks(sess, table: str):
             yield res
         return
     data = info.data
-    manifest = data.snapshot()
+    from snappydata_tpu.storage import mvcc
+
+    # one manifest for the whole stream (per-unit consistency) — the
+    # ambient pinned epoch when a snapshot-pinned statement streams
+    manifest = mvcc.snapshot_of(data)
     for view in manifest.views:
         live = view.live_mask()
         n = int(live.sum())

@@ -157,6 +157,20 @@ def _render_dashboard(svc) -> str:
     rows_mvc = "".join(
         f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
         for k, v in mv.items() if k != "views")
+    from snappydata_tpu.observability.stats_service import mvcc_snapshot
+
+    mvc = mvcc_snapshot(svc.session.catalog)
+    mvcc_tables = mvc.pop("tables", {})
+    rows_mvcc = "".join(
+        f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
+        for k, v in mvc.items())
+    rows_mvcct = "".join(
+        f"<tr><td>{esc(str(name))}</td><td>{t['version']}</td>"
+        f"<td>{t['epoch']}</td><td>{t['wal_seq']}</td>"
+        f"<td>{len(t['retained_epochs'])}</td>"
+        f"<td>{sum(e['pins'] for e in t['retained_epochs'])}</td>"
+        f"<td>{t['retained_bytes']:,}</td></tr>"
+        for name, t in sorted(mvcc_tables.items()))
     from snappydata_tpu.serving import serving_snapshot
 
     sv = serving_snapshot(svc.session.catalog)
@@ -222,6 +236,11 @@ tiled scans)</h2>
 <table>{rows_sv}</table>
 <table><tr><th>prepared sql</th><th>params</th><th>executes</th>
 <th>mode</th></tr>{rows_svh}</table>
+<h2>Snapshot isolation (MVCC epochs / pins / retained bytes)</h2>
+<table>{rows_mvcc}</table>
+<table><tr><th>table</th><th>version</th><th>epoch</th><th>commit seq</th>
+<th>retained epochs</th><th>pins</th><th>retained bytes</th></tr>
+{rows_mvcct}</table>
 <h2>Materialized views ({len(mv["views"])})</h2>
 <table><tr><th>view</th><th>base</th><th>groups</th><th>state bytes</th>
 <th>freshness</th><th>delta folds</th><th>rows folded</th>
@@ -353,6 +372,14 @@ class RestService:
                     from snappydata_tpu.views import view_snapshot
 
                     self._send(view_snapshot(svc.session.catalog))
+                elif path == "/status/api/v1/mvcc":
+                    # snapshot-isolation stats: epoch clock, active pins,
+                    # per-table version vector + retained-epoch list and
+                    # bytes — what readers can rely on, as numbers
+                    from snappydata_tpu.observability.stats_service import \
+                        mvcc_snapshot
+
+                    self._send(mvcc_snapshot(svc.session.catalog))
                 elif path == "/status/api/v1/streaming":
                     # streaming query progress (ref: the structured-
                     # streaming UI tab / StreamingQueryManager.active);

@@ -293,6 +293,23 @@ class Properties:
     # 0 = disabled.
     slow_query_ms: float = 0.0
 
+    # MVCC snapshot isolation (storage/mvcc.py; ref: the reference's
+    # snapshot-isolation transactions around store writes,
+    # JDBCSourceAsColumnarStore beginTx/commitTx).  Every statement pins
+    # ONE consistent cross-table storage epoch at start — long scans and
+    # sustained ingest proceed concurrently, neither blocking the other,
+    # and a query's reads (binds, host fallbacks, tile passes, matview
+    # syncs, subqueries) all traverse that epoch.  snapshot_isolation=
+    # False restores live-manifest reads (each bind sees the newest
+    # committed state; statements no longer pin).
+    snapshot_isolation: bool = True
+    # Unpinned manifest history retained per table beyond active pins
+    # (observability + pins racing a publish); pinned epochs are always
+    # retained until released.  The degradation ladder trims unpinned
+    # retained epochs first; retained bytes ride the broker ledger as
+    # `retained_epoch_bytes`.
+    mvcc_retained_epochs: int = 2
+
     # Streaming (ref: SnappySinkCallback.scala:49-360)
     sink_state_table: str = "snappysys_internal____sink_state_table"
     sink_max_retries: int = 3

@@ -196,6 +196,7 @@ def _row_table_device(info, used):
     The built DeviceTable is cached per (mutation version, mesh, columns):
     rebuilding the string-code lookup of the whole table on EVERY bind was
     O(table) host work per query (round-1 weak finding)."""
+    from snappydata_tpu.storage import mvcc
     from snappydata_tpu.storage.device import DeviceTable
     from snappydata_tpu.parallel.mesh import MeshContext
 
@@ -203,7 +204,17 @@ def _row_table_device(info, used):
     cache = getattr(info.data, "_device_cache", None)
     if cache is None:
         cache = info.data._device_cache = {}
-    key = (info.data.version, ctx.token if ctx else None, tuple(used))
+    # a pinned statement reads its captured host snapshot (row tables
+    # mutate in place) and keys the cache by the CAPTURED version — the
+    # version the arrays actually reflect, not whatever is live now;
+    # unpinned binds keep the cheap hit path (no host materialization)
+    pin = mvcc.current_pin()
+    if pin is not None:
+        arrays, row_masks, n, ver = pin.row_snapshot(info.data)
+    else:
+        arrays = None
+        ver = info.data.version
+    key = (ver, ctx.token if ctx else None, tuple(used))
     hit = cache.get(key)
     if hit is not None:
         return hit
@@ -213,7 +224,8 @@ def _row_table_device(info, used):
             return jnp.asarray(host_array)
         return jax.device_put(host_array, ctx.replicated)
 
-    arrays, row_masks, n = info.data.to_arrays_with_nulls()
+    if arrays is None:
+        arrays, row_masks, n = info.data.to_arrays_with_nulls()
     cap = max(1, n)
     cols = {}
     dicts = {}
@@ -249,8 +261,15 @@ def _row_table_device(info, used):
                      {}, {}, n, nulls)
     from snappydata_tpu.storage.device import _cache_budget
 
-    for k in [k for k in cache if k[0] != key[0]]:
-        cache.pop(k, None)   # old-version entries are dead
+    _pinned_vers = mvcc.pinned_row_versions(info.data)
+    _live_ver = info.data.version
+    for k in [k for k in cache
+              if k[0] != key[0] and k[0] != _live_ver
+              and k[0] not in _pinned_vers]:
+        # old-version entries are dead — unless pinned, or the LIVE
+        # version (a pinned bind at an older capture must not evict the
+        # entry concurrent unpinned traffic is hitting)
+        cache.pop(k, None)
         _cache_budget.forget(cache, k)
     cache[key] = dt
     if _cache_budget.enabled():
@@ -751,11 +770,12 @@ def _strategy_token(props) -> int:
 
 
 def _row_count_of(info) -> int:
+    from snappydata_tpu.storage import mvcc
     from snappydata_tpu.storage.table_store import RowTableData
 
     if isinstance(info.data, RowTableData):
         return info.data.count()
-    return info.data.snapshot().total_rows()
+    return mvcc.snapshot_of(info.data).total_rows()
 
 
 def _join_reject(reason: str, msg: str) -> None:
@@ -814,11 +834,19 @@ def _require_f64_exact_int_key(info, ordinal: int) -> None:
     reroute to the exact host join."""
     import weakref
 
+    from snappydata_tpu.storage import mvcc
     from snappydata_tpu.storage.table_store import RowTableData
 
     data = info.data
-    ver = data.version if isinstance(data, RowTableData) \
-        else data.snapshot().version
+    if isinstance(data, RowTableData):
+        # version only: the pin's captured version when pinned, else the
+        # live attribute — row_snapshot_of would MATERIALIZE the whole
+        # table on the unpinned path just to read an int
+        pin = mvcc.current_pin()
+        ver = pin.row_snapshot(data)[3] if pin is not None \
+            else data.version
+    else:
+        ver = mvcc.snapshot_of(data).version
     key = (id(data), ver, ordinal)
     ok = None
     entry = _absmax_cache.get(key)
@@ -847,13 +875,14 @@ def _require_f64_exact_int_key(info, ordinal: int) -> None:
 
 
 def _host_key_columns(info, ordinals: Tuple[int, ...]) -> List[np.ndarray]:
+    from snappydata_tpu.storage import mvcc
     from snappydata_tpu.storage.table_store import RowTableData
 
     data = info.data
     if isinstance(data, RowTableData):
-        arrays, _, n = data.to_arrays_with_nulls()
+        arrays, _, n, _ver = mvcc.row_snapshot_of(data)
         return [np.asarray(arrays[i])[:n] for i in ordinals]
-    m = data.snapshot()
+    m = mvcc.snapshot_of(data)
     out = []
     for i in ordinals:
         name = info.schema.fields[i].name
@@ -3329,7 +3358,9 @@ class Executor:
                 if isinstance(x, ast.Func) and x.name in ast.AGG_FUNCS:
                     return None
         data = info.data
-        m = data.snapshot()
+        from snappydata_tpu.storage import mvcc
+
+        m = mvcc.snapshot_of(data)
         schema = info.schema
         if proj is not None:
             names = [_expr_name(e) for e in proj.exprs]

@@ -1134,7 +1134,11 @@ def _eval_rel(plan: ast.Plan, params, executor):
         from snappydata_tpu.storage.table_store import RowTableData
 
         if isinstance(info.data, RowTableData):
-            arrays, col_nulls, cnt = info.data.to_arrays_with_nulls()
+            from snappydata_tpu.storage import mvcc
+
+            # pinned statements read their captured host snapshot (row
+            # tables mutate in place; repeatable reads within the query)
+            arrays, col_nulls, cnt, _ver = mvcc.row_snapshot_of(info.data)
             cols = [np.asarray(a) for a in arrays]
         else:
             from snappydata_tpu.resource.context import check_current

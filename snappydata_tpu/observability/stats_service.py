@@ -214,6 +214,56 @@ def join_snapshot() -> dict:
     }
 
 
+def mvcc_snapshot(catalog=None) -> dict:
+    """Snapshot-isolation stats for `/status/api/v1/mvcc` and the
+    dashboard's MVCC section: the epoch clock, active pins, per-table
+    version vector (current version/epoch/commit-seq + the retained-
+    epoch list with pin counts and bytes), and the pin/conflict/trim
+    counters every isolation claim is observable through."""
+    from snappydata_tpu import config
+    from snappydata_tpu.storage import mvcc
+
+    snap = global_registry().snapshot()
+    c = snap["counters"]
+    out = {
+        "enabled": bool(config.global_properties().get(
+            "snapshot_isolation", True)),
+        "retained_epochs_max": config.global_properties().get(
+            "mvcc_retained_epochs"),
+        "current_epoch": mvcc.current_epoch(),
+        "active_pins": mvcc.active_pin_count(),
+        "pins": c.get("mvcc_pins", 0),
+        "pin_releases": c.get("mvcc_pin_releases", 0),
+        "repins": c.get("mvcc_repins", 0),
+        "ddl_conflicts": c.get("mvcc_ddl_conflicts", 0),
+        "epoch_trims": c.get("mvcc_epoch_trims", 0),
+        "view_pending_folds": c.get("view_pending_folds", 0),
+        "view_pending_replays": c.get("view_pending_replays", 0),
+        "retained_epoch_bytes": 0,
+        "tables": {},
+    }
+    if catalog is not None:
+        for info in catalog.list_tables():
+            data = info.data
+            if not hasattr(data, "_manifest"):
+                continue
+            try:
+                m = data.snapshot()
+                epochs = mvcc.retained_epochs_of(data)
+            except Exception:
+                continue
+            retained_bytes = sum(e["bytes"] for e in epochs)
+            out["retained_epoch_bytes"] += retained_bytes
+            out["tables"][info.name] = {
+                "version": int(m.version),
+                "epoch": int(getattr(m, "epoch", 0)),
+                "wal_seq": int(getattr(m, "wal_seq", 0)),
+                "retained_epochs": epochs,
+                "retained_bytes": retained_bytes,
+            }
+    return out
+
+
 def ha_snapshot(catalog=None, distributed=None) -> dict:
     """End-to-end request-reliability stats for `/status/api/v1/ha` and
     the dashboard's High-availability section: failovers, hedged reads,

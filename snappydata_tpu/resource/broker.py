@@ -171,13 +171,18 @@ class ResourceBroker:
         join_bytes = join_build_cache_nbytes()
         view_bytes = matview_state_nbytes()
         serving_bytes = serving_registry_nbytes()
+        from snappydata_tpu.storage.mvcc import \
+            retained_epoch_bytes_by_table
+
+        retained = retained_epoch_bytes_by_table(tables)
+        retained_total = sum(retained.values())
         with self._cond:
             queries = {qid: int(ctx.estimate_bytes)
                        for qid, ctx in self._active.items()}
         # this walk IS the measurement — refresh the gauge cache so a
         # metrics scrape right after a ledger read can't serve a value
         # staler than the ledger it's compared against
-        host_total = sum(host.values()) + serving_bytes
+        host_total = sum(host.values()) + serving_bytes + retained_total
         device_total = sum(device.values()) + gidx_bytes + join_bytes \
             + view_bytes
         self._measured_cache = (time.monotonic(), host_total, device_total)
@@ -199,6 +204,13 @@ class ResourceBroker:
             "gidx_cache_bytes": gidx_bytes,
             "join_build_cache_bytes": join_bytes,
             "matview_state_bytes": view_bytes,
+            # MVCC retained epochs (storage/mvcc): host bytes old
+            # manifests hold beyond the current one — row-buffer
+            # snapshot copies + diverged delete/update deltas — while
+            # pinned readers (or the short unpinned history) keep them
+            # alive; trimmed by the degradation ladder, drains to ~0
+            # once readers release
+            "retained_epoch_bytes": retained_total,
             "device_total": device_total,
             "queries": queries,
             "inflight_bytes": int(self._inflight_bytes),
@@ -219,9 +231,13 @@ class ResourceBroker:
         from snappydata_tpu.serving import serving_registry_nbytes
         from snappydata_tpu.views.matview import matview_state_nbytes
 
+        from snappydata_tpu.storage.mvcc import \
+            retained_epoch_bytes_by_table
+
         tables = self._iter_tables()
         host = sum(_host_table_bytes(d) for _, d in tables) \
-            + serving_registry_nbytes()
+            + serving_registry_nbytes() \
+            + sum(retained_epoch_bytes_by_table(tables).values())
         device = sum(device_cache_bytes_by_table(tables).values()) \
             + gidx_cache_nbytes() + join_build_cache_nbytes() \
             + matview_state_nbytes()
@@ -401,6 +417,16 @@ class ResourceBroker:
 
         if evict_all_states():
             reg.inc("governor_degrade_view_evictions")
+        host, device = self.measured_bytes()
+        if host + device <= target_bytes:
+            return
+        # trim MVCC retained epochs nobody pins (and stale device-cache
+        # plates of old versions) — cheaper than spilling hot batches;
+        # pinned epochs are untouchable mid-scan by design
+        from snappydata_tpu.storage import mvcc
+
+        if mvcc.trim_unpinned(self._iter_tables()):
+            reg.inc("governor_degrade_epoch_trims")
         host, device = self.measured_bytes()
         if host + device <= target_bytes:
             return

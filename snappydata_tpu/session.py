@@ -284,6 +284,22 @@ class SnappySession:
             # reach the WAL (replay runs as admin and would apply it);
             # non-journaled paths authorize once in execute_statement
             self._authorize(stmt)
+            import contextlib as _ctx
+
+            ddl_gate = _ctx.nullcontext()
+            if isinstance(stmt, ast.AlterTable) and not stmt.add:
+                # DROP COLUMN vs an active pinned snapshot raises a typed
+                # 40001 — the gate is entered BEFORE journaling (the WAL
+                # must never hold a statement that did not apply: replay
+                # would run it) and HELD across journal+apply, so a pin
+                # admitted between check and remap can't make the 40001
+                # fire post-append and diverge the log from memory
+                from snappydata_tpu.storage import mvcc as _mvcc
+
+                info = self.catalog.lookup_table(stmt.table)
+                if info is not None:
+                    ddl_gate = _mvcc.ddl_scope(
+                        info.data, "ALTER TABLE DROP COLUMN")
             # journal BEFORE applying, under the mutation lock shared with
             # checkpoints (WAL invariant: on-disk log ≥ in-memory state)
             table = getattr(stmt, "table", None) or stmt.name
@@ -296,11 +312,16 @@ class SnappySession:
             from snappydata_tpu.reliability import current_stmt_id
 
             sid = current_stmt_id()
-            with ds.mutation_lock:
+            from snappydata_tpu.storage import mvcc
+
+            with ddl_gate, ds.mutation_lock:
                 seq = ds.wal_append(_norm(table), "sql", sql=sql_text,
                                     params=tuple(params),
                                     extra={"stmt_id": sid} if sid else None)
-                result = self.execute_statement(stmt, tuple(params))
+                # the WAL seq IS the commit timestamp: manifests this
+                # statement publishes carry it (mvcc epoch fences)
+                with mvcc.commit_scope(seq):
+                    result = self.execute_statement(stmt, tuple(params))
             # ack gate (group commit): the record may still sit in the
             # commit buffer — wal_sync blocks until the covering fsync,
             # OUTSIDE the mutation lock so concurrent committers coalesce
@@ -461,7 +482,44 @@ class SnappySession:
         finally:
             broker.release(ctx)
 
+    def _snapshot_tables_for(self, stmt: ast.Statement):
+        """Tables a statement's READS should pin at one consistent epoch
+        (storage/mvcc): the query plan's relations, a CTAS source, an
+        INSERT ... SELECT source, and UPDATE/DELETE WHERE-subquery
+        relations.  None = statement has no snapshot-shaped reads."""
+        if isinstance(stmt, ast.Query):
+            return _referenced_tables(stmt.plan)
+        if isinstance(stmt, ast.CreateTable) and stmt.as_select is not None:
+            return _referenced_tables(stmt.as_select)
+        if isinstance(stmt, ast.InsertInto) \
+                and not isinstance(stmt.source, ast.Values):
+            return _referenced_tables(stmt.source) or None
+        if isinstance(stmt, ast.UpdateStmt):
+            names = []
+            for e in [stmt.where] + [x for _, x in stmt.assignments]:
+                if e is not None:
+                    names.extend(_expr_subquery_tables(e))
+            return names or None
+        if isinstance(stmt, ast.DeleteStmt) and stmt.where is not None:
+            return _expr_subquery_tables(stmt.where) or None
+        return None
+
     def execute_statement(self, stmt: ast.Statement, user_params=()) -> Result:
+        """Statement entry: reads pin ONE snapshot epoch for the whole
+        statement (matview syncs, subquery rewrites, tile passes and
+        host fallbacks all traverse it), so a long scan and concurrent
+        ingest never block each other and never mix table versions.
+        Nested executions find the ambient pin and extend it."""
+        from snappydata_tpu.storage import mvcc
+
+        names = self._snapshot_tables_for(stmt)
+        if names is not None and mvcc.current_pin() is None:
+            with mvcc.pinned_scope(self.catalog, names):
+                return self._execute_statement_body(stmt, user_params)
+        return self._execute_statement_body(stmt, user_params)
+
+    def _execute_statement_body(self, stmt: ast.Statement,
+                                user_params=()) -> Result:
         self._authorize(stmt)
         if isinstance(stmt, ast.Query):
             # materialized views referenced by the query re-merge their
@@ -1209,6 +1267,7 @@ class SnappySession:
         device-resident builds would under-admit by whole tables."""
         if not build_infos:
             return 0
+        from snappydata_tpu.storage import mvcc
         from snappydata_tpu.storage.table_store import RowTableData
 
         used = {c.name.lower() for e in exprs for c in ast.walk(e)
@@ -1216,7 +1275,7 @@ class SnappySession:
         total = 0
         for bi in build_infos:
             rows = bi.data.count() if isinstance(bi.data, RowTableData) \
-                else bi.data.snapshot().total_rows()
+                else mvcc.snapshot_of(bi.data).total_rows()
             w = 1
             for f in bi.schema.fields:
                 cw = self._decoded_col_width(f)
@@ -1248,10 +1307,14 @@ class SnappySession:
         outer, having, node, info, exprs, build_infos = shaped
         data = info.data
 
+        from snappydata_tpu.storage import mvcc
         from snappydata_tpu.storage.device import (scan_unit_count,
                                                    scan_window)
 
-        manifest = data.snapshot()
+        # the tile pass pins ONE manifest across every window — read it
+        # through the statement's ambient pin so a tiled aggregate and
+        # an untiled one see the same epoch
+        manifest = mvcc.snapshot_of(data)
         units = scan_unit_count(data, manifest)
         if units <= 1:
             return None
@@ -1758,11 +1821,13 @@ class SnappySession:
         from snappydata_tpu.reliability import current_stmt_id
 
         sid = current_stmt_id()
+        from snappydata_tpu.storage import mvcc
+
         with ds.mutation_lock:
             seq = ds.wal_append(info.name, kind, arrays=arrays,
                                 nulls=nulls,
                                 extra={"stmt_id": sid} if sid else None)
-            with _mv.managed_base_write():
+            with mvcc.commit_scope(seq), _mv.managed_base_write():
                 out = apply_fn()
         ds.wal_sync(seq, force=sync_force)
         return out
@@ -1854,10 +1919,13 @@ class SnappySession:
         extra = {"key_columns": list(key_columns)}
         if current_stmt_id():
             extra["stmt_id"] = current_stmt_id()
+        from snappydata_tpu.storage import mvcc
+
         with self.disk_store.mutation_lock:
             seq = self.disk_store.wal_append(
                 info.name, "delete_keys", arrays=key_arrays, extra=extra)
-            out = apply()
+            with mvcc.commit_scope(seq):
+                out = apply()
         self.disk_store.wal_sync(seq)   # ack after the covering fsync
         return out
 

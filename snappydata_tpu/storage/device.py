@@ -81,7 +81,9 @@ def scan_window_active() -> bool:
 def scan_unit_count(data, manifest=None) -> int:
     """Number of bindable units (column batches + row-buffer chunks)."""
     if manifest is None:
-        manifest = data.snapshot()
+        from snappydata_tpu.storage import mvcc
+
+        manifest = mvcc.snapshot_of(data)
     n_chunks = -(-manifest.row_count // data.capacity) \
         if manifest.row_count > 0 else 0
     return len(manifest.views) + n_chunks
@@ -190,10 +192,18 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
     cache = data._device_cache.setdefault(cache_key, {})
     # prune stale versions AND stale mesh placements (keep only this exact
     # placement + the previous version of it) so a loop that recreates
-    # meshes doesn't pin duplicate device copies of every column
+    # meshes doesn't pin duplicate device copies of every column —
+    # EXCEPT versions an active snapshot pin holds: a long pinned scan
+    # re-binding its (old) epoch per tile must not have its plates
+    # evicted by concurrent ingest binding newer versions (the
+    # degradation ladder can still trim them via mvcc.trim_unpinned)
+    from snappydata_tpu.storage import mvcc as _mvcc
+
+    _pinned_vers = _mvcc.pinned_versions(data)
     for k in [k for k in data._device_cache
-              if k != cache_key and not (k[1] == cache_key[1]
-                                         and k[0] >= manifest.version - 1)]:
+              if k != cache_key and k[0] not in _pinned_vers
+              and not (k[1] == cache_key[1]
+                       and k[0] >= manifest.version - 1)]:
         data._device_cache.pop(k, None)
         _cache_budget.forget(data._device_cache, k)
     if window is not None and not _cache_budget.enabled():
@@ -891,7 +901,13 @@ def _scan_units(data, manifest=None):
         if wentry[2] is not None:
             manifest = wentry[2]
     if manifest is None:
-        manifest = data.snapshot()
+        # the ambient pinned snapshot (storage/mvcc): EVERY read this
+        # contract serves — device bind, host fallback, LIMIT-n scan —
+        # resolves the statement's pinned epoch, so concurrent ingest
+        # publishing new manifests never changes a query mid-flight
+        from snappydata_tpu.storage import mvcc
+
+        manifest = mvcc.snapshot_of(data)
     # (wentry[3], when present, is the pass's nominal tile width — used
     # only by current_scan_scale, never for unit slicing)
     views = list(manifest.views)

@@ -1,0 +1,715 @@
+"""MVCC snapshot-isolation epochs: versioned storage that decouples
+scans from ingest.
+
+The reference runs snapshot-isolation transactions around its store
+writes (JDBCSourceAsColumnarStore beginTx/commitTx); here the storage
+layer is already MVCC-shaped — batches are write-once, mutations are
+delta'd, and every committed write publishes a fresh immutable
+``Manifest`` — so snapshot isolation is a thin layer over what exists:
+
+- **Epoch clock**: a process-wide monotone counter.  Every manifest
+  publish stamps the next epoch (and, on durable sessions, the WAL seq
+  of the committing statement — the commit timestamp).  Recovery seeds
+  the clock past the checkpoint/WAL fences so the vector stays
+  monotone across restarts.
+
+- **Pins**: a query pins ONE consistent cross-table cut at statement
+  start (``pinned_scope``).  The cut is atomic — publishes swap their
+  manifest under the same clock lock the pin capture holds — so a join
+  over two tables can never see table A before a commit and table B
+  after it.  Tables the statement discovers later (view expansions,
+  matview backing tables re-written by sync, scratch tables) extend
+  the pin at first read.  Row tables, which mutate in place, are
+  captured as host-array snapshots at first read (repeatable reads
+  within the statement).
+
+- **Reads**: every scan-shaped read goes through ``snapshot_of`` /
+  ``row_snapshot_of`` — the device bind (`storage/device._scan_units`),
+  the host fallback, the LIMIT-n early-stop scan, join key encodes and
+  the tiled-aggregate pass all resolve the pinned manifest instead of
+  the live one.  The gidx/join/build caches need no changes: their
+  bind-identity keys already version by the manifest's ``valid`` array,
+  which differs per pinned version.
+
+- **Retention**: a pinned manifest is kept alive by refcounts
+  (``data._retained_epochs``); on top of pins a short unpinned history
+  (``mvcc_retained_epochs``) is retained for observability.  Retained
+  bytes ride the resource broker's ledger (``retained_epoch_bytes``)
+  and the degradation ladder trims the oldest unpinned epochs (and
+  their stale device-cache plates) under memory pressure.
+
+- **Writers never wait on readers**: ingest, DML and compaction publish
+  new manifests without holding ``mutation_lock`` across any scan; the
+  one remaining read-under-mutation-lock (matview ``refresh_full``)
+  was rebuilt on top of pins + a pending-fold journal (views/matview).
+
+DDL that would mutate state a pinned reader is traversing IN PLACE
+(``DROP COLUMN`` remaps dictionaries and shifts ordinals) raises a
+typed ``SnapshotConflictError`` (SQLSTATE 40001) while pins are
+active; TRUNCATE/ADD COLUMN/DROP TABLE bump the epoch cleanly —
+pinned readers keep their immutable manifests.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+class SnapshotConflictError(RuntimeError):
+    """DDL raced an active pinned snapshot in a way MVCC cannot make
+    safe (in-place dictionary remap / ordinal shift).  SQLSTATE 40001
+    (serialization failure) — the client retries once readers drain."""
+
+    sqlstate = "40001"
+
+    def __init__(self, msg: str):
+        super().__init__(f"{msg} [SQLSTATE {self.sqlstate}]")
+
+
+# --------------------------------------------------------------------------
+# epoch clock
+# --------------------------------------------------------------------------
+
+# One lock orders everything cheap: epoch bumps, manifest swaps
+# (ColumnTableData._publish takes it around the reference swap), pin
+# capture, and retention refcounts.  Nothing slow ever runs under it —
+# that is the whole point of the subsystem.
+_clock_lock = threading.RLock()
+_epoch = [0]
+
+
+def clock():
+    """The shared epoch lock (context manager).  ``_publish`` swaps its
+    manifest reference under it so pin captures are atomic cuts."""
+    return _clock_lock
+
+
+def current_epoch() -> int:
+    return _epoch[0]
+
+
+def _bump_epoch_locked() -> int:
+    _epoch[0] += 1
+    return _epoch[0]
+
+
+def advance_to(seq: int) -> None:
+    """Recovery: resume the clock past a checkpoint/WAL fence so
+    post-recovery epochs stay monotone with pre-crash ones."""
+    with _clock_lock:
+        if int(seq) > _epoch[0]:
+            _epoch[0] = int(seq)
+
+
+# WAL seq of the committing statement, set by the session's journal
+# paths (and WAL replay) around apply — ``_publish`` stamps it on the
+# manifest as the commit timestamp.
+_commit_seq: contextvars.ContextVar = contextvars.ContextVar(
+    "mvcc_commit_seq", default=0)
+
+
+@contextlib.contextmanager
+def commit_scope(seq: int):
+    tok = _commit_seq.set(int(seq))
+    try:
+        yield
+    finally:
+        _commit_seq.reset(tok)
+
+
+def current_commit_seq() -> int:
+    return _commit_seq.get()
+
+
+def enabled() -> bool:
+    from snappydata_tpu import config
+
+    return bool(config.global_properties().get("snapshot_isolation", True))
+
+
+def _retain_cap() -> int:
+    from snappydata_tpu import config
+
+    try:
+        return max(0, int(config.global_properties().get(
+            "mvcc_retained_epochs", 2)))
+    except (TypeError, ValueError):
+        return 2
+
+
+def _reg():
+    from snappydata_tpu.observability.metrics import global_registry
+
+    return global_registry()
+
+
+# --------------------------------------------------------------------------
+# publish-side hooks (called by ColumnTableData._publish under clock())
+# --------------------------------------------------------------------------
+
+def retain_locked(data, old_manifest) -> None:
+    """Move the just-superseded manifest into the table's retained-epoch
+    list.  Pinned versions stay for as long as any pin holds them; on
+    top of that the most recent ``mvcc_retained_epochs`` unpinned
+    manifests are kept (observability / short pins racing the publish).
+    Caller holds the clock lock."""
+    retained = getattr(data, "_retained_epochs", None)
+    if retained is None:
+        retained = data._retained_epochs = {}
+    retained[int(old_manifest.version)] = old_manifest
+    _trim_retained_locked(data)
+
+
+def _trim_retained_locked(data, keep_unpinned: Optional[int] = None) -> int:
+    retained = getattr(data, "_retained_epochs", None)
+    if not retained:
+        return 0
+    pins = getattr(data, "_pin_counts", {})
+    cap = _retain_cap() if keep_unpinned is None else keep_unpinned
+    unpinned = sorted(v for v in retained if v not in pins)
+    dropped = 0
+    for v in unpinned[:max(0, len(unpinned) - cap)]:
+        retained.pop(v, None)
+        dropped += 1
+    return dropped
+
+
+# --------------------------------------------------------------------------
+# pin refcounts
+# --------------------------------------------------------------------------
+
+def _ref_locked(data, manifest) -> None:
+    counts = getattr(data, "_pin_counts", None)
+    if counts is None:
+        counts = data._pin_counts = {}
+    v = int(manifest.version)
+    counts[v] = counts.get(v, 0) + 1
+    retained = getattr(data, "_retained_epochs", None)
+    if retained is None:
+        retained = data._retained_epochs = {}
+    retained.setdefault(v, manifest)
+
+
+def _unref(data, manifest) -> None:
+    with _clock_lock:
+        counts = getattr(data, "_pin_counts", None)
+        if not counts:
+            return
+        v = int(manifest.version)
+        n = counts.get(v, 0) - 1
+        if n > 0:
+            counts[v] = n
+            return
+        counts.pop(v, None)
+        # an unpinned retained epoch survives only inside the history cap
+        _trim_retained_locked(data)
+
+
+def _ref_row_locked(data, version: int) -> None:
+    counts = getattr(data, "_row_pin_counts", None)
+    if counts is None:
+        counts = data._row_pin_counts = {}
+    counts[int(version)] = counts.get(int(version), 0) + 1
+
+
+def _unref_row(data, version: int) -> None:
+    with _clock_lock:
+        counts = getattr(data, "_row_pin_counts", None)
+        if not counts:
+            return
+        v = int(version)
+        n = counts.get(v, 0) - 1
+        if n > 0:
+            counts[v] = n
+        else:
+            counts.pop(v, None)
+            # the shared host-snapshot of a now-unpinned old version is
+            # dead weight (the current version re-captures on demand)
+            cache = getattr(data, "_row_snapshot_cache", None)
+            if cache is not None and v != int(getattr(data, "version", v)):
+                cache.pop(v, None)
+
+
+def _captured_row_arrays(data) -> Tuple[list, list, int, int]:
+    """(arrays, null masks, n, version): the host materialization of a
+    row table at its current version, shared through a per-version
+    cache on the data object.  Consumers treat captured arrays as
+    read-only — the same discipline sharing within one pinned statement
+    already requires.  Row tables mutate IN PLACE, so without this
+    every pinned statement would pay an O(table) Python-loop conversion
+    per bind even when the device cache is warm."""
+    cache = getattr(data, "_row_snapshot_cache", None)
+    if cache is None:
+        cache = data._row_snapshot_cache = {}
+    ver = int(data.version)
+    got = cache.get(ver)
+    if got is not None:
+        return got[0], got[1], got[2], ver
+    arrays, masks, n = data.to_arrays_with_nulls()
+    if int(data.version) != ver:
+        # a mutation raced the copy: serve it privately, never cache
+        return arrays, masks, n, ver
+    with _clock_lock:
+        cache[ver] = (arrays, masks, n)
+        pinned = getattr(data, "_row_pin_counts", {})
+        for v in [v for v in cache if v != ver and v not in pinned]:
+            cache.pop(v, None)
+    return arrays, masks, n, ver
+
+
+def pinned_versions(data) -> frozenset:
+    """Manifest versions some active pin holds on `data` — the device
+    cache must not prune their entries mid-scan."""
+    counts = getattr(data, "_pin_counts", None)
+    if not counts:
+        return frozenset()
+    with _clock_lock:   # snapshot under the lock refs mutate beneath
+        return frozenset(counts)
+
+
+def pinned_row_versions(data) -> frozenset:
+    counts = getattr(data, "_row_pin_counts", None)
+    if not counts:
+        return frozenset()
+    with _clock_lock:
+        return frozenset(counts)
+
+
+def has_pins(data) -> bool:
+    return bool(getattr(data, "_pin_counts", None)) \
+        or bool(getattr(data, "_row_pin_counts", None))
+
+
+def _check_pins_locked(data, what: str) -> None:
+    if has_pins(data):
+        _reg().inc("mvcc_ddl_conflicts")
+        raise SnapshotConflictError(
+            f"{what} conflicts with an active pinned snapshot "
+            f"(a concurrent query is reading this table); retry when "
+            f"readers drain")
+
+
+def check_ddl(data, what: str) -> None:
+    """Early (pre-WAL) gate for DDL that mutates storage state IN PLACE
+    (dictionary remaps, ordinal shifts): refuse with a typed retryable
+    error while any pinned snapshot could be traversing the old layout.
+    DDL that publishes a fresh manifest (TRUNCATE, ADD COLUMN, DROP
+    TABLE) needs no gate — pinned readers keep their immutable epoch.
+    The mutation itself must run under ``ddl_scope``, which re-checks
+    AND blocks new pins for its duration — a bare check alone leaves a
+    check-then-mutate window where a pin admitted mid-remap would
+    traverse half-shifted state."""
+    with _clock_lock:
+        _check_pins_locked(data, what)
+
+
+def _ddl_gate_locked(data) -> None:
+    """Pin-capture side of the DDL fence (caller holds the clock lock):
+    refuse to pin a table whose in-place remap is mid-flight.  Typed
+    and retryable, symmetric with the writer-side 40001."""
+    if getattr(data, "_ddl_in_progress", 0):
+        _reg().inc("mvcc_ddl_conflicts")
+        raise SnapshotConflictError(
+            "query admission raced in-place DDL (ALTER TABLE DROP "
+            "COLUMN) on this table; retry when it completes")
+
+
+@contextlib.contextmanager
+def ddl_scope(data, what: str):
+    """Bracket an in-place DDL mutation: refuses (40001) while pins
+    exist and blocks NEW pins until the mutation finishes, closing the
+    TOCTOU window between the pin check and the remap.  The clock lock
+    is held only for the entry/exit bookkeeping, never across the
+    remap itself."""
+    with _clock_lock:
+        _check_pins_locked(data, what)
+        data._ddl_in_progress = getattr(data, "_ddl_in_progress", 0) + 1
+    try:
+        yield
+    finally:
+        with _clock_lock:
+            data._ddl_in_progress -= 1
+
+
+# --------------------------------------------------------------------------
+# the pin
+# --------------------------------------------------------------------------
+
+class SnapshotPin:
+    """One statement's consistent cut: {table data -> pinned Manifest}
+    (+ captured host snapshots for in-place row tables).  Extended at
+    first read for tables the statement discovers late; released once
+    at statement end."""
+
+    __slots__ = ("epoch", "_manifests", "_rows", "_datas", "_lock",
+                 "released")
+
+    def __init__(self):
+        self.epoch = current_epoch()
+        self._manifests: Dict[int, object] = {}
+        self._rows: Dict[int, tuple] = {}
+        self._datas: Dict[int, object] = {}
+        self._lock = threading.Lock()
+        self.released = False
+
+    # -- column tables -----------------------------------------------------
+
+    def pin_many(self, datas) -> None:
+        """Atomic cross-table capture: all manifests read under ONE
+        clock-lock hold, so no commit can interleave between tables."""
+        with _clock_lock:
+            if self.released:
+                return
+            # gate-check every table BEFORE reffing any, so a raced
+            # in-place DDL aborts the capture without partial refs
+            # from THIS call (earlier captures release via the pin)
+            for data in datas:
+                _ddl_gate_locked(data)
+            for data in datas:
+                key = id(data)
+                if key in self._manifests:
+                    continue
+                m = data._manifest
+                self._manifests[key] = m
+                self._datas[key] = data
+                _ref_locked(data, m)
+
+    def manifest_for(self, data):
+        got = self._manifests.get(id(data))
+        if got is not None:
+            return got
+        with _clock_lock:
+            if self.released:
+                # a straggler thread (copied context outliving the
+                # statement) extending a released pin: serve the live
+                # manifest and hold NOTHING — a ref taken here would
+                # never be released (release already ran)
+                return data._manifest
+            got = self._manifests.get(id(data))
+            if got is None:
+                _ddl_gate_locked(data)
+                got = data._manifest
+                self._manifests[id(data)] = got
+                self._datas[id(data)] = data
+                _ref_locked(data, got)
+        return got
+
+    def repin(self, data):
+        """Re-capture `data` at its CURRENT manifest.  Matview sync uses
+        this (briefly under ``mutation_lock``) so the base table's
+        pinned epoch lands exactly where the view's folded state is —
+        base and view then agree to the row."""
+        with _clock_lock:
+            cur = data._manifest
+            if self.released:
+                return cur
+            old = self._manifests.get(id(data))
+            if old is cur:
+                return cur
+            self._manifests[id(data)] = cur
+            self._datas[id(data)] = data
+            _ref_locked(data, cur)
+        if old is not None:
+            _unref(data, old)
+        _reg().inc("mvcc_repins")
+        return cur
+
+    def repin_row(self, data) -> None:
+        """Drop the captured host snapshot of a ROW table so the next
+        read re-captures at the CURRENT version — the row-table analogue
+        of ``repin`` (matview refresh under ``mutation_lock`` uses it:
+        the pin's earlier capture may predate the refresh fence)."""
+        key = id(data)
+        with self._lock:
+            got = self._rows.pop(key, None)
+        if got is not None:
+            _unref_row(data, got[3])
+            _reg().inc("mvcc_repins")
+
+    # -- row tables (in-place storage: capture on first read) --------------
+
+    def row_snapshot(self, data) -> tuple:
+        key = id(data)
+        got = self._rows.get(key)
+        if got is not None:
+            return got
+        with _clock_lock:
+            _ddl_gate_locked(data)
+        arrays, masks, n, ver = _captured_row_arrays(data)
+        with self._lock:
+            if self.released:
+                return (arrays, masks, n, ver)   # live read, hold nothing
+            got = self._rows.get(key)
+            if got is None:
+                got = (arrays, masks, n, ver)
+                self._rows[key] = got
+                self._datas.setdefault(key, data)
+                with _clock_lock:
+                    _ref_row_locked(data, ver)
+        return got
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def release(self) -> None:
+        # drain under BOTH locks: manifest_for/pin_many/repin mutate the
+        # dicts under the clock lock, row_snapshot under self._lock —
+        # holding both (same self._lock -> clock order row_snapshot
+        # uses) means no extension can interleave with the drain, and
+        # the released flag is visible under whichever lock a reader
+        # holds
+        with self._lock, _clock_lock:
+            if self.released:
+                return
+            self.released = True
+            manifests = [(self._datas[k], m)
+                         for k, m in self._manifests.items()]
+            rows = [(self._datas[k], v[3]) for k, v in self._rows.items()]
+            self._manifests.clear()
+            self._rows.clear()
+            self._datas.clear()
+            _ACTIVE_PINS.discard(self)
+        for data, m in manifests:
+            _unref(data, m)
+        for data, ver in rows:
+            _unref_row(data, ver)
+        _reg().inc("mvcc_pin_releases")
+
+
+_pin_var: contextvars.ContextVar = contextvars.ContextVar(
+    "mvcc_pin", default=None)
+_ACTIVE_PINS: set = set()
+
+
+def current_pin() -> Optional[SnapshotPin]:
+    return _pin_var.get()
+
+
+def active_pin_count() -> int:
+    with _clock_lock:
+        return len(_ACTIVE_PINS)
+
+
+@contextlib.contextmanager
+def pinned_scope(catalog, table_names=()):
+    """Pin one consistent snapshot for the duration of a statement.
+    No-op (yields the ambient pin) when nested — tile partials, matview
+    syncs, subquery rewrites and scratch merges all read the OUTER
+    statement's epoch.  Matview backing tables are excluded from the
+    eager cut: sync() rewrites them under this very pin, and the query
+    must read the post-sync rows (they pin at first read instead)."""
+    ambient = _pin_var.get()
+    if ambient is not None or not enabled():
+        yield ambient
+        return
+    pin = SnapshotPin()
+    datas = []
+    seen = set()
+    names = list(table_names or ())
+    while names:
+        nm = names.pop()
+        low = str(nm).lower()
+        if low in seen:
+            continue
+        seen.add(low)
+        info = catalog.lookup_table(nm) if catalog is not None else None
+        if info is None:
+            # plain views: expand one level so the cut covers the
+            # underlying tables a late analysis would touch
+            view = catalog.lookup_view(nm) if catalog is not None else None
+            if view is not None:
+                try:
+                    from snappydata_tpu.session import _referenced_tables
+
+                    names.extend(_referenced_tables(view))
+                except Exception:
+                    pass
+            continue
+        if info.options.get("materialized_view"):
+            continue   # pinned at first read, AFTER sync rewrites it
+        maints = getattr(catalog, "_sample_maintainers", None)
+        if maints and info.name in maints:
+            # SAMPLE tables are lazily rebuilt (truncate + re-insert from
+            # the reservoir) inside the statement, like matview sync —
+            # pin at first read, AFTER the refresh publishes
+            continue
+        if hasattr(info.data, "_manifest"):
+            datas.append(info.data)
+    try:
+        pin.pin_many(datas)
+    except SnapshotConflictError:
+        pin.release()   # drop any refs an earlier capture took
+        raise
+    with _clock_lock:
+        _ACTIVE_PINS.add(pin)
+    _reg().inc("mvcc_pins")
+    from snappydata_tpu.observability import tracing
+
+    tracing.annotate("pinned_epoch", pin.epoch)
+    tok = _pin_var.set(pin)
+    try:
+        yield pin
+    finally:
+        _pin_var.reset(tok)
+        pin.release()
+
+
+@contextlib.contextmanager
+def unpinned_scope():
+    """Suspend the ambient pin for statement-PRIVATE storage: matview
+    scratch tables (``__mv_delta`` / ``__mv_partials``) are truncated,
+    re-filled and re-read MANY times within one outer statement, so
+    capturing them into the outer cut would serve the first rewrite's
+    manifest to every later read (stale-fold corruption — the second
+    fold of a pinned statement would re-aggregate the first fold's
+    rows).  Reads inside resolve live manifests; the outer pin resumes
+    on exit."""
+    tok = _pin_var.set(None)
+    try:
+        yield
+    finally:
+        _pin_var.reset(tok)
+
+
+# --------------------------------------------------------------------------
+# pin-aware read helpers (THE seam every scan-shaped read goes through)
+# --------------------------------------------------------------------------
+
+def snapshot_of(data):
+    """The manifest a read of `data` should traverse: the ambient pin's
+    (extending the pin at first read) or, unpinned, the live one."""
+    pin = _pin_var.get()
+    if pin is not None and hasattr(data, "_manifest"):
+        return pin.manifest_for(data)
+    return data.snapshot()
+
+
+def row_snapshot_of(data) -> Tuple[list, list, int, int]:
+    """(arrays, null masks, n, version) of a ROW table — the ambient
+    pin's captured copy (repeatable reads: the table mutates in place)
+    or a fresh read."""
+    pin = _pin_var.get()
+    if pin is not None:
+        return pin.row_snapshot(data)
+    arrays, masks, n = data.to_arrays_with_nulls()
+    return arrays, masks, n, int(data.version)
+
+
+# --------------------------------------------------------------------------
+# retained-epoch accounting (resource broker ledger + degradation)
+# --------------------------------------------------------------------------
+
+def _arr_bytes(a) -> int:
+    if a is None:
+        return 0
+    if isinstance(a, np.ndarray) and a.dtype == object:
+        return 8 * a.size          # pointer estimate, like the host ledger
+    return int(getattr(a, "nbytes", 0))
+
+
+def _manifest_extra_bytes(m, cur) -> int:
+    """Bytes a retained manifest holds beyond what the CURRENT one
+    shares: its row-buffer snapshot copies plus per-batch delete masks /
+    update deltas whose view object diverged.  Batch payloads are
+    write-once and shared across manifests — never double counted."""
+    total = sum(_arr_bytes(a) for a in m.row_arrays)
+    total += sum(_arr_bytes(a) for a in (m.row_nulls or ()))
+    cur_views = {v.batch.batch_id: v for v in cur.views} \
+        if cur is not None else {}
+    for v in m.views:
+        cv = cur_views.get(v.batch.batch_id)
+        if cv is v:
+            continue
+        if v.delete_mask is not None and (
+                cv is None or cv.delete_mask is not v.delete_mask):
+            total += _arr_bytes(v.delete_mask)
+        cur_deltas = set(map(id, cv.deltas)) if cv is not None else set()
+        for d in v.deltas:
+            if id(d) not in cur_deltas:
+                total += _arr_bytes(d[1]) + _arr_bytes(d[2]) \
+                    + _arr_bytes(d[3])
+    return total
+
+
+def retained_bytes_of(data) -> int:
+    retained = getattr(data, "_retained_epochs", None)
+    if not retained:
+        return 0
+    cur = data._manifest
+    total = 0
+    with _clock_lock:
+        items = [(v, m) for v, m in retained.items()
+                 if v != cur.version]
+    for _v, m in items:
+        total += _manifest_extra_bytes(m, cur)
+    return total
+
+
+def retained_epoch_bytes_by_table(tables) -> Dict[str, int]:
+    """Per-table retained-epoch bytes for the broker ledger.  `tables`
+    is an iterable of (name, data)."""
+    out: Dict[str, int] = {}
+    for name, data in tables:
+        if not hasattr(data, "_manifest"):
+            continue
+        try:
+            b = retained_bytes_of(data)
+        except Exception:
+            b = 0
+        if b:
+            out[name] = out.get(name, 0) + b
+    return out
+
+
+def retained_epochs_of(data) -> List[dict]:
+    """Observability rows for one table's retained-epoch list."""
+    retained = getattr(data, "_retained_epochs", None)
+    if not retained:
+        return []
+    cur = data._manifest
+    pins = getattr(data, "_pin_counts", {})
+    with _clock_lock:
+        items = sorted(retained.items())
+    out = []
+    for v, m in items:
+        out.append({
+            "version": v,
+            "epoch": int(getattr(m, "epoch", 0)),
+            "wal_seq": int(getattr(m, "wal_seq", 0)),
+            "pins": int(pins.get(v, 0)),
+            "current": v == cur.version,
+            "bytes": 0 if v == cur.version
+            else _manifest_extra_bytes(m, cur),
+        })
+    return out
+
+
+def trim_unpinned(tables) -> int:
+    """Degradation-ladder step: drop every retained epoch no pin holds
+    (keeping only the current manifest) and evict device-cache entries
+    for versions that are neither pinned nor current.  Returns how many
+    epochs/cache entries were trimmed."""
+    trimmed = 0
+    for _nm, data in tables:
+        if not hasattr(data, "_manifest"):
+            continue
+        with _clock_lock:
+            trimmed += _trim_retained_locked(data, keep_unpinned=0)
+        pinned = pinned_versions(data)
+        cache = getattr(data, "_device_cache", None)
+        if cache:
+            cur_ver = data._manifest.version
+            from snappydata_tpu.storage.device import _cache_budget
+
+            for k in [k for k in list(cache)
+                      if k[0] != cur_ver and k[0] not in pinned]:
+                cache.pop(k, None)
+                _cache_budget.forget(cache, k)
+                trimmed += 1
+    if trimmed:
+        _reg().inc("mvcc_epoch_trims", trimmed)
+    return trimmed

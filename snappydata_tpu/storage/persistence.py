@@ -660,6 +660,10 @@ class DiskStore:
             batch_entries.append(entry)
         manifest = {
             "version": m.version,
+            # epoch fence: recovery advances the mvcc clock past it so
+            # post-recovery commit epochs stay monotone with pre-crash
+            # ones (the per-table version vector resumes, never rewinds)
+            "epoch": int(getattr(m, "epoch", 0)),
             "batches": batch_entries,
             "row_count": m.row_count,
             # schema as of this checkpoint: ALTER TABLE between checkpoints
@@ -1198,7 +1202,12 @@ class DiskStore:
         per table on the checkpoint's wal_seq, then views and AQP
         registrations."""
         from snappydata_tpu.catalog import Catalog
+        from snappydata_tpu.storage import mvcc
 
+        # the WAL seq floor doubles as the epoch floor (seqs ARE commit
+        # timestamps): the mvcc clock resumes past everything this store
+        # ever acked, before any replay publishes
+        mvcc.advance_to(self._wal_seq)
         cat_path = os.path.join(self.path, "catalog.json")
         catalog = Catalog()
         if not os.path.exists(cat_path):
@@ -1464,7 +1473,14 @@ class DiskStore:
             max_id = max((e["batch_id"] for e in manifest["batches"]),
                          default=-1)
             data._batch_ids = itertools.count(max_id + 1)
-            data._publish(tuple(views))
+            from snappydata_tpu.storage import mvcc
+
+            # rebuild the version vector: the clock resumes past the
+            # checkpointed epoch, and the recovered manifest is stamped
+            # with the checkpoint's wal_seq (its commit fence)
+            mvcc.advance_to(int(manifest.get("epoch", 0)))
+            with mvcc.commit_scope(int(manifest.get("wal_seq", 0))):
+                data._publish(tuple(views))
         return manifest.get("wal_seq", 0)
 
     def _read_batch(self, fpath: str, entry: dict, schema: T.Schema
@@ -1555,11 +1571,27 @@ class DiskStore:
                 sid, {"names": ["count"], "rows": [[int(n_rows)]],
                       "replayed": True})
 
+        from snappydata_tpu.storage import mvcc
+
+        # every replayed record re-applies under its ORIGINAL seq as the
+        # commit timestamp, so re-published manifests carry the same
+        # epoch fences the pre-crash ones did (one token pair brackets
+        # the whole loop; the replay is single-threaded)
+        _seq_tok = mvcc._commit_seq.set(0)
+        try:
+            self._replay_records(catalog, session, folded, wal,
+                                 last_drop, reseed_dedup, mvcc)
+        finally:
+            mvcc._commit_seq.reset(_seq_tok)
+
+    def _replay_records(self, catalog, session, folded, wal, last_drop,
+                        reseed_dedup, mvcc) -> None:
         with open(wal, "rb") as fh:
             for header, arrays in read_records(fh):
                 table = header.get("table")
                 seq = header.get("seq", 0)
                 kind = header["kind"]
+                mvcc._commit_seq.set(int(seq))
                 if kind == "drop":
                     continue
                 if seq <= folded.get(table, 0) or \

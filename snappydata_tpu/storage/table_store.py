@@ -108,6 +108,12 @@ class Manifest:
     row_count: int
     # per-column bool null masks for the row-buffer rows (None = no nulls)
     row_nulls: Tuple[Optional[np.ndarray], ...] = ()
+    # commit stamps (storage/mvcc.py): the process-wide epoch this
+    # publish advanced to, and — on durable sessions — the WAL seq of
+    # the committing statement (the commit timestamp; 0 for in-memory
+    # publishes and recovery-loaded checkpoints, whose seq is the fence)
+    epoch: int = 0
+    wal_seq: int = 0
 
     def total_rows(self) -> int:
         return sum(v.live_rows() for v in self.views) + self.row_count
@@ -249,10 +255,19 @@ class ColumnTableData:
         return self._manifest
 
     def _publish(self, views: Tuple[BatchView, ...]) -> Manifest:
+        from snappydata_tpu.storage import mvcc
+
         row_arrays, row_nulls, row_count = self._row_buffer.snapshot()
-        m = Manifest(self._manifest.version + 1, views, row_arrays, row_count,
-                     row_nulls)
-        self._manifest = m
+        # the epoch stamp and the reference swap happen under ONE clock
+        # hold so a pin capturing a cross-table cut can never observe
+        # half a commit (mvcc.SnapshotPin.pin_many holds the same lock)
+        with mvcc.clock():
+            m = Manifest(self._manifest.version + 1, views, row_arrays,
+                         row_count, row_nulls,
+                         epoch=mvcc._bump_epoch_locked(),
+                         wal_seq=mvcc.current_commit_seq())
+            mvcc.retain_locked(self, self._manifest)
+            self._manifest = m
         return m
 
     # --- dictionaries ----------------------------------------------------
@@ -600,7 +615,16 @@ class ColumnTableData:
             self._publish(tuple(views))
 
     def drop_column(self, name: str) -> None:
-        with self._lock:
+        from snappydata_tpu.storage import mvcc
+
+        # DROP COLUMN remaps the shared dictionaries IN PLACE and shifts
+        # ordinals — state a pinned reader may be traversing right now.
+        # Unlike TRUNCATE/ADD COLUMN (which publish fresh manifests and
+        # leave pinned epochs intact) this cannot be made snapshot-safe,
+        # so it fails typed-and-retryable while snapshots are active —
+        # and ddl_scope blocks NEW pins for the remap's duration (a pin
+        # admitted mid-remap would traverse half-shifted state)
+        with mvcc.ddl_scope(self, "ALTER TABLE DROP COLUMN"), self._lock:
             idx = self.schema.index(name)
             if len(self.schema.fields) == 1:
                 raise ValueError("cannot drop the only column")
@@ -1060,7 +1084,14 @@ class RowTableData:
             self._version += 1
 
     def drop_column(self, name: str) -> None:
-        with self._lock:
+        from snappydata_tpu.storage import mvcc
+
+        # row tables mutate columns in place: a pinned reader that has
+        # not yet captured its host snapshot would resolve stale
+        # ordinals against the shifted layout — same typed refusal as
+        # the column-table form, and the same new-pin fence for the
+        # shift's duration
+        with mvcc.ddl_scope(self, "ALTER TABLE DROP COLUMN"), self._lock:
             idx = self.schema.index(name)
             if len(self.schema.fields) == 1:
                 raise ValueError("cannot drop the only column")

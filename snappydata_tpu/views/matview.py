@@ -109,6 +109,50 @@ def _data_version(data) -> int:
     return int(getattr(data, "version", 0))
 
 
+def _concat_pending(entries):
+    """Coalesce a pending-fold journal into maximal same-sign runs:
+    [(arrays, nulls, sign)].  Sum/count slots commute within a sign, so
+    concatenating preserves the fold result exactly while bounding the
+    replay at O(sign flips) partial-program runs instead of O(commits)."""
+    out = []
+    run: List[tuple] = []
+    run_sign = 0
+
+    def flush():
+        if not run:
+            return
+        if len(run) == 1:
+            out.append((run[0][1], run[0][2], run_sign))
+            return
+        ncols = len(run[0][1])
+        arrays, nulls = [], []
+        for ci in range(ncols):
+            parts = [np.asarray(e[1][ci]) for e in run]
+            if any(p.dtype == object for p in parts):
+                parts = [np.asarray(p, dtype=object) for p in parts]
+            arrays.append(np.concatenate(parts))
+            mparts, any_mask = [], False
+            for e in run:
+                m = e[2][ci] if e[2] is not None else None
+                if m is not None:
+                    any_mask = True
+                    mparts.append(np.asarray(m, dtype=bool))
+                else:
+                    mparts.append(np.zeros(len(np.asarray(e[1][ci])),
+                                           dtype=bool))
+            nulls.append(np.concatenate(mparts) if any_mask else None)
+        out.append((arrays, nulls, run_sign))
+
+    for _ver, arrays, nulls, sign in entries:
+        if run and sign != run_sign:
+            flush()
+            run = []
+        run_sign = sign
+        run.append((None, arrays, nulls))
+    flush()
+    return out
+
+
 class MaterializedView:
     """One maintained view: definition + partial programs + [G] state."""
 
@@ -142,6 +186,17 @@ class MaterializedView:
         self._dirty = True                # backing table out of date
         self.wal_seq = 0                  # checkpoint fence (high watermark)
         self._refresh_version = -1        # base data version at refresh
+        # refresh-without-mutation_lock machinery (storage/mvcc): while a
+        # full refresh rescans the base OUTSIDE any lock, concurrent
+        # commits keep flowing — their deltas land in the pending-fold
+        # journal (with the base version they committed at) and replay
+        # on top of the rebuilt state for versions past the rescan's
+        # pinned epoch.  _refresh_lock serializes whole refreshes.
+        self._refresh_lock = threading.Lock()
+        self._refreshing = False
+        self._pending: List[tuple] = []   # (base_version, arrays, nulls, sign)
+        self._pending_dirtied = False     # raced mark_stale/minmax delete
+        self._PENDING_CAP = 256           # journal bound: beyond it, stay stale
         # evidence counters (also bumped in the global registry)
         self.folds = 0
         self.rows_folded = 0
@@ -487,6 +542,27 @@ class MaterializedView:
         subtracts (delete path; only valid when `subtractable`)."""
         reg = global_registry()
         with self._lock:
+            if self._refreshing:
+                # a full refresh is rescanning the base WITHOUT holding
+                # mutation_lock (the old design stalled every committer
+                # behind the scan): divert this commit's delta to the
+                # pending journal — the refresh replays entries past its
+                # pinned epoch on top of the rebuilt state
+                if sign < 0 and not self.subtractable:
+                    self._pending_dirtied = True
+                    return
+                if len(self._pending) >= self._PENDING_CAP:
+                    # journal bound: give up on this refresh converging
+                    # (stays stale, next read re-aggregates)
+                    self._pending_dirtied = True
+                    return
+                n = int(np.asarray(arrays[0]).shape[0]) if arrays else 0
+                if n:
+                    self._pending.append((version, list(arrays),
+                                          list(nulls) if nulls is not None
+                                          else None, sign))
+                    reg.inc("view_pending_folds")
+                return
             if self.stale:
                 return   # stale views re-aggregate at next read anyway
             if sign < 0 and not self.subtractable:
@@ -513,6 +589,15 @@ class MaterializedView:
                 reg.inc("view_subtract_folds")
 
     def _run_partial_over_delta(self, arrays, nulls):
+        from snappydata_tpu.storage import mvcc
+
+        # the scratch table is rewritten per fold: an outer statement's
+        # pin must NOT capture it (the second fold under one pin would
+        # re-read the first fold's manifest) — scratch reads are live
+        with mvcc.unpinned_scope():
+            return self._run_partial_over_delta_unpinned(arrays, nulls)
+
+    def _run_partial_over_delta_unpinned(self, arrays, nulls):
         s = self._scratch_session()
         info = s.catalog.describe("__mv_delta")
         info.data.truncate()
@@ -610,6 +695,10 @@ class MaterializedView:
 
     def mark_stale(self, reason: str = "") -> None:
         with self._lock:
+            if self._refreshing:
+                # raced a lock-free refresh: its rebuilt state must not
+                # publish as fresh (the mark arrived mid-rescan)
+                self._pending_dirtied = True
             if not self.stale:
                 self.stale = True
                 self.stale_marks += 1
@@ -619,6 +708,10 @@ class MaterializedView:
     def reset_empty(self, wal_seq: int = 0) -> None:
         """TRUNCATE of the base table: the aggregate of nothing."""
         with self._lock:
+            if self._refreshing:
+                # a TRUNCATE racing a lock-free refresh: the in-flight
+                # rescan's result is pre-truncate — poison it
+                self._pending_dirtied = True
             self._reset_state()
             self.stale = False
             self._dirty = True
@@ -627,30 +720,119 @@ class MaterializedView:
     def refresh_full(self, session) -> None:
         """Re-aggregate the base table through the session's full engine
         (tiled scans and all) and rebuild the state — the stale-exit and
-        REFRESH MATERIALIZED VIEW path."""
+        REFRESH MATERIALIZED VIEW path.
+
+        The rescan runs WITHOUT mutation_lock: it pins one storage epoch
+        (the outer statement's, when ambient — the "stale-refresh reads
+        under the outer query's epoch" contract) and aggregates that
+        immutable manifest while committers keep publishing.  Deltas
+        committed during the scan divert to the pending-fold journal
+        (see fold_delta) and replay on top of the rebuilt state for
+        versions PAST the pinned epoch — versions at or below it are
+        already inside the scan.  The old design held mutation_lock
+        across the whole rescan, stalling every writer behind one long
+        analytic read (the PR 6 ABBA fix was a symptom of that lock
+        discipline)."""
         from snappydata_tpu.engine.result import to_host_domain
+        from snappydata_tpu.storage import mvcc
 
         ds = session.disk_store
-        lock_cm = ds.mutation_lock if ds is not None else _null_cm()
-        with lock_cm:
+        with self._refresh_lock:
+            base = session.catalog.lookup_table(self.base_table)
+            if base is None:
+                raise MatViewError(
+                    f"base table dropped: {self.base_table}")
             with self._lock:
-                base = session.catalog.lookup_table(self.base_table)
-                if base is None:
-                    raise MatViewError(
-                        f"base table dropped: {self.base_table}")
                 self.bind_base(base)
                 self.invalidate_scratch()
-                v0 = _data_version(base.data)
-                res = to_host_domain(session.sql(self.base_partial_sql))
-                self._reset_state()
-                self.stale = False
-                self._merge_partial(res, 1)
-                self._refresh_version = v0
-                self._dirty = True
-                self.full_refreshes += 1
-                self.wal_seq = ds.current_wal_seq() if ds is not None \
-                    else 0
-                global_registry().inc("view_full_refreshes")
+                # open the journal BEFORE pinning: every commit published
+                # after the pin lands in it (never silently lost)
+                self._refreshing = True
+                self._pending = []
+                self._pending_dirtied = False
+            try:
+                pin = mvcc.current_pin()
+                own_scope = _null_cm()
+                if pin is None and hasattr(base.data, "_manifest"):
+                    # REFRESH statement / recovery path: no ambient pin —
+                    # take one so the rescan reads one epoch end to end
+                    own_scope = mvcc.pinned_scope(session.catalog,
+                                                  [self.base_table])
+                with own_scope:
+                    pin = mvcc.current_pin()
+                    col_pin = pin is not None \
+                        and hasattr(base.data, "_manifest")
+                    # a column-manifest pin makes the rescan race-free
+                    # WITHOUT any lock; otherwise (snapshot_isolation
+                    # off, or a row-table base) fall back to the old
+                    # discipline — mutation_lock across the rescan — or
+                    # a commit racing the scan could be both partially
+                    # seen by it AND journal-replayed on top (double
+                    # count)
+                    lock_cm = _null_cm() if col_pin or ds is None \
+                        else ds.mutation_lock
+                    with lock_cm:
+                        if col_pin:
+                            manifest = pin.repin(base.data)
+                            v0 = int(manifest.version)
+                            fence = int(manifest.wal_seq)
+                        else:
+                            if pin is not None:
+                                # the pin's earlier row capture may
+                                # predate the fence: re-capture NOW,
+                                # under the lock
+                                pin.repin_row(base.data)
+                            v0 = _data_version(base.data)
+                            fence = ds.current_wal_seq() if ds is not None \
+                                else 0
+                        res = to_host_domain(
+                            session.sql(self.base_partial_sql))
+                with self._lock:
+                    self._reset_state()
+                    self.stale = False
+                    self._merge_partial(res, 1)
+                    self._refresh_version = v0
+                    # replay commits that raced the rescan (version past
+                    # the pinned epoch; None = provenance unknown,
+                    # replay).  Same-sign runs concatenate into ONE
+                    # partial-program pass — a committer hammering
+                    # single-row inserts during a long rescan must not
+                    # cost one scratch query per diverted commit
+                    pend = [p for p in self._pending
+                            if p[0] is None or p[0] > v0]
+                    self._pending = []
+                    if self._pending_dirtied:
+                        # a min/max delete (or journal overflow / raced
+                        # TRUNCATE / ALTER) hit mid-refresh: the rebuilt
+                        # state cannot be trusted — stay stale (next
+                        # read re-aggregates) and SKIP the replay: its
+                        # entries may not even match the schema any
+                        # more, and the result is discarded regardless
+                        self.stale = True
+                    else:
+                        for parrays, pnulls, psign in _concat_pending(pend):
+                            pres = self._run_partial_over_delta(
+                                parrays, pnulls)
+                            self._merge_partial(pres, psign)
+                            self.folds += 1
+                            global_registry().inc("view_pending_replays")
+                    self._dirty = True
+                    self.full_refreshes += 1
+                    self.wal_seq = fence
+                    # close the journal INSIDE the same lock hold as the
+                    # replay: a fold landing between replay and a later
+                    # flag flip would be appended and then discarded
+                    self._refreshing = False
+                    global_registry().inc("view_full_refreshes")
+            finally:
+                with self._lock:
+                    if self._refreshing:
+                        # error path (scan raised / admission rejected):
+                        # diverted folds are lost with the journal — the
+                        # state must not pass for fresh
+                        self._refreshing = False
+                        self._pending = []
+                        self.stale = True
 
     # -- read path ---------------------------------------------------------
 
@@ -689,7 +871,11 @@ class MaterializedView:
 
     def finalize(self):
         """Merged (final) Result of the maintained state: O(G) work."""
-        with self._lock:
+        from snappydata_tpu.storage import mvcc
+
+        # __mv_partials is truncated + re-filled per merge: like the
+        # delta scratch, it must never be captured into an outer pin
+        with self._lock, mvcc.unpinned_scope():
             s = self._scratch_session()
             info = s.catalog.describe("__mv_partials")
             info.data.truncate()
@@ -719,15 +905,40 @@ class MaterializedView:
         stale, then re-merge into the backing rows only when folds
         dirtied the state since the last sync.
 
-        Lock order matters: refresh_full acquires mutation_lock THEN the
-        view lock (the same order every ingest fold uses — _journal_then
-        holds mutation_lock when fold_delta takes the view lock), so the
-        stale check runs BEFORE this method takes the view lock; taking
-        the view lock first and refreshing inside it would ABBA-deadlock
-        a reader against a concurrent committer."""
-        if self.stale:
+        Under an ambient snapshot pin (the outer query's), the base
+        table is RE-pinned right before the merge, briefly under
+        mutation_lock so no committer can sit between journal-apply and
+        fold: at that instant state == aggregate(base@pin), and the
+        query then reads base rows and view rows that agree exactly —
+        the base-vs-view skew window PR 6 left open is closed.  Lock
+        order stays mutation_lock → view lock, the same order every
+        ingest fold uses (_journal_then holds mutation_lock when
+        fold_delta takes the view lock)."""
+        from snappydata_tpu.storage import mvcc
+
+        for _attempt in range(2):
+            if not self.stale:
+                break
             self.refresh_full(session)
+        pin = mvcc.current_pin()
+        base = session.catalog.lookup_table(self.base_table) \
+            if pin is not None else None
+        ds = session.disk_store
+        lock_cm = ds.mutation_lock \
+            if (pin is not None and ds is not None) else _null_cm()
+        with lock_cm:
+            self._sync_merge(session, pin, base)
+
+    def _sync_merge(self, session, pin, base) -> None:
         with self._lock:
+            if self.stale:
+                return   # a racing dirtier won: next read re-aggregates
+            if pin is not None and base is not None \
+                    and hasattr(base.data, "_manifest"):
+                # caller holds mutation_lock (durable sessions): no
+                # commit is mid journal→apply→fold, so the re-pinned
+                # epoch is exactly what the folded state aggregates
+                pin.repin(base.data)
             if not self._dirty:
                 return
             merged = self.finalize()
@@ -756,6 +967,12 @@ class MaterializedView:
                 backing.data.insert_arrays(
                     cols, nulls=masks if any(m is not None for m in masks)
                     else None)
+            if pin is not None and hasattr(backing.data, "_manifest"):
+                # the base was repinned forward above — move the backing
+                # with it, or a pin that already read the view (fold →
+                # re-read inside one pinned scope) would keep the
+                # pre-merge manifest and skew base-vs-view WITHIN the pin
+                pin.repin(backing.data)
             self._dirty = False
             global_registry().inc("view_syncs")
 
@@ -799,7 +1016,10 @@ class MaterializedView:
                 "base_table": self.base_table,
                 "wal_seq": int(self.wal_seq),
                 "groups": int(live.size),
-                "stale": bool(self.stale),
+                # a checkpoint racing a lock-free refresh persists STALE:
+                # folds are diverted to the pending journal right now, so
+                # this state image misses them — recovery re-aggregates
+                "stale": bool(self.stale or self._refreshing),
                 "n_arrays": len(arrays),
             }
             if base_rows is not None:
@@ -1019,9 +1239,14 @@ def fold_deleted(catalog, table: str, captured) -> None:
         return
     info = catalog.lookup_table(_norm(table))
     arrays, nulls = _captured_to_arrays(info, captured)
+    # the post-apply base version rides along like fold_ingest's: a
+    # refresh racing this delete needs it to decide whether its rescan
+    # already observed the deletion (replaying it twice would
+    # double-subtract)
+    version = _data_version(info.data) if info is not None else None
     for mv in mvs:
         if mv.subtractable:
-            mv.fold_delta(arrays, nulls, sign=-1)
+            mv.fold_delta(arrays, nulls, sign=-1, version=version)
         else:
             mv.mark_stale("delete on a min/max view")
 

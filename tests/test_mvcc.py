@@ -1,0 +1,691 @@
+"""MVCC snapshot isolation (storage/mvcc.py): statement-pinned storage
+epochs decouple scans from ingest — a query reads ONE consistent
+cross-table cut while ingest/DML/compaction publish freely, DDL racing
+a pin either bumps the epoch cleanly or fails typed, matview syncs pin
+the outer statement's epoch (base==view to the row), retained-epoch
+bytes are ledgered and drain when readers release, and the WAL seq is
+the commit timestamp recovery rebuilds the vector from.
+"""
+
+import json
+import random
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.observability.metrics import global_registry
+from snappydata_tpu.storage import mvcc
+
+pytestmark = pytest.mark.mvcc
+
+
+def _counter(name: str) -> int:
+    return global_registry().counter(name)
+
+
+def _mk():
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE t (k INT, v DOUBLE) USING column")
+    s.insert("t", (1, 1.0), (2, 2.0), (3, 3.0))
+    return s
+
+
+def _rows(s, sql):
+    return s.sql(sql).rows()
+
+
+# -- the core isolation contract ------------------------------------------
+
+def test_pinned_reads_isolated_from_concurrent_ingest():
+    """A pinned statement scope sees the epoch it pinned — inserts
+    committed meanwhile are invisible until release, then visible."""
+    s = _mk()
+    with mvcc.pinned_scope(s.catalog, ["t"]) as pin:
+        assert pin is not None and pin.epoch >= 1
+        assert _rows(s, "SELECT count(*), sum(v) FROM t") == [(3, 6.0)]
+        done = []
+
+        def ingest():
+            w = SnappySession(catalog=s.catalog)
+            w.insert("t", (4, 4.0))
+            done.append(True)
+
+        th = threading.Thread(target=ingest)
+        th.start()
+        th.join(timeout=30)
+        assert done, "ingest blocked behind a pinned reader"
+        # repeated reads inside the pin: same epoch, same answer
+        assert _rows(s, "SELECT count(*), sum(v) FROM t") == [(3, 6.0)]
+        assert _rows(s, "SELECT sum(v) FROM t WHERE k >= 1") == [(6.0,)]
+    assert _rows(s, "SELECT count(*), sum(v) FROM t") == [(4, 10.0)]
+    s.stop()
+
+
+def test_delete_and_update_invisible_to_pinned_reader():
+    s = _mk()
+    with mvcc.pinned_scope(s.catalog, ["t"]):
+        assert _rows(s, "SELECT sum(v) FROM t") == [(6.0,)]
+        w = SnappySession(catalog=s.catalog)
+        w.sql("DELETE FROM t WHERE k = 1")
+        w.sql("UPDATE t SET v = 100.0 WHERE k = 2")
+        # the pinned epoch predates both mutations
+        assert _rows(s, "SELECT sum(v) FROM t") == [(6.0,)]
+        assert _rows(s, "SELECT v FROM t WHERE k = 2") == [(2.0,)]
+    assert _rows(s, "SELECT sum(v) FROM t") == [(103.0,)]
+    s.stop()
+
+
+def test_cross_table_cut_is_atomic():
+    """A join pins BOTH tables in one clock hold: commits land entirely
+    before or entirely after the cut, never half."""
+    s = _mk()
+    s.sql("CREATE TABLE u (k INT, w DOUBLE) USING column")
+    s.insert("u", (1, 10.0), (2, 20.0))
+    with mvcc.pinned_scope(s.catalog, ["t", "u"]):
+        w = SnappySession(catalog=s.catalog)
+        w.insert("t", (9, 9.0))
+        w.insert("u", (9, 90.0))
+        assert _rows(s, "SELECT count(*) FROM t JOIN u ON t.k = u.k") \
+            == [(2,)]
+        assert _rows(s, "SELECT count(*) FROM t") == [(3,)]
+        assert _rows(s, "SELECT count(*) FROM u") == [(2,)]
+    assert _rows(s, "SELECT count(*) FROM t JOIN u ON t.k = u.k") == [(3,)]
+    s.stop()
+
+
+def test_row_table_repeatable_reads_under_pin():
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE r (k INT PRIMARY KEY, v DOUBLE) USING row")
+    s.insert("r", (1, 1.0), (2, 2.0))
+    with mvcc.pinned_scope(s.catalog, ["r"]):
+        assert _rows(s, "SELECT sum(v) FROM r") == [(3.0,)]
+        w = SnappySession(catalog=s.catalog)
+        w.sql("UPDATE r SET v = 50.0 WHERE k = 1")
+        w.insert("r", (3, 3.0))
+        # the first pinned read captured the host snapshot: repeatable
+        assert _rows(s, "SELECT sum(v) FROM r") == [(3.0,)]
+    assert _rows(s, "SELECT sum(v) FROM r") == [(55.0,)]
+    s.stop()
+
+
+def test_every_statement_pins_by_default():
+    """Plain session.sql pins without any explicit scope (the counters
+    prove it), and snapshot_isolation=False turns pinning off."""
+    s = _mk()
+    p0 = _counter("mvcc_pins")
+    _rows(s, "SELECT count(*) FROM t")
+    assert _counter("mvcc_pins") == p0 + 1
+    assert _counter("mvcc_pin_releases") >= 1
+    s.conf.set("snapshot_isolation", "false")
+    try:
+        p1 = _counter("mvcc_pins")
+        _rows(s, "SELECT count(*) FROM t")
+        assert _counter("mvcc_pins") == p1
+    finally:
+        s.conf.set("snapshot_isolation", "true")
+    s.stop()
+
+
+# -- DDL vs pinned snapshots (satellite) ----------------------------------
+
+def test_truncate_bumps_epoch_cleanly_under_pin():
+    s = _mk()
+    with mvcc.pinned_scope(s.catalog, ["t"]):
+        assert _rows(s, "SELECT count(*) FROM t") == [(3,)]
+        SnappySession(catalog=s.catalog).sql("TRUNCATE TABLE t")
+        # pinned reader keeps its immutable epoch; no error, no torn read
+        assert _rows(s, "SELECT count(*) FROM t") == [(3,)]
+    assert _rows(s, "SELECT count(*) FROM t") == [(0,)]
+    s.stop()
+
+
+def test_add_column_and_drop_table_safe_under_pin():
+    s = _mk()
+    info = s.catalog.describe("t")
+    with mvcc.pinned_scope(s.catalog, ["t"]):
+        assert _rows(s, "SELECT sum(v) FROM t") == [(6.0,)]
+        SnappySession(catalog=s.catalog).sql(
+            "ALTER TABLE t ADD COLUMN extra DOUBLE")
+        assert _rows(s, "SELECT sum(v) FROM t") == [(6.0,)]
+        # DROP TABLE: catalog entry goes, the pinned manifest stays alive
+        SnappySession(catalog=s.catalog).sql("DROP TABLE t")
+        m = mvcc.current_pin().manifest_for(info.data)
+        assert m.total_rows() == 3
+    s.stop()
+
+
+def test_drop_column_conflict_is_typed_sqlstate_40001():
+    s = _mk()
+    c0 = _counter("mvcc_ddl_conflicts")
+    with mvcc.pinned_scope(s.catalog, ["t"]):
+        _rows(s, "SELECT count(*) FROM t")
+        with pytest.raises(mvcc.SnapshotConflictError) as ei:
+            s.sql("ALTER TABLE t DROP COLUMN v")
+        assert "40001" in str(ei.value)
+        assert ei.value.sqlstate == "40001"
+    assert _counter("mvcc_ddl_conflicts") == c0 + 1
+    # readers drained: the retried DDL succeeds
+    s.sql("ALTER TABLE t DROP COLUMN v")
+    assert [f.name for f in s.catalog.describe("t").schema.fields] == ["k"]
+    s.stop()
+
+
+def test_drop_column_conflict_never_reaches_the_wal(tmp_path):
+    """The typed conflict fires BEFORE journaling: recovery must not
+    replay a DDL that never applied."""
+    dirn = str(tmp_path / "store")
+    s = SnappySession(data_dir=dirn)
+    s.sql("CREATE TABLE d (a INT, b DOUBLE) USING column")
+    s.sql("INSERT INTO d VALUES (1, 1.0)")
+    with mvcc.pinned_scope(s.catalog, ["d"]):
+        _rows(s, "SELECT count(*) FROM d")
+        with pytest.raises(mvcc.SnapshotConflictError):
+            s.sql("ALTER TABLE d DROP COLUMN b")
+    s.disk_store.close()
+    s2 = SnappySession(data_dir=dirn)
+    assert [f.name for f in s2.catalog.describe("d").schema.fields] == \
+        ["a", "b"], "a refused DDL leaked into the WAL"
+    assert _rows(s2, "SELECT b FROM d") == [(1.0,)]
+    s2.disk_store.close()
+
+
+# -- matview sync under the outer epoch (satellite) ------------------------
+
+def test_matview_sync_pins_same_epoch_as_outer_statement(tmp_path):
+    """base and view read under ONE pinned epoch: the count of base rows
+    and the view's folded count(*) agree EXACTLY in every statement,
+    even with a committer hammering single-row inserts throughout."""
+    s = SnappySession(data_dir=str(tmp_path / "store"))
+    s.sql("CREATE TABLE base (k INT, v DOUBLE) USING column")
+    s.insert("base", (1, 1.0), (2, 2.0))
+    s.sql("CREATE MATERIALIZED VIEW mv AS SELECT k, count(*) AS c, "
+          "sum(v) AS sv FROM base GROUP BY k")
+    stop = threading.Event()
+    errs = []
+
+    def writer():
+        w = SnappySession(catalog=s.catalog)
+        w.disk_store = s.disk_store
+        i = 0
+        try:
+            while not stop.is_set():
+                w.insert("base", (i % 5, 1.0))
+                i += 1
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    th = threading.Thread(target=writer, daemon=True)
+    th.start()
+    try:
+        for _ in range(15):
+            rows = _rows(s, "SELECT (SELECT count(*) FROM base) - "
+                            "(SELECT sum(c) FROM mv) AS skew")
+            assert rows == [(0,)], f"base-vs-view skew: {rows}"
+    finally:
+        stop.set()
+        th.join(timeout=30)
+    assert not errs, errs
+    s.disk_store.close()
+
+
+def test_stale_refresh_reads_under_outer_epoch(tmp_path):
+    """The stale-exit full refresh rescans the base WITHOUT stalling
+    committers, and the rebuilt view still matches the outer pinned
+    epoch exactly (pending-fold journal replays raced commits)."""
+    s = SnappySession(data_dir=str(tmp_path / "store"))
+    s.sql("CREATE TABLE base (k INT, v DOUBLE) USING column")
+    s.insert("base", *[(i % 7, float(i)) for i in range(500)])
+    s.sql("CREATE MATERIALIZED VIEW mv AS SELECT k, count(*) AS c "
+          "FROM base GROUP BY k")
+    from snappydata_tpu.views import matviews
+
+    mv = matviews(s.catalog)["mv"]
+    stop = threading.Event()
+    errs = []
+
+    def writer():
+        w = SnappySession(catalog=s.catalog)
+        w.disk_store = s.disk_store
+        i = 0
+        try:
+            while not stop.is_set():
+                w.insert("base", (i % 7, 1.0))
+                i += 1
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    th = threading.Thread(target=writer, daemon=True)
+    th.start()
+    try:
+        for _ in range(5):
+            mv.mark_stale("test")   # force the refresh_full path
+            rows = _rows(s, "SELECT (SELECT count(*) FROM base) - "
+                            "(SELECT sum(c) FROM mv) AS skew")
+            assert rows == [(0,)], f"refresh left skew: {rows}"
+    finally:
+        stop.set()
+        th.join(timeout=30)
+    assert not errs, errs
+    s.disk_store.close()
+
+
+# -- review-round regressions ---------------------------------------------
+
+def test_matview_folds_read_live_scratch_under_ambient_pin():
+    """Two folds inside ONE pinned scope: the per-view scratch table is
+    truncated + re-filled per fold, so it must read LIVE (an outer pin
+    capturing it would serve fold #1's manifest to fold #2, silently
+    double-counting the first delta and dropping the second)."""
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE fb (k INT, v DOUBLE) USING column")
+    s.sql("CREATE MATERIALIZED VIEW fmv AS SELECT k, count(*) AS c, "
+          "sum(v) AS sv FROM fb GROUP BY k")
+    with mvcc.pinned_scope(s.catalog, ["fb"]):
+        s.insert("fb", (1, 10.0))
+        s.insert("fb", (1, 20.0))
+        s.insert("fb", (2, 5.0))
+    assert sorted(_rows(s, "SELECT k, c, sv FROM fmv")) \
+        == [(1, 2, 30.0), (2, 1, 5.0)]
+    assert _rows(s, "SELECT (SELECT count(*) FROM fb) - "
+                    "(SELECT sum(c) FROM fmv)") == [(0,)]
+    s.stop()
+
+
+def test_matview_fold_then_reread_inside_one_pin():
+    """Read view → fold → read view again, all under one pin: the sync
+    repins base AND backing forward together, so the second read agrees
+    with the base to the row (no internal base-vs-view skew)."""
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE rb (k INT, v DOUBLE) USING column")
+    s.insert("rb", (1, 1.0))
+    s.sql("CREATE MATERIALIZED VIEW rmv AS SELECT k, count(*) AS c "
+          "FROM rb GROUP BY k")
+    with mvcc.pinned_scope(s.catalog, ["rb"]):
+        assert _rows(s, "SELECT sum(c) FROM rmv") == [(1,)]
+        s.insert("rb", (1, 2.0))
+        assert _rows(s, "SELECT (SELECT count(*) FROM rb) - "
+                        "(SELECT sum(c) FROM rmv)") == [(0,)]
+    s.stop()
+
+
+def test_released_pin_extension_holds_nothing():
+    """A straggler thread extending a RELEASED pin (copied context
+    outliving the statement) reads live state and leaks no refcount —
+    a leaked ref would block DROP COLUMN forever (40001) and keep
+    retained-epoch bytes on the ledger."""
+    s = _mk()
+    data = s.catalog.describe("t").data
+    pin = mvcc.SnapshotPin()
+    pin.pin_many([data])
+    assert mvcc.has_pins(data)
+    pin.release()
+    assert not mvcc.has_pins(data)
+    # post-release extensions: live manifest, no refs taken
+    m = pin.manifest_for(data)
+    assert m is data.snapshot()
+    assert not mvcc.has_pins(data)
+    pin.release()   # idempotent
+    s.sql("ALTER TABLE t DROP COLUMN v")   # no lingering 40001
+    s.stop()
+
+
+def test_ddl_scope_blocks_new_pins_during_remap():
+    """The pin-admission side of the DDL fence: while an in-place remap
+    is mid-flight (ddl_scope held), pin capture fails typed-and-
+    retryable instead of traversing half-shifted state."""
+    s = _mk()
+    data = s.catalog.describe("t").data
+    with mvcc.ddl_scope(data, "ALTER TABLE DROP COLUMN"):
+        with pytest.raises(mvcc.SnapshotConflictError) as ei:
+            with mvcc.pinned_scope(s.catalog, ["t"]):
+                pass   # pragma: no cover
+        assert ei.value.sqlstate == "40001"
+        assert not mvcc.has_pins(data), "aborted capture must not leak refs"
+    # gate released: pinning works again
+    with mvcc.pinned_scope(s.catalog, ["t"]):
+        assert _rows(s, "SELECT count(*) FROM t") == [(3,)]
+    s.stop()
+
+
+def test_row_snapshot_cache_makes_warm_pinned_binds_cheap():
+    """The per-version host-snapshot cache: a second pinned statement
+    over an unchanged row table must NOT re-materialize the whole table
+    (O(table) Python-loop conversion per statement was the regression)."""
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE rc (k INT PRIMARY KEY, v DOUBLE) USING row")
+    s.insert("rc", (1, 1.0), (2, 2.0))
+    data = s.catalog.describe("rc").data
+    assert _rows(s, "SELECT sum(v) FROM rc") == [(3.0,)]   # warm the cache
+    calls = [0]
+    orig = data.to_arrays_with_nulls
+
+    def counting():
+        calls[0] += 1
+        return orig()
+
+    data.to_arrays_with_nulls = counting
+    try:
+        assert _rows(s, "SELECT sum(v) FROM rc") == [(3.0,)]
+        assert _rows(s, "SELECT sum(v) FROM rc") == [(3.0,)]
+        assert calls[0] == 0, \
+            f"warm pinned binds re-materialized the row table {calls[0]}x"
+        # a mutation bumps the version: exactly one fresh capture
+        s.sql("UPDATE rc SET v = 10.0 WHERE k = 1")
+        assert _rows(s, "SELECT sum(v) FROM rc") == [(12.0,)]
+        assert calls[0] >= 1
+    finally:
+        data.to_arrays_with_nulls = orig
+    s.stop()
+
+
+def test_pinned_row_bind_spares_live_device_cache_entry():
+    """A pinned statement binding an OLDER captured row-table version
+    must not evict the live version's cached DeviceTable — concurrent
+    unpinned traffic would pay the O(table) rebuild on its next bind."""
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE lv (k INT PRIMARY KEY, v DOUBLE) USING row")
+    s.insert("lv", (1, 1.0), (2, 2.0))
+    data = s.catalog.describe("lv").data
+    def unpinned(sql, out):
+        # pins are contextvar-scoped: a fresh thread reads live
+        w = SnappySession(catalog=s.catalog)
+        out.append(w.sql(sql).rows())
+
+    with mvcc.pinned_scope(s.catalog, ["lv"]):
+        assert _rows(s, "SELECT sum(v) FROM lv") == [(3.0,)]   # pin @ v
+        SnappySession(catalog=s.catalog).insert("lv", (3, 4.0))  # live moves
+        got = []
+        th = threading.Thread(target=unpinned,
+                              args=("SELECT sum(v) FROM lv", got))
+        th.start()
+        th.join(timeout=60)
+        assert got == [[(7.0,)]], got
+        live_ver = data.version
+        assert any(k[0] == live_ver for k in data._device_cache)
+        # the pinned re-bind at the OLD captured version...
+        assert _rows(s, "SELECT sum(v) FROM lv") == [(3.0,)]
+        # ...leaves the live entry in place
+        assert any(k[0] == live_ver for k in data._device_cache), \
+            "pinned bind evicted the live version's device-cache entry"
+    s.stop()
+
+
+# -- retained epochs: ledger + degradation --------------------------------
+
+def test_retained_epoch_bytes_ledgered_and_drain_on_release():
+    from snappydata_tpu.observability.stats_service import mvcc_snapshot
+    from snappydata_tpu.resource import global_broker
+
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE big (k INT, v DOUBLE) USING column")
+    s.insert("big", *[(i, float(i)) for i in range(50)])
+    with mvcc.pinned_scope(s.catalog, ["big"]):
+        _rows(s, "SELECT count(*) FROM big")
+        w = SnappySession(catalog=s.catalog)
+        w.sql("DELETE FROM big WHERE k < 10")          # delete-mask delta
+        w.insert("big", *[(100 + i, 1.0) for i in range(40)])
+        snap = mvcc_snapshot(s.catalog)
+        assert snap["active_pins"] >= 1
+        assert snap["retained_epoch_bytes"] > 0, \
+            "a pinned old epoch must show on the ledger"
+        assert "big" in snap["tables"]
+        assert any(e["pins"] > 0
+                   for e in snap["tables"]["big"]["retained_epochs"])
+        # the broker ledger is PROCESS-wide (it sums every registered
+        # catalog's tables), the snapshot is catalog-scoped: the ledger
+        # line must carry at least this catalog's retained bytes
+        ledger = global_broker().ledger()
+        assert ledger["retained_epoch_bytes"] >= \
+            snap["retained_epoch_bytes"]
+    # readers drained: the degradation trim drains retained bytes to 0
+    mvcc.trim_unpinned([("big", s.catalog.describe("big").data)])
+    snap = mvcc_snapshot(s.catalog)
+    assert snap["retained_epoch_bytes"] == 0
+    s.stop()
+
+
+def test_degradation_trim_counts_and_respects_pins():
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE tr (k INT) USING column")
+    data = s.catalog.describe("tr").data
+    for i in range(4):
+        s.insert("tr", (i,))
+    t0 = _counter("mvcc_epoch_trims")
+    with mvcc.pinned_scope(s.catalog, ["tr"]) as pin:
+        pinned = pin.manifest_for(data)
+        SnappySession(catalog=s.catalog).insert("tr", (99,))
+        trimmed = mvcc.trim_unpinned([("tr", data)])
+        # the pinned manifest must survive the trim
+        assert pinned.version in data._retained_epochs
+        assert _rows(s, "SELECT count(*) FROM tr") == [(4,)]
+    assert trimmed >= 0 and _counter("mvcc_epoch_trims") >= t0
+    # unpinned history obeys the cap
+    cap = int(s.conf.get("mvcc_retained_epochs", 2))
+    unpinned = [v for v in data._retained_epochs
+                if v != data.snapshot().version]
+    assert len(unpinned) <= cap + 1
+    s.stop()
+
+
+# -- recovery: the WAL seq is the commit timestamp -------------------------
+
+def test_recovery_rebuilds_epoch_fences(tmp_path):
+    dirn = str(tmp_path / "store")
+    s = SnappySession(data_dir=dirn)
+    s.sql("CREATE TABLE f (k INT, v DOUBLE) USING column")
+    s.sql("INSERT INTO f VALUES (1, 1.0)")
+    s.sql("INSERT INTO f VALUES (2, 2.0)")
+    m0 = s.catalog.describe("f").data.snapshot()
+    assert m0.wal_seq > 0, "durable commits stamp their WAL seq"
+    assert m0.epoch > 0
+    s.checkpoint()
+    s.disk_store.close()
+    s2 = SnappySession(data_dir=dirn)
+    m1 = s2.catalog.describe("f").data.snapshot()
+    # the recovered manifest carries the checkpoint's fence, and the
+    # epoch clock resumed PAST the pre-crash epochs
+    assert m1.wal_seq >= m0.wal_seq
+    assert mvcc.current_epoch() >= m0.epoch
+    s2.sql("INSERT INTO f VALUES (3, 3.0)")
+    m2 = s2.catalog.describe("f").data.snapshot()
+    assert m2.epoch > m0.epoch, "post-recovery epochs stay monotone"
+    assert m2.wal_seq > m1.wal_seq
+    assert _rows(s2, "SELECT sum(v) FROM f") == [(6.0,)]
+    s2.disk_store.close()
+
+
+# -- HTAP chaos schedule (satellite) --------------------------------------
+
+@pytest.mark.chaos
+def test_htap_chaos_schedule(tmp_path):
+    """Seeded HTAP schedule on a durable store: one committer sustains
+    ingest while readers take pinned snapshot scans, with a kill→rejoin
+    (crash-recovery) window in the middle.  Every snapshot read is
+    value-asserted against a serialized replay (the cumulative log at
+    the pinned version), no acked row is lost across the crash, and
+    retained-epoch bytes return to baseline once readers drain."""
+    from snappydata_tpu.observability.stats_service import mvcc_snapshot
+
+    rng = random.Random(4242)
+    dirn = str(tmp_path / "store")
+    s = SnappySession(data_dir=dirn)
+    s.sql("CREATE TABLE h (k INT, v DOUBLE) USING column")
+    data = s.catalog.describe("h").data
+
+    # serialized replay log: manifest version -> cumulative (count, sum)
+    # (single committer => publishes are totally ordered)
+    expected = {data.snapshot().version: (0, 0.0)}
+    acked_rows = [0]
+    acked_sum = [0.0]
+    log_lock = threading.Lock()
+    stop = threading.Event()
+    errs = []
+
+    def committer(sess):
+        try:
+            while not stop.is_set():
+                n = rng.randint(1, 40)
+                vals = [float(rng.randint(0, 9)) for _ in range(n)]
+                sess.insert("h", *[(i, v) for i, v in enumerate(vals)])
+                with log_lock:
+                    acked_rows[0] += n
+                    acked_sum[0] += sum(vals)
+                    expected[data.snapshot().version] = (
+                        acked_rows[0], acked_sum[0])
+        except Exception as e:
+            errs.append(e)
+
+    def reader(sess, n_reads):
+        import time as _time
+
+        try:
+            for _ in range(n_reads):
+                with mvcc.pinned_scope(sess.catalog, ["h"]) as pin:
+                    ver = pin.manifest_for(data).version
+                    got = sess.sql(
+                        "SELECT count(*), sum(v) FROM h").rows()[0]
+                # the committer logs AFTER its insert returns — a pin
+                # taken in that gap needs one beat for the log entry
+                want = None
+                for _spin in range(200):
+                    with log_lock:
+                        want = expected.get(ver)
+                    if want is not None:
+                        break
+                    _time.sleep(0.01)
+                assert want is not None, \
+                    f"pinned version {ver} missing from the commit log"
+                cnt = int(got[0])
+                sm = float(got[1]) if got[1] is not None else 0.0
+                assert (cnt, round(sm, 6)) == (want[0], round(want[1], 6)), \
+                    f"snapshot@v{ver} read {got}, serialized replay " \
+                    f"says {want}"
+        except Exception as e:
+            errs.append(e)
+
+    w = threading.Thread(target=committer, args=(s,), daemon=True)
+    readers = [threading.Thread(target=reader, args=(s, 8), daemon=True)
+               for _ in range(2)]
+    w.start()
+    for r in readers:
+        r.start()
+    for r in readers:
+        r.join(timeout=120)
+    stop.set()
+    w.join(timeout=30)
+    assert not errs, errs
+    assert not w.is_alive() and not any(r.is_alive() for r in readers)
+    # ---- kill → rejoin window: abandon the session (no checkpoint, no
+    # graceful close) and recover from WAL alone
+    final_acked, final_sum = acked_rows[0], acked_sum[0]
+    s2 = SnappySession(data_dir=dirn)
+    got = s2.sql("SELECT count(*), sum(v) FROM h").rows()[0]
+    assert int(got[0]) == final_acked, \
+        f"acked rows lost across the crash: {got[0]} != {final_acked}"
+    assert round(float(got[1]), 6) == round(final_sum, 6)
+    # ---- post-rejoin: the schedule keeps running on the recovered store
+    data2 = s2.catalog.describe("h").data
+    expected.clear()
+    expected[data2.snapshot().version] = (final_acked, final_sum)
+    acked_rows[0], acked_sum[0] = final_acked, final_sum
+    stop.clear()
+    data = data2          # committer/reader closures read `data`
+    w2 = threading.Thread(target=committer, args=(s2,), daemon=True)
+    r2 = threading.Thread(target=reader, args=(s2, 5), daemon=True)
+    w2.start()
+    r2.start()
+    r2.join(timeout=120)
+    stop.set()
+    w2.join(timeout=30)
+    assert not errs, errs
+    # ---- readers drained: retained-epoch bytes return to baseline
+    mvcc.trim_unpinned([("h", data2)])
+    snap = mvcc_snapshot(s2.catalog)
+    assert snap["retained_epoch_bytes"] == 0, snap["retained_epoch_bytes"]
+    assert snap["active_pins"] == 0
+    s2.disk_store.close()
+
+
+# -- observability surfaces -----------------------------------------------
+
+def test_mvcc_snapshot_rest_and_dashboard():
+    from snappydata_tpu.cluster.rest import RestService
+    from snappydata_tpu.observability.stats_service import (
+        TableStatsService, mvcc_snapshot)
+
+    s = _mk()
+    _rows(s, "SELECT count(*) FROM t")
+    snap = mvcc_snapshot(s.catalog)
+    assert snap["enabled"] and snap["current_epoch"] >= 1
+    assert snap["pins"] >= 1
+    assert "t" in snap["tables"]
+    assert snap["tables"]["t"]["version"] >= 1
+    svc = RestService(s, TableStatsService(s.catalog), port=0).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://{svc.host}:{svc.port}/status/api/v1/mvcc",
+                timeout=5) as resp:
+            body = json.loads(resp.read())
+        assert body["enabled"] is True
+        assert {"current_epoch", "active_pins", "pins", "ddl_conflicts",
+                "retained_epoch_bytes", "tables"} <= set(body)
+        assert "t" in body["tables"]
+        with urllib.request.urlopen(
+                f"http://{svc.host}:{svc.port}/dashboard",
+                timeout=5) as resp:
+            html = resp.read().decode()
+        assert "Snapshot isolation" in html
+    finally:
+        svc.stop()
+        s.stop()
+
+
+def test_trace_annotates_pinned_epoch():
+    from snappydata_tpu.observability import tracing
+
+    s = _mk()
+    with tracing.request_scope("SELECT count(*) FROM t", user="admin",
+                               kind="test", force=True) as tr:
+        _rows(s, "SELECT count(*) FROM t")
+    attrs = tr.root.attrs
+    assert "pinned_epoch" in attrs and int(attrs["pinned_epoch"]) >= 1
+    s.stop()
+
+
+# -- bench guard logic (satellite: the htap axis cannot silently slide) ---
+
+def test_bench_htap_guard_logic():
+    import bench
+
+    base = {"value": 100.0, "detail": {
+        "load_s": 10.0,
+        "htap": {"concurrent": {"scan_p50_ms": 10.0},
+                 "serialized": {"scan_p50_ms": 8.0},
+                 "value_mismatches": 0}}}
+    ok = {"value": 100.0, "detail": {
+        "load_s": 10.0,
+        "htap": {"concurrent": {"scan_p50_ms": 20.0},
+                 "serialized": {"scan_p50_ms": 8.0},
+                 "value_mismatches": 0}}}
+    assert bench.check_regression(ok, base) == []
+    bad_value = {"value": 100.0, "detail": {
+        "load_s": 10.0,
+        "htap": {"concurrent": {"scan_p50_ms": 9.0},
+                 "serialized": {"scan_p50_ms": 8.0},
+                 "value_mismatches": 3}}}
+    msgs = bench.check_regression(bad_value, base)
+    assert any("htap" in m for m in msgs), msgs
+    blowup = {"value": 100.0, "detail": {
+        "load_s": 10.0,
+        "htap": {"concurrent": {"scan_p50_ms": 900.0},
+                 "serialized": {"scan_p50_ms": 8.0},
+                 "value_mismatches": 0}}}
+    msgs = bench.check_regression(blowup, base)
+    assert any("htap" in m for m in msgs), msgs
+    # records predating the htap axis stay comparable
+    assert bench.check_regression(
+        {"value": 100.0, "detail": {"load_s": 10.0}}, base) == []
