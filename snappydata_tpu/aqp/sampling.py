@@ -15,6 +15,7 @@ later optimization, per SURVEY.md §7.9.
 from __future__ import annotations
 
 import threading
+from snappydata_tpu.utils import locks
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -34,7 +35,7 @@ class StratifiedReservoir:
         self.num_columns = num_columns
         self.cap = reservoir_size
         self._rng = np.random.default_rng(seed)
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("aqp.reservoir")
         # stratum key -> (list of row tuples (len == cap max), seen count)
         self._strata: Dict[tuple, Tuple[List[tuple], int]] = {}
         # stable stratum → integer id (materialization order)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import math
 import threading
+from snappydata_tpu.utils import locks
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -78,7 +79,7 @@ class TimeDecayedTopK:
         self.max_buckets = max_buckets
         self.cms_width = cms_width
         self._buckets: Dict[int, TopKSummary] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("aqp.decayed_topk")
 
     def _bucket_of(self, ts: float) -> int:
         return int(ts // self.bucket_seconds)
@@ -131,7 +132,7 @@ class TopKSummary:
         self.cms_width = cms_width
         self.cms = CountMinSketch(cms_depth, cms_width)
         self._counts: Dict = {}
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("aqp.topk")
 
     def observe(self, keys: Sequence, counts: Optional[Sequence] = None
                 ) -> None:

@@ -14,6 +14,7 @@ import dataclasses
 import json
 import os
 import threading
+from snappydata_tpu.utils import locks
 from typing import Dict, List, Optional, Sequence
 
 from snappydata_tpu import types as T
@@ -46,7 +47,7 @@ def _norm(name: str) -> str:
 
 class Catalog:
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("catalog.state")
         self._tables: Dict[str, TableInfo] = {}
         self._views: Dict[str, object] = {}   # name -> logical plan
         # bumped on every DDL so compiled-plan caches keyed on it can't

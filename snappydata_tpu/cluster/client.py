@@ -260,6 +260,9 @@ class SnappyClient:
             self._invalidate()
             if not retry:
                 raise
+            # locklint: metric-dynamic retry_metric is one of the two
+            # declared names "failover_retries"/"mutation_retries"
+            # (keyword default + explicit call sites in this file)
             global_registry().inc(retry_metric)
             d = self._backoff.delay(0)
             rem = reliability.remaining()

@@ -26,9 +26,11 @@ scatter + merge:
 from __future__ import annotations
 
 import dataclasses
+import logging
 import queue as _queue
 import random as _random
 import threading
+from snappydata_tpu.utils import locks
 import time as _time
 import uuid as _uuid
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -142,10 +144,10 @@ class DistributedSession:
         self.bucket_seq: List[int] = [0] * num_buckets
         self._death_snapshots: Dict[int, dict] = {}
         # bounded concurrent hedged reads (hedge_max_concurrent)
-        self._hedge_lock = threading.Lock()
+        self._hedge_lock = locks.named_lock("cluster.hedge")
         self._hedges_inflight = 0
         self._rejoin_stop: Optional[threading.Event] = None
-        self._rejoin_lock = threading.Lock()
+        self._rejoin_lock = locks.named_lock("cluster.rejoin")
         # planning catalog: schemas only (no data) on the lead
         self.planner = SnappySession(catalog=Catalog())
 
@@ -450,6 +452,12 @@ class DistributedSession:
             if self.alive[index]:
                 return {"rejoined": False,
                         "reason": "member already alive"}
+            # locklint: blocking-under-lock,lock-order-undeclared rejoin
+            # is repair-plane: the lock exists to serialize WHOLE rejoins
+            # (bucket moves are not transactional vs each other); nothing
+            # latency-sensitive contends on it, its RPCs/backoffs are
+            # deadline-exempt, and the locator-client/backoff locks it
+            # reaches are leaves of the client stack
             return self._rejoin_locked(index, address)
 
     def _rejoin_locked(self, index: int, address: Optional[str]) -> dict:
@@ -759,8 +767,15 @@ class DistributedSession:
             while not stop.wait(interval_s):
                 try:
                     self.poll_rejoins()
-                except Exception:
-                    pass   # next tick retries; rejoin errors are counted
+                except Exception as e:
+                    # next tick retries — but a poll that ALWAYS raises
+                    # must be visible, not a silently-idle thread
+                    from snappydata_tpu.observability.metrics import \
+                        global_registry
+
+                    logging.getLogger(__name__).warning(
+                        "auto-rejoin poll failed: %s", e)
+                    global_registry().inc("auto_rejoin_poll_errors")
 
         threading.Thread(target=loop, daemon=True).start()
 

@@ -19,6 +19,7 @@ from __future__ import annotations
 
 import json
 import threading
+from snappydata_tpu.utils import locks
 from typing import Optional
 
 import numpy as np
@@ -385,7 +386,7 @@ class SnappyFlightServer(flight.FlightServerBase):
         self.auth_provider = auth_provider
         self.internal_token = internal_token
         self._issued_tokens: dict = {}   # token -> (user, expiry)
-        self._token_lock = threading.Lock()
+        self._token_lock = locks.named_lock("flight.tokens")
         self.host = host
         self._location = location
 

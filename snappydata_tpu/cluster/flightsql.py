@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import json
 import threading
+from snappydata_tpu.utils import locks
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -189,7 +190,7 @@ class FlightSqlHandler:
     def __init__(self, server):
         self.server = server
         self._prepared: Dict[bytes, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("flightsql.handles")
         self._next_handle = 0
 
     # -- helpers -------------------------------------------------------

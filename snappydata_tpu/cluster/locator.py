@@ -22,6 +22,7 @@ import logging
 import socket
 import socketserver
 import threading
+from snappydata_tpu.utils import locks
 import time
 from typing import Dict, List, Optional, Tuple
 
@@ -50,7 +51,7 @@ class MemberInfo:
 
 class _State:
     def __init__(self, timeout_s: float):
-        self.lock = threading.Lock()
+        self.lock = locks.named_lock("locator.state")
         self.members: Dict[str, MemberInfo] = {}
         self.view_version = 0
         self.locks: Dict[str, Tuple[str, float]] = {}  # name -> (owner, expiry)
@@ -230,7 +231,7 @@ class LocatorClient:
         # park the heartbeat thread inside _lock forever (every other
         # locator call would then block on the lock behind it)
         self.request_timeout_s = request_timeout_s
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("locator.client")
         self._sock: Optional[socket.socket] = None
         self._stop = threading.Event()
         self._hb: Optional[threading.Thread] = None

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import threading
+from snappydata_tpu.utils import locks
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional
@@ -23,7 +24,7 @@ class JobRegistry:
     def __init__(self, session):
         self.session = session
         self._jobs: Dict[str, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("rest.jobs")
 
     def submit_sql(self, sql: str, params=(), session=None,
                    timeout_s=None) -> str:

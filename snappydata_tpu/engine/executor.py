@@ -34,6 +34,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import threading
+from snappydata_tpu.utils import locks
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
@@ -667,7 +668,7 @@ _PRE_CACHE_BYTES = [0]
 # concurrent sessions (Flight server threads, jobserver workers) execute
 # compiled plans in parallel — every cache mutation holds this lock so
 # eviction races can't KeyError a query or corrupt the byte accounting
-_PRE_CACHE_LOCK = threading.Lock()
+_PRE_CACHE_LOCK = locks.named_lock("executor.pre_cache")
 
 
 def gidx_cache_nbytes() -> int:

@@ -45,6 +45,7 @@ import json
 import os
 import random
 import threading
+from snappydata_tpu.utils import locks
 import time
 from typing import Dict, List, Optional
 
@@ -96,7 +97,7 @@ class FaultSpec:
 
 class FailpointRegistry:
     def __init__(self, seed: Optional[int] = None):
-        self._lock = threading.RLock()
+        self._lock = locks.named_rlock("fault.registry")
         self._specs: Dict[str, List[FaultSpec]] = {}
         if seed is None:
             seed = int(os.environ.get("SNAPPY_TPU_FAULT_SEED", "0") or 0)

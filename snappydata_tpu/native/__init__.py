@@ -13,6 +13,7 @@ import subprocess
 import sys
 import sysconfig
 import threading
+from snappydata_tpu.utils import locks
 from typing import Optional, Tuple
 
 import numpy as np
@@ -22,7 +23,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
 _SRC = os.path.join(_REPO_ROOT, "native", "_fastingest.cpp")
 _BUILD_DIR = os.path.join(_REPO_ROOT, "native", "build")
 
-_lock = threading.Lock()
+_lock = locks.named_lock("native.loader")
 _native = None
 _tried = False
 

@@ -9,7 +9,9 @@ dashboard/metrics. Here: a daemon thread snapshotting the catalog.
 
 from __future__ import annotations
 
+import logging
 import threading
+from snappydata_tpu.utils import locks
 import time
 from typing import Dict, Optional
 
@@ -333,7 +335,7 @@ class TableStatsService:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._stats: Dict[str, dict] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("observability.stats")
 
     def collect_once(self) -> Dict[str, dict]:
         stats: Dict[str, dict] = {}
@@ -383,8 +385,12 @@ class TableStatsService:
             while not self._stop.wait(self.interval_s):
                 try:
                     self.collect_once()
-                except Exception:
-                    pass
+                except Exception as e:
+                    # keep polling, but a permanently-failing collector
+                    # must not look like a healthy idle thread
+                    logging.getLogger(__name__).warning(
+                        "stats poll failed: %s", e)
+                    self.registry.inc("stats_poll_errors")
 
         self.collect_once()
         self._thread = threading.Thread(target=loop, daemon=True)

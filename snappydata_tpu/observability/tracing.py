@@ -44,6 +44,7 @@ import contextlib
 import contextvars
 import itertools
 import threading
+from snappydata_tpu.utils import locks
 import time
 import uuid
 from collections import deque
@@ -352,7 +353,7 @@ class TraceRing:
     SLOW_ENTRIES = 64
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("tracing.rings")
         self._ring: "deque[Trace]" = deque()
         self._slow: "deque[Trace]" = deque(maxlen=self.SLOW_ENTRIES)
         self.recorded = 0

@@ -43,6 +43,7 @@ holds the pieces the executor's join emitter composes:
 from __future__ import annotations
 
 import threading
+from snappydata_tpu.utils import locks
 import weakref
 from typing import Callable, List, Optional, Tuple
 
@@ -126,7 +127,7 @@ def encode_build_keys(pairs, valid_flat, null_flat):
 
 # --- build artifact cache -------------------------------------------------
 
-_CACHE_LOCK = threading.Lock()
+_CACHE_LOCK = locks.named_lock("join.build_cache")
 _BUILD_CACHE: dict = {}      # (id(ident), token) -> entry
 _BUILD_BYTES = [0]
 _tick = [0]

@@ -17,6 +17,7 @@ compiler instead of hand-written messaging.
 from __future__ import annotations
 
 import threading
+from snappydata_tpu.utils import locks
 from typing import Optional
 
 import jax
@@ -34,7 +35,7 @@ class MeshContext:
 
     _current: Optional["MeshContext"] = None
     _stack: list = []          # supports nested/reentrant `with`
-    _lock = threading.Lock()
+    _lock = locks.named_lock("parallel.mesh")
     _next_token = 0
 
     def __init__(self, mesh: Mesh):

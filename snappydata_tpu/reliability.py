@@ -32,6 +32,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import threading
+from snappydata_tpu.utils import locks
 import time
 from collections import OrderedDict
 from typing import Optional
@@ -115,7 +116,7 @@ class MutationDedup:
         self.max_entries = max(16, int(max_entries))
         self._done: "OrderedDict[str, dict]" = OrderedDict()
         self._pending: dict = {}       # sid -> threading.Event
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("reliability.dedup")
 
     def begin(self, sid: str, wait_s: float = 60.0) -> Optional[dict]:
         deadline = time.monotonic() + wait_s
@@ -167,7 +168,7 @@ class MutationDedup:
             return len(self._done)
 
 
-_DEDUP_LOCK = threading.Lock()
+_DEDUP_LOCK = locks.named_lock("reliability.dedup_registry")
 
 
 def dedup_for(catalog) -> MutationDedup:

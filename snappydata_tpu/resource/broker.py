@@ -24,6 +24,7 @@ memory_management.md:86-103). This module is the TPU-first analogue:
 from __future__ import annotations
 
 import threading
+from snappydata_tpu.utils import locks
 import time
 import weakref
 from typing import Dict, List, Optional, Tuple
@@ -76,7 +77,7 @@ class ResourceBroker:
 
     def __init__(self, props=None):
         self.props = props or config.global_properties()
-        self._cond = threading.Condition(threading.Lock())
+        self._cond = locks.named_condition("resource.broker_cond")
         self._active: Dict[str, QueryContext] = {}
         self._queue: List[QueryContext] = []
         self._inflight_bytes = 0
@@ -84,7 +85,7 @@ class ResourceBroker:
         # while the metrics registry lock is held, and admission bumps
         # metrics counters while _cond is held — sharing _cond here
         # would be a lock-order inversion (snapshot deadlock)
-        self._tables_lock = threading.Lock()
+        self._tables_lock = locks.named_lock("resource.broker_tables")
         # keyed (owner, name): one process holds many Catalog instances
         # (per-test sessions, scratch merges) — name-only keys let a
         # same-named table in another catalog silently replace this one's
@@ -142,6 +143,8 @@ class ResourceBroker:
         with self._tables_lock:
             dead = []
             for (owner, nm), ref in self._tables.items():
+                # locklint: callback-under-lock weakref deref, not a
+                # callback: it runs no user code and touches no locks
                 data = ref()
                 if data is None:
                     dead.append((owner, nm))
@@ -500,7 +503,7 @@ class ResourceBroker:
 
 
 _global_broker: Optional[ResourceBroker] = None
-_global_lock = threading.Lock()
+_global_lock = locks.named_lock("resource.broker_global")
 
 
 def global_broker() -> ResourceBroker:

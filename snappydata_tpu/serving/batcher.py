@@ -25,6 +25,7 @@ Correctness inside a fused batch:
 from __future__ import annotations
 
 import threading
+from snappydata_tpu.utils import locks
 import time
 from typing import List, Optional, Sequence
 
@@ -88,7 +89,7 @@ class BatchQueue:
     """Per-PreparedPlan queue + leader election state."""
 
     def __init__(self):
-        self.cond = threading.Condition(threading.Lock())
+        self.cond = locks.named_condition("serving.batcher_cond")
         self.waiting: List[_Request] = []
         self.leader: Optional[_Request] = None
         # adaptive coalescing signal: last time a request arrived while
@@ -106,6 +107,7 @@ class MicroBatcher:
         (or error) is ready."""
         q = entry.batch_queue
         if q is None:
+            # locklint: lock=serving.plan (entry is a PreparedPlan)
             with entry._lock:
                 if entry.batch_queue is None:
                     entry.batch_queue = BatchQueue()

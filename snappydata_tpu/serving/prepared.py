@@ -20,6 +20,7 @@ and their (host) bytes ride the resource broker's unified ledger.
 from __future__ import annotations
 
 import threading
+from snappydata_tpu.utils import locks
 import time
 import weakref
 from collections import OrderedDict
@@ -76,7 +77,7 @@ class PreparedPlan:
     def __init__(self, session, sql_text: str):
         self.sql = sql_text
         self.catalog = session.catalog
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("serving.plan")
         # per-compiled-plan micro-batch queue lives on the entry so it
         # dies with it (see batcher.BatchQueue)
         self.batch_queue = None
@@ -558,7 +559,7 @@ class ServingRegistry:
 
     def __init__(self, catalog):
         self.catalog = catalog
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("serving.registry")
         self._entries: "OrderedDict[str, PreparedPlan]" = OrderedDict()
         _REGISTRIES.add(self)
 
@@ -629,7 +630,7 @@ class ServingRegistry:
 
 # every live registry, for the broker's unified ledger
 _REGISTRIES: "weakref.WeakSet" = weakref.WeakSet()
-_REG_LOCK = threading.Lock()
+_REG_LOCK = locks.named_lock("serving.registry_global")
 
 
 def registry_for(catalog) -> ServingRegistry:

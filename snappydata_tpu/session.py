@@ -9,6 +9,7 @@ parse → analyze → plan-cache lookup keyed on tokenized plan → execute).
 from __future__ import annotations
 
 import threading
+from snappydata_tpu.utils import locks
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -31,7 +32,7 @@ class SnappySession:
     SnappyCluster (or a process-local default), mirroring embedded mode."""
 
     _default_catalog: Optional[Catalog] = None
-    _default_lock = threading.Lock()
+    _default_lock = locks.named_lock("session.default_registry")
 
     def __init__(self, catalog: Optional[Catalog] = None, conf=None,
                  data_dir: Optional[str] = None, recover: bool = True,
@@ -321,6 +322,11 @@ class SnappySession:
                 # the WAL seq IS the commit timestamp: manifests this
                 # statement publishes carry it (mvcc epoch fences)
                 with mvcc.commit_scope(seq):
+                    # locklint: blocking-under-lock nested reads under a
+                    # DML's mutation hold run on STORE-LESS scratch
+                    # sessions (tile-merge scratch, matview folds) whose
+                    # _sql_statement never reaches wal_sync/fsync; device
+                    # waits here are the cost of journal->apply atomicity
                     result = self.execute_statement(stmt, tuple(params))
             # ack gate (group commit): the record may still sit in the
             # commit buffer — wal_sync blocks until the covering fsync,
@@ -1828,6 +1834,10 @@ class SnappySession:
                                 nulls=nulls,
                                 extra={"stmt_id": sid} if sid else None)
             with mvcc.commit_scope(seq), _mv.managed_base_write():
+                # locklint: callback-under-lock journal->apply under ONE
+                # mutation hold IS the WAL invariant (on-disk log >=
+                # in-memory state); apply_fn is the statement's own
+                # apply, not a foreign registry callback
                 out = apply_fn()
         ds.wal_sync(seq, force=sync_force)
         return out

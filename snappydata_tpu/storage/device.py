@@ -27,6 +27,7 @@ import numpy as np
 
 from snappydata_tpu import types as T
 from snappydata_tpu.storage.table_store import ColumnTableData, Manifest
+from snappydata_tpu.utils import locks
 
 
 def _next_pow2(n: int) -> int:
@@ -790,7 +791,7 @@ class _DeviceCacheBudget:
     def __init__(self):
         import threading
 
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("storage.device_cache")
         # (id(table_cache_dict), cache_key) -> (bytes, tick, cache_ref)
         self._entries: Dict = {}
         self._tick = 0

@@ -24,6 +24,7 @@ import os
 import shutil
 import tempfile
 import threading
+from snappydata_tpu.utils import locks
 import weakref
 from typing import Optional, Tuple
 
@@ -31,7 +32,7 @@ import numpy as np
 
 _spill_dir: Optional[str] = None
 _spill_ids = itertools.count()  # unique filenames (id() values recycle)
-_spill_lock = threading.Lock()
+_spill_lock = locks.named_lock("storage.spill")
 _spill_bytes = 0                # live spill-file bytes (broker ledger)
 
 
@@ -121,6 +122,9 @@ def spill_batch(batch) -> Tuple[int, object]:
                 freed += ac.nbytes
             staged.append(offs)
         fh.flush()
+        # locklint: blocking-under-lock spill runs on the degradation
+        # ladder under the table lock BY DESIGN: the manifest swap must
+        # be atomic vs mutation, and the write IS the memory relief
         os.fsync(fh.fileno())
     if freed == 0:
         os.unlink(path)
@@ -158,6 +162,7 @@ def spill_to_budget(data, budget: int) -> int:
     from snappydata_tpu.observability.metrics import global_registry
 
     spilled = 0
+    # locklint: lock=storage.column_table (only column tables spill)
     with data._lock:
         m = data._manifest
         per_view = [batch_resident_bytes(v.batch) for v in m.views]

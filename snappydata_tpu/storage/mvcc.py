@@ -55,6 +55,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import threading
+from snappydata_tpu.utils import locks
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -79,7 +80,7 @@ class SnapshotConflictError(RuntimeError):
 # (ColumnTableData._publish takes it around the reference swap), pin
 # capture, and retention refcounts.  Nothing slow ever runs under it —
 # that is the whole point of the subsystem.
-_clock_lock = threading.RLock()
+_clock_lock = locks.named_rlock("mvcc.clock")
 _epoch = [0]
 
 
@@ -354,7 +355,7 @@ class SnapshotPin:
         self._manifests: Dict[int, object] = {}
         self._rows: Dict[int, tuple] = {}
         self._datas: Dict[int, object] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("mvcc.pin")
         self.released = False
 
     # -- column tables -----------------------------------------------------
@@ -527,7 +528,10 @@ def pinned_scope(catalog, table_names=()):
 
                     names.extend(_referenced_tables(view))
                 except Exception:
-                    pass
+                    # best-effort widening only: the unexpanded table
+                    # still pins at first read — count it so a broken
+                    # view expansion is visible
+                    _reg().inc("mvcc_cut_expand_errors")
             continue
         if info.options.get("materialized_view"):
             continue   # pinned at first read, AFTER sync rewrites it

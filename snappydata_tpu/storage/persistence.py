@@ -31,6 +31,7 @@ import logging
 import os
 import struct
 import threading
+from snappydata_tpu.utils import locks
 import time
 import zlib
 from typing import Dict, List, Optional, Tuple
@@ -326,14 +327,20 @@ def salvage_file(path: str, counter: str = "wal_corrupt_records") -> int:
     with open(path + ".corrupt", "ab") as out:
         out.write(bad)
         out.flush()
+        # locklint: blocking-under-lock salvage runs at boot/first-touch
+        # under the io lock BY DESIGN: no write may land on an unsalvaged
+        # tail, and nothing serves traffic during recovery
         os.fsync(out.fileno())
     with open(path, "rb+") as fh:
         fh.truncate(valid_end)
         fh.flush()
+        # locklint: blocking-under-lock same salvage invariant as above
         os.fsync(fh.fileno())
     if err is not None:
         from snappydata_tpu.observability.metrics import global_registry
 
+        # locklint: metric-dynamic counter is one of the two declared
+        # names "wal_corrupt_records" (default) / "batch_corrupt_records"
         global_registry().inc(counter)
         _log.warning(
             "%s: %s at byte %d — salvaged %d-byte prefix, quarantined "
@@ -428,11 +435,11 @@ class DiskStore:
     def __init__(self, path: str):
         self.path = path
         os.makedirs(os.path.join(path, "tables"), exist_ok=True)
-        self._lock = threading.Lock()
-        self.mutation_lock = threading.RLock()
+        self._lock = locks.named_lock("storage.wal_buffer")
+        self.mutation_lock = locks.named_rlock("storage.mutation_lock")
         # serializes WAL file writes/rotation; lock order is always
         # _io_lock -> _lock, never the reverse
-        self._io_lock = threading.RLock()
+        self._io_lock = locks.named_rlock("storage.wal_io")
         self._wal_fh: Optional[io.BufferedWriter] = None
         # boot-time repair: quarantine damaged/torn suffixes BEFORE the
         # first append — appending after a torn tail would strand the new
@@ -474,7 +481,7 @@ class DiskStore:
         # flushed under _io_lock by WHOEVER writes next, so no other
         # bytes can reach the log before them (file order == seq order)
         self._pending_torn: List[Tuple[List[Tuple[int, bytes]], int]] = []
-        self._commit_cond = threading.Condition(self._lock)
+        self._commit_cond = locks.named_condition("storage.wal_buffer", self._lock)
         self._flusher: Optional[threading.Thread] = None
         self._closed = False
 
@@ -499,10 +506,15 @@ class DiskStore:
             raise failpoints.FaultError(
                 "failpoint checkpoint.write: injected torn write")
         with open(tmp, "rb") as fh:
+            # locklint: blocking-under-lock checkpoints hold mutation_lock
+            # across their durable-replace fsyncs BY DESIGN: the fold must
+            # be atomic vs committers (journal >= state invariant); rare,
+            # operator-paced
             os.fsync(fh.fileno())
         os.replace(tmp, dst)
         dfd = os.open(os.path.dirname(dst) or ".", os.O_RDONLY)
         try:
+            # locklint: blocking-under-lock same checkpoint invariant
             os.fsync(dfd)
         finally:
             os.close(dfd)
@@ -704,6 +716,8 @@ class DiskStore:
         # mutation_lock: no writer can be between journal and apply, so
         # every snapshot state == everything journaled up to wal_seq
         with self.mutation_lock:
+            # locklint: blocking-under-lock checkpoint must drain+fsync
+            # INSIDE its mutation hold (see below) — rare, operator-paced
             # drain the commit buffer BEFORE folding anything: the
             # snapshot below must only ever fold rows whose WAL records
             # are already fsynced — folding a buffered record and THEN
@@ -910,6 +924,9 @@ class DiskStore:
                 fh = self._ensure_fh()
                 fh.write(b"".join(raw for _, raw in group))
                 fh.flush()
+                # locklint: blocking-under-lock the pending-torn FIFO must
+                # flush under the io lock before ANY later write so file
+                # order == seq order after a crash-shaped tear; rare path
                 os.fsync(fh.fileno())
                 covered = group[-2][0] if len(group) > 1 else None
                 with self._lock:
@@ -925,6 +942,10 @@ class DiskStore:
                     # on a seq that can never drain
                     self._durable_seq = max(self._durable_seq, torn_seq)
                     self._commit_cond.notify_all()
+            # locklint: swallowed-exception not swallowed: the error
+            # object itself is routed to EVERY waiter through the
+            # poisoned seq range (_lost) and the _wal_damaged fence —
+            # strictly louder than a log line
             except Exception as e:
                 # a REAL I/O failure on top of the injected tear: nothing
                 # in this group is provably durable — poison it all so no
@@ -941,6 +962,9 @@ class DiskStore:
                 if self._wal_fh is not None:
                     try:
                         self._wal_fh.close()
+                    # locklint: swallowed-exception best-effort close on
+                    # an already-failing handle; the tear itself is
+                    # recorded via _lost/_wal_damaged above
                     except Exception:
                         pass
                     self._wal_fh = None
@@ -1019,6 +1043,9 @@ class DiskStore:
                     fh = self._ensure_fh()
                     fh.write(data[:keep])
                     fh.flush()
+                    # locklint: blocking-under-lock the drain IS the group
+                    # fsync (PR 3): wal_io exists to serialize it; acks
+                    # wait on _commit_cond, never on wal_io
                     os.fsync(fh.fileno())
                     # records whose frames lie ENTIRELY inside the
                     # written-and-fsynced prefix are durable — their acks
@@ -1041,6 +1068,8 @@ class DiskStore:
                 fh = self._ensure_fh()
                 fh.write(data)
                 fh.flush()
+                # locklint: blocking-under-lock the drain IS the group
+                # fsync (PR 3); see the torn-branch note above
                 os.fsync(fh.fileno())
             except BaseException as e:
                 # the group's records may be torn or absent on disk: the
@@ -1131,7 +1160,14 @@ class DiskStore:
             try:
                 self._drain()
             except Exception:
-                pass   # seq range poisoned; waiters raise it as the ack
+                # the failed seq range is poisoned — every waiter RAISES
+                # it as its ack — but count the event too: a flusher
+                # failing every tick should show on the dashboard, not
+                # only on whichever request happens to wait
+                from snappydata_tpu.observability.metrics import \
+                    global_registry
+
+                global_registry().inc("wal_flusher_errors")
 
     def current_wal_seq(self) -> int:
         with self._lock:
@@ -1439,6 +1475,8 @@ class DiskStore:
                                for ci, hit, vals, vn in deltas
                                if remap[ci] is not None)
             views.append(BatchView(batch, delete_mask, deltas))
+        # locklint: lock=storage.column_table (batch recovery is
+        # column-table only; row tables restore through their own path)
         with data._lock:
             # re-intern dictionaries so table-level codes match batch codes
             for ci in data._dicts:

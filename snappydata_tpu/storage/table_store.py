@@ -28,6 +28,7 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import threading
+from snappydata_tpu.utils import locks
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -198,7 +199,7 @@ class ColumnTableData:
         self.schema = schema
         self.capacity = capacity or props.column_batch_rows
         self.max_delta_rows = max_delta_rows or props.column_max_delta_rows
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("storage.column_table")
         self._batch_ids = itertools.count()
         self._row_buffer = RowBuffer(schema, max(self.max_delta_rows * 2,
                                                  self.capacity))
@@ -687,6 +688,9 @@ class ColumnTableData:
                 deltas = list(view.deltas)
                 for name, fn in assignments.items():
                     ci = self.schema.index(name)
+                    # locklint: callback-under-lock assignment evaluators
+                    # are pure host functions over the captured arrays;
+                    # they never touch storage locks or this table
                     raw = fn(cols)
                     values, vnulls = self._to_device_domain(
                         ci, raw, cols[self.schema.fields[ci].name])
@@ -703,6 +707,10 @@ class ColumnTableData:
                     for name, fn in assignments.items():
                         ci = self.schema.index(name)
                         col = rb._cols[ci][:rb.count]
+                        # locklint: callback-under-lock assignment
+                        # evaluators are pure host functions over the
+                        # captured arrays (compiled by the executor);
+                        # they never touch storage locks or this table
                         raw = fn(rb_cols)
                         if raw is None:  # SQL NULL assignment
                             if rb._nulls[ci] is None:
@@ -904,7 +912,7 @@ class RowTableData:
         self.schema = schema
         self.key_columns = [k.lower() for k in key_columns]
         self._key_idx = [schema.index(k) for k in self.key_columns]
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("storage.row_table")
         self._cols: List[List] = [[] for _ in schema.fields]
         self._live: List[bool] = []
         self._pk: Dict[tuple, int] = {}
@@ -1006,6 +1014,9 @@ class RowTableData:
             hit = np.asarray(predicate(cols)) & np.array(self._live)
             for name, fn in assignments.items():
                 ci = self.schema.index(name)
+                # locklint: callback-under-lock assignment evaluators are
+                # pure host functions over the captured arrays; they
+                # never touch storage locks or this table
                 vals = np.asarray(fn(cols))
                 for ordinal in np.flatnonzero(hit):
                     v = vals if vals.shape == () else vals[ordinal]

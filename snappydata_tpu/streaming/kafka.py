@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import json
 import threading
+from snappydata_tpu.utils import locks
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
@@ -68,7 +69,7 @@ class InProcessBroker(Broker):
     def __init__(self, num_partitions: int = 4):
         self.num_partitions = num_partitions
         self._topics: Dict[str, List[List[dict]]] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("kafka.inproc_broker")
 
     def _topic(self, topic: str) -> List[List[dict]]:
         with self._lock:
@@ -115,7 +116,7 @@ class FileBroker(Broker):
         self.directory = directory
         self.num_partitions = num_partitions
         os.makedirs(directory, exist_ok=True)
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("kafka.file_broker")
         # path -> (file size at parse time, parsed lines); the poll loop
         # hits end_offset for every partition every tick — re-parsing the
         # whole append-only log each time is O(log bytes) per 50ms

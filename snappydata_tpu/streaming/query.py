@@ -11,6 +11,7 @@ client library, in-process brokers work out of the box)."""
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
@@ -18,7 +19,9 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from snappydata_tpu.observability.metrics import global_registry
 from snappydata_tpu.streaming.sink import SnappySink
+from snappydata_tpu.utils import locks
 
 
 class Source:
@@ -34,7 +37,7 @@ class MemorySource(Source):
 
     def __init__(self):
         self._batches: List[Dict[str, np.ndarray]] = []
-        self._lock = threading.Lock()
+        self._lock = locks.named_lock("streaming.query")
 
     def add_batch(self, columns: Dict[str, np.ndarray]) -> None:
         with self._lock:
@@ -98,7 +101,7 @@ class SocketSource(Source):
 
         self.names = list(schema_names)
         self._buf: List[dict] = []
-        self._lock = _t.Lock()
+        self._lock = locks.named_lock("streaming.socket_source")
         self._sock = socket.create_connection((host, port), timeout=10)
         # the 10s timeout covers CONNECT only: a blocking read timeout
         # would poison the pump on any >10s producer idle gap
@@ -183,6 +186,9 @@ class StreamingQuery:
             try:
                 got = self.source.next_batch(offset)
             except Exception as e:  # source hiccup: retry next tick
+                logging.getLogger(__name__).warning(
+                    "stream source fetch failed: %s", e)
+                global_registry().inc("stream_source_errors")
                 self.last_error = e
                 got = None
             if got is None:
@@ -201,6 +207,11 @@ class StreamingQuery:
                 self._prune_source_log(offset)
                 offset = new_offset
             except Exception as e:
+                # retried next tick at the same offset (exactly-once
+                # sinks dedup) — but the stall must be visible
+                logging.getLogger(__name__).warning(
+                    "stream batch apply failed: %s", e)
+                global_registry().inc("stream_apply_errors")
                 self.last_error = e
                 time.sleep(self.interval_s)
 

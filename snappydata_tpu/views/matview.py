@@ -39,6 +39,7 @@ invariant (no acked row lost, no double-fold) extends to view state.
 from __future__ import annotations
 
 import threading
+from snappydata_tpu.utils import locks
 import weakref
 from typing import Dict, List, Optional, Tuple
 
@@ -161,7 +162,7 @@ class MaterializedView:
         self.base_table = _norm(base_table)
         self.sql_text = sql_text          # full CREATE DDL (persisted)
         self.select_sql = ""              # the AS <select> body
-        self._lock = threading.RLock()
+        self._lock = locks.named_rlock("views.matview")
         # definition (filled by define())
         self.group_exprs: Tuple[ast.Expr, ...] = ()
         self.slot_kinds: List[str] = []   # decomposed slot kind per __p
@@ -192,7 +193,7 @@ class MaterializedView:
         # journal (with the base version they committed at) and replay
         # on top of the rebuilt state for versions past the rescan's
         # pinned epoch.  _refresh_lock serializes whole refreshes.
-        self._refresh_lock = threading.Lock()
+        self._refresh_lock = locks.named_lock("views.matview_refresh")
         self._refreshing = False
         self._pending: List[tuple] = []   # (base_version, arrays, nulls, sign)
         self._pending_dirtied = False     # raced mark_stale/minmax delete
@@ -574,6 +575,12 @@ class MaterializedView:
             if n == 0:
                 return
             try:
+                # locklint: lock-order-undeclared,blocking-under-lock the
+                # fold's scratch session is STORE-LESS (_scratch_session):
+                # its statements never take the durable store's
+                # mutation_lock or reach wal_sync/fsync — the static
+                # chain through SnappySession.sql is unreachable here;
+                # device waits are the O(delta) fold itself
                 res = self._run_partial_over_delta(arrays, nulls)
                 self._merge_partial(res, sign)
             except Exception as e:  # noqa: BLE001 — never break ingest
@@ -811,6 +818,9 @@ class MaterializedView:
                         self.stale = True
                     else:
                         for parrays, pnulls, psign in _concat_pending(pend):
+                            # locklint: lock-order-undeclared,blocking-under-lock
+                            # same store-less scratch-session invariant as
+                            # fold_delta's call
                             pres = self._run_partial_over_delta(
                                 parrays, pnulls)
                             self._merge_partial(pres, psign)
@@ -876,6 +886,8 @@ class MaterializedView:
         # __mv_partials is truncated + re-filled per merge: like the
         # delta scratch, it must never be captured into an outer pin
         with self._lock, mvcc.unpinned_scope():
+            # locklint: blocking-under-lock store-less scratch session —
+            # truncate/re-fill never journals or fsyncs
             s = self._scratch_session()
             info = s.catalog.describe("__mv_partials")
             info.data.truncate()
@@ -927,9 +939,17 @@ class MaterializedView:
         lock_cm = ds.mutation_lock \
             if (pin is not None and ds is not None) else _null_cm()
         with lock_cm:
+            # locklint: blocking-under-lock the O(G) device merge runs
+            # under mutation_lock BY DESIGN (base and view must agree to
+            # the row within one statement — PR 11); scratch reads are
+            # store-less, so no fsync hides in here
             self._sync_merge(session, pin, base)
 
     def _sync_merge(self, session, pin, base) -> None:
+        # locklint: blocking-under-lock the O(G) device merge runs under
+        # mutation_lock BY DESIGN (base rows and view rows must agree to
+        # the row within one statement — PR 11); scratch reads are
+        # store-less, so no fsync hides in here
         with self._lock:
             if self.stale:
                 return   # a racing dirtier won: next read re-aggregates
@@ -941,6 +961,9 @@ class MaterializedView:
                 pin.repin(base.data)
             if not self._dirty:
                 return
+            # locklint: blocking-under-lock finalize reads the partial
+            # state through the STORE-LESS scratch session (no journal,
+            # no fsync); the device wait is the merge itself
             merged = self.finalize()
             backing = session.catalog.lookup_table(self.name)
             if backing is None:

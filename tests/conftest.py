@@ -11,6 +11,7 @@ override the *config* after import, before any backend initializes.
 """
 
 import os
+import sys
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -24,6 +25,47 @@ jax.config.update("jax_enable_x64", True)
 assert jax.default_backend() == "cpu", jax.default_backend()
 
 import pytest  # noqa: E402
+
+# ---- runtime lockdep witness (SNAPPY_TPU_LOCKDEP=1) -------------------
+# snappydata_tpu.utils.locks enables itself from the env var at import
+# (before any engine lock exists, since this conftest imports before the
+# test modules import the package). Here we add the END-OF-SESSION
+# check: zero cycle violations, and the observed acquisition-order graph
+# must be a subgraph of the declared manifest (tools/locklint/
+# lock_order.toml) — an edge tests actually exercised that the manifest
+# does not allow fails the run.
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+_LOCKDEP = os.environ.get("SNAPPY_TPU_LOCKDEP", "").strip() in (
+    "1", "true", "on")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if not _LOCKDEP:
+        return
+    from snappydata_tpu.utils import locks
+    from tools.locklint import load_manifest
+
+    problems = list(locks.violations())
+    try:
+        man = load_manifest()
+    except Exception as e:
+        problems.append("lockdep: cannot load lock_order.toml: %s" % e)
+        man = None
+    if man is not None:
+        problems.extend(locks.assert_subgraph(man.allows))
+    if problems:
+        sys.stderr.write(
+            "\n=== lockdep witness failures (%d) ===\n" % len(problems))
+        for p in problems:
+            sys.stderr.write(p + "\n")
+        raise RuntimeError(
+            "lockdep witness: %d problem(s); see stderr above — extend "
+            "LOCK_ORDER.md + lock_order.toml only with a reviewed "
+            "invariant" % len(problems))
 
 
 @pytest.fixture()

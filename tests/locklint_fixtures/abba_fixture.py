@@ -1,0 +1,47 @@
+"""Fixture: the PR 6 ABBA shape, reduced to its skeleton.
+
+Thread A (committer): mutation_lock -> view lock (the fold).
+Thread B (stale-view reader, the PRE-fix bug): view lock ->
+mutation_lock (refresh inside the read).
+
+tools/locklint must flag the `fixture.view -> fixture.mutation` edge as
+undeclared AND report the two-edge cycle with both sites. This module
+is analyzed by tests, never imported by the engine."""
+
+import threading
+
+from snappydata_tpu.utils import locks
+
+
+class Store:
+    def __init__(self):
+        self.mutation_lock = locks.named_rlock("fixture.mutation")
+        self.rows = []
+
+    def commit(self, view: "View", delta):
+        # the fold path: mutation -> view
+        with self.mutation_lock:
+            self.rows.extend(delta)
+            view.fold(delta)
+
+
+class View:
+    def __init__(self, store):
+        self._lock = threading.Lock()   # also an unnamed-lock finding
+        self.store = store
+        self.state = 0
+        self.stale = True
+
+    def fold(self, delta):
+        with self._lock:
+            self.state += len(delta)
+
+    def read(self):
+        # the PRE-FIX bug: refresh under the view lock takes the
+        # mutation lock -> view -> mutation, closing the cycle
+        with self._lock:
+            if self.stale:
+                with self.store.mutation_lock:
+                    self.state = len(self.store.rows)
+                    self.stale = False
+            return self.state

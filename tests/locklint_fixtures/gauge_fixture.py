@@ -1,0 +1,49 @@
+"""Fixture: the PR 10 gauge-under-registry-lock shape.
+
+`snapshot()` iterates the gauge callables and invokes them while STILL
+holding the registry lock — a gauge that touches the registry (e.g. a
+ledger refresh calling `inc()`) self-deadlocks on the non-reentrant
+lock. tools/locklint must flag the `fn()` call as callback-under-lock.
+Also carries a swallowed-exception loop and a metric-name collision
+pair for the sibling lints. Never imported by the engine."""
+
+import time
+
+from snappydata_tpu.utils import locks
+
+
+class Registry:
+    def __init__(self):
+        self._lock = locks.named_lock("fixture.registry")
+        self._gauges = {}
+        self._counters = {}
+
+    def gauge(self, name, fn):
+        with self._lock:
+            self._gauges[name] = fn
+
+    def inc(self, name):
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + 1
+
+    def snapshot(self):
+        out = {}
+        with self._lock:
+            for name, fn in self._gauges.items():
+                out[name] = fn()      # BUG: callback under the lock
+        return out
+
+
+def poller(registry, stop):
+    while not stop.is_set():
+        try:
+            registry.snapshot()
+        except Exception:
+            pass                      # BUG: swallowed in a loop
+        time.sleep(0.05)
+
+
+def collide(reg):
+    # BUG: distinct raw names, one sanitized form ("a.b" vs "a_b")
+    reg.inc("fixture.rows_seen")
+    reg.inc("fixture_rows_seen")

@@ -1,0 +1,372 @@
+"""Concurrency static-analysis + runtime lockdep witness suite.
+
+Covers the tools/locklint passes (lock-order manifest gate over the
+real tree, the PR 6 ABBA and PR 10 gauge-under-lock fixture shapes,
+metrics hygiene, background-exception hygiene), the manifest model, and
+the runtime witness (cycle reported with both stacks BEFORE the threads
+deadlock, RLock reentrancy, subgraph check, zero overhead when off)."""
+
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+pytestmark = pytest.mark.lockdep
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "locklint_fixtures")
+if ROOT not in sys.path:
+    sys.path.insert(0, ROOT)
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.locklint", *args],
+        cwd=ROOT, capture_output=True, text=True, timeout=300)
+
+
+# ---------------------------------------------------------------- CI gate
+
+def test_locklint_clean_on_real_tree():
+    """THE gate: `python -m tools.locklint snappydata_tpu/` exits 0 —
+    zero undeclared lock-order edges, zero unwaived blocking-call /
+    callback / metric / exception findings on the shipped tree."""
+    res = _cli("snappydata_tpu")
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "clean" in res.stdout
+
+
+def test_locklint_flags_historical_bug_fixtures():
+    """The reduced PR 6 ABBA shape and PR 10 gauge shape must be
+    flagged — the analyzer that misses them can't guard the real tree."""
+    res = _cli(os.path.relpath(FIXTURES, ROOT))
+    assert res.returncode == 1, res.stdout + res.stderr
+    out = res.stdout
+    # ABBA: cycle + both edges with sites
+    assert "lock-order-cycle" in out
+    assert "abba_fixture.py" in out
+    assert "fixture.mutation" in out
+    assert out.count("lock-order-undeclared") >= 2
+    # gauge-under-registry-lock
+    assert "callback-under-lock" in out
+    assert "gauge_fixture.py" in out
+    # sibling lints on the same fixtures
+    assert "swallowed-exception" in out
+    assert "metric-collision" in out
+    assert "unnamed-lock" in out
+
+
+# ------------------------------------------------------- analyzer details
+
+def _analyze_fixtures():
+    from tools.locklint import analyzer
+
+    return analyzer.analyze([FIXTURES])
+
+
+def test_static_edges_carry_sites():
+    an = _analyze_fixtures()
+    edges = {k: v for k, v in an.edges.items()}
+    fwd = [(a, b) for (a, b) in edges
+           if a == "fixture.mutation" and "View._lock" in b]
+    rev = [(a, b) for (a, b) in edges
+           if "View._lock" in a and b == "fixture.mutation"]
+    assert fwd and rev, sorted(edges)
+    for key in fwd + rev:
+        path, line, _via = edges[key]
+        assert path.endswith("abba_fixture.py") and line > 0
+
+
+def test_inter_procedural_edge_via_method_call():
+    """commit() holds the mutation lock and calls view.fold(), which
+    takes the view lock — the edge must come from the CALL chain, not a
+    direct with-nesting."""
+    an = _analyze_fixtures()
+    hit = [(k, v) for k, v in an.edges.items()
+           if k[0] == "fixture.mutation"]
+    assert hit
+    assert any("via" in v[2] for _k, v in hit)
+
+
+# ----------------------------------------------------------- manifest
+
+def test_manifest_rejects_declared_cycle():
+    from tools.locklint.manifest import Manifest, ManifestError
+
+    m = Manifest({"order": [{"chain": ["a", "b"]}, {"chain": ["b", "a"]}]})
+    with pytest.raises(ManifestError):
+        m.validate()
+
+
+def test_manifest_rejects_leaf_as_source():
+    from tools.locklint.manifest import Manifest, ManifestError
+
+    m = Manifest({"order": [{"chain": ["metrics", "x"]}],
+                  "leaf": {"names": ["metrics"]}})
+    with pytest.raises(ManifestError):
+        m.validate()
+
+
+def test_manifest_semantics():
+    from tools.locklint.manifest import Manifest
+
+    m = Manifest({
+        "order": [{"chain": ["a", "b", "c"]}, {"chain": ["c", "d"]}],
+        "edge": [{"from": "x", "to": "y"}],
+        "leaf": {"names": ["leafy"]},
+    })
+    m.validate()
+    assert m.allows("a", "b") and m.allows("a", "c")
+    assert m.allows("a", "d"), "closure must compose chains through c"
+    assert m.allows("x", "y") and not m.allows("y", "x")
+    assert not m.allows("b", "a")
+    assert m.allows("anything", "leafy")
+    assert not m.allows("leafy", "a"), "leaves are terminal"
+    assert m.allows("a", "a"), "same lock class: self-nesting policy"
+
+
+def test_shipped_manifest_is_valid_dag():
+    from tools.locklint import load_manifest
+
+    man = load_manifest()
+    # validate() ran inside load(); spot-check the codified orderings
+    assert man.allows("storage.mutation_lock", "views.matview"), \
+        "PR 6 ordering must be declared"
+    assert not man.allows("views.matview", "storage.mutation_lock")
+    assert man.allows("mvcc.pin", "mvcc.clock"), "PR 11 ordering"
+    assert not man.allows("mvcc.clock", "mvcc.pin")
+    assert man.allows("storage.mutation_lock",
+                      "observability.metrics_registry")
+
+
+def test_toml_lite_parses_manifest_shapes():
+    from tools.locklint import toml_lite
+
+    doc = toml_lite.loads(
+        'version = 1\n'
+        '# comment\n'
+        '[[order]]\n'
+        'name = "x"      # trailing comment\n'
+        'chain = ["a", "b",\n'
+        '         "c"]\n'
+        '[[order]]\n'
+        'chain = ["d", "e"]\n'
+        '[leaf]\n'
+        'names = ["m"]\n'
+        'flag = true\n')
+    assert doc["version"] == 1
+    assert doc["order"][0]["chain"] == ["a", "b", "c"]
+    assert doc["order"][1]["chain"] == ["d", "e"]
+    assert doc["leaf"]["names"] == ["m"] and doc["leaf"]["flag"] is True
+
+
+# ------------------------------------------------------ metrics hygiene
+
+def test_metric_registry_in_sync_with_tree():
+    """Every literal metric name used in the package is declared (the
+    lint enforces it in CI; this is the in-process mirror with a useful
+    diff on failure)."""
+    from tools.locklint import metrics_lint
+
+    decl = metrics_lint.load_declared(os.path.join(
+        ROOT, "snappydata_tpu", "observability", "metric_names.py"))
+    used = metrics_lint.collect_used([os.path.join(ROOT, "snappydata_tpu")])
+    declared_all = decl["counter"] | decl["timer"] | decl["gauge"]
+    missing = {k: sorted(v - declared_all) for k, v in used.items()
+               if v - declared_all}
+    assert not missing, missing
+
+
+def test_metric_collision_detected():
+    from tools.locklint import metrics_lint
+
+    assert metrics_lint._sanitize("a.b") == metrics_lint._sanitize("a_b")
+    res = _cli(os.path.relpath(FIXTURES, ROOT))
+    assert "metric-collision" in res.stdout
+
+
+# ------------------------------------------------------ runtime witness
+
+@pytest.fixture()
+def witness():
+    from snappydata_tpu.utils import locks
+
+    was = locks.enabled()
+    # save/RESTORE the global witness state: this fixture's tests create
+    # deliberate violations and fixture.* edges, which must not leak
+    # into a lockdep-enabled outer session's end-of-run check — but a
+    # blanket reset() would also erase the REAL edges/violations that
+    # session accumulated before this test file ran
+    snap = locks.snapshot_state()
+    locks.enable()
+    try:
+        yield locks
+    finally:
+        locks.restore_state(snap)
+        if not was:
+            locks.disable()
+
+
+def test_witness_reports_cycle_with_both_stacks_before_deadlock(witness):
+    """Two seeded threads: T1 establishes A->B; T2 takes B then tries A.
+    The witness must raise IN T2, BEFORE it blocks on A — with both
+    acquisition stacks — and both threads must finish (no deadlock)."""
+    locks = witness
+    A = locks.named_lock("fixture.thread_a")
+    B = locks.named_lock("fixture.thread_b")
+    e1, e2 = threading.Event(), threading.Event()
+    caught = []
+
+    def t1():
+        with A:
+            with B:            # establishes A -> B
+                pass
+        e1.set()
+        e2.wait(10)
+        with A:                # still fine afterwards
+            pass
+
+    def t2():
+        e1.wait(10)
+        with B:
+            try:
+                with A:        # closes the cycle: witness must raise
+                    pass
+            except locks.LockdepViolation as e:
+                caught.append(str(e))
+        e2.set()
+
+    th1 = threading.Thread(target=t1)
+    th2 = threading.Thread(target=t2)
+    th1.start(); th2.start()
+    th1.join(15); th2.join(15)
+    assert not th1.is_alive() and not th2.is_alive(), "threads deadlocked"
+    assert caught, "witness did not fire"
+    msg = caught[0]
+    assert "fixture.thread_a" in msg and "fixture.thread_b" in msg
+    assert "closes the cycle" in msg
+    # both stacks: the current thread's and the first-observed reverse edge's
+    assert "--- this thread" in msg and "--- reverse edge" in msg
+    assert msg.count("test_locklint.py") >= 2, msg
+    assert locks.violations(), "violation must be recorded globally too"
+
+
+def test_witness_detects_self_deadlock_on_plain_lock(witness):
+    """Same-thread re-acquisition of a non-reentrant named Lock is a
+    guaranteed self-deadlock (the PR 10 gauge shape): the witness must
+    RAISE instead of hanging, and record the violation globally."""
+    locks = witness
+    locks.reset()
+    L = locks.named_lock("fixture.selfdead")
+    with L:
+        with pytest.raises(locks.LockdepViolation, match="self-deadlock"):
+            L.acquire()
+    assert any("fixture.selfdead" in v for v in locks.violations())
+    # the lock is released and reusable afterwards
+    with L:
+        pass
+
+
+def test_witness_observes_edges_and_subgraph_check(witness):
+    locks = witness
+    locks.reset()
+    A = locks.named_lock("fixture.sub_a")
+    B = locks.named_lock("fixture.sub_b")
+    with A:
+        with B:
+            pass
+    assert ("fixture.sub_a", "fixture.sub_b") in locks.observed_edges()
+    bad = locks.assert_subgraph(lambda a, b: False)
+    assert any("fixture.sub_a -> fixture.sub_b" in m for m in bad)
+    ok = locks.assert_subgraph(lambda a, b: True)
+    assert ok == []
+
+
+def test_witness_rlock_reentrancy_no_self_edge(witness):
+    locks = witness
+    locks.reset()
+    R = locks.named_rlock("fixture.reentrant")
+    with R:
+        with R:                 # reentrant: no edge, no violation
+            pass
+    assert ("fixture.reentrant", "fixture.reentrant") \
+        not in locks.observed_edges()
+    assert not locks.violations()
+
+
+def test_witness_same_name_instances_nest(witness):
+    """Two instances of one lock CLASS may nest (per-table locks) —
+    self-nesting is the class's own business, not a cycle."""
+    locks = witness
+    locks.reset()
+    t1 = locks.named_lock("fixture.table")
+    t2 = locks.named_lock("fixture.table")
+    with t1:
+        with t2:
+            pass
+    assert not locks.violations()
+    assert ("fixture.table", "fixture.table") not in locks.observed_edges()
+
+
+def test_witness_condition_wait_releases_held_entry(witness):
+    locks = witness
+    locks.reset()
+    cond = locks.named_condition("fixture.cond")
+    hit = []
+
+    def waiter():
+        with cond:
+            cond.wait(timeout=5)
+            hit.append(True)
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    import time
+
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        with cond:
+            cond.notify_all()
+        if hit:
+            break
+        time.sleep(0.01)
+    th.join(5)
+    assert hit and not th.is_alive()
+    assert not locks.violations()
+
+
+def test_named_lock_plain_when_disabled():
+    from snappydata_tpu.utils import locks
+
+    if locks.enabled():
+        pytest.skip("outer session runs under SNAPPY_TPU_LOCKDEP")
+    lk = locks.named_lock("fixture.off")
+    assert type(lk) is type(threading.Lock()), \
+        "disabled witness must hand back the raw primitive (hot paths)"
+    rl = locks.named_rlock("fixture.off_r")
+    assert type(rl) is type(threading.RLock())
+
+
+# ------------------------------------------- witness over the real engine
+
+def test_representative_htap_chaos_under_lockdep():
+    """One representative seeded HTAP chaos test runs under
+    SNAPPY_TPU_LOCKDEP=1: zero cycle reports, and the conftest
+    session-end check proves the observed graph is a subgraph of the
+    declared manifest (a witness failure raises out of sessionfinish →
+    nonzero exit)."""
+    env = dict(os.environ)
+    env["SNAPPY_TPU_LOCKDEP"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PYTEST_CURRENT_TEST", None)
+    res = subprocess.run(
+        [sys.executable, "-m", "pytest",
+         "tests/test_mvcc.py::test_htap_chaos_schedule",
+         "-q", "-p", "no:cacheprovider"],
+        cwd=ROOT, capture_output=True, text=True, timeout=420, env=env)
+    assert res.returncode == 0, res.stdout[-4000:] + res.stderr[-4000:]
+    assert "1 passed" in res.stdout
+    assert "lockdep witness" not in res.stderr

@@ -1,0 +1,9 @@
+"""locklint: static lock-order analysis + metrics/exception hygiene.
+
+Run ``python -m tools.locklint snappydata_tpu/`` — exits nonzero on any
+unwaived finding. See LOCK_ORDER.md for the declared hierarchy and
+README "Concurrency invariants & static analysis" for how to read a
+report and extend the manifest."""
+
+from .common import Finding                      # noqa: F401
+from .manifest import Manifest, load as load_manifest  # noqa: F401

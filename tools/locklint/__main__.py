@@ -1,0 +1,87 @@
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from collections import Counter
+
+from . import analyzer, except_lint, manifest as manifest_mod, metrics_lint
+
+DEFAULT_DECL = os.path.join("snappydata_tpu", "observability",
+                            "metric_names.py")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.locklint",
+        description="static lock-order analysis + runtime-witness manifest "
+                    "check + metrics/exception hygiene lints")
+    ap.add_argument("paths", nargs="*", default=["snappydata_tpu"],
+                    help="package dirs/files to scan (default snappydata_tpu)")
+    ap.add_argument("--manifest", default=manifest_mod.DEFAULT_PATH,
+                    help="lock_order.toml path")
+    ap.add_argument("--metric-decls", default=None,
+                    help="metric_names.py path (default: "
+                         "<first-path>/observability/metric_names.py when "
+                         "present, else the repo default)")
+    ap.add_argument("--list-edges", action="store_true",
+                    help="dump the observed static lock-order graph and exit")
+    ap.add_argument("--dump-metrics", action="store_true",
+                    help="dump every literal metric name found and exit")
+    ap.add_argument("--no-metrics", action="store_true",
+                    help="skip the metrics-hygiene lint")
+    ap.add_argument("--no-except", action="store_true",
+                    help="skip the background-exception lint")
+    ap.add_argument("--no-locks", action="store_true",
+                    help="skip the lock-order pass")
+    args = ap.parse_args(argv)
+    paths = args.paths or ["snappydata_tpu"]
+
+    if args.dump_metrics:
+        used = metrics_lint.collect_used(paths)
+        for kind in ("counter", "timer", "gauge"):
+            for name in sorted(used[kind]):
+                print("%s %s" % (kind, name))
+        return 0
+
+    findings = []
+
+    if not args.no_locks:
+        man = manifest_mod.load(args.manifest)
+        an = analyzer.analyze(paths)
+        if args.list_edges:
+            for (a, b), (path, line, via) in sorted(an.edges.items()):
+                mark = " " if man.allows(a, b) else "!"
+                print("%s %s -> %s   (%s:%d %s)" % (mark, a, b, path, line,
+                                                    via))
+            return 0
+        findings.extend(an.check(man))
+
+    if not args.no_metrics:
+        decl = args.metric_decls
+        if decl is None:
+            cand = os.path.join(paths[0], "observability", "metric_names.py")
+            decl = cand if os.path.exists(cand) else DEFAULT_DECL
+        if os.path.exists(decl):
+            findings.extend(metrics_lint.run(paths, decl))
+        else:
+            print("locklint: metric declarations not found at %s — "
+                  "skipping metrics lint" % decl)
+
+    if not args.no_except:
+        findings.extend(except_lint.run(paths))
+
+    if not findings:
+        print("locklint: clean (%s)" % ", ".join(paths))
+        return 0
+    by_rule = Counter(f.rule for f in findings)
+    for f in sorted(findings):
+        print(f.render())
+    print("locklint: %d finding(s): %s"
+          % (len(findings),
+             ", ".join("%s=%d" % kv for kv in sorted(by_rule.items()))))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1046 @@
+"""Static lock-order analysis.
+
+Walks every module in the scanned tree and:
+
+1. **Inventories locks** — every ``locks.named_lock/named_rlock/
+   named_condition`` assignment (module global or ``self.attr``) maps an
+   attribute/global to a stable lock NAME; raw ``threading.Lock()``
+   constructions are themselves a finding (``unnamed-lock``) because an
+   anonymous lock defeats both this pass and the runtime witness.
+
+2. **Resolves acquisition sites** — ``with lock:`` items and
+   ``.acquire()`` calls, through a lightweight type propagation
+   (``self.x = C(...)``, parameter annotations, locals assigned from
+   constructors / typed attributes / lock-returning helpers) with a
+   unique-attribute fallback and a ``# locklint: lock=NAME`` escape
+   hatch.
+
+3. **Builds the inter-procedural held-while-acquiring graph** — per
+   function: (lock, held-set) at each acquisition plus every call made
+   under each held set; a fixed point propagates callee-acquired locks
+   and callee-reachable blocking calls up through resolved calls (self
+   methods, typed receivers, module/imported functions). Unresolvable
+   calls are skipped: the pass is deliberately unsound-but-useful, and
+   the runtime witness backstops it on the paths tests actually run.
+
+4. **Reports** — edges not derivable from the committed manifest
+   (``lock-order-undeclared``), cycles in the observed static graph
+   (``lock-order-cycle``, the ABBA shape), blocking calls executed or
+   reachable while a lock is held (``blocking-under-lock``: fsync /
+   wal_sync / sleeps / socket & Flight calls / ``block_until_ready`` /
+   thread joins / condition-or-event waits beyond the condition's own
+   lock), and callbacks invoked under a lock (``callback-under-lock``,
+   the PR 10 gauge-under-registry-lock shape: calling a value fetched
+   from a container or parameter while holding the container's lock).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .common import (Finding, SourceFile, dotted, load_sources, module_name,
+                     str_const, terminal_name)
+
+NAMED_CTORS = {"named_lock", "named_rlock", "named_condition"}
+RAW_CTORS = {"Lock", "RLock", "Condition"}
+
+# blocking-call terminals: matched against the last component of the
+# callee's dotted name; (terminal, extra-predicate description)
+_BLOCKING_TERMINALS = {
+    "sleep": "time.sleep",
+    "fsync": "os.fsync",
+    "wal_sync": "WAL fsync gate",
+    "flush_wals": "cluster durability barrier",
+    "block_until_ready": "device sync",
+    "device_get": "device transfer",
+    "urlopen": "HTTP round-trip",
+    "sendall": "socket write",
+    "recv": "socket read",
+    "accept": "socket accept",
+    "do_get": "Flight/gRPC call",
+    "do_put": "Flight/gRPC call",
+    "do_action": "Flight/gRPC call",
+    "get_flight_info": "Flight/gRPC call",
+}
+_THREADISH_RE = re.compile(
+    r"(thread|worker|flusher|poller|drainer|proc)", re.IGNORECASE)
+
+
+class ClassInfo:
+    def __init__(self, key: str, module: str, name: str):
+        self.key = key
+        self.module = module
+        self.name = name
+        self.node: Optional[ast.ClassDef] = None
+        self.base_names: List[str] = []
+        self.attr_locks: Dict[str, str] = {}
+        self.attr_types: Dict[str, str] = {}     # attr -> class key
+        self.methods: Dict[str, str] = {}        # name -> func key
+
+
+class FuncInfo:
+    def __init__(self, key: str, node: ast.AST, module: str,
+                 class_key: Optional[str], src: SourceFile):
+        self.key = key
+        self.node = node
+        self.module = module
+        self.class_key = class_key
+        self.src = src
+        # analysis results
+        self.direct_edges: List[Tuple[Tuple[str, ...], str, int]] = []
+        self.acquired: Set[str] = set()
+        self.calls: List[Tuple[str, Tuple[str, ...], int]] = []
+        self.blocking: List[Tuple[str, int, bool]] = []  # (desc, line, held)
+        self.callbacks: List[Tuple[Tuple[str, ...], int, str]] = []
+        self.unresolved: List[Tuple[int, str]] = []
+        # generator-based contextmanagers: locks held across the yield —
+        # the caller's with-body runs under them
+        self.yields_under: Set[str] = set()
+
+    def reset_results(self) -> None:
+        self.direct_edges = []
+        self.acquired = set()
+        self.calls = []
+        self.blocking = []
+        self.callbacks = []
+        self.unresolved = []
+        # fixed-point summaries
+        self.reach_locks: Dict[str, Tuple[str, ...]] = {}   # lock -> chain
+        self.reach_blocking: Dict[str, Tuple[str, ...]] = {}
+
+
+class ModuleInfo:
+    def __init__(self, modname: str, src: SourceFile):
+        self.name = modname
+        self.src = src
+        self.import_mods: Dict[str, str] = {}        # alias -> dotted module
+        self.import_names: Dict[str, Tuple[str, str]] = {}  # name->(mod,name)
+        self.classes: Dict[str, ClassInfo] = {}
+        self.functions: Dict[str, str] = {}          # name -> func key
+        self.global_locks: Dict[str, str] = {}       # global var -> lock name
+        self.global_types: Dict[str, str] = {}       # global var -> class key
+        self.lock_returners: Dict[str, str] = {}     # func name -> lock name
+        self.func_return_types: Dict[str, str] = {}  # func name -> class key
+
+
+class Analysis:
+    """Whole-tree analysis state + results."""
+
+    def __init__(self, paths: Sequence[str]):
+        self.sources = load_sources(list(paths))
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.classes: Dict[str, ClassInfo] = {}
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.findings: List[Finding] = []
+        self.lock_names: Set[str] = set()
+        # attr -> set of lock names (for the unique-attr fallback)
+        self.attr_name_index: Dict[str, Set[str]] = {}
+        # (held, acquired) -> (file, line, via)
+        self.edges: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+
+    # ---------------- phase 1: module shells ----------------
+
+    def build(self) -> None:
+        for path, src in sorted(self.sources.items()):
+            modname = module_name(path)
+            mi = ModuleInfo(modname, src)
+            self.modules[modname] = mi
+            self._scan_imports(mi)
+            self._scan_defs(mi)
+        for mi in self.modules.values():
+            self._scan_locks(mi)
+        for mi in self.modules.values():
+            self._scan_returners(mi)
+        # two walker rounds: the first discovers which contextmanager
+        # functions hold locks across their yield; the second re-walks
+        # with that knowledge so callers' with-bodies count as held
+        for fi in self.funcs.values():
+            _FunctionWalker(self, fi).run()
+        for fi in self.funcs.values():
+            fi.reset_results()
+        for fi in self.funcs.values():
+            _FunctionWalker(self, fi).run()
+        self._fixed_point()
+        self._assemble_edges()
+
+    def _scan_imports(self, mi: ModuleInfo) -> None:
+        for node in ast.walk(mi.src.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mi.import_mods[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:
+                    pkg = mi.name.split(".")
+                    pkg = pkg[: len(pkg) - node.level]
+                    base = ".".join(pkg + ([node.module]
+                                           if node.module else []))
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    target = a.asname or a.name
+                    mi.import_names[target] = (base, a.name)
+
+    def _scan_defs(self, mi: ModuleInfo) -> None:
+        def add_func(node, class_key, qual):
+            key = "%s:%s" % (mi.name, qual)
+            self.funcs[key] = FuncInfo(key, node, mi.name, class_key, mi.src)
+            return key
+
+        def walk_body(body, class_info: Optional[ClassInfo], prefix: str):
+            for node in body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    qual = prefix + node.name
+                    key = add_func(
+                        node, class_info.key if class_info else None, qual)
+                    if class_info is not None and prefix.count(".") == 1:
+                        class_info.methods[node.name] = key
+                    elif class_info is None and prefix == "":
+                        mi.functions[node.name] = key
+                    # nested defs (thread bodies, closures)
+                    walk_body(node.body, class_info, qual + ".")
+                elif isinstance(node, ast.ClassDef) and prefix == "":
+                    ck = "%s:%s" % (mi.name, node.name)
+                    ci = ClassInfo(ck, mi.name, node.name)
+                    ci.node = node
+                    for b in node.bases:
+                        d = dotted(b)
+                        if d:
+                            ci.base_names.append(d)
+                    mi.classes[node.name] = ci
+                    self.classes[ck] = ci
+                    walk_body(node.body, ci, node.name + ".")
+
+        walk_body(mi.src.tree.body, None, "")
+
+    # ---------------- phase 2: lock + type inventory ----------------
+
+    def _lock_ctor(self, value: ast.AST, mi: ModuleInfo,
+                   owner_attrs: Optional[Dict[str, str]],
+                   default_name: str, line: int) -> Optional[str]:
+        """If `value` constructs a lock, return its name (registering
+        findings for raw constructors)."""
+        if not isinstance(value, ast.Call):
+            return None
+        fn = value.func
+        term = terminal_name(fn)
+        if term in NAMED_CTORS:
+            name = str_const(value.args[0]) if value.args else None
+            if name is None:
+                self._finding("unnamed-lock", mi.src, line,
+                              "named lock constructor needs a literal name")
+                name = default_name
+            if term == "named_condition" and len(value.args) > 1:
+                # condition over an existing named lock: alias its name
+                inner = dotted(value.args[1])
+                if inner and owner_attrs is not None:
+                    attr = inner.split(".")[-1]
+                    if attr in owner_attrs:
+                        name = owner_attrs[attr]
+            return name
+        if term in RAW_CTORS:
+            d = dotted(fn) or term
+            head = d.split(".")[0]
+            if d == ("threading.%s" % term) or (
+                    mi.import_mods.get(head) == "threading") or (
+                    term in mi.import_names
+                    and mi.import_names[term][0] == "threading"):
+                self._finding(
+                    "unnamed-lock", mi.src, line,
+                    "raw threading.%s() — create it through "
+                    "snappydata_tpu.utils.locks.named_* so the analyzer "
+                    "and the runtime witness can name it" % term)
+                return default_name
+        return None
+
+    def _scan_locks(self, mi: ModuleInfo) -> None:
+        # module-level globals
+        for node in mi.src.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                var = node.targets[0].id
+                name = self._lock_ctor(node.value, mi, None,
+                                       "%s.%s" % (mi.name, var), node.lineno)
+                if name:
+                    mi.global_locks[var] = name
+                    self._register_lock(name, var)
+                    continue
+                ck = self._class_of_ctor(node.value, mi)
+                if ck:
+                    mi.global_types[var] = ck
+        # class attributes + self.attr assignments in every method; two
+        # passes so a named_condition(..., self._lock) alias resolves no
+        # matter where the condition sits relative to the lock
+        for ci in mi.classes.values():
+            for conditions_pass in (False, True):
+                for attr, value, line in self._class_attr_assigns(ci):
+                    is_cond = (isinstance(value, ast.Call)
+                               and terminal_name(value.func)
+                               == "named_condition")
+                    if is_cond != conditions_pass:
+                        continue
+                    name = self._lock_ctor(
+                        value, mi, ci.attr_locks,
+                        "%s.%s.%s" % (mi.name, ci.name, attr), line)
+                    if name:
+                        ci.attr_locks[attr] = name
+                        self._register_lock(name, attr)
+                    elif not conditions_pass:
+                        ck = self._class_of_ctor(value, mi)
+                        if ck:
+                            ci.attr_types[attr] = ck
+
+    def _class_attr_assigns(self, ci: ClassInfo):
+        """(attr, value, line) for class-body assigns and `self.attr =`
+        assigns in every method, in source order."""
+        out = []
+        if ci.node is not None:
+            for node in ci.node.body:
+                if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                        and isinstance(node.targets[0], ast.Name):
+                    out.append((node.targets[0].id, node.value, node.lineno))
+        for _mname, fkey in ci.methods.items():
+            fi = self.funcs.get(fkey)
+            if fi is None:
+                continue
+            for node in ast.walk(fi.node):
+                if not (isinstance(node, ast.Assign)
+                        and len(node.targets) == 1):
+                    continue
+                tgt = node.targets[0]
+                if isinstance(tgt, ast.Attribute) \
+                        and isinstance(tgt.value, ast.Name) \
+                        and tgt.value.id == "self":
+                    out.append((tgt.attr, node.value, node.lineno))
+        out.sort(key=lambda t: t[2])
+        return out
+
+    def _class_of_ctor(self, value: ast.AST, mi: ModuleInfo) -> Optional[str]:
+        if isinstance(value, ast.Call):
+            d = dotted(value.func)
+            if d:
+                return self._resolve_class(d, mi)
+        return None
+
+    def _resolve_class(self, d: str, mi: ModuleInfo) -> Optional[str]:
+        head, _, tail = d.partition(".")
+        if not tail:
+            if head in mi.classes:
+                return mi.classes[head].key
+            if head in mi.import_names:
+                srcmod, srcname = mi.import_names[head]
+                tgt = self._find_module(srcmod)
+                if tgt and srcname in tgt.classes:
+                    return tgt.classes[srcname].key
+            return None
+        if head in mi.import_mods:
+            tgt = self._find_module(mi.import_mods[head])
+            if tgt and tail in tgt.classes:
+                return tgt.classes[tail].key
+        return None
+
+    def _find_module(self, dotted_name: str) -> Optional[ModuleInfo]:
+        if dotted_name in self.modules:
+            return self.modules[dotted_name]
+        for name, mi in self.modules.items():
+            if name.endswith("." + dotted_name) or dotted_name.endswith(
+                    "." + name):
+                return mi
+        tail = dotted_name.split(".")[-1]
+        for name, mi in self.modules.items():
+            if name.split(".")[-1] == tail and (
+                    dotted_name in name or name in dotted_name):
+                return mi
+        return None
+
+    def _scan_returners(self, mi: ModuleInfo) -> None:
+        """Module functions that just return a lock or a typed global —
+        `clock_lock()` helpers, `global_registry()` accessors."""
+        for fname, fkey in mi.functions.items():
+            fi = self.funcs[fkey]
+            node = fi.node
+            rets = [n for n in ast.walk(node) if isinstance(n, ast.Return)
+                    and n.value is not None]
+            if len(rets) != 1:
+                continue
+            d = dotted(rets[0].value)
+            if d and d in mi.global_locks:
+                mi.lock_returners[fname] = mi.global_locks[d]
+            elif d and d in mi.global_types:
+                mi.func_return_types[fname] = mi.global_types[d]
+            else:
+                ck = self._class_of_ctor(rets[0].value, mi)
+                if ck:
+                    mi.func_return_types[fname] = ck
+
+    def _register_lock(self, name: str, attr: str) -> None:
+        self.lock_names.add(name)
+        self.attr_name_index.setdefault(attr, set()).add(name)
+
+    def _finding(self, rule: str, src: SourceFile, line: int,
+                 message: str) -> None:
+        if src.waived(line, rule):
+            return
+        self.findings.append(Finding(rule, src.path, line, message))
+
+    # ---------------- phase 4: fixed point ----------------
+
+    def _fixed_point(self) -> None:
+        for fi in self.funcs.values():
+            for lock in fi.acquired:
+                fi.reach_locks.setdefault(lock, (fi.key,))
+            for desc, line, _held in fi.blocking:
+                fi.reach_blocking.setdefault(
+                    desc, ("%s:%d" % (fi.key, line),))
+        changed = True
+        rounds = 0
+        while changed and rounds < 50:
+            changed = False
+            rounds += 1
+            for fi in self.funcs.values():
+                for callee_key, _held, _line in fi.calls:
+                    callee = self.funcs.get(callee_key)
+                    if callee is None:
+                        continue
+                    for lock, chain in callee.reach_locks.items():
+                        if lock not in fi.reach_locks:
+                            fi.reach_locks[lock] = (fi.key,) + chain
+                            changed = True
+                    for desc, chain in callee.reach_blocking.items():
+                        if desc not in fi.reach_blocking:
+                            fi.reach_blocking[desc] = (fi.key,) + chain
+                            changed = True
+
+    def _assemble_edges(self) -> None:
+        for fi in self.funcs.values():
+            for held, lock, line in fi.direct_edges:
+                for h in held:
+                    if h != lock:
+                        self._add_edge(h, lock, fi.src.path, line, "direct")
+            for callee_key, held, line in fi.calls:
+                if not held:
+                    continue
+                callee = self.funcs.get(callee_key)
+                if callee is None:
+                    continue
+                for lock, chain in callee.reach_locks.items():
+                    for h in held:
+                        if h != lock:
+                            self._add_edge(h, lock, fi.src.path, line,
+                                           "via " + " -> ".join(chain))
+
+    def _add_edge(self, held: str, lock: str, path: str, line: int,
+                  via: str) -> None:
+        key = (held, lock)
+        if key not in self.edges:
+            self.edges[key] = (path, line, via)
+
+    # ---------------- phase 5: report ----------------
+
+    def check(self, manifest) -> List[Finding]:
+        out: List[Finding] = list(self.findings)
+        # a waiver at the edge's recorded site removes it from the graph:
+        # one annotation kills both the undeclared-edge and any cycle it
+        # would close
+        active = {}
+        for key, (path, line, via) in self.edges.items():
+            src = self.sources.get(path)
+            if src and src.waived(line, "lock-order-undeclared"):
+                continue
+            active[key] = (path, line, via)
+        for (held, lock), (path, line, via) in sorted(active.items()):
+            if manifest is not None and not manifest.allows(held, lock):
+                out.append(Finding(
+                    "lock-order-undeclared", path, line,
+                    "acquires '%s' while holding '%s' (%s) — edge not in "
+                    "the declared hierarchy (lock_order.toml)"
+                    % (lock, held, via)))
+        out.extend(self._cycles(active))
+        for fi in self.funcs.values():
+            for line, msg in fi.unresolved:
+                self._append(out, "unresolved-acquisition", fi.src, line, msg)
+            for held, line, what in fi.callbacks:
+                self._append(
+                    out, "callback-under-lock", fi.src, line,
+                    "invokes %s while holding %s — a callback that "
+                    "touches the guarded structure self-deadlocks (the "
+                    "gauge-under-registry-lock shape); call it outside "
+                    "the lock or waive with the invariant"
+                    % (what, "/".join(sorted(set(held)))))
+            for desc, line, was_held in fi.blocking:
+                if not was_held:
+                    continue
+                self._append(
+                    out, "blocking-under-lock", fi.src, line,
+                    "%s while holding a lock — blocks every sibling of "
+                    "that lock for the call's full latency" % desc)
+            for callee_key, held, line in fi.calls:
+                if not held:
+                    continue
+                callee = self.funcs.get(callee_key)
+                if callee is None:
+                    continue
+                for desc, chain in callee.reach_blocking.items():
+                    self._append(
+                        out, "blocking-under-lock", fi.src, line,
+                        "%s reachable under lock %s (call chain %s)"
+                        % (desc, "/".join(sorted(set(held))),
+                           " -> ".join(chain)))
+        return out
+
+    def _append(self, out: List[Finding], rule: str, src: SourceFile,
+                line: int, msg: str) -> None:
+        if src.waived(line, rule):
+            return
+        out.append(Finding(rule, src.path, line, msg))
+
+    def _cycles(self, edges) -> List[Finding]:
+        adj: Dict[str, Set[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, set()).add(b)
+        out: List[Finding] = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+        for a, b in sorted(edges):
+            # path b -> a closes a cycle through edge (a, b)
+            path = self._path(adj, b, a)
+            if path is None:
+                continue
+            cyc = [a, b] + path[1:-1]   # path ends at a; keep nodes unique
+            k = min(tuple(cyc[i:] + cyc[:i]) for i in range(len(cyc)))
+            if k in seen_cycles:
+                continue
+            seen_cycles.add(k)
+            p, line, via = edges[(a, b)]
+            sites = []
+            for x, y in zip(cyc, cyc[1:] + [cyc[0]]):
+                e = edges.get((x, y))
+                if e:
+                    sites.append("%s->%s at %s:%d" % (x, y, e[0], e[1]))
+            out.append(Finding(
+                "lock-order-cycle", p, line,
+                "potential ABBA deadlock: cycle %s (%s)"
+                % (" -> ".join(cyc + [cyc[0]]), "; ".join(sites))))
+        return out
+
+    @staticmethod
+    def _path(adj, src, dst) -> Optional[List[str]]:
+        stack = [(src, [src])]
+        seen = {src}
+        while stack:
+            node, path = stack.pop()
+            for nxt in adj.get(node, ()):
+                if nxt == dst:
+                    return path + [dst]
+                if nxt not in seen:
+                    seen.add(nxt)
+                    stack.append((nxt, path + [nxt]))
+        return None
+
+    # ---------------- shared resolution helpers ----------------
+
+    def method_lookup(self, class_key: str, name: str,
+                      _depth: int = 0) -> Optional[str]:
+        ci = self.classes.get(class_key)
+        if ci is None or _depth > 8:
+            return None
+        if name in ci.methods:
+            return ci.methods[name]
+        mi = self.modules.get(ci.module)
+        for b in ci.base_names:
+            bk = self._resolve_class(b, mi) if mi else None
+            if bk:
+                got = self.method_lookup(bk, name, _depth + 1)
+                if got:
+                    return got
+        return None
+
+    def attr_lock_lookup(self, class_key: str, attr: str,
+                         _depth: int = 0) -> Optional[str]:
+        ci = self.classes.get(class_key)
+        if ci is None or _depth > 8:
+            return None
+        if attr in ci.attr_locks:
+            return ci.attr_locks[attr]
+        mi = self.modules.get(ci.module)
+        for b in ci.base_names:
+            bk = self._resolve_class(b, mi) if mi else None
+            if bk:
+                got = self.attr_lock_lookup(bk, attr, _depth + 1)
+                if got:
+                    return got
+        return None
+
+    def attr_type_lookup(self, class_key: str, attr: str) -> Optional[str]:
+        ci = self.classes.get(class_key)
+        if ci is None:
+            return None
+        if attr in ci.attr_types:
+            return ci.attr_types[attr]
+        return None
+
+
+class _FunctionWalker:
+    """Single-function pass: tracks the statically-held lock set through
+    with-blocks and acquire/release pairs, records acquisitions, calls,
+    blocking calls, and callback invocations."""
+
+    def __init__(self, an: Analysis, fi: FuncInfo):
+        self.an = an
+        self.fi = fi
+        self.mi = an.modules[fi.module]
+        self.src = fi.src
+        self.local_types: Dict[str, str] = {}
+        self.local_lock_alias: Dict[str, str] = {}
+        self.callable_locals: Set[str] = set()
+        node = fi.node
+        args = getattr(node, "args", None)
+        if args is not None:
+            allargs = list(args.posonlyargs) + list(args.args) + \
+                list(args.kwonlyargs)
+            for a in allargs:
+                if a.arg in ("self", "cls"):
+                    continue
+                ck = self._annotation_class(a.annotation)
+                if ck:
+                    self.local_types[a.arg] = ck
+            self.params = {a.arg for a in allargs
+                           if a.arg not in ("self", "cls")}
+        else:
+            self.params = set()
+
+    def _annotation_class(self, ann) -> Optional[str]:
+        if ann is None:
+            return None
+        if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+            d = ann.value.strip().strip('"')
+        else:
+            d = dotted(ann)
+        if not d:
+            return None
+        d = d.replace("Optional[", "").replace("]", "").strip()
+        return self.an._resolve_class(d, self.mi)
+
+    def run(self) -> None:
+        self.walk_block(self.fi.node.body, ())
+
+    # -------- lock / type / callee resolution --------
+
+    def resolve_type(self, expr: ast.AST, _depth: int = 0) -> Optional[str]:
+        if _depth > 6:
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id == "self" and self.fi.class_key:
+                return self.fi.class_key
+            if expr.id in self.local_types:
+                return self.local_types[expr.id]
+            if expr.id in self.mi.global_types:
+                return self.mi.global_types[expr.id]
+            return None
+        if isinstance(expr, ast.Attribute):
+            base = self.resolve_type(expr.value, _depth + 1)
+            if base:
+                return self.an.attr_type_lookup(base, expr.attr)
+            return None
+        if isinstance(expr, ast.Call):
+            d = dotted(expr.func)
+            if d:
+                ck = self.an._resolve_class(d, self.mi)
+                if ck:
+                    return ck
+                rt = self._func_return_type(d)
+                if rt:
+                    return rt
+            return None
+        return None
+
+    def _func_return_type(self, d: str) -> Optional[str]:
+        head, _, tail = d.partition(".")
+        if not tail:
+            if head in self.mi.func_return_types:
+                return self.mi.func_return_types[head]
+            if head in self.mi.import_names:
+                srcmod, srcname = self.mi.import_names[head]
+                tgt = self.an._find_module(srcmod)
+                if tgt and srcname in tgt.func_return_types:
+                    return tgt.func_return_types[srcname]
+            return None
+        if head in self.mi.import_mods:
+            tgt = self.an._find_module(self.mi.import_mods[head])
+            if tgt and tail in tgt.func_return_types:
+                return tgt.func_return_types[tail]
+        return None
+
+    def resolve_lock(self, expr: ast.AST, _depth: int = 0) -> Optional[str]:
+        if _depth > 6:
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in self.local_lock_alias:
+                return self.local_lock_alias[expr.id]
+            if expr.id in self.mi.global_locks:
+                return self.mi.global_locks[expr.id]
+            if expr.id in self.mi.import_names:
+                srcmod, srcname = self.mi.import_names[expr.id]
+                tgt = self.an._find_module(srcmod)
+                if tgt and srcname in tgt.global_locks:
+                    return tgt.global_locks[srcname]
+            return None
+        if isinstance(expr, ast.Attribute):
+            recv = expr.value
+            attr = expr.attr
+            if isinstance(recv, ast.Name) and recv.id in self.mi.import_mods:
+                tgt = self.an._find_module(self.mi.import_mods[recv.id])
+                if tgt and attr in tgt.global_locks:
+                    return tgt.global_locks[attr]
+            if isinstance(recv, ast.Name):
+                # class attribute access: Mesh._lock / cls._lock
+                ck = self.an._resolve_class(recv.id, self.mi)
+                if ck:
+                    got = self.an.attr_lock_lookup(ck, attr)
+                    if got:
+                        return got
+                if recv.id == "cls" and self.fi.class_key:
+                    got = self.an.attr_lock_lookup(self.fi.class_key, attr)
+                    if got:
+                        return got
+            ck = self.resolve_type(recv, _depth + 1)
+            if ck:
+                got = self.an.attr_lock_lookup(ck, attr)
+                if got:
+                    return got
+            # unique terminal attribute fallback
+            cands = self.an.attr_name_index.get(attr, set())
+            if len(cands) == 1:
+                return next(iter(cands))
+            return None
+        if isinstance(expr, ast.Call):
+            d = dotted(expr.func)
+            if d:
+                head, _, tail = d.partition(".")
+                if not tail and head in self.mi.lock_returners:
+                    return self.mi.lock_returners[head]
+                if not tail and head in self.mi.import_names:
+                    srcmod, srcname = self.mi.import_names[head]
+                    tgt = self.an._find_module(srcmod)
+                    if tgt and srcname in tgt.lock_returners:
+                        return tgt.lock_returners[srcname]
+                if tail and head in self.mi.import_mods:
+                    tgt = self.an._find_module(self.mi.import_mods[head])
+                    if tgt and tail in tgt.lock_returners:
+                        return tgt.lock_returners[tail]
+            return None
+        if isinstance(expr, ast.IfExp):
+            return self.resolve_lock(expr.body, _depth + 1) or \
+                self.resolve_lock(expr.orelse, _depth + 1)
+        return None
+
+    def resolve_callee(self, func: ast.AST) -> Optional[str]:
+        if isinstance(func, ast.Name):
+            # locally-defined nested function (thread bodies, helpers)
+            local_key = "%s.%s" % (self.fi.key, func.id)
+            if local_key in self.an.funcs:
+                return local_key
+            if func.id in self.mi.functions:
+                return self.mi.functions[func.id]
+            if func.id in self.mi.import_names:
+                srcmod, srcname = self.mi.import_names[func.id]
+                tgt = self.an._find_module(srcmod)
+                if tgt and srcname in tgt.functions:
+                    return tgt.functions[srcname]
+                # class constructor call -> its __init__
+                if tgt and srcname in tgt.classes:
+                    return self.an.method_lookup(
+                        tgt.classes[srcname].key, "__init__")
+            if func.id in self.mi.classes:
+                return self.an.method_lookup(
+                    self.mi.classes[func.id].key, "__init__")
+            return None
+        if isinstance(func, ast.Attribute):
+            recv, meth = func.value, func.attr
+            if isinstance(recv, ast.Name) and recv.id in self.mi.import_mods:
+                tgt = self.an._find_module(self.mi.import_mods[recv.id])
+                if tgt:
+                    if meth in tgt.functions:
+                        return tgt.functions[meth]
+                    if meth in tgt.classes:
+                        return self.an.method_lookup(
+                            tgt.classes[meth].key, "__init__")
+            ck = self.resolve_type(recv)
+            if ck:
+                return self.an.method_lookup(ck, meth)
+            return None
+        return None
+
+    # -------- statement walking --------
+
+    def walk_block(self, stmts: Sequence[ast.stmt],
+                   held: Tuple[str, ...]) -> None:
+        i = 0
+        n = len(stmts)
+        while i < n:
+            s = stmts[i]
+            acq = self._acquire_stmt(s)
+            if acq is not None:
+                expr_dump, lock = acq
+                self._record_acquire(lock, held, s.lineno)
+                end = self._find_release(stmts, i + 1, expr_dump)
+                self.walk_block(stmts[i + 1:end], held + (lock,))
+                i = end
+                continue
+            self.visit_stmt(s, held)
+            i += 1
+
+    def _acquire_stmt(self, s: ast.stmt):
+        """`lock.acquire()` (or `ok = lock.acquire(...)`) as its own
+        statement → (receiver-dump, lockname)."""
+        call = None
+        if isinstance(s, ast.Expr) and isinstance(s.value, ast.Call):
+            call = s.value
+        elif isinstance(s, ast.Assign) and isinstance(s.value, ast.Call):
+            call = s.value
+        if call is None or not isinstance(call.func, ast.Attribute) \
+                or call.func.attr != "acquire":
+            return None
+        lock = self.resolve_lock(call.func.value) \
+            or self.src.lock_hint(s.lineno)
+        if lock is None:
+            term = terminal_name(call.func.value)
+            if term and re.search(r"lock|cond|mutex|sem", term, re.I):
+                self.fi.unresolved.append((
+                    s.lineno,
+                    "cannot resolve the lock behind %r.acquire() — add a "
+                    "`# locklint: lock=NAME` hint" % (dotted(call.func.value)
+                                                      or term)))
+            return None
+        return (ast.dump(call.func.value), lock)
+
+    def _find_release(self, stmts, start: int, expr_dump: str) -> int:
+        for j in range(start, len(stmts)):
+            for node in ast.walk(stmts[j]):
+                if isinstance(node, ast.Call) \
+                        and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "release" \
+                        and ast.dump(node.func.value) == expr_dump:
+                    return j + 1
+        return len(stmts)
+
+    def _record_acquire(self, lock: str, held: Tuple[str, ...],
+                        line: int) -> None:
+        self.fi.acquired.add(lock)
+        if held:
+            self.fi.direct_edges.append((held, lock, line))
+
+    def visit_stmt(self, s: ast.stmt, held: Tuple[str, ...]) -> None:
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            return   # nested defs are separate FuncInfos
+        if held and isinstance(s, ast.Expr) \
+                and isinstance(s.value, (ast.Yield, ast.YieldFrom)):
+            self.fi.yields_under.update(held)
+        if isinstance(s, (ast.With, ast.AsyncWith)):
+            cur = held
+            for item in s.items:
+                self._scan_expr(item.context_expr, cur, skip_with_call=True)
+                lock = self.resolve_lock(item.context_expr) \
+                    or self.src.lock_hint(s.lineno)
+                if lock is not None:
+                    self._record_acquire(lock, cur, s.lineno)
+                    cur = cur + (lock,)
+                    continue
+                # contextmanager holding lock(s) across its yield: the
+                # with-body runs under them
+                if isinstance(item.context_expr, ast.Call):
+                    callee = self.resolve_callee(item.context_expr.func)
+                    cfi = self.an.funcs.get(callee) if callee else None
+                    if cfi is not None and cfi.yields_under:
+                        for lk in sorted(cfi.yields_under):
+                            self._record_acquire(lk, cur, s.lineno)
+                            cur = cur + (lk,)
+                        continue
+                self._maybe_unresolved_with(item.context_expr, s.lineno)
+            self.walk_block(s.body, cur)
+            return
+        if isinstance(s, ast.Assign):
+            self._track_assign(s)
+        elif isinstance(s, ast.AnnAssign) and s.value is not None \
+                and isinstance(s.target, ast.Name):
+            self._track_assign_target(s.target.id, s.value, s.annotation)
+        # scan expressions in this statement (not nested blocks)
+        for field in ast.iter_fields(s):
+            val = field[1]
+            if isinstance(val, ast.expr):
+                self._scan_expr(val, held)
+            elif isinstance(val, list):
+                for v in val:
+                    if isinstance(v, ast.expr):
+                        self._scan_expr(v, held)
+        if isinstance(s, ast.For):
+            self._track_for(s)   # BEFORE the body: `for k, fn in ...`
+        # nested blocks
+        for attr in ("body", "orelse", "finalbody"):
+            body = getattr(s, attr, None)
+            if body:
+                self.walk_block(body, held)
+        for h in getattr(s, "handlers", []) or []:
+            self.walk_block(h.body, held)
+
+    def _maybe_unresolved_with(self, expr: ast.AST, line: int) -> None:
+        term = terminal_name(expr)
+        if term and re.search(r"(^|_)(lock|cond|mutex)", term, re.I):
+            if self.src.waived(line, "unresolved-acquisition"):
+                return
+            self.fi.unresolved.append((
+                line,
+                "cannot resolve lock %r in with-statement — add a "
+                "`# locklint: lock=NAME` hint or waive" % (dotted(expr)
+                                                           or term)))
+
+    def _track_assign(self, s: ast.Assign) -> None:
+        if len(s.targets) != 1:
+            return
+        tgt = s.targets[0]
+        if isinstance(tgt, ast.Name):
+            self._track_assign_target(tgt.id, s.value, None)
+        elif isinstance(tgt, ast.Tuple):
+            # tuple unpack from .items()/zip: targets become callables
+            for el in tgt.elts:
+                if isinstance(el, ast.Name):
+                    self.callable_locals.add(el.id)
+
+    def _track_assign_target(self, name: str, value: ast.AST,
+                             _ann) -> None:
+        lock = self.resolve_lock(value)
+        if lock is not None:
+            self.local_lock_alias[name] = lock
+            return
+        ck = self.resolve_type(value)
+        if ck:
+            self.local_types[name] = ck
+            return
+        if isinstance(value, ast.Subscript):
+            self.callable_locals.add(name)
+
+    def _track_for(self, s: ast.For) -> None:
+        tgt = s.target
+        names = []
+        if isinstance(tgt, ast.Name):
+            names = [tgt.id]
+        elif isinstance(tgt, ast.Tuple):
+            names = [e.id for e in tgt.elts if isinstance(e, ast.Name)]
+        for nm in names:
+            self.callable_locals.add(nm)
+
+    # -------- expression scanning --------
+
+    def _scan_expr(self, expr: ast.AST, held: Tuple[str, ...],
+                   skip_with_call: bool = False) -> None:
+        # zero-arg calls compared with `is`/`is None` are weakref
+        # liveness probes (`entry["plan"]() is not plan`), not callbacks
+        probes = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Compare):
+                for sub in [node.left] + list(node.comparators):
+                    if isinstance(sub, ast.Call) and not sub.args \
+                            and not sub.keywords:
+                        probes.add(id(sub))
+            elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                                   ast.GeneratorExp)):
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Compare):
+                        for c in [sub.left] + list(sub.comparators):
+                            if isinstance(c, ast.Call) and not c.args \
+                                    and not c.keywords:
+                                probes.add(id(c))
+        for node in ast.walk(expr):
+            if not isinstance(node, ast.Call):
+                continue
+            self._handle_call(node, held, skip_top=skip_with_call
+                              and node is expr,
+                              is_probe=id(node) in probes)
+
+    def _handle_call(self, call: ast.Call, held: Tuple[str, ...],
+                     skip_top: bool = False, is_probe: bool = False) -> None:
+        func = call.func
+        term = terminal_name(func)
+        if term in ("acquire", "release") and isinstance(
+                func, ast.Attribute) and self.resolve_lock(
+                func.value) is not None:
+            return      # handled structurally
+        line = call.lineno
+        # blocking calls — a waiver at the SOURCE line suppresses the
+        # direct finding AND stops propagation up the call chains (the
+        # invariant is the callee's, not every caller's). Recorded even
+        # when nothing is held here: a caller may hold a lock across us.
+        if not skip_top:
+            desc = self._blocking_desc(func, term, held)
+            if desc and not self.src.waived(line, "blocking-under-lock"):
+                self.fi.blocking.append((desc, line, bool(held)))
+        # callback-under-lock: calling a value, not a known function
+        if held and not is_probe and self._is_callback_call(func) \
+                and not self.src.waived(line, "callback-under-lock"):
+            self.fi.callbacks.append(
+                (held, line, "callable value %r" % (dotted(func)
+                                                    or "<subscript>")))
+        # call graph
+        callee = self.resolve_callee(func)
+        if callee is not None:
+            self.fi.calls.append((callee, held, line))
+
+    def _blocking_desc(self, func, term, held) -> Optional[str]:
+        if term is None:
+            return None
+        if term == "wait" and isinstance(func, ast.Attribute):
+            own = self.resolve_lock(func.value)
+            others = [h for h in held if h != own]
+            if own is not None and others:
+                return ("condition wait on '%s' under other lock(s) %s — "
+                        "wait releases only its own lock"
+                        % (own, "/".join(others)))
+            if own is None:
+                d = dotted(func.value) or ""
+                if re.search(r"(event|ev|done|ready|stop|barrier|fut)",
+                             d.split(".")[-1], re.I):
+                    return "event/future wait (%s.wait)" % d
+            return None
+        if term == "join" and isinstance(func, ast.Attribute):
+            d = dotted(func.value) or ""
+            ck = self.resolve_type(func.value)
+            tailid = d.split(".")[-1]
+            if _THREADISH_RE.search(tailid) or tailid in ("t", "th") or (
+                    ck or "").endswith(":Thread"):
+                return "thread join (%s.join)" % d
+            return None
+        if term in _BLOCKING_TERMINALS:
+            d = dotted(func) or term
+            if term == "sleep":
+                head = d.split(".")[0]
+                if head not in ("time",) and d != "sleep":
+                    return None
+            if term == "recv":
+                # only socket-ish receivers
+                dd = (dotted(func.value) or "") if isinstance(
+                    func, ast.Attribute) else ""
+                if not re.search(r"sock|conn|chan", dd, re.I):
+                    return None
+            return "%s (%s)" % (_BLOCKING_TERMINALS[term], d)
+        return None
+
+    def _is_callback_call(self, func: ast.AST) -> bool:
+        if isinstance(func, ast.Subscript):
+            return True
+        if isinstance(func, ast.Name):
+            nm = func.id
+            if nm in self.callable_locals:
+                return True
+            if nm in self.params and nm not in self.local_types \
+                    and re.search(r"(fn|func|callback|cb|hook)$", nm, re.I):
+                return True
+        return False
+
+
+def analyze(paths: Sequence[str]) -> Analysis:
+    an = Analysis(paths)
+    an.build()
+    return an

@@ -1,0 +1,124 @@
+"""Shared plumbing for the locklint passes: findings, waiver comments,
+file walking, dotted-name rendering."""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterator, List, NamedTuple, Optional
+
+
+class Finding(NamedTuple):
+    rule: str
+    file: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return "%s:%d: [%s] %s" % (self.file, self.line, self.rule,
+                                   self.message)
+
+
+# `# locklint: rule1,rule2 <invariant text>` on the offending line or the
+# line above waives those rules at that site; the free text is the
+# reviewed invariant that makes the shape safe. `# locklint: lock=NAME`
+# additionally resolves an acquisition the analyzer cannot type.
+_WAIVE_RE = re.compile(r"#\s*locklint:\s*([A-Za-z0-9_,.\-]+)(?:\s+(.*))?")
+_LOCK_HINT_RE = re.compile(r"#\s*locklint:\s*lock=([A-Za-z0-9_.\-]+)")
+
+
+class SourceFile:
+    def __init__(self, path: str, text: str):
+        self.path = path
+        self.text = text
+        self.lines = text.split("\n")
+        self.tree = ast.parse(text, filename=path)
+
+    def _annotation_lines(self, line: int):
+        """The finding line itself, then upward through the contiguous
+        pure-comment block above it (multi-line invariant comments)."""
+        if 1 <= line <= len(self.lines):
+            yield self.lines[line - 1]
+        ln = line - 1
+        while ln >= 1 and self.lines[ln - 1].strip().startswith("#"):
+            yield self.lines[ln - 1]
+            ln -= 1
+
+    def waived(self, line: int, rule: str) -> bool:
+        for text in self._annotation_lines(line):
+            m = _WAIVE_RE.search(text)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                if rule in rules or "all" in rules:
+                    return True
+        return False
+
+    def lock_hint(self, line: int) -> Optional[str]:
+        for text in self._annotation_lines(line):
+            m = _LOCK_HINT_RE.search(text)
+            if m:
+                return m.group(1)
+        return None
+
+
+def iter_py_files(paths: List[str]) -> Iterator[str]:
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            yield p
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        yield os.path.join(dirpath, fn)
+
+
+def load_sources(paths: List[str]) -> Dict[str, SourceFile]:
+    out: Dict[str, SourceFile] = {}
+    for path in iter_py_files(paths):
+        with open(path, "r", encoding="utf-8") as fh:
+            out[path] = SourceFile(path, fh.read())
+    return out
+
+
+def module_name(path: str) -> str:
+    """Dotted module name from the path, rooted at the scanned tree."""
+    parts = os.path.normpath(path).split(os.sep)
+    if parts and parts[-1].endswith(".py"):
+        parts[-1] = parts[-1][:-3]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    # keep at most the package-relative tail; strip leading ./ roots
+    parts = [p for p in parts if p not in (".", "", "..")]
+    return ".".join(parts)
+
+
+def dotted(expr: ast.AST) -> Optional[str]:
+    """Render a Name/Attribute chain as 'a.b.c' (None for anything
+    fancier)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        base = dotted(expr.value)
+        if base is None:
+            return None
+        return base + "." + expr.attr
+    if isinstance(expr, ast.Call):
+        return None
+    return None
+
+
+def terminal_name(expr: ast.AST) -> Optional[str]:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

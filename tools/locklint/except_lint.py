@@ -1,0 +1,88 @@
+"""Background-thread exception lint.
+
+A long-lived loop (heartbeat, WAL flusher, stats poller, auto-rejoin)
+that catches broadly and swallows silently turns an infrastructure
+failure into a thread that is still "running" but doing nothing — the
+locator-heartbeat bug class PR 8 fixed by hand. The rule: inside any
+``while`` loop, a handler for ``except:`` / ``except Exception`` /
+``except BaseException`` must do at least one of:
+
+- log (a call whose name mentions log/warn/error/exception/debug/info,
+  or a ``logging``/``logger``/``log`` receiver),
+- bump a counter (``.inc(...)`` / ``record_time``),
+- re-raise, or leave the loop (``raise`` / ``return`` / ``break``).
+
+A handler that only sleeps/continues is the finding. Waive with
+``# locklint: swallowed-exception <invariant>`` when silence is the
+contract (e.g. best-effort cleanup)."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from .common import Finding, dotted, load_sources
+
+_LOGGISH_RE = re.compile(
+    r"(log|warn|error|exception|debug|info|print_exc)", re.IGNORECASE)
+_BROAD = (None, "Exception", "BaseException")
+
+
+def _handler_is_broad(h: ast.ExceptHandler) -> bool:
+    t = h.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, (ast.Name, ast.Attribute)):
+        names = [dotted(t)]
+    elif isinstance(t, ast.Tuple):
+        names = [dotted(e) for e in t.elts]
+    return any(n and n.split(".")[-1] in ("Exception", "BaseException")
+               for n in names)
+
+
+def _handler_handles(h: ast.ExceptHandler) -> bool:
+    for node in ast.walk(h):
+        if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+            return True
+        if isinstance(node, ast.Call):
+            d = dotted(node.func) or ""
+            term = d.split(".")[-1]
+            if not term and isinstance(node.func, ast.Attribute):
+                term = node.func.attr    # reg-returning call: x().inc(...)
+            if term in ("inc", "record_time"):
+                return True
+            if term == "print":
+                return True      # REPL/CLI loops surface to the human
+            if _LOGGISH_RE.search(term):
+                return True
+            head = d.split(".")[0]
+            if head in ("logging", "logger", "log", "LOG", "_log"):
+                return True
+    return False
+
+
+def run(paths: List[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for path, src in sorted(load_sources(paths).items()):
+        loops = [n for n in ast.walk(src.tree) if isinstance(n, ast.While)]
+        for loop in loops:
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Try):
+                    continue
+                for h in node.handlers:
+                    if not _handler_is_broad(h):
+                        continue
+                    if _handler_handles(h):
+                        continue
+                    line = h.lineno
+                    if src.waived(line, "swallowed-exception"):
+                        continue
+                    findings.append(Finding(
+                        "swallowed-exception", path, line,
+                        "broad except inside a loop swallows the error "
+                        "silently — log it and bump a counter (or break/"
+                        "re-raise); a dead background loop must be "
+                        "visible"))
+    return findings

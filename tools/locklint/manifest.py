@@ -1,0 +1,119 @@
+"""The committed lock-hierarchy manifest.
+
+``lock_order.toml`` is the machine-readable twin of LOCK_ORDER.md: it
+declares which lock-order edges are LEGAL. Both the static pass and the
+runtime witness check the graph they observe against it — any edge not
+derivable from the manifest fails.
+
+Semantics:
+
+- ``[[order]] chain = [a, b, c]`` — a may be held while acquiring b or
+  c, b while acquiring c (consecutive pairs; transitivity comes from the
+  closure, so chains sharing a lock compose).
+- ``[[edge]] from/to`` — a single extra legal edge.
+- ``[leaf] names = [...]`` — terminal locks: ANY lock may be held while
+  acquiring a leaf, and a leaf may not be held while acquiring anything
+  (unless an explicit chain/edge says so). The metrics-registry lock is
+  the canonical leaf: every hot region increments counters.
+- ``[self_nesting] names = [...]`` — lock classes whose INSTANCES may
+  nest (per-table locks); the witness and static pass skip same-name
+  edges for everyone, this section just documents which classes rely on
+  it.
+
+The declared graph must itself be acyclic — ``validate()`` enforces it,
+so a manifest edit can never quietly legalize an ABBA pair."""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Set, Tuple
+
+from . import toml_lite
+
+DEFAULT_PATH = os.path.join(os.path.dirname(__file__), "lock_order.toml")
+
+
+class ManifestError(ValueError):
+    pass
+
+
+class Manifest:
+    def __init__(self, doc: dict):
+        self.doc = doc
+        self.edges: Set[Tuple[str, str]] = set()
+        self.reasons: Dict[Tuple[str, str], str] = {}
+        self.leaves: Set[str] = set()
+        self.self_nesting: Set[str] = set()
+        self.names: Set[str] = set()
+        for order in doc.get("order", []):
+            chain = order.get("chain", [])
+            if not isinstance(chain, list) or len(chain) < 2:
+                raise ManifestError("[[order]] needs a chain of >= 2 locks: %r"
+                                    % (order,))
+            for a, b in zip(chain, chain[1:]):
+                self.edges.add((a, b))
+                self.reasons.setdefault(
+                    (a, b), order.get("reason", order.get("name", "")))
+            self.names.update(chain)
+        for edge in doc.get("edge", []):
+            a, b = edge.get("from"), edge.get("to")
+            if not a or not b:
+                raise ManifestError("[[edge]] needs from/to: %r" % (edge,))
+            self.edges.add((a, b))
+            self.reasons.setdefault((a, b), edge.get("reason", ""))
+            self.names.update((a, b))
+        self.leaves = set(doc.get("leaf", {}).get("names", []))
+        self.self_nesting = set(doc.get("self_nesting", {}).get("names", []))
+        self.names |= self.leaves | self.self_nesting
+        self._closure = self._compute_closure()
+
+    def _compute_closure(self) -> Dict[str, Set[str]]:
+        adj: Dict[str, Set[str]] = {}
+        for a, b in self.edges:
+            adj.setdefault(a, set()).add(b)
+        closure: Dict[str, Set[str]] = {}
+        for src in adj:
+            seen: Set[str] = set()
+            stack = [src]
+            while stack:
+                node = stack.pop()
+                for nxt in adj.get(node, ()):
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        stack.append(nxt)
+            closure[src] = seen
+        return closure
+
+    def validate(self) -> None:
+        """The declared hierarchy must be a DAG and leaves terminal."""
+        for src, reach in self._closure.items():
+            if src in reach:
+                raise ManifestError(
+                    "declared lock order contains a cycle through %r" % src)
+        for a, b in self.edges:
+            if a in self.leaves:
+                raise ManifestError(
+                    "leaf lock %r declared as predecessor of %r — leaves are "
+                    "terminal; drop it from [leaf] or drop the edge" % (a, b))
+
+    def allows(self, held: str, acquired: str) -> bool:
+        if held == acquired:
+            return True          # same lock class: self-nesting policy
+        if held in self.leaves:
+            return False         # leaves acquire nothing
+        if acquired in self.leaves:
+            return True
+        return acquired in self._closure.get(held, ())
+
+    def allowed_edges(self) -> Set[Tuple[str, str]]:
+        out = set(self.edges)
+        for src, reach in self._closure.items():
+            for dst in reach:
+                out.add((src, dst))
+        return out
+
+
+def load(path: str = DEFAULT_PATH) -> Manifest:
+    m = Manifest(toml_lite.load(path))
+    m.validate()
+    return m

@@ -1,0 +1,114 @@
+"""Minimal TOML-subset parser (this container's Python predates
+stdlib tomllib, and locklint must not grow third-party deps).
+
+Supported: ``[table]``, ``[[array-of-tables]]``, ``key = value`` with
+string / integer / boolean / array-of-strings values (arrays may span
+lines), and ``#`` comments. That is exactly the shape of
+``lock_order.toml``; anything else raises."""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List
+
+_KEY_RE = re.compile(r"^([A-Za-z0-9_.-]+)\s*=\s*(.*)$")
+_TABLE_RE = re.compile(r"^\[([A-Za-z0-9_.-]+)\]$")
+_ARRAY_TABLE_RE = re.compile(r"^\[\[([A-Za-z0-9_.-]+)\]\]$")
+
+
+class TomlError(ValueError):
+    pass
+
+
+def _strip_comment(line: str) -> str:
+    out = []
+    in_str = False
+    for ch in line:
+        if ch == '"':
+            in_str = not in_str
+        if ch == "#" and not in_str:
+            break
+        out.append(ch)
+    return "".join(out).strip()
+
+
+def _parse_scalar(tok: str, lineno: int) -> Any:
+    tok = tok.strip()
+    if tok.startswith('"') and tok.endswith('"') and len(tok) >= 2:
+        return tok[1:-1].replace('\\"', '"').replace("\\\\", "\\")
+    if tok in ("true", "false"):
+        return tok == "true"
+    if re.fullmatch(r"-?[0-9]+", tok):
+        return int(tok)
+    raise TomlError("line %d: unsupported value %r" % (lineno, tok))
+
+
+def _split_array_items(body: str, lineno: int) -> List[Any]:
+    items, cur, in_str = [], [], False
+    for ch in body:
+        if ch == '"':
+            in_str = not in_str
+            cur.append(ch)
+        elif ch == "," and not in_str:
+            tok = "".join(cur).strip()
+            if tok:
+                items.append(_parse_scalar(tok, lineno))
+            cur = []
+        else:
+            cur.append(ch)
+    tok = "".join(cur).strip()
+    if tok:
+        items.append(_parse_scalar(tok, lineno))
+    return items
+
+
+def loads(text: str) -> Dict[str, Any]:
+    root: Dict[str, Any] = {}
+    target = root
+    lines = text.split("\n")
+    i = 0
+    while i < len(lines):
+        raw = lines[i]
+        line = _strip_comment(raw)
+        i += 1
+        if not line:
+            continue
+        m = _ARRAY_TABLE_RE.match(line)
+        if m:
+            root.setdefault(m.group(1), [])
+            if not isinstance(root[m.group(1)], list):
+                raise TomlError("line %d: %s is not an array of tables"
+                                % (i, m.group(1)))
+            target = {}
+            root[m.group(1)].append(target)
+            continue
+        m = _TABLE_RE.match(line)
+        if m:
+            target = root.setdefault(m.group(1), {})
+            if not isinstance(target, dict):
+                raise TomlError("line %d: %s is not a table" % (i, m.group(1)))
+            continue
+        m = _KEY_RE.match(line)
+        if not m:
+            raise TomlError("line %d: cannot parse %r" % (i, raw))
+        key, val = m.group(1), m.group(2).strip()
+        if val.startswith("["):
+            body = val[1:]
+            start = i
+            # accumulate until the body (quotes balanced) ends with "]"
+            while not (body.count('"') % 2 == 0
+                       and body.rstrip().endswith("]")):
+                if i >= len(lines):
+                    raise TomlError("line %d: unterminated array" % start)
+                body += " " + _strip_comment(lines[i])
+                i += 1
+            body = body.rstrip()
+            target[key] = _split_array_items(body[:-1], start)
+        else:
+            target[key] = _parse_scalar(val, i)
+    return root
+
+
+def load(path: str) -> Dict[str, Any]:
+    with open(path, "r", encoding="utf-8") as fh:
+        return loads(fh.read())
