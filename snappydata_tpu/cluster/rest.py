@@ -144,6 +144,23 @@ def _render_dashboard(svc) -> str:
     rows_jn = "".join(
         f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
         for k, v in jn.items())
+    from snappydata_tpu.observability.stats_service import mesh_snapshot
+
+    msh = mesh_snapshot(svc.session.catalog, svc.session)
+    mesh_placement = msh.pop("placement", None)
+    mesh_perdev = msh.pop("resident_bytes_by_device", {})
+    rows_msh = "".join(
+        f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
+        for k, v in msh.items())
+    if mesh_placement is not None:
+        rows_msh += (
+            f"<tr><td>placement (gen "
+            f"{mesh_placement['generation']}, "
+            f"{mesh_placement['num_buckets']} buckets)</td>"
+            f"<td>{esc(str(mesh_placement['bucket_map']))}</td></tr>")
+    rows_mshd = "".join(
+        f"<tr><td>{esc(str(d))}</td><td>{b:,}</td></tr>"
+        for d, b in mesh_perdev.items())
     from snappydata_tpu.views import view_snapshot
 
     mv = view_snapshot(svc.session.catalog)
@@ -233,6 +250,9 @@ tiled scans)</h2>
 <th>device resident</th><th>resident B/row</th></tr>{rows_enc}</table>
 <h2>Join engine (device path / build cache / expansion)</h2>
 <table>{rows_jn}</table>
+<h2>Mesh execution (shard_map lane / exchange / placement)</h2>
+<table>{rows_msh}</table>
+<table><tr><th>device</th><th>resident bytes</th></tr>{rows_mshd}</table>
 <h2>Serving path (prepared statements / micro-batched dispatch)</h2>
 <table>{rows_sv}</table>
 <table><tr><th>prepared sql</th><th>params</th><th>executes</th>
@@ -381,6 +401,15 @@ class RestService:
                         mvcc_snapshot
 
                     self._send(mvcc_snapshot(svc.session.catalog))
+                elif path == "/status/api/v1/mesh":
+                    # mesh execution: shard_map lane counters, join
+                    # distribution strategies, bucket→device placement,
+                    # per-device resident plate bytes
+                    from snappydata_tpu.observability.stats_service import \
+                        mesh_snapshot
+
+                    self._send(mesh_snapshot(svc.session.catalog,
+                                             svc.session))
                 elif path == "/status/api/v1/streaming":
                     # streaming query progress (ref: the structured-
                     # streaming UI tab / StreamingQueryManager.active);

@@ -310,6 +310,39 @@ class Properties:
     # `retained_epoch_bytes`.
     mvcc_retained_epochs: int = 2
 
+    # Mesh-sharded query execution (engine/mesh_exec.py + parallel/).
+    # With a device mesh active (session.default_mesh / MeshContext),
+    # tilable aggregate shapes run their compile-once PARTIAL program
+    # per-shard under shard_map — every device scans only its batch
+    # slice of the (still-encoded) plates and the per-family [G]
+    # partials merge in-trace with psum/pmin/pmax (the reference's
+    # partial aggregation + CollectAggregateExec merge, done by
+    # collectives).  "off" keeps plain GSPMD jit for everything (the
+    # pre-r13 behavior); ineligible shapes always fall back to GSPMD,
+    # counted mesh_fallback_<reason>.
+    mesh_shard_exec: str = "auto"
+    # Join distribution strategy under the mesh lane:
+    #   auto       broadcast-build while the build side's decoded bytes
+    #              stay under mesh_broadcast_build_bytes, else
+    #              shuffle-on-key when the shape allows it
+    #   broadcast  always replicate the build side (probe stays sharded)
+    #   shuffle    always exchange BOTH sides bucket-wise on the join
+    #              key (parallel/hashing murmur3 over the encoded int64
+    #              key domain) so each device joins only its buckets
+    # Selection is per bind, counted mesh_join_broadcast /
+    # mesh_join_shuffle (+ mesh_join_shuffle_fallback_<reason> when an
+    # ineligible shape declines to broadcast).
+    mesh_join_strategy: str = "auto"
+    mesh_broadcast_build_bytes: int = 64 << 20
+    # Bucket granularity of the mesh shard placement (parallel/
+    # placement.py): the batch axis divides into this many logical
+    # buckets for rebalance accounting and the bucket→device map.
+    mesh_num_buckets: int = 32
+    # Bounded cache of shuffle-exchanged bind layouts (per compiled
+    # plan): entries re-use the bucketed exchange across executions of
+    # an unchanged table version. Entry COUNT cap, small by design.
+    mesh_shuffle_cache_entries: int = 4
+
     # Streaming (ref: SnappySinkCallback.scala:49-360)
     sink_state_table: str = "snappysys_internal____sink_state_table"
     sink_max_retries: int = 3

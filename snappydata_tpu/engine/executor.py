@@ -326,6 +326,11 @@ class CompiledPlan:
         # vmapped variants for the serving micro-batcher, keyed
         # (static sizes, padded batch size)
         self._jitted_vmap: Dict[tuple, Callable] = {}
+        # shard_map variants for the mesh execution lane, keyed
+        # (static sizes, mesh token, strategy) — engine/mesh_exec.py
+        self._jitted_mesh: Dict[tuple, Callable] = {}
+        # per-join distribution metadata (set by Compiler.compile)
+        self.join_meta: List[Dict] = []
         # compressed-domain trace notes per (static, phase): how many
         # predicates lowered to the code/run lanes in that trace —
         # tallied once at trace time, re-counted per execution
@@ -393,14 +398,20 @@ class CompiledPlan:
             if keep is not None and not keep.all():
                 # batch skipping: gather only qualifying batches (padded
                 # to a {2^k, 1.5*2^k} bucket so executable shapes stay
-                # stable — same bucketing as the bind)
+                # stable — same bucketing as the bind; under a mesh the
+                # bucket must ALSO divide by the shard count or the
+                # gathered arrays couldn't re-shard evenly)
+                from snappydata_tpu.parallel.mesh import (MeshContext,
+                                                          shard_bucket)
                 from snappydata_tpu.storage.device import batch_bucket
 
                 kept = np.flatnonzero(keep)
                 reg.inc("column_batches_skipped",
                         int(dt.num_batches - len(kept)))
                 sp.add("batches_skipped", int(dt.num_batches - len(kept)))
-                b_new = batch_bucket(len(kept))
+                mctx = MeshContext.current()
+                b_new = shard_bucket(len(kept), mctx.num_devices) \
+                    if mctx is not None else batch_bucket(len(kept))
                 pad_valid = np.zeros(b_new, dtype=bool)
                 pad_valid[:len(kept)] = True
                 idx = np.zeros(b_new, dtype=np.int64)
@@ -449,8 +460,36 @@ class CompiledPlan:
 
     def _run_device(self, params: Tuple):
         """Bind + dispatch; returns (tables, outs) with outs still ON
-        DEVICE (async) — callers decide when/whether to transfer."""
+        DEVICE (async) — callers decide when/whether to transfer.
+
+        Under an active mesh every dispatch serializes on
+        parallel.mesh.dispatch_lock and BLOCKS to completion inside the
+        hold: concurrent multi-device programs interleave their XLA CPU
+        collective participants into one rendezvous and deadlock (see
+        the lock's comment); single-device execution keeps the async
+        fast path untouched."""
+        import contextlib
+
         from snappydata_tpu.observability.metrics import global_registry
+        from snappydata_tpu.parallel.mesh import MeshContext, dispatch_lock
+
+        mesh_active = MeshContext.current() is not None
+
+        @contextlib.contextmanager
+        def _dispatch_scope():
+            if not mesh_active:
+                yield
+                return
+            with dispatch_lock:
+                yield
+
+        def _settle(outs):
+            if mesh_active:
+                # locklint: blocking-under-lock the dispatch lock exists
+                # exactly to fence device collectives; it is a leaf —
+                # nothing is acquired under it
+                jax.block_until_ready(outs)
+            return outs
 
         reg = global_registry()
         tables, arrays, aux, static, pvals = self._bind(params)
@@ -482,10 +521,11 @@ class CompiledPlan:
                 # the dispatch — surfaced as its own span so a trace shows
                 # compile time apart from steady-state execution
                 with tracing.span("jit_compile" if first
-                                  else "device_execute", phase="pre"):
-                    pre = self._noted_call(
+                                  else "device_execute", phase="pre"), \
+                        _dispatch_scope():
+                    pre = _settle(self._noted_call(
                         static, "pre", fnp,
-                        (tuple(arrays), tuple(aux), pvals))
+                        (tuple(arrays), tuple(aux), pvals)))
                 _pre_cache_put(self, static, pkey, tables, pre)
             else:
                 reg.inc("gidx_cache_hits")
@@ -496,10 +536,11 @@ class CompiledPlan:
                 fn = jax.jit(functools.partial(self.traced_main, static))
                 self._jitted_main[static] = fn
             with tracing.span("jit_compile" if first
-                              else "device_execute", phase="main"):
-                outs = self._noted_call(
+                              else "device_execute", phase="main"), \
+                    _dispatch_scope():
+                outs = _settle(self._noted_call(
                     static, "main", fn,
-                    (tuple(arrays), tuple(aux), pvals, pre))
+                    (tuple(arrays), tuple(aux), pvals, pre)))
             # a gidx-cache hit SKIPPED the pre pass — its code predicates
             # didn't run this execution (review finding: they were
             # re-counted in proportion to the hit rate)
@@ -512,10 +553,11 @@ class CompiledPlan:
                 fn = jax.jit(functools.partial(self.traced, static))
                 self._jitted[static] = fn
             with tracing.span("jit_compile" if first
-                              else "device_execute"):
-                outs = self._noted_call(
+                              else "device_execute"), \
+                    _dispatch_scope():
+                outs = _settle(self._noted_call(
                     static, "single", fn,
-                    (tuple(arrays), tuple(aux), pvals))
+                    (tuple(arrays), tuple(aux), pvals)))
             self._count_compressed(reg, static, ("single",))
         note = self.agg_notes.get(static) if self.agg_notes else None
         if note is not None:
@@ -930,6 +972,10 @@ class Compiler:
         self.aux_builders: List[Callable] = []
         self.static_providers: List[Callable] = []
         self.bind_checks: List[Callable] = []
+        # per-join metadata the mesh execution lane reads to pick and
+        # apply a distribution strategy (broadcast-build vs
+        # shuffle-on-key) — see engine/mesh_exec.py
+        self.join_meta: List[Dict] = []
 
     # -- static/aux plumbing ----------------------------------------------
 
@@ -1014,12 +1060,14 @@ class Compiler:
         out_scope = [oc if isinstance(oc, _ScopeCol)
                      else _ScopeCol(oc.name, oc.dtype, oc.dict_provider)
                      for oc in out_cols]
-        return CompiledPlan(self.relations, self.aux_builders,
-                            self.static_providers, traced, out_scope, is_agg,
-                            self.bind_checks,
-                            traced_pre=traced_pre, traced_main=traced_main,
-                            agg_notes=getattr(self, "_agg_notes", None),
-                            tile_merge=getattr(self, "_tile_merge", None))
+        cp = CompiledPlan(self.relations, self.aux_builders,
+                          self.static_providers, traced, out_scope, is_agg,
+                          self.bind_checks,
+                          traced_pre=traced_pre, traced_main=traced_main,
+                          agg_notes=getattr(self, "_agg_notes", None),
+                          tile_merge=getattr(self, "_tile_merge", None))
+        cp.join_meta = self.join_meta
+        return cp
 
     def _pre_cacheable(self, plan: ast.Plan) -> bool:
         """Is the aggregate's prefix (valid + gidx) safe and worthwhile
@@ -1623,7 +1671,20 @@ class Compiler:
 
         art_aux = None
         artifact_of = None
+        shuf_si = None
         if artifact_mode:
+            # mesh shuffle-on-key: when the mesh lane's bucketed
+            # exchange re-laid both sides out bucket-aligned, the trace
+            # sorts its LOCAL build slice in-trace instead of indexing
+            # the global artifact (whose order permutation describes the
+            # pre-exchange layout).  Rides the STATIC key, so shuffled
+            # and unshuffled executions are distinct specializations.
+            def shuffle_provider() -> int:
+                from snappydata_tpu.engine import mesh_exec
+
+                return 1 if mesh_exec.shuffle_active() else 0
+
+            shuf_si = self._add_static(shuffle_provider)
             build_rel.no_skip = True  # order indexes the FULL flat layout
             enc_sig = tuple(enc_spec)
 
@@ -1654,6 +1715,14 @@ class Compiler:
             art_tls = threading.local()
 
             def _aux_artifact(params):
+                from snappydata_tpu.engine import mesh_exec
+
+                if mesh_exec.shuffle_active():
+                    # shuffle binds sort per-shard in-trace — feeding the
+                    # GLOBAL sorted artifact would replicate it to every
+                    # device for nothing (mode_provider re-derives the
+                    # uniqueness verdict/bound via artifact_of directly)
+                    return np.zeros((2, 1), dtype=np.int64)
                 art = artifact_of()
                 if how not in ("semi", "anti"):
                     # mode_provider is the stash's only consumer; a
@@ -1750,6 +1819,26 @@ class Compiler:
                 bound = _dj.probe_expand_bound(
                     art, dtp.valid, tuple(s[2] for s in psources),
                     null_extend, compute_pkeys)
+                from snappydata_tpu.engine import mesh_exec
+
+                nd = mesh_exec.bind_devices()
+                if nd > 1:
+                    # mesh lane: each shard expands only ITS slice of
+                    # the probe — size the per-shard output axis to the
+                    # shard's own bound instead of replicating the
+                    # GLOBAL bucket on every device.  Broadcast shards
+                    # on batch position: the top-ceil(B/D) per-batch
+                    # bound is exact-sound; a key-bucket shuffle gets
+                    # fair-share with 2x skew headroom.  An
+                    # under-estimate trips the in-trace overflow flag
+                    # (loud reroute), never silent row loss.
+                    if mesh_exec.shuffle_active():
+                        bound = min(bound, -(-bound // nd) * 2)
+                    else:
+                        bound = min(bound, _dj.probe_expand_bound_per_shard(
+                            art, dtp.valid,
+                            tuple(s[2] for s in psources), null_extend,
+                            compute_pkeys, nd, tuple(dtp.valid.shape)))
                 bucket = _dj.expand_bucket(max(1, bound))
                 _check_expand_cap(bucket + fext)
                 reg.inc("join_device_joins")
@@ -1782,6 +1871,25 @@ class Compiler:
         builder = self._builder_for(lscope + rscope)
         residual_run = builder.emit(residual) if residual is not None \
             else None
+
+        # distribution metadata for the mesh lane (engine/mesh_exec.py):
+        # which relations carry the probe/build sides, how their keys
+        # encode into the shared int64 domain, and the static/aux slots
+        # the shuffle specialization rides
+        self.join_meta.append({
+            "how": how,
+            "artifact_mode": artifact_mode,
+            "probe_rel": probe_rel,
+            "build_rel": build_rel,
+            "probe_ords": tuple(s[2] for s in psources)
+            if all(s is not None for s in psources) else None,
+            "build_ords": build_ords,
+            "enc_spec": tuple(enc_spec),
+            "trans_getters": dict(trans_getters),
+            "art_aux": art_aux,
+            "shuf_si": shuf_si,
+            "build_filtered": build_filtered,
+        })
 
         def run_join(ctx) -> RelOut:
             lo = left(ctx)
@@ -1817,7 +1925,9 @@ class Compiler:
                                   jnp.int64(_dj.PROBE_NULL_SENTINEL),
                                   pkeys)
 
-            if artifact_mode:
+            use_art = artifact_mode and (
+                shuf_si is None or ctx.static[shuf_si] == 0)
+            if use_art:
                 packed = ctx.aux[art_aux]
                 skeys, order = packed[0], packed[1]
                 pass_flat = ro.valid.reshape(-1)
@@ -1836,9 +1946,11 @@ class Compiler:
                     def locate(b, r):
                         return _dj.nth_match_dense(b, r, order)
             else:
-                # derived build (semi/anti only): sort in-trace — the
-                # sentinel already excludes filtered/NULL/dead rows, so
-                # the dense range math applies
+                # derived build (semi/anti) OR a mesh shuffle bind: sort
+                # in-trace — the key sentinel already excludes filtered/
+                # NULL/dead rows (ro.valid carries the in-trace build
+                # filter), so the dense range math applies; under
+                # shuffle every shard sorts only ITS bucket slice
                 rpairs_b = [DVal(_broadcast_to_mask(d.value, ro.valid),
                                  _broadcast_to_mask(d.null, ro.valid)
                                  if d.null is not None else None, d.dtype)

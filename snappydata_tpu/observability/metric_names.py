@@ -65,6 +65,30 @@ COUNTERS = {
     "member_heartbeat_failures",
     "member_heartbeats_stopped",
     "member_rejoins",
+    "mesh_broadcast_bytes",
+    "mesh_broadcast_cache_hits",
+    "mesh_buckets_moved",
+    "mesh_cache_moves",
+    "mesh_exchange_bytes",
+    "mesh_exchange_cache_hits",
+    "mesh_exchange_rows",
+    "mesh_fallback_budget",
+    "mesh_fallback_compile",
+    "mesh_fallback_complex",
+    "mesh_fallback_decimal_exact",
+    "mesh_fallback_decompose",
+    "mesh_fallback_error",
+    "mesh_fallback_merge_space",
+    "mesh_fallback_outer_sort",
+    "mesh_fallback_overflow",
+    "mesh_fallback_params",
+    "mesh_fallback_shape",
+    "mesh_join_broadcast",
+    "mesh_join_shuffle",
+    "mesh_moved_bytes",
+    "mesh_psum_merges",
+    "mesh_rebalances",
+    "mesh_shard_execs",
     "mutation_dedup_hits",
     "mvcc_cut_expand_errors",
     "mvcc_ddl_conflicts",
@@ -154,4 +178,6 @@ DYNAMIC_PREFIXES = {
     "agg_strategy_",
     "compressed_fallback_",
     "join_fallback_",
+    "mesh_fallback_",
+    "mesh_join_shuffle_fallback_",
 }

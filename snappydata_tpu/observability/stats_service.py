@@ -216,6 +216,76 @@ def join_snapshot() -> dict:
     }
 
 
+def mesh_snapshot(catalog=None, session=None) -> dict:
+    """Mesh-execution stats for `/status/api/v1/mesh` and the
+    dashboard's Mesh section: the active mesh + bucket→device placement,
+    PER-DEVICE resident plate bytes (the proof sharded tables stay
+    encoded per device), exchange/psum evidence, and the join
+    distribution strategy counters — observable like the join engine's
+    fallback reasons."""
+    from snappydata_tpu import config
+    from snappydata_tpu.engine.mesh_exec import mesh_layout_cache_nbytes
+    from snappydata_tpu.parallel.mesh import MeshContext
+
+    snap = global_registry().snapshot()
+    c = snap["counters"]
+    props = config.global_properties()
+    ctx = MeshContext.current()
+    if ctx is None and session is not None \
+            and getattr(session, "_mesh_ctx", None) is not None:
+        ctx = session._mesh_ctx
+    out = {
+        "mesh_shard_exec": props.get("mesh_shard_exec"),
+        "mesh_join_strategy": props.get("mesh_join_strategy"),
+        "mesh_broadcast_build_bytes":
+            props.get("mesh_broadcast_build_bytes"),
+        "active": ctx is not None,
+        "mesh_shard_execs": c.get("mesh_shard_execs", 0),
+        "mesh_psum_merges": c.get("mesh_psum_merges", 0),
+        "mesh_join_broadcast": c.get("mesh_join_broadcast", 0),
+        "mesh_join_shuffle": c.get("mesh_join_shuffle", 0),
+        "mesh_shuffle_fallback_reasons": {
+            k[len("mesh_join_shuffle_fallback_"):]: v
+            for k, v in sorted(c.items())
+            if k.startswith("mesh_join_shuffle_fallback_")},
+        "mesh_fallback_reasons": {
+            k[len("mesh_fallback_"):]: v for k, v in sorted(c.items())
+            if k.startswith("mesh_fallback_")},
+        "mesh_exchange_bytes": c.get("mesh_exchange_bytes", 0),
+        "mesh_exchange_rows": c.get("mesh_exchange_rows", 0),
+        "mesh_exchange_cache_hits": c.get("mesh_exchange_cache_hits", 0),
+        "mesh_broadcast_bytes": c.get("mesh_broadcast_bytes", 0),
+        "mesh_broadcast_cache_hits":
+            c.get("mesh_broadcast_cache_hits", 0),
+        "mesh_layout_cache_nbytes": mesh_layout_cache_nbytes(),
+        "rebalances": c.get("mesh_rebalances", 0),
+        "buckets_moved": c.get("mesh_buckets_moved", 0),
+        "cache_entries_moved": c.get("mesh_cache_moves", 0),
+        "bytes_moved": c.get("mesh_moved_bytes", 0),
+    }
+    if ctx is not None:
+        out["num_devices"] = ctx.num_devices
+        out["token"] = ctx.token
+        out["placement"] = {
+            "generation": ctx.placement.generation,
+            "num_buckets": ctx.placement.num_buckets,
+            "bucket_map": {str(k): v for k, v in
+                           ctx.placement.bucket_map().items()},
+        }
+    if catalog is not None:
+        from snappydata_tpu.storage.device import \
+            device_cache_bytes_by_device
+
+        try:
+            per_dev = device_cache_bytes_by_device(
+                (i.name, i.data) for i in catalog.list_tables())
+        except Exception:
+            per_dev = {}
+        out["resident_bytes_by_device"] = {
+            k: per_dev[k] for k in sorted(per_dev)}
+    return out
+
+
 def mvcc_snapshot(catalog=None) -> dict:
     """Snapshot-isolation stats for `/status/api/v1/mvcc` and the
     dashboard's MVCC section: the epoch clock, active pins, per-table

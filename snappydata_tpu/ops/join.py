@@ -244,6 +244,43 @@ def probe_expand_bound(artifact: dict, probe_ident, probe_token,
     return bound
 
 
+def probe_expand_bound_per_shard(artifact: dict, probe_ident,
+                                 probe_token, null_extend: bool,
+                                 compute_pkeys: Callable[[], tuple],
+                                 num_shards: int,
+                                 batch_shape: tuple) -> int:
+    """PER-SHARD upper bound on expanded output rows for a mesh bind
+    whose probe shards on the batch axis: the sum of the ceil(B/D)
+    LARGEST per-batch expansion bounds.  Sound under ANY assignment of
+    at most that many batches to a shard — which covers both the plain
+    contiguous split and whatever subset a bind-time batch skip gathers
+    onto each device.  Sizing each shard's output axis to this instead
+    of the GLOBAL bound is what makes join expansion memory/work shrink
+    with the mesh.  Memoized like probe_expand_bound."""
+    key = (id(probe_ident), probe_token, bool(null_extend),
+           "shard", int(num_shards))
+    with _CACHE_LOCK:
+        hit = artifact["bounds"].get(key)
+        if hit is not None and hit[0]() is probe_ident:
+            return hit[1]
+    pkeys, valid_flat = compute_pkeys()
+    skeys = artifact["skeys"]
+    lo = jnp.searchsorted(skeys, pkeys, side="left")
+    hi = jnp.searchsorted(skeys, pkeys, side="right")
+    counts = jnp.where(valid_flat, (hi - lo).astype(jnp.int64), 0)
+    if null_extend:
+        counts = counts + valid_flat.astype(jnp.int64)
+    per_batch = counts.reshape(batch_shape).sum(axis=1)
+    k = max(1, -(-int(batch_shape[0]) // int(num_shards)))
+    top = jax.lax.top_k(per_batch, min(k, int(batch_shape[0])))[0]
+    bound = int(jax.device_get(top.sum()))
+    with _CACHE_LOCK:
+        if len(artifact["bounds"]) > 64:
+            artifact["bounds"].clear()
+        artifact["bounds"][key] = (weakref.ref(probe_ident), bound)
+    return bound
+
+
 # --- in-trace expansion ---------------------------------------------------
 # Two range flavors:
 #   dense      — the build has NO in-trace filter.  Dead/padded and

@@ -16,6 +16,7 @@ compiler instead of hand-written messaging.
 
 from __future__ import annotations
 
+import contextvars
 import threading
 from snappydata_tpu.utils import locks
 from typing import Optional
@@ -31,20 +32,43 @@ class MeshContext:
 
     Each context carries a process-unique `token` (monotonic counter) used
     by device caches instead of id(mesh) — ids get reused after GC, which
-    would let a 4-device run hit arrays placed for a dead 8-device mesh."""
+    would let a 4-device run hit arrays placed for a dead 8-device mesh.
 
-    _current: Optional["MeshContext"] = None
-    _stack: list = []          # supports nested/reentrant `with`
+    `placement` is the bucket→device map (parallel/placement.py): the
+    batch axis splits into logical buckets owned by devices, so a mesh
+    resize is a bucket REBALANCE (storage/device.migrate_mesh_cache moves
+    resident plates device-to-device) instead of a cache invalidation."""
+
+    # the active-context stack is PER-THREAD (a contextvar): concurrent
+    # sessions each enter their own context, and a class-global stack
+    # would let thread A's __exit__ pop thread B's context mid-query —
+    # the first concurrent-mesh workload (the PR 13 rebalance-under-
+    # traffic test) deadlocked/bound-wrong exactly there.  `activate()`
+    # still sets a process-wide default that current() falls back to.
+    _ctx_stack: "object" = None   # initialized below (contextvar)
+    _default: Optional["MeshContext"] = None
     _lock = locks.named_lock("parallel.mesh")
     _next_token = 0
 
-    def __init__(self, mesh: Mesh):
+    def __init__(self, mesh: Mesh, placement=None):
+        from snappydata_tpu.parallel.placement import ShardPlacement
+
         self.mesh = mesh
         self.batch_sharding = NamedSharding(mesh, P("data", None))
         self.replicated = NamedSharding(mesh, P())
+        self.placement = placement if placement is not None \
+            else ShardPlacement.balanced(mesh.devices.size)
         with MeshContext._lock:
             MeshContext._next_token += 1
             self.token = MeshContext._next_token
+
+    def sharding_for(self, leaf) -> NamedSharding:
+        """Batch-axis NamedSharding matching a host/device array's rank
+        (axis 0 = the batch/bucket axis, everything else replicated)."""
+        import numpy as _np
+
+        return NamedSharding(
+            self.mesh, P("data", *([None] * (_np.ndim(leaf) - 1))))
 
     @property
     def num_devices(self) -> int:
@@ -52,25 +76,70 @@ class MeshContext:
 
     @classmethod
     def current(cls) -> Optional["MeshContext"]:
-        return cls._current
+        stack = cls._ctx_stack.get()
+        return stack[-1] if stack else cls._default
 
     @classmethod
     def activate(cls, mesh: Optional[Mesh]) -> Optional["MeshContext"]:
         with cls._lock:
-            cls._current = MeshContext(mesh) if mesh is not None else None
-            return cls._current
+            cls._default = MeshContext(mesh) if mesh is not None else None
+            return cls._default
 
     def __enter__(self):
-        with MeshContext._lock:
-            MeshContext._stack.append(MeshContext._current)
-            MeshContext._current = self
+        # plain push/pop on the per-thread stack VALUE (no contextvar
+        # tokens: one shared context object entered by many threads
+        # would mix tokens across threads)
+        MeshContext._ctx_stack.set(
+            MeshContext._ctx_stack.get() + (self,))
         return self
 
     def __exit__(self, *exc):
-        with MeshContext._lock:
-            MeshContext._current = MeshContext._stack.pop() \
-                if MeshContext._stack else None
+        stack = MeshContext._ctx_stack.get()
+        if stack and stack[-1] is self:
+            MeshContext._ctx_stack.set(stack[:-1])
         return False
+
+
+MeshContext._ctx_stack = contextvars.ContextVar("mesh_ctx_stack",
+                                                default=())
+
+# Process-wide serialization of MULTI-DEVICE dispatches.  XLA's CPU
+# collectives rendezvous by (global devices, op id): two threads
+# concurrently executing 8-participant programs interleave their
+# participant threads into each other's rendezvous and deadlock (the
+# rebalance-under-traffic test hung exactly there, with
+# collective_ops_utils.h "waiting for all participants" spew).  Every
+# sharded dispatch — shard_map lane, plain GSPMD jit under a mesh, and
+# the shuffle exchange's bucketed gathers — holds this RLock across
+# dispatch + completion; single-device execution never touches it.
+# Reentrant: a mesh query's host-side finalize may nest another sharded
+# read.  Known boundary: EAGER ops on sharded arrays at bind time
+# (join-artifact argsorts, expansion-bound searchsorteds) also lower to
+# multi-device programs and are NOT fenced yet — concurrent mesh JOIN
+# binds share the pre-PR-13 exposure; fencing the bind path wholesale
+# is the open follow-up.
+dispatch_lock = locks.named_rlock("parallel.mesh_dispatch")
+
+
+class _NoMesh:
+    """Escape hatch: `with no_mesh():` masks any ambient MeshContext —
+    used by the mesh lane's scratch finalize so a [G]-row merge table
+    never binds sharded over 8 devices."""
+
+    def __enter__(self):
+        MeshContext._ctx_stack.set(
+            MeshContext._ctx_stack.get() + (None,))
+        return self
+
+    def __exit__(self, *exc):
+        stack = MeshContext._ctx_stack.get()
+        if stack and stack[-1] is None:
+            MeshContext._ctx_stack.set(stack[:-1])
+        return False
+
+
+def no_mesh() -> _NoMesh:
+    return _NoMesh()
 
 
 def data_mesh(num_devices: Optional[int] = None) -> Mesh:
@@ -99,3 +168,36 @@ def shard_batches(array, ctx: Optional[MeshContext]):
 
 def round_up_to(n: int, mult: int) -> int:
     return ((n + mult - 1) // mult) * mult
+
+
+def shard_bucket(n: int, num_shards: int) -> int:
+    """Padded batch-axis size for a MESH bind: the smallest value of the
+    storage layer's {2^k, 1.5·2^k} batch-bucket ladder that is >= n AND
+    divisible by `num_shards`.
+
+    The divisibility constraint is what NamedSharding needs (equal
+    blocks per device); staying ON the ladder is what keeps compiled
+    executables shared — a table bound at 1/2/4/8 devices must land on
+    the same handful of padded sizes the single-device ladder already
+    produced, or every reshard would re-specialize every static key.
+    For shard counts the ladder never divides (e.g. 5), falls back to
+    the nearest multiple — off-ladder but still shape-stable."""
+    n = max(1, n, num_shards)
+    v = _ladder(n)
+    # the ladder doubles every two steps; 8 steps ≈ 16x headroom, far
+    # past any divisible hit for pow2/3·pow2 shard counts
+    for _ in range(8):
+        if v % num_shards == 0:
+            return v
+        v = _ladder(v + 1)
+    return round_up_to(_ladder(n), num_shards)
+
+
+def _ladder(n: int) -> int:
+    """Smallest {2^k, 1.5·2^k} >= n (storage/device.batch_bucket's
+    ladder, duplicated here to avoid a parallel→storage import cycle —
+    the unit test pins the two against each other)."""
+    if n <= 1:
+        return 1
+    p = 1 << (n - 1).bit_length()
+    return p * 3 // 4 if p * 3 // 4 >= n else p

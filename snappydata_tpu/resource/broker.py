@@ -174,6 +174,10 @@ class ResourceBroker:
         join_bytes = join_build_cache_nbytes()
         view_bytes = matview_state_nbytes()
         serving_bytes = serving_registry_nbytes()
+        from snappydata_tpu.engine.mesh_exec import \
+            mesh_layout_cache_nbytes
+
+        mesh_bytes = mesh_layout_cache_nbytes()
         from snappydata_tpu.storage.mvcc import \
             retained_epoch_bytes_by_table
 
@@ -187,7 +191,7 @@ class ResourceBroker:
         # staler than the ledger it's compared against
         host_total = sum(host.values()) + serving_bytes + retained_total
         device_total = sum(device.values()) + gidx_bytes + join_bytes \
-            + view_bytes
+            + view_bytes + mesh_bytes
         self._measured_cache = (time.monotonic(), host_total, device_total)
         return {
             "host": host,
@@ -207,6 +211,10 @@ class ResourceBroker:
             "gidx_cache_bytes": gidx_bytes,
             "join_build_cache_bytes": join_bytes,
             "matview_state_bytes": view_bytes,
+            # mesh shuffle/broadcast bind layouts (engine/mesh_exec):
+            # exchanged/replicated device copies of join sides, LRU-
+            # bounded by mesh_shuffle_cache_entries
+            "mesh_layout_cache_bytes": mesh_bytes,
             # MVCC retained epochs (storage/mvcc): host bytes old
             # manifests hold beyond the current one — row-buffer
             # snapshot copies + diverged delete/update deltas — while
@@ -241,9 +249,12 @@ class ResourceBroker:
         host = sum(_host_table_bytes(d) for _, d in tables) \
             + serving_registry_nbytes() \
             + sum(retained_epoch_bytes_by_table(tables).values())
+        from snappydata_tpu.engine.mesh_exec import \
+            mesh_layout_cache_nbytes
+
         device = sum(device_cache_bytes_by_table(tables).values()) \
             + gidx_cache_nbytes() + join_build_cache_nbytes() \
-            + matview_state_nbytes()
+            + matview_state_nbytes() + mesh_layout_cache_nbytes()
         self._measured_cache = (time.monotonic(), host, device)
         return host, device
 

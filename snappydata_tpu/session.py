@@ -67,9 +67,13 @@ class SnappySession:
         self.conf = conf or config.global_properties()
         self.analyzer = Analyzer(catalog)
         self.executor = Executor(catalog, self.conf)
-        # optional per-session device mesh: queries run GSPMD-sharded
-        # over it (a data server's local chips — see ServerNode(mesh=…))
+        # optional per-session device mesh: queries run sharded over it
+        # (a data server's local chips — see ServerNode(mesh=…));
+        # the MeshContext is cached (see _mesh_context) and swaps under
+        # _mesh_resize_lock on a live resize_mesh() rebalance
         self.default_mesh = None
+        self._mesh_ctx = None
+        self._mesh_resize_lock = locks.named_lock("session.mesh")
         if needs_recovery:
             self.disk_store.recover_catalog(session=self)
 
@@ -175,6 +179,10 @@ class SnappySession:
         s.analyzer = self.analyzer
         s.executor = self.executor
         s.default_mesh = self.default_mesh
+        # share the cached MeshContext: a fresh token per derived session
+        # would rotate the device cache on every network request
+        s._mesh_ctx = self._mesh_ctx
+        s._mesh_resize_lock = self._mesh_resize_lock
         s.remote = remote
         s.authenticated = authenticated
         return s
@@ -1433,6 +1441,16 @@ class SnappySession:
             self._in_tile = False
         if merged is not None:
             pieces = [merged]
+        return self._merge_partial_pieces(pieces, node, merged_select,
+                                          merge_having, outer)
+
+    def _merge_partial_pieces(self, pieces, node, merged_select,
+                              merge_having, outer) -> Result:
+        """Partial [G] results → final aggregate: the finalize step the
+        tiled scan AND the mesh shard_map lane share (avg = sum/count,
+        HAVING over merged slots, outer sort/limit re-applied)."""
+        from snappydata_tpu.engine.partial_agg import ddl_type
+        from snappydata_tpu.sql.render import render_expr
 
         # merge in a THROWAWAY in-memory session (never journaled/persisted)
         from snappydata_tpu.catalog import Catalog as _Cat
@@ -1480,9 +1498,247 @@ class SnappySession:
             from snappydata_tpu.parallel.mesh import MeshContext
 
             if MeshContext.current() is None:
-                with MeshContext(self.default_mesh):
+                with self._mesh_context():
                     return self.executor.execute(tokenized, params)
         return self.executor.execute(tokenized, params)
+
+    def _mesh_context(self):
+        """The session's cached MeshContext for default_mesh.  Cached
+        because the device cache keys on the context's process-unique
+        token: a FRESH context per query (the old composition) rotated
+        the token every statement, so every mesh query re-uploaded every
+        plate — the mesh path could never hold a warm working set.
+
+        The miss path re-checks under _mesh_resize_lock: a query thread
+        racing resize_mesh() could otherwise observe the new
+        default_mesh with the old _mesh_ctx and clobber the freshly
+        migrated context with a throwaway token — orphaning every plate
+        the rebalance just moved (review finding)."""
+        from snappydata_tpu.parallel.mesh import MeshContext
+
+        ctx = self._mesh_ctx
+        if ctx is not None and ctx.mesh is self.default_mesh:
+            return ctx
+        with self._mesh_resize_lock:
+            ctx = self._mesh_ctx
+            if ctx is None or ctx.mesh is not self.default_mesh:
+                ctx = MeshContext(self.default_mesh)
+                self._mesh_ctx = ctx
+            return ctx
+
+    def resize_mesh(self, num_devices: Optional[int] = None,
+                    devices=None) -> dict:
+        """Live mesh resize — the in-process twin of the cluster layer's
+        kill→rejoin bucket rebalance (PR 8 rejoin_server): the shard
+        placement rebalances bucket ownership onto the new device set
+        and every RESIDENT plate migrates device-to-device
+        (storage/device.migrate_mesh_cache) instead of invalidating the
+        world.  Queries already in flight keep their bound arrays on
+        the old placement and stay value-correct; new statements bind
+        under the new one.  Returns a summary for the caller/dashboard."""
+        from snappydata_tpu.observability.metrics import global_registry
+        from snappydata_tpu.parallel.mesh import MeshContext, data_mesh, \
+            submesh
+        from snappydata_tpu.storage.device import migrate_mesh_cache
+
+        reg = global_registry()
+        with self._mesh_resize_lock:
+            old_ctx = self._mesh_ctx
+            if old_ctx is None and self.default_mesh is not None:
+                # construct directly — _mesh_context()'s miss path
+                # re-acquires the NON-REENTRANT lock we already hold
+                # (review finding: resize before any mesh query ran
+                # self-deadlocked)
+                old_ctx = MeshContext(self.default_mesh)
+            new_mesh = submesh(devices) if devices is not None \
+                else data_mesh(num_devices)
+            placement = old_ctx.placement.rebalance(new_mesh.devices.size) \
+                if old_ctx is not None else None
+            new_ctx = MeshContext(new_mesh, placement=placement)
+            moved_entries = moved_bytes = 0
+            if old_ctx is not None:
+                for ti in self.catalog.list_tables():
+                    if hasattr(ti.data, "_device_cache"):
+                        e, b = migrate_mesh_cache(ti.data, old_ctx.token,
+                                                  new_ctx)
+                        moved_entries += e
+                        moved_bytes += b
+            self.default_mesh = new_mesh
+            self._mesh_ctx = new_ctx
+            moved_buckets = placement.moved_from_previous \
+                if placement is not None else 0
+            reg.inc("mesh_rebalances")
+            reg.inc("mesh_buckets_moved", moved_buckets)
+            reg.inc("mesh_cache_moves", moved_entries)
+            reg.inc("mesh_moved_bytes", moved_bytes)
+            return {"num_devices": new_ctx.num_devices,
+                    "buckets_moved": moved_buckets,
+                    "cache_entries_moved": moved_entries,
+                    "bytes_moved": moved_bytes,
+                    "placement_generation":
+                        new_ctx.placement.generation}
+
+    def _maybe_mesh_aggregate(self, plan: ast.Plan,
+                              user_params) -> Optional[Result]:
+        """Mesh-sharded execution of a tilable aggregate shape: the
+        compile-once PARTIAL program runs per-shard under shard_map with
+        psum/pmin/pmax merges (engine/mesh_exec.py), then the shared
+        scratch merge finalizes — Q1/Q6/Q3C and friends scan only their
+        device's slice of the (still-encoded) plates.  Returns None to
+        fall back to plain GSPMD jit over the sharded bind, counted
+        mesh_fallback_<reason> so a shape that silently leaves the lane
+        is diagnosable from the dashboard."""
+        from snappydata_tpu.observability.metrics import global_registry
+        from snappydata_tpu.parallel.mesh import MeshContext
+
+        if getattr(self, "_in_tile", False):
+            return None
+        ctx = MeshContext.current()
+        if ctx is None and self.default_mesh is None:
+            return None
+        from snappydata_tpu import config as _config
+
+        if str(_config.global_properties().get(
+                "mesh_shard_exec", "auto") or "auto").lower() \
+                not in ("auto", "on"):
+            return None
+        reg = global_registry()
+        if user_params:
+            # `?` binds ride the GSPMD lane (still sharded): the merge
+            # decomposition renders literal SQL, which params are not
+            reg.inc("mesh_fallback_params")
+            return None
+        shaped = self._tilable_agg_shape(plan)
+        if shaped is None:
+            reg.inc("mesh_fallback_shape")
+            return None
+        outer, having, node, info, exprs, build_infos = shaped
+        data = info.data
+
+        from snappydata_tpu.storage import mvcc
+        from snappydata_tpu.storage.device import scan_unit_count
+
+        build_bytes = self._join_build_side_bytes(exprs, build_infos)
+        if build_bytes is None:
+            reg.inc("mesh_fallback_complex")
+            return None
+        budget = self._tile_budget()
+        if budget > 0:
+            # oversized tables keep the tiled streaming pass (mesh ×
+            # tiling does not compose yet — per-device HBM is the same
+            # HBM the tile budget protects)
+            manifest = mvcc.snapshot_of(data)
+            units = scan_unit_count(data, manifest)
+            used = {c.name.lower() for e in exprs for c in ast.walk(e)
+                    if isinstance(c, ast.Col)}
+            unit_bytes = data.capacity
+            for f in info.schema.fields:
+                if f.name.lower() not in used:
+                    continue
+                cw = self._decoded_col_width(f)
+                if cw is None:
+                    reg.inc("mesh_fallback_complex")
+                    return None
+                unit_bytes += data.capacity * cw
+            if units > 1 and build_bytes < budget \
+                    and unit_bytes * units > budget - build_bytes:
+                reg.inc("mesh_fallback_budget")
+                return None
+
+        from snappydata_tpu.engine.partial_agg import (
+            NotDecomposableError, decompose_aggregate)
+        from snappydata_tpu.sql.render import RenderError, render_plan
+
+        try:
+            partial_plan, merged_select, _, merge_having = \
+                decompose_aggregate(node, having)
+            partial_sql = render_plan(partial_plan)
+        except (NotDecomposableError, RenderError):
+            reg.inc("mesh_fallback_decompose")
+            return None
+        # outer ORDER BY must reference output columns by name/position
+        # (same admission the tiled merge applies)
+        out_names = [_expr_name(e).lower() for e in node.agg_exprs]
+        for op in outer:
+            if isinstance(op, ast.Sort):
+                for o in op.orders:
+                    tgt = o[0].child if isinstance(o[0], ast.Alias) \
+                        else o[0]
+                    if isinstance(tgt, ast.Col) and \
+                            tgt.name.lower() in out_names:
+                        continue
+                    if isinstance(tgt, ast.Lit) and \
+                            isinstance(tgt.value, int):
+                        continue
+                    reg.inc("mesh_fallback_outer_sort")
+                    return None
+
+        try:
+            from snappydata_tpu.sql.optimizer import optimize as _optimize
+            from snappydata_tpu.sql.parser import parse as _parse
+
+            pplan = _optimize(_parse(partial_sql).plan, self.catalog)
+            resolved_p, _ = self.analyzer.analyze_plan(pplan)
+            if self.conf.tokenize and self.conf.plan_caching:
+                tokenized, lit_params = tokenize_plan(resolved_p)
+            else:
+                from snappydata_tpu.sql.analyzer import \
+                    assign_param_positions
+
+                tokenized, lit_params = \
+                    assign_param_positions(resolved_p, 0), ()
+            params = tuple(lit_params)
+            compiled = self.executor.compiled_partial(tokenized)
+        except Exception:  # noqa: BLE001 — any analysis hiccup: GSPMD
+            reg.inc("mesh_fallback_compile")
+            return None
+        if compiled is None or compiled.tile_merge is None \
+                or not compiled.tile_merge_ok():
+            reg.inc("mesh_fallback_merge_space")
+            return None
+        for oc in compiled.out_scope:
+            # exact decimals ride scaled int64 on device; the scratch
+            # merge finalizes through host DOUBLE columns and would
+            # silently demote the exactness contract — GSPMD keeps the
+            # int64 partial sums exact end to end, so that lane serves
+            if oc.dtype is not None and oc.dtype.name == "decimal" \
+                    and np.dtype(oc.dtype.device_dtype()).kind == "i":
+                reg.inc("mesh_fallback_decimal_exact")
+                return None
+
+        from snappydata_tpu.engine import mesh_exec
+        from snappydata_tpu.engine.exprs import CompileError
+
+        try:
+            if ctx is not None:
+                ran = mesh_exec.run_partial(compiled, params, data, ctx,
+                                            build_bytes)
+            else:
+                with self._mesh_context() as c2:
+                    ran = mesh_exec.run_partial(compiled, params, data,
+                                                c2, build_bytes)
+        except CompileError:
+            reg.inc("mesh_fallback_overflow")
+            return None
+        except Exception:  # noqa: BLE001 — lane must never break a query
+            reg.inc("mesh_fallback_error")
+            import traceback
+
+            traceback.print_exc()
+            return None
+        if ran is None:
+            return None
+        host, tables = ran
+        partial_res = compiled._assemble(host, tables)
+        from snappydata_tpu.parallel.mesh import no_mesh
+
+        # the finalize merges a [G]-row partial table — mask any ambient
+        # mesh so it binds single-device instead of sharding G rows
+        # over the whole device set
+        with no_mesh():
+            return self._merge_partial_pieces([partial_res], node,
+                                              merged_select,
+                                              merge_having, outer)
 
     def _tiled_device_pass(self, compiled, params, data, manifest, units,
                            tile_units) -> Optional[Result]:
@@ -1733,6 +1989,9 @@ class SnappySession:
         tiled = self._maybe_tiled_aggregate(plan, user_params)
         if tiled is not None:
             return tiled
+        meshed = self._maybe_mesh_aggregate(plan, user_params)
+        if meshed is not None:
+            return meshed
         with tracing.span("optimize"):
             plan = self._decorrelate(plan)
             plan = self._rewrite_subqueries(plan, user_params)
@@ -1758,8 +2017,10 @@ class SnappySession:
                 # local device submesh runs EVERY query GSPMD-sharded
                 # over it, so distributed execution is scatter →
                 # per-server SPMD → merge (ref: embedded executors per
-                # store JVM, ExecutorInitiator.scala:45-105)
-                with MeshContext(self.default_mesh):
+                # store JVM, ExecutorInitiator.scala:45-105); the
+                # context is session-cached so the device cache stays
+                # warm across statements (see _mesh_context)
+                with self._mesh_context():
                     return self.executor.execute(tokenized, params)
         return self.executor.execute(tokenized, params)
 

@@ -116,7 +116,7 @@ class DeviceTable:
         return self.columns[idx]
 
 
-def _compressed_mode(ctx, is_str: bool, dec_exact: bool, use_dd: bool,
+def _compressed_mode(is_str: bool, dec_exact: bool, use_dd: bool,
                      cols_enc, any_delta: bool, has_row_chunks: bool,
                      code_ok: bool, count: bool = False) -> Optional[str]:
     """Per-column compressed-domain decision: 'dict' | 'rle' | 'bitset'
@@ -153,9 +153,6 @@ def _compressed_mode(ctx, is_str: bool, dec_exact: bool, use_dd: bool,
         return None
     if not use_dd:
         reject("device_decode_off")
-        return None
-    if ctx is not None:
-        reject("mesh")
         return None
     if not code_ok:
         reject("join_key")
@@ -227,10 +224,15 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
     b = batch_bucket(b_actual) if data_pow2() else max(1, b_actual)
     b = max(b, 1)
     if ctx is not None:
-        # batch axis is the sharded axis: pad to a mesh multiple
-        from snappydata_tpu.parallel.mesh import round_up_to
+        # batch axis is the sharded axis: pad to a MESH-DIVISIBLE ladder
+        # size (shard_bucket keeps the padded size on the same
+        # {2^k, 1.5·2^k} ladder the single-device bind uses, so a
+        # resharded table reuses executable shapes instead of
+        # re-specializing every static key)
+        from snappydata_tpu.parallel.mesh import round_up_to, shard_bucket
 
-        b = round_up_to(b, ctx.num_devices)
+        b = shard_bucket(b, ctx.num_devices) if data_pow2() \
+            else round_up_to(b, ctx.num_devices)
 
     # device.transfer failpoint: one hit per table build (not per column
     # — the build is the unit a caller can retry); an injected raise
@@ -245,6 +247,15 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
         return shard_batches(host_array, ctx) if ctx is not None \
             else jnp.asarray(host_array)
 
+    if "valid" in cache:
+        # a partially-filled entry pins the padded batch shape: a
+        # MIGRATED cache (live mesh rebalance) keeps its old-mesh
+        # padding, and a column bound fresh into it must match — mixing
+        # paddings inside one entry produced (old_b, cap) valid vs
+        # (new_b, cap) plates (found by the rebalance-under-traffic
+        # test).  Old paddings stay shard-able: migration only runs
+        # when the new mesh size divides them.
+        b = int(cache["valid"].shape[0])
     if "valid" not in cache:
         valid = np.zeros((b, cap), dtype=np.bool_)
         for i, v in enumerate(views):
@@ -315,13 +326,17 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
         # DEVICE plate is the scaled int64 unscaled value, converted
         # here at bind (types.DecimalType docstring)
         dec_exact = f.dtype.name == "decimal" and dt.kind == "i"
-        use_dd_col = (ctx is None and not is_str and not dec_exact
+        # compressed-domain eligibility is mesh-agnostic: encoded plates
+        # are [B, ...]-leading pytrees, so they shard over the mesh the
+        # same way decoded plates do (per-device HBM keeps the encoded
+        # capacity win — the decoded plate never materializes globally)
+        use_dd_col = (not is_str and not dec_exact
                       and config.global_properties().device_decode)
         cols_enc = [v.batch.columns[ci] for v in views]
         # only deltas that target THIS column disqualify its encoded
         # form (update deltas replace values; deletes ride live_mask)
         any_delta = any(any(d[0] == ci for d in v.deltas) for v in views)
-        cd_mode = _compressed_mode(ctx, is_str, dec_exact, use_dd_col,
+        cd_mode = _compressed_mode(is_str, dec_exact, use_dd_col,
                                    cols_enc, any_delta, bool(row_chunks),
                                    code_ok)
         key = ("ccol", ci) if cd_mode else ("col", ci)
@@ -329,7 +344,7 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
             # itemized fallback counting happens exactly once per build
             # (cache miss), decoded OR compressed — so every decode-first
             # reroute of a compressible column shows up
-            _compressed_mode(ctx, is_str, dec_exact, use_dd_col,
+            _compressed_mode(is_str, dec_exact, use_dd_col,
                              cols_enc, any_delta, bool(row_chunks),
                              code_ok, count=True)
         if cd_mode and key not in cache:
@@ -363,12 +378,12 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
                     smax[i] = float(bits.max())
             if cd_mode == "dict":
                 plate, host_dicts, dict_sizes = _dd.code_plates(
-                    cols_enc, b, cap, dt)
+                    cols_enc, b, cap, dt, place=_place)
                 cache[("dictdom", ci)] = (host_dicts, dict_sizes)
             elif cd_mode == "rle":
-                plate = _dd.rle_plates(cols_enc, b, cap, dt)
+                plate = _dd.rle_plates(cols_enc, b, cap, dt, place=_place)
             else:
-                plate = _dd.bit_plates(cols_enc, b, cap)
+                plate = _dd.bit_plates(cols_enc, b, cap, place=_place)
             cache[key] = (plate, smin, smax,
                           _place(null_mask) if any_null else None)
         if key not in cache:
@@ -380,10 +395,12 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
             # in-trace decode: RLE / bitset batches without deltas ship
             # their ENCODED arrays to the device and expand there (ref
             # decode-at-scan: ColumnTableScan.scala:684). Mesh binds keep
-            # host decode — the shard placement happens on host arrays.
+            # host decode on THIS decoded-plate path (the eager .at[].set
+            # assembly below places unsharded) — fully-encoded columns
+            # skip it entirely via the sharded compressed plates above.
             # Encoded decimal forms are host-domain floats, so the exact
             # path keeps host decode + scaled conversion.
-            use_dd = use_dd_col
+            use_dd = use_dd_col and ctx is None
             dd_rle: list = []      # (batch row, EncodedColumn)
             dd_bits: list = []
             dd_vd: list = []       # VALUE_DICT: uint8 codes + value dict
@@ -846,6 +863,117 @@ def _entry_bytes(dt_cols: Dict) -> int:
         return int(v.nbytes) if hasattr(v, "nbytes") else 0
 
     return sum(arr_bytes(v) for v in dt_cols.values())
+
+
+def _map_cache_leaves(entry, fn):
+    """Apply `fn` to every DEVICE-array leaf of one device-cache entry
+    dict, preserving structure (host stats/dictdom tuples pass through).
+    The single traversal migrate_mesh_cache and the per-device ledger
+    share — cache-entry shapes must not drift between them.  Snapshots
+    the items: a concurrent reader may fill the entry mid-walk."""
+    out = {}
+    for k, v in list(entry.items()):
+        if k == "valid":
+            out[k] = fn(v)
+        elif k == "nrows":
+            out[k] = v
+        elif isinstance(k, tuple) and k[0] == "dictdom":
+            out[k] = v                       # host-side probe surface
+        elif isinstance(k, tuple) and isinstance(v, tuple) and len(v) == 4:
+            plate, smin, smax, nulls = v
+
+            def leaf(x):
+                if x is None:
+                    return None
+                if isinstance(x, tuple):  # plates nest (CodePlate, acol)
+                    parts = [leaf(p) for p in x]
+                    return type(x)(*parts) if hasattr(x, "_fields") \
+                        else tuple(parts)
+                return fn(x)
+
+            out[k] = (leaf(plate), smin, smax, leaf(nulls))
+        else:
+            out[k] = v
+    return out
+
+
+def migrate_mesh_cache(data, old_token, new_ctx) -> Tuple[int, int]:
+    """Live bucket rebalance of one table's resident plates: re-place
+    every cache entry bound under `old_token` onto `new_ctx`'s mesh via
+    jax.device_put (device-to-device moves — no host rebuild, the world
+    is NOT invalidated).  Returns (entries_moved, bytes_moved).  Entries
+    whose padded batch axis the new mesh size doesn't divide are left to
+    rebuild from host on next bind (counted by the caller)."""
+    import jax
+
+    moved = bytes_moved = 0
+    nd = new_ctx.num_devices
+    for key in [k for k in list(data._device_cache)
+                if len(k) >= 2 and k[1] == old_token]:
+        entry = data._device_cache.get(key)
+        if entry is None:
+            continue
+        valid = entry.get("valid")
+        if valid is None or valid.shape[0] % nd != 0:
+            continue
+        counted = [0]
+
+        def _replace(x, _c=counted):
+            _c[0] += int(getattr(x, "nbytes", 0))
+            return jax.device_put(x, new_ctx.sharding_for(x))
+
+        new_entry = _map_cache_leaves(entry, _replace)
+        new_key = (key[0], new_ctx.token) + tuple(key[2:])
+        data._device_cache[new_key] = new_entry
+        data._device_cache.pop(key, None)
+        _cache_budget.forget(data._device_cache, key)
+        if _cache_budget.enabled():
+            _cache_budget.touch(data._device_cache, new_key,
+                                _entry_bytes(new_entry))
+        moved += 1
+        bytes_moved += counted[0]
+    return moved, bytes_moved
+
+
+def device_cache_bytes_by_device(tables) -> Dict[str, int]:
+    """Per-DEVICE resident bytes of every cached plate — the mesh
+    dashboard's proof that sharded tables stay encoded per device
+    (read off each array's addressable shards, so replicated build
+    plates correctly count full bytes on every device)."""
+    out: Dict[str, int] = {}
+
+    def leaf(x):
+        if x is None or isinstance(x, (int, float)):
+            return
+        if isinstance(x, tuple):
+            for p in x:
+                leaf(p)
+            return
+        try:
+            shards = getattr(x, "addressable_shards", None)
+            if shards:
+                for sh in shards:
+                    k = str(sh.device)
+                    out[k] = out.get(k, 0) + int(sh.data.nbytes)
+            elif hasattr(x, "nbytes"):
+                for d in getattr(x.sharding, "device_set", []):
+                    out[str(d)] = out.get(str(d), 0) + int(x.nbytes)
+        except Exception:
+            pass
+
+    for _name, data in tables:
+        caches = getattr(data, "_device_cache", None)
+        if not caches:
+            continue
+        for entry in list(caches.values()):
+            for k, v in list(entry.items()):
+                if k == "valid":
+                    leaf(v)
+                elif isinstance(k, tuple) and k[0] != "dictdom" \
+                        and isinstance(v, tuple) and len(v) == 4:
+                    leaf(v[0])
+                    leaf(v[3])
+    return out
 
 
 def device_cache_bytes_by_table(tables) -> Dict[str, int]:

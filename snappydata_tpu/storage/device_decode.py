@@ -213,14 +213,17 @@ def compressed_fallback(reason: str, n: int = 1) -> None:
     reg.inc("compressed_fallback_" + reason, n)
 
 
-def code_plates(vd_cols, b: int, cap: int, dt):
+def code_plates(vd_cols, b: int, cap: int, dt, place=jnp.asarray):
     """VALUE_DICT views → a resident CodePlate plus the HOST-side sorted
     dictionary stack the bind-time sarg skipper reads.
 
     Returns (CodePlate, host_dicts [b, Dp] float64, sizes [b] int64).
     Dictionary rows pad by REPEATING the last value so each row stays
     sorted — the property the in-trace searchsorted threshold
-    translation and the host membership probe both rely on."""
+    translation and the host membership probe both rely on.
+    `place` is the bind's device-placement hook: under a mesh the plate
+    leaves shard on the batch axis like decoded plates (codes AND
+    per-batch dictionaries are [b, ...]-leading)."""
     d_pad = _next_pow2(max(1, max(len(c.dictionary) for c in vd_cols)))
     codes = np.zeros((b, cap), dtype=_valdict_code_dtype(vd_cols))
     dicts = np.zeros((b, d_pad), dtype=dt)
@@ -240,11 +243,12 @@ def code_plates(vd_cols, b: int, cap: int, dt):
         _counters["bytes_decoded_equiv"] += int(cap * d.dtype.itemsize)
         _counters["batches_device_decoded"] += 1
         _counters["batches_code_bound"] += 1
-    return (CodePlate(jnp.asarray(codes), jnp.asarray(dicts)),
+    return (CodePlate(place(codes), place(dicts)),
             host, sizes)
 
 
-def rle_plates(rle_cols, b: int, cap: int, dt) -> RlePlate:
+def rle_plates(rle_cols, b: int, cap: int, dt,
+               place=jnp.asarray) -> RlePlate:
     """RUN_LENGTH views → a resident RlePlate (run values + cumulative
     end offsets, O(runs) bytes in HBM instead of O(cap))."""
     r_pad = _next_pow2(max(1, max(len(c.data) for c in rle_cols)))
@@ -263,10 +267,10 @@ def rle_plates(rle_cols, b: int, cap: int, dt) -> RlePlate:
         _counters["bytes_decoded_equiv"] += int(cap * vals.dtype.itemsize)
         _counters["batches_device_decoded"] += 1
         _counters["batches_code_bound"] += 1
-    return RlePlate(jnp.asarray(vals), jnp.asarray(ends))
+    return RlePlate(place(vals), place(ends))
 
 
-def bit_plates(bit_cols, b: int, cap: int) -> BitPlate:
+def bit_plates(bit_cols, b: int, cap: int, place=jnp.asarray) -> BitPlate:
     """BOOLEAN_BITSET views → a resident BitPlate (8x fewer HBM bytes)."""
     nbytes = (cap + 7) // 8
     packed = np.zeros((b, nbytes), dtype=np.uint8)
@@ -277,7 +281,7 @@ def bit_plates(bit_cols, b: int, cap: int) -> BitPlate:
         _counters["bytes_decoded_equiv"] += int(cap)
         _counters["batches_device_decoded"] += 1
         _counters["batches_code_bound"] += 1
-    return BitPlate(jnp.asarray(packed))
+    return BitPlate(place(packed))
 
 
 # --- in-trace consumers ---------------------------------------------------

@@ -1,0 +1,438 @@
+"""Mesh-sharded query execution (engine/mesh_exec.py + parallel/):
+
+* shard_bucket padding contract (mesh-divisible {2^k, 1.5·2^k} ladder)
+* sharded-vs-single-device VALUE equivalence for aggregate/join/filter
+  shapes — NULL keys, empty shards, non-unique builds, `?` binds
+* broadcast-vs-shuffle strategy selection proof (counters + values)
+* encoded plates stay resident per device under the mesh
+* MVCC pinned scan isolated from concurrent sharded ingest
+* live rebalance (kill→rejoin moves buckets) under query traffic
+* REST /status/api/v1/mesh + dashboard surface, bench --check guards
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession, config
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.observability.metrics import global_registry
+from snappydata_tpu.parallel import MeshContext, data_mesh
+from snappydata_tpu.parallel.mesh import shard_bucket
+from snappydata_tpu.parallel.placement import ShardPlacement
+from snappydata_tpu.storage import mvcc
+from snappydata_tpu.utils import tpch
+
+pytestmark = pytest.mark.mesh
+
+
+def _counters():
+    return dict(global_registry().snapshot()["counters"])
+
+
+def _delta(c0, key):
+    return _counters().get(key, 0) - c0.get(key, 0)
+
+
+def _rows_equal(a, b, rel=1e-9):
+    assert len(a) == len(b), (len(a), len(b))
+    for ra, rb in zip(a, b):
+        for x, y in zip(ra, rb):
+            if isinstance(x, float) or isinstance(y, float):
+                assert abs(float(x) - float(y)) <= \
+                    rel * max(1.0, abs(float(x))), (ra, rb)
+            else:
+                assert x == y, (ra, rb)
+
+
+# -- padding contract ------------------------------------------------------
+
+def test_shard_bucket_ladder():
+    """shard counts must divide the padded batch size AND the result
+    stays on the storage ladder, so a resharded table reuses executable
+    shapes instead of re-specializing every static key."""
+    from snappydata_tpu.storage.device import batch_bucket
+
+    ladder = set()
+    n = 1
+    while n < 1 << 16:
+        ladder.add(batch_bucket(n))
+        n += 1
+    for nd in (1, 2, 4, 8, 16):
+        for n in list(range(1, 70)) + [100, 129, 192, 1000]:
+            b = shard_bucket(n, nd)
+            assert b >= n and b % nd == 0, (n, nd, b)
+            assert b in ladder, (n, nd, b)   # pow2 shard counts: ladder
+    # 3·2^k shard counts still land on the ladder's 1.5·2^k rungs
+    for n in (1, 5, 7, 16, 100):
+        b = shard_bucket(n, 6)
+        assert b >= n and b % 6 == 0
+        assert b in ladder, (n, b)
+    # shard counts the ladder never divides fall back to a multiple
+    b = shard_bucket(16, 5)
+    assert b >= 16 and b % 5 == 0
+    # sanity: the single-device path is the plain ladder
+    for n in (1, 3, 5, 100):
+        assert shard_bucket(n, 1) == batch_bucket(n)
+
+
+def test_placement_rebalance_moves_minimum_metadata():
+    p = ShardPlacement.balanced(8, 32)
+    assert p.num_buckets == 32 and len(set(p.assignment)) == 8
+    assert all(p.device_of_bucket(b) == p.assignment[b]
+               for b in range(32))
+    p2 = p.rebalance(4)
+    assert p2.num_devices == 4 and p2.generation > p.generation
+    assert p2.moved_from_previous > 0
+    assert set(p2.assignment) == set(range(4))
+    # bucket→device map is the dashboard surface
+    assert p2.bucket_map()[0] == 0
+
+
+# -- shared tiny workload --------------------------------------------------
+
+@pytest.fixture(scope="module")
+def loaded():
+    s = SnappySession(catalog=Catalog())
+    tpch.load_tpch(s, sf=0.02, seed=11)
+    s.sql("CREATE TABLE nk (g BIGINT, grp STRING, v DOUBLE) USING column")
+    rng = np.random.default_rng(5)
+    g = rng.integers(0, 4, 4000).astype(np.float64)
+    g[rng.random(4000) < 0.1] = np.nan   # NULL group keys
+    grp = np.array(["a", "b", "c"], dtype=object)[
+        rng.integers(0, 3, 4000)]
+    v = rng.normal(size=4000)
+    nulls = [np.isnan(g), None, None]
+    s.catalog.describe("nk").data.insert_arrays(
+        [np.nan_to_num(g).astype(np.int64), grp, v], nulls=nulls)
+    yield s
+    s.stop()
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    """ONE shared 8-device context for the whole module: a fresh
+    context per test would rotate the device-cache token (re-upload
+    every plate) — the jit caches already share across equal meshes."""
+    return MeshContext(data_mesh(8))
+
+
+def _mesh_vs_single(s, ctx, q, params=()):
+    single = s.sql(q, params=params).rows() if params \
+        else s.sql(q).rows()
+    with ctx:
+        mesh = s.sql(q, params=params).rows() if params \
+            else s.sql(q).rows()
+    _rows_equal(single, mesh)
+    return mesh
+
+
+def test_mesh_q1_q6_value_equivalence_and_lane_evidence(loaded, mesh8):
+    c0 = _counters()
+    _mesh_vs_single(loaded, mesh8, tpch.Q1)
+    _mesh_vs_single(loaded, mesh8, tpch.Q6)
+    assert _delta(c0, "mesh_shard_execs") >= 2
+    assert _delta(c0, "mesh_psum_merges") >= 3
+
+
+def test_mesh_aggregate_shapes(loaded, mesh8):
+    # min/max families, HAVING, WHERE, avg — all through the psum/pmin/
+    # pmax merge tree; NULL group keys ride the nk table
+    _mesh_vs_single(loaded, mesh8, (
+        "SELECT l_returnflag, min(l_quantity), max(l_extendedprice), "
+        "avg(l_discount), count(*) FROM lineitem "
+        "WHERE l_shipdate > DATE '1994-01-01' "
+        "GROUP BY l_returnflag HAVING count(*) > 10 "
+        "ORDER BY l_returnflag"))
+
+
+def test_mesh_null_group_keys(loaded, mesh8):
+    _mesh_vs_single(loaded, mesh8, (
+        "SELECT g, grp, count(*), sum(v) FROM nk "
+        "GROUP BY g, grp ORDER BY g, grp"))
+
+
+def test_mesh_empty_shards(loaded, mesh8):
+    """A table with fewer batches than devices: some shards see only
+    dead padded batches — identity partials must merge away."""
+    s = loaded
+    s.sql("CREATE TABLE tiny (k BIGINT, v DOUBLE) USING column")
+    s.insert_arrays("tiny", [np.arange(50, dtype=np.int64),
+                             np.arange(50, dtype=np.float64)])
+    _mesh_vs_single(s, mesh8, "SELECT k % 3, sum(v), count(*) FROM tiny "
+                              "GROUP BY k % 3 ORDER BY 1")
+
+
+def test_mesh_param_binds_stay_correct(loaded, mesh8):
+    """`?` binds decline the partial lane (counted) but stay sharded
+    and value-correct through the GSPMD lane."""
+    s = loaded
+    c0 = _counters()
+    single = s.sql("SELECT count(*), sum(l_quantity) FROM lineitem "
+                   "WHERE l_quantity < ?", params=(25,)).rows()
+    with mesh8:
+        mesh = s.sql("SELECT count(*), sum(l_quantity) FROM lineitem "
+                     "WHERE l_quantity < ?", params=(25,)).rows()
+    _rows_equal(single, mesh)
+    assert _delta(c0, "mesh_fallback_params") >= 1
+
+
+def test_mesh_encoded_plates_resident_per_device(loaded, mesh8):
+    """Sharded tables keep plates ENCODED per device: the CodePlate
+    leaves shard over the mesh and per-device resident bytes stay at
+    the encoded size (no decode-on-shard regression)."""
+    from snappydata_tpu.storage.device import (
+        build_device_table, device_cache_bytes_by_device)
+    from snappydata_tpu.storage.device_decode import CodePlate
+
+    s = loaded
+    info = s.catalog.lookup_table("lineitem")
+    info.data._device_cache.clear()
+    with mesh8 as ctx:
+        dt = build_device_table(info.data, None, [4])  # l_quantity
+        col = dt.columns[4]
+        assert isinstance(col, CodePlate), type(col)
+        assert len(col.codes.sharding.device_set) == 8
+        assert col.codes.shape[0] % 8 == 0
+        per_dev = device_cache_bytes_by_device(
+            [("lineitem", info.data)])
+        assert len(per_dev) == 8
+        total = sum(per_dev.values())
+        decoded = dt.valid.size * 8   # the f64 plate that never existed
+        assert total < decoded, (total, decoded)
+        # evenly spread: no device holds the whole column
+        assert max(per_dev.values()) < total
+
+
+# -- join distribution strategies -----------------------------------------
+
+def test_join_broadcast_default_and_shuffle_forced(loaded, mesh8):
+    """Q3C (non-unique build side): AUTO picks broadcast-build under
+    the byte threshold; forcing shuffle exchanges both sides
+    bucket-wise — both strategies value-identical, both counted, and
+    the shuffle exchange is cached across executions.  A tiny
+    mesh_broadcast_build_bytes then proves AUTO flips to shuffle."""
+    s = loaded
+    single = s.sql(tpch.Q3C).rows()
+    props = config.global_properties()
+    c0 = _counters()
+    with mesh8:
+        _rows_equal(single, s.sql(tpch.Q3C).rows())
+    assert _delta(c0, "mesh_join_broadcast") >= 1
+    assert _delta(c0, "mesh_join_shuffle") == 0
+    old = props.get("mesh_join_strategy")
+    try:
+        props.set("mesh_join_strategy", "shuffle")
+        c1 = _counters()
+        with mesh8:
+            _rows_equal(single, s.sql(tpch.Q3C).rows())
+            _rows_equal(single, s.sql(tpch.Q3C).rows())
+        assert _delta(c1, "mesh_join_shuffle") >= 2
+        assert _delta(c1, "mesh_exchange_bytes") > 0
+        assert _delta(c1, "mesh_exchange_rows") > 0
+        assert _delta(c1, "mesh_exchange_cache_hits") >= 1
+    finally:
+        props.set("mesh_join_strategy", old)
+    # AUTO past the broadcast budget: selection flips per bind, no
+    # knob-flush needed (the shuffle specialization rides a static)
+    old_b = props.get("mesh_broadcast_build_bytes")
+    try:
+        props.set("mesh_broadcast_build_bytes", 1)  # everything is big
+        c2 = _counters()
+        with mesh8:
+            _rows_equal(single, s.sql(tpch.Q3C).rows())
+        assert _delta(c2, "mesh_join_shuffle") >= 1
+        assert _delta(c2, "mesh_join_broadcast") == 0
+    finally:
+        props.set("mesh_broadcast_build_bytes", old_b)
+
+
+def test_shuffle_ineligible_declines_to_broadcast(loaded, mesh8):
+    """A multi-join tree can't shuffle on ONE key — the decline is
+    itemized by reason (like the join engine's fallback reasons) and
+    the query still answers correctly via broadcast."""
+    s = loaded
+    q = ("SELECT o_orderpriority, count(*) FROM orders "
+         "JOIN lineitem ON o_orderkey = l_orderkey "
+         "JOIN customer ON o_custkey = c_custkey "
+         "GROUP BY o_orderpriority ORDER BY o_orderpriority")
+    props = config.global_properties()
+    single = s.sql(q).rows()
+    old = props.get("mesh_join_strategy")
+    try:
+        props.set("mesh_join_strategy", "shuffle")
+        c0 = _counters()
+        with mesh8:
+            _rows_equal(single, s.sql(q).rows())
+        fallbacks = {k: v for k, v in _counters().items()
+                     if k.startswith("mesh_join_shuffle_fallback_")
+                     and v > c0.get(k, 0)}
+        assert fallbacks, "expected an itemized shuffle decline"
+    finally:
+        props.set("mesh_join_strategy", old)
+
+
+# -- MVCC × mesh -----------------------------------------------------------
+
+def test_mesh_pinned_scan_isolated_from_sharded_ingest(loaded, mesh8):
+    """A pinned statement scope under the mesh reads its epoch while a
+    concurrent writer ingests into the SHARDED table — repeatable
+    reads, then the new rows appear after release."""
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE mt (k BIGINT, v DOUBLE) USING column")
+    s.insert_arrays("mt", [np.arange(1000, dtype=np.int64),
+                           np.ones(1000)])
+    with mesh8:
+        with mvcc.pinned_scope(s.catalog, ["mt"]) as pin:
+            assert pin is not None
+            before = s.sql("SELECT count(*), sum(v) FROM mt").rows()
+            assert before == [(1000, 1000.0)]
+            done = []
+
+            def ingest():
+                w = SnappySession(catalog=s.catalog)
+                w.insert_arrays("mt", [np.arange(500, dtype=np.int64),
+                                       np.full(500, 2.0)])
+                done.append(True)
+
+            th = threading.Thread(target=ingest)
+            th.start()
+            th.join(timeout=30)
+            assert done, "sharded ingest blocked behind a pinned reader"
+            # the pinned statement still reads its epoch
+            assert s.sql("SELECT count(*), sum(v) FROM mt").rows() \
+                == [(1000, 1000.0)]
+        # release → the concurrent commit is visible
+        assert s.sql("SELECT count(*), sum(v) FROM mt").rows() \
+            == [(1500, 2000.0)]
+    s.stop()
+
+
+# -- live rebalance --------------------------------------------------------
+
+def test_rebalance_under_traffic(loaded):
+    """Kill→rejoin as a mesh resize: buckets move, resident plates
+    migrate device-to-device, and every in-flight query stays
+    value-correct throughout."""
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE rt (k BIGINT, v DOUBLE) USING column")
+    n = 20_000
+    s.insert_arrays("rt", [np.arange(n, dtype=np.int64),
+                           np.arange(n, dtype=np.float64)])
+    expect = s.sql("SELECT k % 7, count(*), sum(v) FROM rt "
+                   "GROUP BY k % 7 ORDER BY 1").rows()
+    s.default_mesh = data_mesh(8)
+    # COLD resize — no mesh query has run, _mesh_ctx is None: the miss
+    # path must not re-acquire the non-reentrant resize lock (review
+    # finding: it self-deadlocked; under lockdep it raises instead)
+    assert s.resize_mesh(8)["num_devices"] == 8
+    s.sql("SELECT count(*) FROM rt")   # warm the mesh cache
+    errors = []
+    stop = threading.Event()
+
+    def reader():
+        w = SnappySession(catalog=s.catalog)
+        w.default_mesh = s.default_mesh
+        w._mesh_ctx = s._mesh_ctx
+        while not stop.is_set():
+            try:
+                got = w.sql("SELECT k % 7, count(*), sum(v) FROM rt "
+                            "GROUP BY k % 7 ORDER BY 1").rows()
+                _rows_equal(expect, got)
+                # the resize swaps the session's mesh mid-traffic
+                w.default_mesh = s.default_mesh
+                w._mesh_ctx = s._mesh_ctx
+            except Exception as e:  # noqa: BLE001 — collected, asserted
+                errors.append(repr(e))
+                return
+
+    threads = [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    try:
+        c0 = _counters()
+        down = s.resize_mesh(4)    # "kill": half the devices leave
+        assert down["num_devices"] == 4 and down["buckets_moved"] > 0
+        for _ in range(3):
+            got = s.sql("SELECT k % 7, count(*), sum(v) FROM rt "
+                        "GROUP BY k % 7 ORDER BY 1").rows()
+            _rows_equal(expect, got)
+        up = s.resize_mesh(8)      # "rejoin": they come back
+        assert up["num_devices"] == 8 and up["buckets_moved"] > 0
+        for _ in range(3):
+            got = s.sql("SELECT k % 7, count(*), sum(v) FROM rt "
+                        "GROUP BY k % 7 ORDER BY 1").rows()
+            _rows_equal(expect, got)
+        assert _delta(c0, "mesh_rebalances") == 2
+        # resident plates MIGRATED instead of rebuilding from host
+        assert down["cache_entries_moved"] > 0
+        assert down["bytes_moved"] > 0
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+    assert not errors, errors
+    s.executor.clear_cache()
+    s.stop()
+
+
+# -- surfaces --------------------------------------------------------------
+
+def test_mesh_snapshot_and_rest_surface(loaded, mesh8):
+    from snappydata_tpu.cluster.rest import RestService
+    from snappydata_tpu.observability.stats_service import (
+        TableStatsService, mesh_snapshot)
+
+    s = loaded
+    with mesh8:
+        s.sql(tpch.Q6)
+        snap = mesh_snapshot(s.catalog, s)
+        assert snap["active"] and snap["num_devices"] == 8
+        assert snap["mesh_shard_execs"] >= 1
+        assert snap["placement"]["bucket_map"]
+        assert snap["resident_bytes_by_device"]
+    svc = RestService(s, TableStatsService(s.catalog), port=0).start()
+    base = f"http://{svc.host}:{svc.port}"
+    try:
+        with urllib.request.urlopen(base + "/status/api/v1/mesh",
+                                    timeout=5) as resp:
+            body = json.loads(resp.read())
+        assert "mesh_shard_execs" in body
+        assert "mesh_join_strategy" in body
+        with urllib.request.urlopen(base + "/dashboard",
+                                    timeout=5) as resp:
+            html = resp.read().decode()
+        assert "Mesh execution" in html
+    finally:
+        svc.stop()
+
+
+def test_bench_mesh_guard_logic():
+    import bench
+
+    def rec(mc):
+        return {"value": 1e6, "detail": {"multichip": mc}}
+
+    good = {"value_mismatches": 0, "mesh_shard_execs": 8,
+            "scaling_efficiency": {"2": 0.95, "4": 0.9, "8": 0.85},
+            "resident_bytes_per_row_single": 25.0,
+            "resident_bytes_per_row_sharded": 26.0}
+    assert bench.check_regression(rec(good), rec(good)) == []
+    # pre-mesh records (no multichip section) skip the guards
+    assert bench.check_regression(
+        {"value": 1e6, "detail": {}}, {"value": 1e6, "detail": {}}) == []
+    bad = dict(good, value_mismatches=3)
+    assert any("diverged" in f for f in
+               bench.check_regression(rec(bad), rec(good)))
+    bad = dict(good, scaling_efficiency={"2": 1.0, "4": 1.0, "8": 0.4})
+    assert any("efficiency" in f for f in
+               bench.check_regression(rec(bad), rec(good)))
+    bad = dict(good, mesh_shard_execs=0)
+    assert any("shard_map" in f for f in
+               bench.check_regression(rec(bad), rec(good)))
+    bad = dict(good, resident_bytes_per_row_sharded=60.0)
+    assert any("encoded" in f for f in
+               bench.check_regression(rec(bad), rec(good)))
