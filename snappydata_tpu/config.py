@@ -162,6 +162,18 @@ class Properties:
     # to host, from which they rebuild on next access)
     device_cache_bytes: int = 0               # 0 = unlimited
 
+    # Out-of-core tier ladder (storage/tier.py): steady-state caps the
+    # tiled lane enforces after a pass — device plates demote to the
+    # host pool past tier_device_bytes, resident encoded batches demote
+    # to CRC-framed disk-tier files past tier_host_bytes (both 0 = off;
+    # the broker's degradation ladder walks the same rungs on pressure
+    # regardless). tier_prefetch_depth is the tile look-ahead of the
+    # background host->HBM prefetcher: how many windows ahead of the
+    # consumer the upload thread warms (0 disables the prefetcher).
+    tier_device_bytes: int = 0
+    tier_host_bytes: int = 0
+    tier_prefetch_depth: int = 1
+
     # Resource governor (resource/broker.py; ref: critical-heap-percentage
     # admission + LowMemoryException fail-fast). memory_limit_bytes is the
     # unified host+device budget admission meters query estimates against;

@@ -468,6 +468,25 @@ def mesh_layout_cache_nbytes() -> int:
                    for entry in cache.values())
 
 
+def trim_layout_caches(target_bytes: int) -> int:
+    """Tier ladder's HBM rung for the exchange caches: drop
+    least-recently-used exchanged layouts until the total fits
+    `target_bytes`.  Returns bytes freed; dropped layouts rebuild from
+    the next bind (one re-exchange), exactly like an evicted plate."""
+    freed = 0
+    with _cache_lock:
+        total = sum(entry[1] for cache in _LAYOUT_CACHES.values()
+                    for entry in cache.values())
+        for cache in list(_LAYOUT_CACHES.values()):
+            while cache and total > max(0, int(target_bytes)):
+                _k, entry = cache.popitem(last=False)
+                total -= entry[1]
+                freed += entry[1]
+            if total <= max(0, int(target_bytes)):
+                break
+    return freed
+
+
 def _cache_key(tables, static, params, ctx, kind: str):
     try:
         hash(params)

@@ -52,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from snappydata_tpu import config
+from snappydata_tpu.parallel import mesh
 # the expanded-output axis reuses the batch axis' two-shapes-per-octave
 # bucketing ({2^k, 1.5*2^k}) — one policy, so a waste-bound tweak there
 # reaches the join expansion too
@@ -174,20 +175,26 @@ def build_artifact(ident, token, compute: Callable[[], object]) -> dict:
             # id() reuse after GC: the weakref proves staleness
             _BUILD_BYTES[0] -= _BUILD_CACHE.pop(key)["nbytes"]
     reg.inc("join_build_cache_misses")
-    bkeys = compute()
-    order = jnp.argsort(bkeys).astype(jnp.int64)
-    skeys = bkeys[order]
+    # the whole eager build — key materialization, argsort, dup probe,
+    # pack — lowers to multi-device programs under a mesh (sharded
+    # inputs) and fences like any other dispatch; the cache stores and
+    # counter increments stay OUTSIDE (dispatch_lock is a leaf)
+    with mesh.eager_fence():
+        bkeys = compute()
+        order = jnp.argsort(bkeys).astype(jnp.int64)
+        skeys = bkeys[order]
+        if skeys.shape[0] > 1:
+            dup = jnp.any((skeys[1:] == skeys[:-1])
+                          & (skeys[:-1] != jnp.int64(BUILD_NULL_SENTINEL)))
+            unique = not bool(jax.device_get(dup))
+        else:
+            unique = True
+        # `packed` [2, F] stacks (skeys, order) so the executor ships the
+        # artifact through ONE aux input slot; `skeys` is kept separate
+        # for the bind-time expansion bound's searchsorted
+        packed = jnp.stack([skeys, order])
     reg.inc("join_build_sorts")
-    if skeys.shape[0] > 1:
-        dup = jnp.any((skeys[1:] == skeys[:-1])
-                      & (skeys[:-1] != jnp.int64(BUILD_NULL_SENTINEL)))
-        unique = not bool(jax.device_get(dup))
-    else:
-        unique = True
-    # `packed` [2, F] stacks (skeys, order) so the executor ships the
-    # artifact through ONE aux input slot; `skeys` is kept separate for
-    # the bind-time expansion bound's searchsorted
-    entry = {"skeys": skeys, "packed": jnp.stack([skeys, order]),
+    entry = {"skeys": skeys, "packed": packed,
              "unique": unique,
              "nbytes": int(skeys.nbytes) * 3,
              "ident": weakref.ref(ident), "tick": _next_tick(),
@@ -228,15 +235,18 @@ def probe_expand_bound(artifact: dict, probe_ident, probe_token,
         hit = artifact["bounds"].get(key)
         if hit is not None and hit[0]() is probe_ident:
             return hit[1]
-    pkeys, valid_flat = compute_pkeys()
-    skeys = artifact["skeys"]
-    lo = jnp.searchsorted(skeys, pkeys, side="left")
-    hi = jnp.searchsorted(skeys, pkeys, side="right")
-    counts = jnp.where(valid_flat, (hi - lo).astype(jnp.int64), 0)
-    total = counts.sum()
-    if null_extend:
-        total = total + valid_flat.sum().astype(jnp.int64)
-    bound = int(jax.device_get(total))
+    # eager searchsorteds over (possibly sharded) probe keys: fenced
+    # like a dispatch; the memo store stays outside (leaf discipline)
+    with mesh.eager_fence():
+        pkeys, valid_flat = compute_pkeys()
+        skeys = artifact["skeys"]
+        lo = jnp.searchsorted(skeys, pkeys, side="left")
+        hi = jnp.searchsorted(skeys, pkeys, side="right")
+        counts = jnp.where(valid_flat, (hi - lo).astype(jnp.int64), 0)
+        total = counts.sum()
+        if null_extend:
+            total = total + valid_flat.sum().astype(jnp.int64)
+        bound = int(jax.device_get(total))
     with _CACHE_LOCK:
         if len(artifact["bounds"]) > 64:
             artifact["bounds"].clear()
@@ -263,17 +273,18 @@ def probe_expand_bound_per_shard(artifact: dict, probe_ident,
         hit = artifact["bounds"].get(key)
         if hit is not None and hit[0]() is probe_ident:
             return hit[1]
-    pkeys, valid_flat = compute_pkeys()
-    skeys = artifact["skeys"]
-    lo = jnp.searchsorted(skeys, pkeys, side="left")
-    hi = jnp.searchsorted(skeys, pkeys, side="right")
-    counts = jnp.where(valid_flat, (hi - lo).astype(jnp.int64), 0)
-    if null_extend:
-        counts = counts + valid_flat.astype(jnp.int64)
-    per_batch = counts.reshape(batch_shape).sum(axis=1)
-    k = max(1, -(-int(batch_shape[0]) // int(num_shards)))
-    top = jax.lax.top_k(per_batch, min(k, int(batch_shape[0])))[0]
-    bound = int(jax.device_get(top.sum()))
+    with mesh.eager_fence():
+        pkeys, valid_flat = compute_pkeys()
+        skeys = artifact["skeys"]
+        lo = jnp.searchsorted(skeys, pkeys, side="left")
+        hi = jnp.searchsorted(skeys, pkeys, side="right")
+        counts = jnp.where(valid_flat, (hi - lo).astype(jnp.int64), 0)
+        if null_extend:
+            counts = counts + valid_flat.astype(jnp.int64)
+        per_batch = counts.reshape(batch_shape).sum(axis=1)
+        k = max(1, -(-int(batch_shape[0]) // int(num_shards)))
+        top = jax.lax.top_k(per_batch, min(k, int(batch_shape[0])))[0]
+        bound = int(jax.device_get(top.sum()))
     with _CACHE_LOCK:
         if len(artifact["bounds"]) > 64:
             artifact["bounds"].clear()

@@ -16,6 +16,7 @@ compiler instead of hand-written messaging.
 
 from __future__ import annotations
 
+import contextlib
 import contextvars
 import threading
 from snappydata_tpu.utils import locks
@@ -113,12 +114,53 @@ MeshContext._ctx_stack = contextvars.ContextVar("mesh_ctx_stack",
 # the shuffle exchange's bucketed gathers — holds this RLock across
 # dispatch + completion; single-device execution never touches it.
 # Reentrant: a mesh query's host-side finalize may nest another sharded
-# read.  Known boundary: EAGER ops on sharded arrays at bind time
-# (join-artifact argsorts, expansion-bound searchsorteds) also lower to
-# multi-device programs and are NOT fenced yet — concurrent mesh JOIN
-# binds share the pre-PR-13 exposure; fencing the bind path wholesale
-# is the open follow-up.
+# read.  EAGER ops on sharded arrays are dispatches too and fence the
+# same way: join-artifact argsorts and expansion-bound searchsorteds at
+# GSPMD bind time run inside `eager_fence()` (ops/join.py), and the tile
+# prefetcher's background `device_put`s — multi-device placements from a
+# non-query thread — fence through `prefetch_fence()` below.  The lock
+# is a declared LEAF of the hierarchy: nothing may be acquired while it
+# is held, so fenced regions are pure dispatch (cache probes, metric
+# increments and lock-taking callbacks all happen outside the fence).
 dispatch_lock = locks.named_rlock("parallel.mesh_dispatch")
+
+# set inside a prefetch worker (storage/prefetch.py): makes
+# shard_batches wrap its device_put in dispatch_lock — the ONLY fenced
+# instruction of the background upload, so the worker never holds the
+# leaf across cache/lock-taking code
+_prefetch_fencing = contextvars.ContextVar("mesh_prefetch_fencing",
+                                           default=False)
+
+
+@contextlib.contextmanager
+def prefetch_fence():
+    """Mark this thread's placements as background prefetch uploads:
+    every `shard_batches` device_put inside runs under dispatch_lock so
+    it cannot interleave with a foreground collective's rendezvous."""
+    tok = _prefetch_fencing.set(True)
+    try:
+        yield
+    finally:
+        _prefetch_fencing.reset(tok)
+
+
+@contextlib.contextmanager
+def eager_fence():
+    """Fence a region of EAGER multi-device ops (bind-time argsorts,
+    searchsorteds, device_gets on sharded arrays) exactly like a
+    compiled dispatch.  No-op outside a mesh — single-device eager ops
+    have no rendezvous to interleave.  The region must acquire NOTHING:
+    dispatch_lock is a declared leaf, so hoist cache stores and metric
+    increments out of the fence."""
+    if MeshContext.current() is None:
+        yield
+        return
+    # locklint: blocking-under-lock the fenced eager ops block on device
+    # completion while holding the dispatch fence BY DESIGN — identical
+    # to the compiled-dispatch holds above (the serialization IS the fix
+    # for the rendezvous-interleave deadlock)
+    with dispatch_lock:
+        yield
 
 
 class _NoMesh:
@@ -163,6 +205,14 @@ def shard_batches(array, ctx: Optional[MeshContext]):
     device builder (pow2 bucketing covers pow2 meshes)."""
     if ctx is None:
         return array
+    if _prefetch_fencing.get():
+        # background prefetch upload: a multi-device placement from a
+        # non-query thread must not interleave with a foreground
+        # collective's rendezvous (see dispatch_lock)
+        # locklint: blocking-under-lock the placement blocks on the
+        # transfer while holding the dispatch fence BY DESIGN
+        with dispatch_lock:
+            return jax.device_put(array, ctx.batch_sharding)
     return jax.device_put(array, ctx.batch_sharding)
 
 

@@ -157,7 +157,7 @@ class ResourceBroker:
     def ledger(self) -> dict:
         """Point-in-time unified ledger: per-table host/device bytes,
         spill-file bytes, and per-query admitted estimates."""
-        from snappydata_tpu.storage import hoststore
+        from snappydata_tpu.storage import hoststore, tier
         from snappydata_tpu.storage.device import device_cache_bytes_by_table
 
         tables = self._iter_tables()
@@ -197,6 +197,11 @@ class ResourceBroker:
             "host": host,
             "device": device,
             "spill_file_bytes": hoststore.spill_file_bytes(),
+            # CRC-framed disk-tier files (storage/tier.py): batches the
+            # demotion ladder pushed host -> disk; like spill files,
+            # their memmapped pages belong to the OS cache, so they are
+            # ledgered here but never counted into host_total
+            "tier_file_bytes": tier.tier_file_bytes(),
             "host_total": host_total,
             # prepared-plan registry (serving/): analyzed+tokenized plan
             # shapes held for compile-once executes — LRU-capped by
@@ -441,6 +446,18 @@ class ResourceBroker:
 
         if mvcc.trim_unpinned(self._iter_tables()):
             reg.inc("governor_degrade_epoch_trims")
+        host, device = self.measured_bytes()
+        if host + device <= target_bytes:
+            return
+        # walk the tier ladder (storage/tier.py): drop cold UNPINNED
+        # device plates back to the host pool, then frame the oldest
+        # host batches into CRC-checked disk-tier files — both rungs
+        # rebuild transparently on the next bind/scan
+        from snappydata_tpu.storage import tier
+
+        if tier.demote(self._iter_tables(),
+                       host + device - target_bytes):
+            reg.inc("governor_degrade_tier_demotions")
         host, device = self.measured_bytes()
         if host + device <= target_bytes:
             return

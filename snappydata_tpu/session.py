@@ -74,6 +74,14 @@ class SnappySession:
         self.default_mesh = None
         self._mesh_ctx = None
         self._mesh_resize_lock = locks.named_lock("session.mesh")
+        # reusable tile-merge scratch sessions keyed by partial schema
+        # (see _merge_partial_pieces) — GIL-atomic list pop/append, no
+        # lock: a throwaway session per merge re-COMPILED the merge
+        # aggregate every tiled statement (~100ms of XLA per query)
+        self._tile_merge_pool: Dict[str, list] = {}
+        # set by the tiled lane; consumed (and cleared) after the
+        # statement's read pin releases — see execute_statement
+        self._tier_enforce_pending = False
         if needs_recovery:
             self.disk_store.recover_catalog(session=self)
 
@@ -527,10 +535,22 @@ class SnappySession:
         from snappydata_tpu.storage import mvcc
 
         names = self._snapshot_tables_for(stmt)
-        if names is not None and mvcc.current_pin() is None:
-            with mvcc.pinned_scope(self.catalog, names):
-                return self._execute_statement_body(stmt, user_params)
-        return self._execute_statement_body(stmt, user_params)
+        try:
+            if names is not None and mvcc.current_pin() is None:
+                with mvcc.pinned_scope(self.catalog, names):
+                    return self._execute_statement_body(stmt, user_params)
+            return self._execute_statement_body(stmt, user_params)
+        finally:
+            # a tiled pass inside the statement may have left a tier
+            # over its knob; the ladder walk has to wait until the
+            # statement pin is gone or demote_device pin-skips the very
+            # entries it must drop.  An ambient caller-held pin defers
+            # to that caller's next unpinned statement.
+            if self._tier_enforce_pending and mvcc.current_pin() is None:
+                self._tier_enforce_pending = False
+                from snappydata_tpu.storage import tier
+
+                tier.maybe_demote()
 
     def _execute_statement_body(self, stmt: ast.Statement,
                                 user_params=()) -> Result:
@@ -1423,22 +1443,49 @@ class SnappySession:
                 merged = self._tiled_device_pass(
                     compiled, params, data, manifest, units, tile_units)
             if merged is None:
-                for lo in range(0, units, tile_units):
-                    # tile boundary = cancellation point: CANCEL <id>,
-                    # statement timeouts and broker kills land here,
-                    # within one tile of the signal
-                    check_current()
-                    with scan_window(data, lo, min(lo + tile_units, units),
-                                     manifest, tile_units=tile_units):
-                        if tokenized is not None:
-                            pieces.append(self._execute_partial(
-                                tokenized, params))
-                        else:  # analysis failed: per-tile SQL fallback
-                            pieces.append(self.sql(partial_sql))
-                    global_registry().inc("scan_tiles")
+                from snappydata_tpu.storage.prefetch import TilePrefetcher
+
+                from snappydata_tpu.parallel.mesh import MeshContext
+
+                # the worker warms through the consumer's mesh context
+                # (ambient, else the session's cached one) so its cache
+                # keys carry the token the consumer's binds will look up
+                mesh_ctx = MeshContext.current() or (
+                    self._mesh_context()
+                    if self.default_mesh is not None else None)
+                pf = TilePrefetcher.maybe(data, manifest, units,
+                                          tile_units, mesh_ctx)
+                try:
+                    for lo in range(0, units, tile_units):
+                        # tile boundary = cancellation point: CANCEL
+                        # <id>, statement timeouts and broker kills land
+                        # here, within one tile of the signal
+                        check_current()
+                        if pf is not None:
+                            pf.await_window(lo)
+                        with scan_window(data, lo,
+                                         min(lo + tile_units, units),
+                                         manifest, tile_units=tile_units):
+                            if tokenized is not None:
+                                pieces.append(self._execute_partial(
+                                    tokenized, params))
+                            else:  # analysis failed: per-tile SQL path
+                                pieces.append(self.sql(partial_sql))
+                        if pf is not None:
+                            pf.advance(lo)
+                        global_registry().inc("scan_tiles")
+                finally:
+                    if pf is not None:
+                        pf.close()
                 global_registry().inc("scan_tile_host_merges")
         finally:
             self._in_tile = False
+        # steady-state tier enforcement: an out-of-core pass may leave a
+        # tier over its knob (tier_device_bytes / tier_host_bytes).  The
+        # statement's own read pin still covers the current epoch here,
+        # so demote_device would pin-skip every entry it should drop —
+        # defer the ladder walk to execute_statement's pin release.
+        self._tier_enforce_pending = True
         if merged is not None:
             pieces = [merged]
         return self._merge_partial_pieces(pieces, node, merged_select,
@@ -1452,22 +1499,33 @@ class SnappySession:
         from snappydata_tpu.engine.partial_agg import ddl_type
         from snappydata_tpu.sql.render import render_expr
 
-        # merge in a THROWAWAY in-memory session (never journaled/persisted)
+        # merge in a pooled in-memory scratch session (never journaled/
+        # persisted), keyed by the partial schema and truncated between
+        # uses: the merge aggregate's compiled plan lives in the scratch
+        # executor, so a throwaway session here re-paid its full XLA
+        # compile (~100ms) on EVERY tiled statement — the pool is what
+        # makes the out-of-core lane's steady state transfer-bound
+        # instead of compile-bound
         from snappydata_tpu.catalog import Catalog as _Cat
         from snappydata_tpu.engine.result import to_host_domain
 
-        scratch_sess = SnappySession(catalog=_Cat(), conf=self.conf)
-        # the merge select must never re-enter the tile pass: partials
-        # of a generic-key aggregate can exceed the (tiny) tile budget,
-        # and a tiled merge would spawn scratch sessions recursively —
-        # each level re-emitting ~G partial rows, never converging
-        scratch_sess._in_tile = True
         first = pieces[0]
         fields_sql = ", ".join(
             f"{nm} {ddl_type(dt)}"
             for nm, dt in zip(first.names, first.dtypes))
-        scratch_sess.sql(f"CREATE TABLE __tile_partials ({fields_sql}) "
-                         f"USING column")
+        pool = self._tile_merge_pool.setdefault(fields_sql, [])
+        try:
+            scratch_sess = pool.pop()   # GIL-atomic claim
+        except IndexError:
+            scratch_sess = SnappySession(catalog=_Cat(), conf=self.conf)
+            # the merge select must never re-enter the tile pass:
+            # partials of a generic-key aggregate can exceed the (tiny)
+            # tile budget, and a tiled merge would spawn scratch
+            # sessions recursively — each level re-emitting ~G partial
+            # rows, never converging
+            scratch_sess._in_tile = True
+            scratch_sess.sql(f"CREATE TABLE __tile_partials "
+                             f"({fields_sql}) USING column")
         sdata = scratch_sess.catalog.describe("__tile_partials").data
         for piece in pieces:
             if piece.num_rows:
@@ -1487,6 +1545,12 @@ class SnappySession:
             msql += f" HAVING {render_expr(merge_having)}"
         result = scratch_sess.sql(msql)
         result.names = [_expr_name(e) for e in node.agg_exprs]
+        # result columns are materialized arrays — safe to recycle the
+        # scratch table underneath them (bounded pool: extras are
+        # dropped, e.g. under concurrent tiled merges of one schema)
+        sdata.truncate()
+        if len(pool) < 4:
+            pool.append(scratch_sess)
         from snappydata_tpu.cluster.distributed import _apply_outer
 
         return _apply_outer(result, outer, self)
@@ -1760,33 +1824,54 @@ class SnappySession:
         from snappydata_tpu.resource import check_current
         from snappydata_tpu.storage import device as device_mod
 
+        from snappydata_tpu.storage.prefetch import TilePrefetcher
+
         reg = global_registry()
         tags = compiled.tile_merge["tags"]
         outs: List[tuple] = []
+        from snappydata_tpu.parallel.mesh import MeshContext
+
+        # out-of-core lane: a background worker warms window k+1's
+        # plates while window k aggregates on device (tier_prefetch_depth
+        # windows of look-ahead); the pass works identically without it.
+        # The worker re-enters the consumer's AMBIENT mesh context (if
+        # any) so its cache keys carry the same token.
+        pf = TilePrefetcher.maybe(data, manifest, units, tile_units,
+                                  MeshContext.current())
         try:
-            for lo in range(0, units, tile_units):
-                check_current()  # tile boundary = cancellation point
-                with device_mod.scan_window(
-                        data, lo, min(lo + tile_units, units), manifest,
-                        tile_units=tile_units):
-                    outs.append(compiled.execute_raw(params))
-                # counts WORK, not queries: when this pass aborts (bind
-                # CompileError / decimal overflow) the host rerun counts
-                # its tiles again — the query genuinely scanned twice
-                reg.inc("scan_tiles")
-                if len(outs) >= 2:
-                    prev = outs[-2]
-                    try:
-                        ready = prev[0].is_ready()
-                    except AttributeError:  # older jax: assume done
-                        ready = True
-                    if not ready:
-                        # this tile's bind/upload overlapped the previous
-                        # tile's device compute — the pipelining evidence
-                        reg.inc("scan_tile_prefetch_overlap")
-                        jax.block_until_ready(prev)
-        except CompileError:
-            return None
+            try:
+                for lo in range(0, units, tile_units):
+                    check_current()  # tile boundary = cancellation point
+                    if pf is not None:
+                        pf.await_window(lo)
+                    with device_mod.scan_window(
+                            data, lo, min(lo + tile_units, units),
+                            manifest, tile_units=tile_units):
+                        outs.append(compiled.execute_raw(params))
+                    if pf is not None:
+                        pf.advance(lo)
+                    # counts WORK, not queries: when this pass aborts
+                    # (bind CompileError / decimal overflow) the host
+                    # rerun counts its tiles again — the query genuinely
+                    # scanned twice
+                    reg.inc("scan_tiles")
+                    if len(outs) >= 2:
+                        prev = outs[-2]
+                        try:
+                            ready = prev[0].is_ready()
+                        except AttributeError:  # older jax: assume done
+                            ready = True
+                        if not ready:
+                            # this tile's bind/upload overlapped the
+                            # previous tile's device compute — the
+                            # pipelining evidence
+                            reg.inc("scan_tile_prefetch_overlap")
+                            jax.block_until_ready(prev)
+            except CompileError:
+                return None
+        finally:
+            if pf is not None:
+                pf.close()
         if len(outs) > 1:
             reg.inc("scan_tile_device_merges", len(outs) - 1)
         while len(outs) > 1:  # pairwise tree merge, all on device

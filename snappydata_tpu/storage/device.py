@@ -19,6 +19,7 @@ from __future__ import annotations
 import contextlib
 import contextvars
 import dataclasses
+import weakref
 from typing import Dict, Optional, Sequence, Tuple
 
 import jax
@@ -198,7 +199,10 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
     from snappydata_tpu.storage import mvcc as _mvcc
 
     _pinned_vers = _mvcc.pinned_versions(data)
-    for k in [k for k in data._device_cache
+    # list() snapshots are C-atomic under the GIL: a prefetch worker
+    # (storage/prefetch.py) inserts window entries concurrently, and a
+    # plain comprehension over the live dict would raise RuntimeError
+    for k in [k for k in list(data._device_cache)
               if k != cache_key and k[0] not in _pinned_vers
               and not (k[1] == cache_key[1]
                        and k[0] >= manifest.version - 1)]:
@@ -213,8 +217,15 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
         # dropping the cache entry here only releases our reference, so
         # peak residency is bounded at two tiles, exactly the pipeline
         # depth the pass throttles to.
-        for k in [k for k in data._device_cache
-                  if k != cache_key and k[2] is not None]:
+        # …EXCEPT windows a live prefetch pass owns (storage/prefetch):
+        # evicting the look-ahead tile the worker just uploaded would
+        # turn the prefetcher into a strict slowdown
+        from snappydata_tpu.storage import prefetch as _prefetch
+
+        _kept = _prefetch.keep_windows(data)
+        for k in [k for k in list(data._device_cache)
+                  if k != cache_key and k[2] is not None
+                  and k[2] not in _kept]:
             data._device_cache.pop(k, None)
             _cache_budget.forget(data._device_cache, k)
 
@@ -538,7 +549,7 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
 
     if _cache_budget.enabled():
         _cache_budget.touch(data._device_cache, cache_key,
-                            _entry_bytes(cache))
+                            _entry_bytes(cache), data=data)
     return DeviceTable(schema, b, cap, cache["valid"], columns, dicts,
                        stats_min, stats_max,
                        cache.get("nrows", manifest.total_rows()), nulls,
@@ -828,25 +839,40 @@ class _DeviceCacheBudget:
         with self._lock:
             self._entries.pop((id(table_cache), repr(cache_key)), None)
 
-    def touch(self, table_cache: Dict, cache_key, nbytes: int) -> None:
+    def touch(self, table_cache: Dict, cache_key, nbytes: int,
+              data=None) -> None:
         budget = self._budget()
         if budget <= 0:
             return
         with self._lock:
             self._tick += 1
             # strong ref to the owning cache dict: it lives with its table
-            # anyway, and eviction empties it (bounded residue)
+            # anyway, and eviction empties it (bounded residue).  The
+            # table itself is a weakref: it is only consulted to spare
+            # MVCC-pinned epochs, never kept alive.
             self._entries[(id(table_cache), repr(cache_key))] = (
-                nbytes, self._tick, table_cache, cache_key)
+                nbytes, self._tick, table_cache, cache_key,
+                weakref.ref(data) if data is not None else None)
             total = sum(e[0] for e in self._entries.values())
             if total <= budget:
                 return
             from snappydata_tpu.observability.metrics import global_registry
+            from snappydata_tpu.storage.mvcc import pinned_versions_peek
 
-            for key, (b, _, owner, ck) in sorted(
+            for key, (b, _, owner, ck, dref) in sorted(
                     self._entries.items(), key=lambda kv: kv[1][1]):
                 if total <= budget:
                     break
+                d = dref() if dref is not None else None
+                if d is not None:
+                    # NEVER evict a pinned epoch's plates out from under
+                    # a live scan (the tier ladder's contract) — the
+                    # lock-free peek keeps mvcc.clock out from under the
+                    # budget lock (no device_cache -> clock edge)
+                    pins = pinned_versions_peek(d)
+                    if pins is None or ck[0] in pins:
+                        global_registry().inc("tier_pinned_skips")
+                        continue
                 owner.pop(ck, None)  # device arrays released
                 self._entries.pop(key, None)
                 total -= b
@@ -856,13 +882,23 @@ class _DeviceCacheBudget:
 _cache_budget = _DeviceCacheBudget()
 
 
-def _entry_bytes(dt_cols: Dict) -> int:
+def _entry_bytes(entry) -> int:
     def arr_bytes(v) -> int:
         if isinstance(v, tuple):  # array-column plates nest one level
             return sum(arr_bytes(x) for x in v)
         return int(v.nbytes) if hasattr(v, "nbytes") else 0
 
-    return sum(arr_bytes(v) for v in dt_cols.values())
+    # row tables cache a whole DeviceTable (executor's replicated-bind
+    # path), column tables a per-column dict — the tier ladder and the
+    # broker ledger walk both shapes
+    if isinstance(entry, DeviceTable):
+        return (arr_bytes(entry.valid)
+                + sum(arr_bytes(v) for v in list(entry.columns.values()))
+                + sum(arr_bytes(v) for v in list(entry.nulls.values())
+                      if v is not None))
+    # list() is a C-atomic snapshot: a prefetch worker may still be
+    # filling this entry while a ledger/tier walk measures it
+    return sum(arr_bytes(v) for v in list(entry.values()))
 
 
 def _map_cache_leaves(entry, fn):
@@ -929,7 +965,7 @@ def migrate_mesh_cache(data, old_token, new_ctx) -> Tuple[int, int]:
         _cache_budget.forget(data._device_cache, key)
         if _cache_budget.enabled():
             _cache_budget.touch(data._device_cache, new_key,
-                                _entry_bytes(new_entry))
+                                _entry_bytes(new_entry), data=data)
         moved += 1
         bytes_moved += counted[0]
     return moved, bytes_moved

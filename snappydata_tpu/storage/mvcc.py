@@ -273,6 +273,22 @@ def pinned_versions(data) -> frozenset:
         return frozenset(counts)
 
 
+def pinned_versions_peek(data):
+    """LOCK-FREE best-effort read of `pinned_versions` for callers that
+    already hold a lock BELOW mvcc.clock (the device-cache budget's
+    pin-aware eviction) — taking the clock there would add a
+    device_cache -> clock edge the hierarchy forbids.  Returns None when
+    the racing snapshot fails; treat None as "assume pinned" (skip the
+    eviction) — a stale positive only delays one eviction."""
+    counts = getattr(data, "_pin_counts", None)
+    if not counts:
+        return frozenset()
+    try:
+        return frozenset(counts)
+    except RuntimeError:   # dict mutated mid-iteration
+        return None
+
+
 def pinned_row_versions(data) -> frozenset:
     counts = getattr(data, "_row_pin_counts", None)
     if not counts:

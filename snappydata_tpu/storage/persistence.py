@@ -136,17 +136,26 @@ def _arr_from_parts(meta: dict, parts: List[bytes]) -> Optional[np.ndarray]:
         .reshape(meta["shape"]).copy()
 
 
-def frame_record(header: dict, arrays: List[Optional[np.ndarray]]) -> bytes:
+def frame_record(header: dict, arrays: List[Optional[np.ndarray]],
+                 codec: Optional[str] = None) -> bytes:
     """Assemble one record as a single contiguous buffer: magic, head
     length, JSON head, parts, trailing CRC32. The CRC is computed in ONE
     pass over the assembled head+parts region (no per-part incremental
     loop) and callers issue ONE write for the whole record — the group
     commit drain concatenates these frames and syncs them with one
-    write+fsync per group."""
+    write+fsync per group.
+
+    `codec` overrides the global at-rest codec for THIS record.  The
+    disk tier (storage/tier.py) frames demoted column batches with
+    codec="none" so raw numeric parts land at computable offsets and can
+    be memmapped back without a decompress pass — the batch arrays are
+    already the encoded (compressed-domain) form, so framing them raw
+    loses nothing."""
     from snappydata_tpu import config
     from snappydata_tpu.storage.encoding import compress_bytes
 
-    codec = config.global_properties().compression_codec
+    if codec is None:
+        codec = config.global_properties().compression_codec
     metas = []
     parts: List[bytes] = []
     codecs: List[str] = []

@@ -1,0 +1,272 @@
+"""Double-buffered host→HBM tile prefetch for the out-of-core scan.
+
+A tiled pass over a table bigger than the device budget alternates
+upload and compute: bind window k, aggregate window k, bind window k+1…
+— paying min-transfer PLUS compute per tile.  The prefetcher overlaps
+them: while the partial program aggregates tile k on device, a
+background worker warms tile k+1's encoded plates through the SAME bind
+path (`device.build_device_table` under its own per-thread
+`scan_window`), so the device cache already holds window k+1 when the
+consumer arrives and the steady-state rate approaches
+min(compute, transfer) — the decode-throughput law's streaming bound
+(PAPERS.md), with the PR 9 encoded plates (~25 B/row) as the wire
+format.
+
+Mesh-aware: the worker enters the consumer's captured `MeshContext`, so
+its cache keys carry the same mesh token and its `device_put`s shard
+per `ShardPlacement` — each device receives only its own buckets.  The
+worker's placements run inside `parallel.mesh_dispatch`
+(mesh.prefetch_fence) like every other multi-device dispatch: an
+UNFENCED background upload interleaving with a foreground collective is
+exactly the rendezvous-deadlock class PR 13's lock exists for.
+
+Coordination is one module lock, `storage.prefetch` — a LEAF: nothing
+is acquired while it is held (metric increments and thread joins happen
+outside; the build itself runs unlocked).  The keep-window registry it
+guards tells the device cache's window prune which tile entries are
+live look-ahead — without it, the consumer binding window k would evict
+the window k+1 entry the worker just paid for.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Set, Tuple
+
+from snappydata_tpu.utils import locks
+
+# one lock for every prefetcher AND the keep-window registry: prefetch
+# passes are per-statement and coordination is rare (one wait per tile)
+_pf_lock = locks.named_lock("storage.prefetch")
+_KEEP: Dict[int, Set[Tuple[int, int]]] = {}   # id(data) -> live windows
+
+_COL_KINDS = ("col", "ccol", "scol", "mcol", "acol")
+
+
+def keep_windows(data) -> Set[Tuple[int, int]]:
+    """Windows of `data` a live prefetch pass owns — the device cache's
+    window prune must not evict these (storage/device.py consults this
+    before dropping sibling tile entries)."""
+    with _pf_lock:
+        s = _KEEP.get(id(data))
+        return set(s) if s else set()
+
+
+def _reg():
+    from snappydata_tpu.observability.metrics import global_registry
+
+    return global_registry()
+
+
+class TilePrefetcher:
+    """Warms tile windows of one (data, manifest, columns) scan ahead of
+    the consumer.  Protocol (both tiled lanes use it identically):
+
+        pf = TilePrefetcher.maybe(data, manifest, units, tile_units, ctx)
+        try:
+            for lo in range(0, units, tile_units):
+                if pf: pf.await_window(lo)        # block until warm
+                with scan_window(...): dispatch(lo)
+                if pf: pf.advance(lo)             # release look-ahead
+        finally:
+            if pf: pf.close()                     # join + drop tiles
+
+    Window 0 binds inline on the consumer (its entry seeds the column
+    set the worker warms); the worker stays `tier_prefetch_depth`
+    windows ahead of the last advance.  A worker death (any exception)
+    is absorbed: the consumer falls back to inline binds.
+    """
+
+    def __init__(self, data, manifest, units: int, tile_units: int,
+                 depth: int, mesh_ctx=None) -> None:
+        self._data = data
+        self._manifest = manifest
+        self._units = int(units)
+        self._tile_units = int(tile_units)
+        self._depth = max(1, int(depth))
+        self._mesh_ctx = mesh_ctx
+        self._cols: Optional[Tuple[int, ...]] = None
+        self._cond = locks.named_condition("storage.prefetch",
+                                           lock=_pf_lock)
+        self._done: Dict[int, float] = {}   # lo -> build ms
+        self._consumed = 0                  # last advanced lo
+        self._next = self._tile_units       # next lo the worker builds
+        self._stop = False
+        self._dead = False
+        self._worker: Optional[threading.Thread] = None
+        self._overlap_ms = 0.0
+        self._overlapped = False
+
+    @classmethod
+    def maybe(cls, data, manifest, units: int, tile_units: int,
+              mesh_ctx=None) -> Optional["TilePrefetcher"]:
+        from snappydata_tpu import config
+
+        depth = int(config.global_properties().tier_prefetch_depth)
+        if depth <= 0 or units <= tile_units or tile_units <= 0:
+            return None
+        return cls(data, manifest, units, tile_units, depth, mesh_ctx)
+
+    # -- consumer side ---------------------------------------------------
+
+    def await_window(self, lo: int) -> None:
+        """Block (bounded) until window `lo` is warm in the device
+        cache, and mark it the consumer's active window so neither
+        side's prune evicts it.  Overlap won = the build time the
+        consumer did NOT have to wait for."""
+        self._keep((lo, min(lo + self._tile_units, self._units)))
+        if lo < self._tile_units or self._worker is None:
+            return
+        reg = _reg()
+        t0 = time.perf_counter()
+        waited = False
+        deadline = t0 + 30.0
+        with self._cond:
+            while lo not in self._done and not self._dead:
+                waited = True
+                if time.perf_counter() >= deadline:
+                    self._dead = True   # wedged worker: inline fallback
+                    break
+                self._cond.wait(0.25)
+            build_ms = self._done.get(lo)
+        if waited:
+            reg.inc("prefetch_window_waits")
+        if build_ms is not None:
+            waited_ms = (time.perf_counter() - t0) * 1000.0
+            won = max(0.0, build_ms - waited_ms)
+            if won > 0:
+                self._overlap_ms += won
+                self._overlapped = True
+
+    def advance(self, lo: int) -> None:
+        """Consumer dispatched window `lo`: retire older look-ahead and
+        let the worker run up to `lo + depth * tile_units`.  advance(0)
+        also infers the column set from the inline-bound window-0 cache
+        entry and starts the worker."""
+        horizon = lo
+        with self._cond:
+            self._consumed = lo
+            ids = _KEEP.get(id(self._data))
+            if ids:
+                for w in [w for w in ids if w[0] < horizon]:
+                    ids.discard(w)
+            for k in [k for k in self._done if k < horizon]:
+                self._done.pop(k)
+            self._cond.notify_all()
+        if lo == 0 and self._worker is None and not self._dead:
+            self._start()
+
+    def close(self) -> None:
+        """End of pass: stop the worker, join OUTSIDE all locks, drop
+        this pass's keep-windows and every orphaned tile entry (restores
+        the ≤1-windowed-entry invariant), publish overlap counters."""
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        w = self._worker
+        if w is not None:
+            w.join(timeout=30.0)
+        with self._cond:
+            ids = _KEEP.get(id(self._data))
+            if ids is not None:
+                ids.clear()
+                _KEEP.pop(id(self._data), None)
+        kept = keep_windows(self._data)   # concurrent passes, if any
+        cache = getattr(self._data, "_device_cache", None)
+        if cache is not None:
+            from snappydata_tpu.storage.device import _cache_budget
+
+            # list(): C-atomic snapshot — another pass's worker may
+            # still be inserting entries into this cache
+            for k in [k for k in list(cache)
+                      if k[2] is not None and k[2] not in kept]:
+                cache.pop(k, None)
+                _cache_budget.forget(cache, k)
+        if self._overlapped:
+            _reg().inc("prefetch_overlap_ms",
+                       max(1, int(self._overlap_ms)))
+
+    def overlap_ms(self) -> float:
+        return self._overlap_ms
+
+    # -- worker side -----------------------------------------------------
+
+    def _keep(self, window: Tuple[int, int]) -> None:
+        with self._cond:
+            _KEEP.setdefault(id(self._data), set()).add(window)
+
+    def _infer_cols(self) -> Optional[Tuple[int, ...]]:
+        """Column set of the pass = the columns the consumer's inline
+        window-0 bind cached (same manifest+token, window starting 0)."""
+        cache = getattr(self._data, "_device_cache", None) or {}
+        from snappydata_tpu.parallel.mesh import MeshContext
+
+        ctx = self._mesh_ctx or MeshContext.current()
+        token = ctx.token if ctx else None
+        for key, entry in list(cache.items()):
+            if key[0] != self._manifest.version or key[1] != token:
+                continue
+            if key[2] is None or key[2][0] != 0:
+                continue
+            cols = sorted({k[1] for k in list(entry)
+                           if isinstance(k, tuple) and k[0] in _COL_KINDS})
+            if cols:
+                return tuple(cols)
+        return None
+
+    def _start(self) -> None:
+        self._cols = self._infer_cols()
+        if self._cols is None:
+            self._dead = True   # nothing cached to mirror: stay inline
+            return
+        self._worker = threading.Thread(
+            target=self._run, name="snappy-tile-prefetch", daemon=True)
+        self._worker.start()
+
+    def _run(self) -> None:
+        try:
+            if self._mesh_ctx is not None:
+                with self._mesh_ctx:
+                    self._loop()
+            else:
+                self._loop()
+        except BaseException:
+            _reg().inc("prefetch_errors")
+            with self._cond:
+                self._dead = True
+                self._cond.notify_all()
+
+    def _loop(self) -> None:
+        from snappydata_tpu.parallel import mesh
+        from snappydata_tpu.storage import device as device_mod
+
+        reg = _reg()
+        while True:
+            with self._cond:
+                while not self._stop and not (
+                        self._next < self._units
+                        and self._next <= self._consumed
+                        + self._depth * self._tile_units):
+                    self._cond.wait(0.25)
+                if self._stop:
+                    return
+                lo = self._next
+                self._next += self._tile_units
+            hi = min(lo + self._tile_units, self._units)
+            self._keep((lo, hi))
+            t0 = time.perf_counter()
+            # the worker's scan_window contextvar is PER-THREAD: the
+            # consumer's window never sees this restriction
+            with device_mod.scan_window(self._data, lo, hi,
+                                        self._manifest,
+                                        tile_units=self._tile_units):
+                with mesh.prefetch_fence():
+                    device_mod.build_device_table(
+                        self._data, self._manifest, self._cols,
+                        code_ok=True)
+            ms = (time.perf_counter() - t0) * 1000.0
+            reg.inc("prefetch_windows_warmed")
+            with self._cond:
+                self._done[lo] = ms
+                self._cond.notify_all()

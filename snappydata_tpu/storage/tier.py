@@ -1,0 +1,428 @@
+"""Three-tier memory hierarchy: device HBM → compressed-at-rest host
+pool → CRC-framed NVMe column-batch files.
+
+The PR 9 encoded plates (~25 B/row) are the at-rest format at EVERY
+level: the device tier caches them as sharded pytrees
+(storage/device.py), the host tier holds the same encoded batch arrays
+resident in RAM, and the disk tier frames those arrays — unmodified —
+through the persistence layer's CRC-checked record format
+(storage/persistence.frame_record with codec="none", so the raw numeric
+parts land at computable offsets and memmap straight back).  Reference:
+SnappyData's disk oplogs spill column batches and fault them back on
+demand (PAPER.md L0); the decode-throughput law (PAPERS.md) is why the
+ENCODED form is what travels — a transfer-bound scan moves 25 B/row
+instead of 47.
+
+Demotion ladder (`demote`, a resource-broker degradation step):
+
+  HBM → host   drop cold device-cache entries; the encoded batches they
+               were built from stay resident, so the plates rebuild
+               transparently on next bind.  Entries of MVCC-pinned
+               epochs are NEVER demoted (a long scan re-binding its
+               pinned version per tile must not lose its plates
+               mid-query — `tier_pinned_skips` counts the refusals);
+               mesh exchange/broadcast layouts trim on the same step.
+  host → disk  frame the oldest batches' numeric arrays into one
+               CRC-checksummed record per batch and replace them with
+               memmap views of the raw parts: residency moves to the OS
+               page cache (reads fault pages back off NVMe through the
+               same arrays), and `promote` re-reads the full record —
+               CRC-verified — to pull a batch resident again.
+
+Lock order (LOCK_ORDER.md "tiered storage"): `storage.tier` serializes
+demotion/promotion and is held ABOVE the broker singleton/registry
+locks, `mvcc.clock` (pin reads), `storage.device_cache` (budget
+forgets), `engine.mesh_exec` (layout trim) and `storage.column_table`
+(the framed spill's manifest swap).  `storage.tier_files` is a leaf:
+file-byte accounting only, safe in GC finalizers.
+"""
+
+from __future__ import annotations
+
+import atexit
+import dataclasses
+import itertools
+import json
+import os
+import shutil
+import struct
+import tempfile
+import weakref
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from snappydata_tpu.utils import locks
+
+_tier_lock = locks.named_lock("storage.tier")
+_files_lock = locks.named_lock("storage.tier_files")
+_tier_dir: Optional[str] = None
+_tier_ids = itertools.count()
+_tier_file_bytes = 0
+_gauges_registered = False
+
+# column arrays a batch spills, in frame order (hoststore's spill set:
+# dictionaries and object-dtype arrays stay resident — small, and not
+# memmappable)
+_SPILL_FIELDS = ("data", "runs", "validity")
+
+
+def _reg():
+    from snappydata_tpu.observability.metrics import global_registry
+
+    return global_registry()
+
+
+def _ensure_gauges() -> None:
+    global _gauges_registered
+    if _gauges_registered:
+        return
+    _gauges_registered = True
+    _reg().gauge("tier_file_bytes", lambda: float(tier_file_bytes()))
+
+
+def tier_file_bytes() -> int:
+    """Live bytes in CRC-framed tier files — the disk rung of the
+    broker's unified ledger (next to hoststore's spill_file_bytes)."""
+    with _files_lock:
+        return _tier_file_bytes
+
+
+def _dir() -> str:
+    global _tier_dir
+    if _tier_dir is None:
+        _tier_dir = tempfile.mkdtemp(prefix="snappy_tier_")
+        atexit.register(shutil.rmtree, _tier_dir, ignore_errors=True)
+    return _tier_dir
+
+
+def _unlink_quiet(path: str, nbytes: int) -> None:
+    global _tier_file_bytes
+    with _files_lock:
+        _tier_file_bytes -= nbytes
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+# --------------------------------------------------------------------------
+# disk tier: CRC-framed batch files
+# --------------------------------------------------------------------------
+
+def frame_batch(batch, header_extra: Optional[dict] = None) -> bytes:
+    """One batch's spillable arrays as ONE persistence-layer record
+    (magic + JSON head + raw parts + trailing CRC32).  codec="none":
+    the arrays are already the encoded at-rest form, and raw parts are
+    what lets the demoted batch memmap back without a decompress."""
+    from snappydata_tpu.storage import persistence
+
+    header = {"kind": "tier_batch", "batch_id": int(batch.batch_id),
+              "ncols": len(batch.columns)}
+    if header_extra:
+        header.update(header_extra)
+    arrays: List[Optional[np.ndarray]] = []
+    for col in batch.columns:
+        for name in _SPILL_FIELDS:
+            a = getattr(col, name)
+            if a is None or isinstance(a, np.memmap) or a.dtype == object:
+                arrays.append(None)
+            else:
+                arrays.append(np.ascontiguousarray(a))
+    return persistence.frame_record(header, arrays, codec="none")
+
+
+def _part_offsets(buf: bytes) -> Tuple[dict, List[int], List[dict]]:
+    """(head, per-part file offsets, array metas) of one framed record —
+    the geometry the memmap reconstruction needs.  Raw-codec parts only
+    (frame_batch guarantees it)."""
+    (hlen,) = struct.unpack("<I", buf[4:8])
+    head = json.loads(buf[8:8 + hlen].decode("utf-8"))
+    offsets = []
+    pos = 8 + hlen
+    for size in head["sizes"]:
+        offsets.append(pos)
+        pos += size
+    return head, offsets, head["arrays"]
+
+
+def demote_batch(batch, table_name: str = "") -> Tuple[int, object]:
+    """host → disk: write one batch as a CRC-framed record and swap its
+    resident numeric arrays for memmap views of the record's raw parts.
+    Returns (resident_bytes_freed, new batch).  The file is unlinked
+    when the new batch object is collected."""
+    buf = frame_batch(batch, {"table": table_name})
+    head, offsets, metas = _part_offsets(buf)
+    freed = sum(
+        a.nbytes for col in batch.columns for name in _SPILL_FIELDS
+        for a in (getattr(col, name),)
+        if a is not None and not isinstance(a, np.memmap)
+        and a.dtype != object)
+    if freed == 0:
+        return 0, batch
+    path = os.path.join(
+        _dir(), f"tier_{next(_tier_ids)}_{batch.batch_id}.snt")
+    with open(path, "wb") as fh:
+        fh.write(buf)
+        fh.flush()
+        # locklint: blocking-under-lock the framed spill runs on the
+        # degradation ladder under the table lock BY DESIGN (manifest
+        # swap atomic vs mutation; the write IS the memory relief)
+        os.fsync(fh.fileno())
+    # ONE mapping (one fd) per tier file: every column array is a view
+    # into this base.  A long schedule demotes thousands of small
+    # batches, and an fd per array (np.memmap holds its descriptor for
+    # the mapping's lifetime) exhausts the process fd limit.  Views
+    # inherit the np.memmap subclass and .filename, which is what
+    # promote_batch keys on.
+    base = np.memmap(path, dtype=np.uint8, mode="r")
+    new_cols = []
+    ai = 0   # array index across the flattened (col × field) grid
+    pi = 0   # part index (kind "none" metas contribute zero parts)
+    for col in batch.columns:
+        repl = {}
+        for name in _SPILL_FIELDS:
+            m = metas[ai]
+            ai += 1
+            if m["kind"] == "none":
+                continue
+            assert m["kind"] == "raw", m  # frame_batch spills numerics only
+            dt = np.dtype(m["dtype"])
+            shape = tuple(m["shape"])
+            nb = dt.itemsize * int(np.prod(shape, dtype=np.int64))
+            view = base[offsets[pi]:offsets[pi] + nb] \
+                .view(dt).reshape(shape)
+            # __array_finalize__ copied the BASE's offset (0); restore
+            # the part's real file offset — corruption tests and any
+            # reframe logic locate bytes through it
+            view.offset = offsets[pi]
+            repl[name] = view
+            pi += 1
+        new_cols.append(dataclasses.replace(col, **repl) if repl else col)
+    new_batch = dataclasses.replace(batch, columns=tuple(new_cols))
+    global _tier_file_bytes
+    with _files_lock:
+        _tier_file_bytes += len(buf)
+    weakref.finalize(new_batch, _unlink_quiet, path, len(buf))
+    _reg().inc("tier_demotions_host")
+    return freed, new_batch
+
+
+def promote_batch(batch) -> Tuple[int, object]:
+    """disk → host: CRC-verify the batch's tier record and replace its
+    memmap views with resident copies.  Raises CorruptRecordError on a
+    damaged record — a faulting scan must fail loudly, never replay
+    flipped bits (the whole point of the framed format)."""
+    from snappydata_tpu.storage import persistence
+
+    paths = {a.filename for col in batch.columns
+             for name in _SPILL_FIELDS for a in (getattr(col, name),)
+             if isinstance(a, np.memmap)
+             and str(a.filename).endswith(".snt")}
+    if not paths:
+        return 0, batch
+    verified: Dict[str, List[Optional[np.ndarray]]] = {}
+    for path in paths:
+        with open(path, "rb") as fh:
+            # read_records re-runs the trailing-CRC pass — this IS the
+            # promote-side integrity check
+            header, arrays = next(persistence.read_records(fh))
+        verified[path] = arrays
+        _reg().inc("tier_crc_verifies")
+    new_cols = []
+    loaded = 0
+    for ci, col in enumerate(batch.columns):
+        repl = {}
+        for fi, name in enumerate(_SPILL_FIELDS):
+            a = getattr(col, name)
+            if not (isinstance(a, np.memmap)
+                    and str(a.filename) in verified):
+                continue
+            arr = verified[str(a.filename)][ci * len(_SPILL_FIELDS) + fi]
+            if arr is not None:
+                repl[name] = arr
+                loaded += arr.nbytes
+        new_cols.append(dataclasses.replace(col, **repl) if repl else col)
+    new_batch = dataclasses.replace(batch, columns=tuple(new_cols))
+    _reg().inc("tier_promotions")
+    return loaded, new_batch
+
+
+def promote_table(data) -> int:
+    """Pull every disk-demoted batch of one table resident again
+    (CRC-verified).  Returns batches promoted."""
+    promoted = 0
+    _ensure_gauges()
+    with _tier_lock:
+        # locklint: lock=storage.column_table (only column tables tier)
+        with data._lock:
+            m = data._manifest
+            new_views = list(m.views)
+            for i, v in enumerate(new_views):
+                loaded, nb = promote_batch(v.batch)
+                if loaded:
+                    new_views[i] = dataclasses.replace(v, batch=nb)
+                    promoted += 1
+            if promoted:
+                data._publish(tuple(new_views))
+    return promoted
+
+
+# --------------------------------------------------------------------------
+# the demotion ladder
+# --------------------------------------------------------------------------
+
+def _device_entries(tables) -> List[Tuple[object, tuple, int]]:
+    """(data, cache_key, nbytes) of every device-cache entry, coldest
+    first: windowed tile entries, then old versions, then current."""
+    from snappydata_tpu.storage.device import _entry_bytes
+
+    out = []
+    for _nm, data in tables:
+        cache = getattr(data, "_device_cache", None)
+        if not cache:
+            continue
+        cur = data._manifest.version if hasattr(data, "_manifest") else -1
+        for k in list(cache):
+            entry = cache.get(k)
+            if entry is None:
+                continue
+            # order key: tiles coldest, then by version age
+            rank = (0 if k[2] is not None else (1 if k[0] != cur else 2),
+                    k[0])
+            out.append((rank, data, k, _entry_bytes(entry)))
+    out.sort(key=lambda t: t[0])
+    return [(d, k, b) for _r, d, k, b in out]
+
+
+def demote_device(tables, excess_bytes: int) -> int:
+    """HBM → host: drop up to `excess_bytes` of cold device-cache
+    entries.  MVCC-pinned epochs are skipped — their plates stay until
+    the pin releases (counted: tier_pinned_skips)."""
+    from snappydata_tpu.storage import mvcc
+    from snappydata_tpu.storage.device import _cache_budget
+
+    reg = _reg()
+    freed = dropped = 0
+    pinned_of: Dict[int, frozenset] = {}
+    for data, k, nbytes in _device_entries(tables):
+        if freed >= excess_bytes:
+            break
+        if id(data) not in pinned_of:
+            pinned_of[id(data)] = mvcc.pinned_versions(data)
+        if k[0] in pinned_of[id(data)]:
+            reg.inc("tier_pinned_skips")
+            continue
+        data._device_cache.pop(k, None)
+        _cache_budget.forget(data._device_cache, k)
+        freed += nbytes
+        dropped += 1
+    if dropped:
+        reg.inc("tier_demotions_hbm", dropped)
+    # mesh exchange/broadcast layouts are device-tier residents too:
+    # trim them on the same rung (they rebuild from the next bind)
+    if freed < excess_bytes:
+        from snappydata_tpu.engine import mesh_exec
+
+        freed += mesh_exec.trim_layout_caches(
+            max(0, mesh_exec.mesh_layout_cache_nbytes()
+                - (excess_bytes - freed)))
+    return dropped
+
+
+def demote_host(tables, excess_bytes: int) -> int:
+    """host → disk: frame the oldest resident batches into CRC-checked
+    tier files until `excess_bytes` of host pool is released."""
+    from snappydata_tpu.storage.hoststore import batch_resident_bytes
+
+    freed = spilled = 0
+    for nm, data in tables:
+        if freed >= excess_bytes:
+            break
+        if not hasattr(data, "_manifest") or not hasattr(data, "_lock"):
+            continue
+        # locklint: lock=storage.column_table (only column tables tier)
+        with data._lock:
+            m = data._manifest
+            new_views = list(m.views)
+            changed = False
+            for i, v in enumerate(new_views):   # oldest first
+                if freed >= excess_bytes:
+                    break
+                if batch_resident_bytes(v.batch) == 0:
+                    continue
+                got, nb = demote_batch(v.batch, table_name=nm)
+                if got == 0:
+                    continue
+                new_views[i] = dataclasses.replace(v, batch=nb)
+                freed += got
+                spilled += 1
+                changed = True
+            if changed:
+                data._publish(tuple(new_views))
+    return spilled
+
+
+def demote(tables, excess_bytes: int) -> int:
+    """The `tier.demote` degradation step: walk the ladder top-down —
+    HBM → host first (cheapest: plates rebuild from resident encoded
+    batches), then host → disk (framed spill; reads fault pages back).
+    Returns entries+batches demoted."""
+    _ensure_gauges()
+    if excess_bytes <= 0:
+        return 0
+    with _tier_lock:
+        n = demote_device(tables, excess_bytes)
+        n += demote_host(tables, excess_bytes)
+    return n
+
+
+def maybe_demote() -> int:
+    """Steady-state enforcement of the tier knobs (`tier_device_bytes`,
+    `tier_host_bytes`), called from the tiled lane after a pass: when a
+    tier sits over its cap, demote it back under.  Holds the tier lock
+    across the broker-registry consult — the `storage.tier →
+    resource.broker_global` ordering LOCK_ORDER.md codifies."""
+    from snappydata_tpu import config
+
+    props = config.global_properties()
+    dev_cap = int(props.tier_device_bytes or 0)
+    host_cap = int(props.tier_host_bytes or 0)
+    if dev_cap <= 0 and host_cap <= 0:
+        return 0
+    _ensure_gauges()
+    from snappydata_tpu.resource.broker import global_broker
+
+    n = 0
+    with _tier_lock:
+        tables = global_broker()._iter_tables()
+        if dev_cap > 0:
+            from snappydata_tpu.storage.device import \
+                device_cache_bytes_by_table
+
+            used = sum(device_cache_bytes_by_table(tables).values())
+            if used > dev_cap:
+                n += demote_device(tables, used - dev_cap)
+        if host_cap > 0:
+            from snappydata_tpu.resource.broker import _host_table_bytes
+
+            used = sum(_host_table_bytes(d) for _nm, d in tables)
+            if used > host_cap:
+                n += demote_host(tables, used - host_cap)
+    return n
+
+
+def tier_snapshot() -> dict:
+    """Point-in-time tier ledger for observability/tests: bytes resident
+    at each rung plus the demotion counters' current values."""
+    from snappydata_tpu.resource.broker import (_host_table_bytes,
+                                                global_broker)
+    from snappydata_tpu.storage.device import device_cache_bytes_by_table
+
+    _ensure_gauges()
+    with _tier_lock:
+        tables = global_broker()._iter_tables()
+        device = sum(device_cache_bytes_by_table(tables).values())
+        host = sum(_host_table_bytes(d) for _nm, d in tables)
+    return {"device_bytes": device, "host_pool_bytes": host,
+            "tier_file_bytes": tier_file_bytes()}

@@ -801,6 +801,9 @@ def test_bench_check_candidate_is_newest_record():
 
     root = os.path.dirname(os.path.abspath(bench.__file__))
     records = bench._bench_records(root)
-    # r05 carries the recorded load_s regression vs r04: checking it
-    # explicitly (as CI would check a just-written newest record) trips
-    assert bench.run_check([records[-1]]) == 1
+    # r05 carries the recorded load_s regression vs r04: checking it by
+    # path (as CI would check a just-written record) must compare it
+    # against r04 and trip — a self-compare would always pass
+    r05 = os.path.join(root, "BENCH_r05.json")
+    assert r05 in records
+    assert bench.run_check([r05]) == 1

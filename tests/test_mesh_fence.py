@@ -1,0 +1,125 @@
+"""PR 13's documented unfenced boundary, now fenced (PR 16, ops/join.py
++ parallel/mesh.eager_fence):
+
+Eager join-artifact device programs at GSPMD bind time — the build-key
+sort in `build_artifact` and the expansion-bound searchsorteds in
+`probe_expand_bound[_per_shard]` — lower to MULTI-device programs when
+their inputs are sharded.  XLA CPU collectives rendezvous by
+participant count, so two threads running 8-device programs
+concurrently interleave participants and deadlock; every multi-device
+dispatch must therefore run under `parallel.mesh_dispatch`.  These
+tests prove the eager bind-time programs now hold the fence under a
+mesh, and that single-device binds stay fence-free (eager_fence
+no-ops without an ambient MeshContext — no new serialization).
+"""
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession, config
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.parallel import MeshContext, data_mesh
+from snappydata_tpu.parallel import mesh
+
+pytestmark = pytest.mark.mesh
+
+
+def _sessions_with_join_tables(n=600, seed=3):
+    sess = SnappySession(catalog=Catalog())
+    rng = np.random.default_rng(seed)
+    sess.sql("CREATE TABLE f (fk BIGINT, x DOUBLE) USING column")
+    sess.sql("CREATE TABLE d (pk BIGINT, tag STRING) USING column")
+    fk = rng.integers(0, 40, n, dtype=np.int64)
+    sess.catalog.describe("f").data.insert_arrays(
+        [fk, rng.normal(0.0, 1.0, n)])
+    pk = np.arange(40, dtype=np.int64)
+    tag = np.array([f"t{i % 5}" for i in range(40)], dtype=object)
+    sess.catalog.describe("d").data.insert_arrays([pk, tag])
+    return sess
+
+
+JOIN_Q = ("SELECT d.tag, count(*), sum(f.x) FROM f JOIN d ON f.fk = d.pk "
+          "GROUP BY d.tag ORDER BY d.tag")
+
+
+@pytest.fixture
+def fence_spy(monkeypatch):
+    """Record whether parallel.mesh_dispatch is held at the moment each
+    eager join-artifact device program actually RUNS (inside compute)."""
+    from snappydata_tpu.ops import join as dj
+
+    seen = {"build": [], "bound": []}
+    real_build, real_bound = dj.build_artifact, dj.probe_expand_bound
+
+    def spy_build(ident, token, compute):
+        def probed():
+            seen["build"].append(mesh.dispatch_lock._is_owned())
+            return compute()
+        return real_build(ident, token, probed)
+
+    def spy_bound(artifact, probe_ident, probe_token, null_extend,
+                  compute_pkeys):
+        def probed():
+            seen["bound"].append(mesh.dispatch_lock._is_owned())
+            return compute_pkeys()
+        return real_bound(artifact, probe_ident, probe_token,
+                          null_extend, probed)
+
+    monkeypatch.setattr(dj, "build_artifact", spy_build)
+    monkeypatch.setattr(dj, "probe_expand_bound", spy_bound)
+    return seen
+
+
+def test_eager_join_binds_fenced_under_mesh(fence_spy):
+    sess = _sessions_with_join_tables()
+    single = sess.sql(JOIN_Q).rows()  # single-device warm-up + oracle
+    nb, nd = len(fence_spy["build"]), len(fence_spy["bound"])
+    with MeshContext(data_mesh(8)):
+        sess2 = _sessions_with_join_tables()
+        got = sess2.sql(JOIN_Q).rows()
+    meshed_builds = fence_spy["build"][nb:]
+    assert meshed_builds and all(meshed_builds), \
+        "eager build-key sort ran UNFENCED under the mesh (PR 13 hole)"
+    meshed_bounds = fence_spy["bound"][nd:]
+    if meshed_bounds:
+        assert all(meshed_bounds), \
+            "eager expansion-bound searchsorted ran unfenced under the mesh"
+    assert [tuple(r) for r in got] == [tuple(r) for r in single]
+
+
+def test_eager_join_binds_unfenced_without_mesh(fence_spy):
+    sess = _sessions_with_join_tables(seed=5)
+    sess.sql(JOIN_Q)
+    assert fence_spy["build"] and not any(fence_spy["build"]), \
+        "eager_fence must no-op (no serialization) without a MeshContext"
+    assert not any(fence_spy["bound"])
+
+
+def test_concurrent_meshed_joins_do_not_interleave():
+    """The regression PR 13 documented: two threads eagerly sorting
+    sharded build keys concurrently interleave XLA CPU collective
+    participants and deadlock.  With the fence this completes and both
+    threads agree with the single-device oracle."""
+    import threading
+
+    sess = _sessions_with_join_tables(seed=9)
+    oracle = [tuple(r) for r in sess.sql(JOIN_Q).rows()]
+    results, errs = {}, []
+
+    def worker(i):
+        try:
+            with MeshContext(data_mesh(8)):
+                s = _sessions_with_join_tables(seed=9)
+                results[i] = [tuple(r) for r in s.sql(JOIN_Q).rows()]
+        except BaseException as e:  # noqa: BLE001 - surface on main thread
+            errs.append(e)
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert not any(t.is_alive() for t in ts), \
+        "meshed join bind deadlocked (unfenced collective interleave)"
+    assert not errs, errs
+    assert results[0] == oracle and results[1] == oracle

@@ -440,13 +440,19 @@ def test_degradation_order_evict_spill_cancel(session):
     assert session.executor._plan_cache
     victim = resource.new_query("hungry", "admin")
     broker.admit(victim, estimate_bytes=10 ** 6)
-    spilled_before = global_registry().counter("host_batches_spilled")
+    spilled_before = (global_registry().counter("host_batches_spilled")
+                      + global_registry().counter("tier_demotions_host"))
     try:
-        broker._degrade(0)                        # impossible target:
+        # impossible target: -1, since the tier ladder can now demote
+        # EVERY resident byte to disk-backed forms and actually reach 0
+        broker._degrade(-1)
         # 1) plan caches dropped
         assert not session.executor._plan_cache
-        # 2) cold batches spilled to disk
-        assert global_registry().counter("host_batches_spilled") \
+        # 2) cold batches spilled to disk — the tier ladder's host→disk
+        # rung (CRC-framed tier files) runs before the hoststore spill
+        # and usually leaves it nothing resident to take
+        assert (global_registry().counter("host_batches_spilled")
+                + global_registry().counter("tier_demotions_host")) \
             > spilled_before
         # 3) hungriest admitted query cancelled
         assert victim.cancelled

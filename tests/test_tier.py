@@ -1,0 +1,266 @@
+"""Out-of-core tiered storage (storage/tier.py + storage/prefetch.py):
+
+* CRC-framed host→disk demotion round-trips values (memmap views over
+  raw record parts; promote re-reads CRC-verified)
+* a corrupted tier record fails LOUDLY at promote (never replays bits)
+* the demotion ladder walks HBM→host→disk and queries stay value-exact
+  over fully demoted tables (pages fault back, plates rebuild)
+* MVCC-pinned epochs are never demoted out from under a live scan
+  (counter-asserted — the acceptance criterion)
+* the double-buffered tile prefetcher warms windows ahead of the
+  consumer, keeps values exact, and restores the ≤1-windowed-entry
+  invariant at close
+* tier knobs (`tier_device_bytes`) enforce steady-state caps after a
+  tiled pass
+"""
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession, config
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.observability.metrics import global_registry
+from snappydata_tpu.storage import mvcc, tier
+
+pytestmark = pytest.mark.outofcore
+
+
+@pytest.fixture
+def small_batches():
+    props = config.global_properties()
+    old = (props.column_batch_rows, props.column_max_delta_rows,
+           props.scan_tile_bytes,
+           props.tier_device_bytes, props.tier_host_bytes,
+           props.tier_prefetch_depth)
+    props.column_batch_rows = 256
+    props.column_max_delta_rows = 256  # fold deltas into column batches
+    yield props
+    (props.column_batch_rows, props.column_max_delta_rows,
+     props.scan_tile_bytes,
+     props.tier_device_bytes, props.tier_host_bytes,
+     props.tier_prefetch_depth) = old
+
+
+def _load(sess, n=4000, seed=7):
+    rng = np.random.default_rng(seed)
+    sess.sql("CREATE TABLE big (k STRING, v DOUBLE, w BIGINT) USING column")
+    k = rng.choice(np.array(["a", "b", "c", "d"], dtype=object), n)
+    v = rng.normal(100.0, 10.0, n)
+    w = rng.integers(0, 1000, n, dtype=np.int64)
+    sess.catalog.describe("big").data.insert_arrays([k, v, w])
+    return k, v, w
+
+
+def _c(name):
+    return global_registry().counter(name)
+
+
+# -- disk tier: framed demotion / promotion --------------------------------
+
+def test_framed_demote_promote_roundtrip(small_batches):
+    sess = SnappySession(catalog=Catalog())
+    _, v, w = _load(sess, n=1500)
+    data = sess.catalog.describe("big").data
+    m = data._manifest
+    batch = m.views[0].batch
+    before = {ci: np.asarray(col.data).copy()
+              for ci, col in enumerate(batch.columns)
+              if col.data is not None and col.data.dtype != object}
+    f0, files0 = _c("tier_demotions_host"), tier.tier_file_bytes()
+    freed, nb = tier.demote_batch(batch, "big")
+    assert freed > 0
+    assert tier.tier_file_bytes() > files0
+    assert _c("tier_demotions_host") == f0 + 1
+    # the demoted batch reads IDENTICAL values through memmap views
+    demoted = 0
+    for ci, col in enumerate(nb.columns):
+        if ci in before:
+            assert isinstance(col.data, np.memmap)
+            np.testing.assert_array_equal(np.asarray(col.data), before[ci])
+            demoted += 1
+    assert demoted > 0
+    c0, p0 = _c("tier_crc_verifies"), _c("tier_promotions")
+    loaded, rb = tier.promote_batch(nb)
+    assert loaded > 0
+    assert _c("tier_crc_verifies") > c0 and _c("tier_promotions") == p0 + 1
+    for ci, col in enumerate(rb.columns):
+        if ci in before:
+            assert not isinstance(col.data, np.memmap)
+            np.testing.assert_array_equal(np.asarray(col.data), before[ci])
+
+
+def test_corrupt_tier_record_fails_loudly(small_batches):
+    from snappydata_tpu.storage.persistence import CorruptRecordError
+
+    sess = SnappySession(catalog=Catalog())
+    _load(sess, n=1200)
+    data = sess.catalog.describe("big").data
+    n0 = tier.demote_host([("big", data)], 1 << 40)
+    assert n0 > 0
+    col = data._manifest.views[0].batch.columns[1]  # v DOUBLE
+    assert isinstance(col.data, np.memmap)
+    path = str(col.data.filename)
+    with open(path, "r+b") as fh:  # flip one part byte under the CRC
+        fh.seek(col.data.offset)
+        b = fh.read(1)
+        fh.seek(col.data.offset)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(CorruptRecordError):
+        tier.promote_table(data)
+
+
+# -- the ladder ------------------------------------------------------------
+
+def test_demote_ladder_values_survive(small_batches):
+    sess = SnappySession(catalog=Catalog())
+    _, v, w = _load(sess)
+    q = "SELECT k, count(*), sum(v), min(w) FROM big GROUP BY k ORDER BY k"
+    expected = sess.sql(q).rows()
+    data = sess.catalog.describe("big").data
+    assert data._device_cache, "warm plates expected before demotion"
+    d0, h0 = _c("tier_demotions_hbm"), _c("tier_demotions_host")
+    n = tier.demote([("big", data)], 1 << 40)
+    assert n > 0
+    assert _c("tier_demotions_hbm") > d0, "device rung should demote"
+    assert _c("tier_demotions_host") > h0, "host rung should demote"
+    assert tier.tier_file_bytes() > 0
+    # every batch's numeric arrays now live in the disk tier
+    assert all(isinstance(vw.batch.columns[1].data, np.memmap)
+               for vw in data._manifest.views)
+    got = sess.sql(q).rows()  # faults pages back + rebuilds plates
+    assert got == expected
+    # promote pulls them resident again, CRC-verified
+    c0 = _c("tier_crc_verifies")
+    assert tier.promote_table(data) > 0
+    assert _c("tier_crc_verifies") > c0
+    assert sess.sql(q).rows() == expected
+    snap = tier.tier_snapshot()
+    assert set(snap) == {"device_bytes", "host_pool_bytes",
+                         "tier_file_bytes"}
+
+
+def test_demotion_respects_mvcc_pins(small_batches):
+    """A pinned epoch's plates are NEVER demoted out from under a live
+    scan — the ladder skips them (counter-asserted) and the pinned read
+    stays value-exact after an aggressive demotion."""
+    sess = SnappySession(catalog=Catalog())
+    _, v, _ = _load(sess, n=2000)
+    data = sess.catalog.describe("big").data
+    with mvcc.pinned_scope(sess.catalog, ["big"]) as pin:
+        expected = sess.sql("SELECT count(*), sum(v) FROM big").rows()[0]
+        ver = pin.manifest_for(data).version
+        assert any(k[0] == ver for k in data._device_cache), \
+            "pinned scan should have warmed plates at its epoch"
+        s0 = _c("tier_pinned_skips")
+        tier.demote([("big", data)], 1 << 40)
+        assert _c("tier_pinned_skips") > s0, \
+            "the ladder must COUNT its refusals to demote pinned plates"
+        assert any(k[0] == ver for k in data._device_cache), \
+            "pinned epoch's plates were demoted out from under the scan"
+        got = sess.sql("SELECT count(*), sum(v) FROM big").rows()[0]
+        assert int(got[0]) == int(expected[0])
+        assert float(got[1]) == pytest.approx(float(expected[1]),
+                                              rel=1e-9)
+
+
+def test_budget_eviction_respects_pins(small_batches):
+    """The device-cache byte budget's LRU must ALSO skip pinned epochs
+    (it evicts through the same tier contract)."""
+    props = small_batches
+    old_budget = props.device_cache_bytes
+    sess = SnappySession(catalog=Catalog())
+    _load(sess, n=2000)
+    data = sess.catalog.describe("big").data
+    try:
+        with mvcc.pinned_scope(sess.catalog, ["big"]) as pin:
+            sess.sql("SELECT sum(v) FROM big")
+            ver = pin.manifest_for(data).version
+            assert any(k[0] == ver for k in data._device_cache)
+            # a 1-byte budget wants to evict EVERYTHING on next touch
+            props.device_cache_bytes = 1
+            sess.sql("SELECT sum(w) FROM big")
+            assert any(k[0] == ver for k in data._device_cache), \
+                "budget LRU evicted a pinned epoch's plates"
+    finally:
+        props.device_cache_bytes = old_budget
+
+
+# -- prefetcher ------------------------------------------------------------
+
+def test_prefetch_values_and_invariant(small_batches):
+    sess = SnappySession(catalog=Catalog())
+    _load(sess)
+    q = ("SELECT k, count(*), sum(v), avg(v), min(w), max(w) "
+         "FROM big GROUP BY k ORDER BY k")
+    expected = sess.sql(q).rows()
+    small_batches.scan_tile_bytes = 2 * 256 * 32
+    w0, t0 = _c("prefetch_windows_warmed"), _c("scan_tiles")
+    got = sess.sql(q).rows()
+    assert _c("scan_tiles") > t0, "expected the tiled path"
+    assert _c("prefetch_windows_warmed") > w0, \
+        "the background worker should have warmed look-ahead windows"
+    assert len(got) == len(expected) == 4
+    for e, g in zip(expected, got):
+        assert e[0] == g[0] and e[1] == g[1] and e[4] == g[4] \
+            and e[5] == g[5]
+        assert g[2] == pytest.approx(e[2], rel=1e-9)
+        assert g[3] == pytest.approx(e[3], rel=1e-9)
+    # the pass must not leave its look-ahead tiles resident
+    data = sess.catalog.describe("big").data
+    windowed = [k for k in data._device_cache if k[2] is not None]
+    assert len(windowed) <= 1, windowed
+    from snappydata_tpu.storage.prefetch import keep_windows
+
+    assert not keep_windows(data), "keep-registry must drain at close"
+
+
+def test_prefetch_disabled_by_knob(small_batches):
+    small_batches.tier_prefetch_depth = 0
+    sess = SnappySession(catalog=Catalog())
+    _load(sess, n=3000)
+    q = "SELECT count(*), sum(v) FROM big"
+    expected = sess.sql(q).rows()
+    small_batches.scan_tile_bytes = 2 * 256 * 32
+    w0 = _c("prefetch_windows_warmed")
+    assert sess.sql(q).rows() == expected
+    assert _c("prefetch_windows_warmed") == w0
+
+
+def test_prefetch_worker_death_falls_back_inline(small_batches,
+                                                 monkeypatch):
+    """A worker that dies on its first build must not wedge or corrupt
+    the pass — the consumer binds inline and values stay exact."""
+    from snappydata_tpu.storage.prefetch import TilePrefetcher
+
+    def boom(self):
+        raise RuntimeError("injected prefetch-worker death")
+
+    monkeypatch.setattr(TilePrefetcher, "_loop", boom)
+    sess = SnappySession(catalog=Catalog())
+    _load(sess, n=3000)
+    q = "SELECT k, count(*), sum(v) FROM big GROUP BY k ORDER BY k"
+    expected = sess.sql(q).rows()
+    small_batches.scan_tile_bytes = 2 * 256 * 32
+    e0 = _c("prefetch_errors")
+    got = sess.sql(q).rows()
+    assert len(got) == len(expected)
+    for e, g in zip(expected, got):
+        assert g[0] == e[0] and g[1] == e[1]
+        assert g[2] == pytest.approx(e[2], rel=1e-9)
+    assert _c("prefetch_errors") > e0
+
+
+# -- steady-state knobs ----------------------------------------------------
+
+def test_tier_device_knob_enforced_after_pass(small_batches):
+    sess = SnappySession(catalog=Catalog())
+    _load(sess)
+    sess.sql("SELECT sum(v) FROM big")   # warm unwindowed plates
+    data = sess.catalog.describe("big").data
+    assert data._device_cache
+    small_batches.tier_device_bytes = 1  # everything is over-cap
+    small_batches.scan_tile_bytes = 2 * 256 * 32
+    d0 = _c("tier_demotions_hbm")
+    sess.sql("SELECT count(*), sum(v) FROM big")
+    assert _c("tier_demotions_hbm") > d0, \
+        "maybe_demote should walk the HBM rung after the tiled pass"
