@@ -81,7 +81,8 @@ def check_regression(candidate: dict, baseline: dict,
                      trace_tol: float = 3.0,
                      htap_tol: float = 10.0,
                      mesh_eff: float = 0.7,
-                     outofcore_ratio: float = 0.5) -> list:
+                     outofcore_ratio: float = 0.5,
+                     fault_recovery: float = 1.0) -> list:
     """Pure comparison used by `--check`: returns a list of human-readable
     failure strings (empty = no regression).  `candidate`/`baseline` are
     bench result records ({"value", "detail": {"load_s", ...}}).  The
@@ -229,6 +230,31 @@ def check_regression(candidate: dict, baseline: dict,
                 f"sharded resident bytes/row {shr} exceeds single-device "
                 f"{sgl} by more than {resident_tol:.0%} — sharded tables "
                 f"stopped staying encoded per device")
+    # --- fault-storm axis (skipped on records predating it) -------------
+    # the self-healing claim: every fault the seeded storm injects must
+    # end in recovery or a typed retryable error — never a wrong row
+    # (value_mismatches is a hard fail) and never unaccounted
+    # (recovered + typed_errors >= fault_recovery * injected, default
+    # 1.0 via SNAPPY_BENCH_FAULT_RECOVERY — fully accounted)
+    fs = ((candidate.get("detail") or {}).get("faultstorm")) or {}
+    if fs and "error" not in fs:
+        if fs.get("value_mismatches"):
+            fails.append(
+                f"fault storm produced wrong rows "
+                f"({fs['value_mismatches']} value mismatches: "
+                f"{(fs.get('unexpected') or ['?'])[:3]})")
+        if fs.get("unexpected"):
+            fails.append(
+                f"fault storm hit untyped/unaccounted failures: "
+                f"{fs['unexpected'][:3]}")
+        ratio = fs.get("recovery_ratio")
+        if isinstance(ratio, (int, float)) and fs.get("injected") \
+                and ratio < fault_recovery:
+            fails.append(
+                f"fault storm recovery ratio {ratio} below "
+                f"{fault_recovery} ({fs.get('accounted')} of "
+                f"{fs.get('injected')} injected faults accounted as "
+                f"recovered or typed-retryable)")
     return fails
 
 
@@ -277,7 +303,9 @@ def run_check(argv: list) -> int:
         htap_tol=float(os.environ.get("SNAPPY_BENCH_HTAP_TOL", "10.0")),
         mesh_eff=float(os.environ.get("SNAPPY_BENCH_MESH_EFF", "0.7")),
         outofcore_ratio=float(os.environ.get(
-            "SNAPPY_BENCH_OUTOFCORE_RATIO", "0.5")))
+            "SNAPPY_BENCH_OUTOFCORE_RATIO", "0.5")),
+        fault_recovery=float(os.environ.get(
+            "SNAPPY_BENCH_FAULT_RECOVERY", "1.0")))
     rel = os.path.basename
     if fails:
         for f in fails:
@@ -630,6 +658,30 @@ def main() -> None:
               flush=True)
         outofcore = {"error": str(e)}
 
+    # Fault storm: seeded fault injection over the constricted HTAP
+    # workload; every injected fault must be accounted as recovered or
+    # typed-retryable, with zero wrong rows (guarded by --check)
+    faultstorm = None
+    try:
+        faultstorm = _faultstorm_bench()
+        print(f"bench: faultstorm {faultstorm['injected']} faults "
+              f"injected (seed {faultstorm['seed']}), "
+              f"{faultstorm['recovered']} recovered in place, "
+              f"{faultstorm['typed_errors']} typed errors, ratio "
+              f"{faultstorm['recovery_ratio']}, "
+              f"{faultstorm['crash_recoveries']} crash-recoveries, "
+              f"{faultstorm['value_mismatches']} value mismatches, "
+              f"scan p50/p99 {faultstorm['scan_p50_ms']}/"
+              f"{faultstorm['scan_p99_ms']}ms vs clean "
+              f"{faultstorm['clean']['scan_p50_ms']}/"
+              f"{faultstorm['clean']['scan_p99_ms']}ms, "
+              f"tier {faultstorm['tier']}, in {faultstorm['storm_s']}s",
+              file=sys.stderr, flush=True)
+    except Exception as e:
+        print(f"bench: faultstorm bench failed: {e}", file=sys.stderr,
+              flush=True)
+        faultstorm = {"error": str(e)}
+
     # Mesh-sharded execution: REAL measured Q1/Q6/Q3C rows/s at 1/2/4/8
     # devices (a forced-topology subprocess — XLA's device-count flag
     # must precede backend init), every sharded answer value-asserted
@@ -760,6 +812,14 @@ def main() -> None:
             # compute), with outofcore/in-HBM rows/s guarded ≥
             # SNAPPY_BENCH_OUTOFCORE_RATIO by --check
             "outofcore": outofcore,
+            # fault-storm-axis evidence (failpoints + self-healing):
+            # seeded injection across WAL/checkpoint/tier/prefetch/
+            # admission seams; recovery_ratio is recovered+typed over
+            # injected (guarded ≥ SNAPPY_BENCH_FAULT_RECOVERY by
+            # --check, default 1.0) and value_mismatches MUST be 0 —
+            # an injected fault may slow an answer or fail it with a
+            # typed error, never change it
+            "faultstorm": faultstorm,
             # mesh-axis evidence: sharded Q1/Q6/Q3C at 1/2/4/8 virtual
             # CPU devices, value-asserted vs single-device.
             # scaling_efficiency is aggregate-throughput RETENTION per
@@ -1494,6 +1554,45 @@ def _outofcore_bench(n_rows: int = 3_200_000, repeats: int = 5) -> dict:
         (props.column_batch_rows, props.column_max_delta_rows,
          props.scan_tile_bytes, props.tier_device_bytes,
          props.tier_host_bytes, props.tier_prefetch_depth) = saved
+
+
+def _faultstorm_bench() -> dict:
+    """Fault-storm axis (reliability/faultstorm.py): a seeded schedule
+    injects one fault per round — WAL append/fsync, checkpoint
+    write/publish, tier write corruption/short-write, memmap EIO,
+    prefetch-worker death, admission failure — into the constricted
+    HTAP workload and reconciles the ledger: every fired fault must end
+    as `recovered` (self-healed in place: quarantine+rebuild, worker
+    restart, bounded re-read) or `typed_errors` (a typed retryable
+    failure followed by verified crash-recovery).  --check guards
+    value_mismatches == 0, no untyped failures, and recovery_ratio >=
+    SNAPPY_BENCH_FAULT_RECOVERY (default 1.0 — fully accounted)."""
+    import shutil
+    import tempfile
+
+    from snappydata_tpu.reliability import faultstorm
+
+    seed = int(os.environ.get("SNAPPY_FAILPOINT_SEED", "1717"))
+    rounds = int(os.environ.get("SNAPPY_BENCH_FAULT_ROUNDS", "30"))
+    tmp = tempfile.mkdtemp(prefix="snappy_faultstorm_")
+    try:
+        t0 = time.perf_counter()
+        res = faultstorm.run_storm(tmp, seed=seed, rounds=rounds)
+        res["storm_s"] = round(time.perf_counter() - t0, 2)
+        # the clean baseline: the SAME seeded op schedule, no fault
+        # armed — what the storm's scan p50/p99 and qps compare against
+        clean_dir = tempfile.mkdtemp(prefix="snappy_faultstorm_clean_")
+        try:
+            clean = faultstorm.run_storm(clean_dir, seed=seed,
+                                         rounds=rounds, inject=False)
+            res["clean"] = {k: clean[k] for k in
+                            ("scans", "scan_p50_ms", "scan_p99_ms",
+                             "scans_per_s", "value_mismatches")}
+        finally:
+            shutil.rmtree(clean_dir, ignore_errors=True)
+        return res
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
 
 
 def _resilience_bench(n_rows: int = 20_000, phase_s: float = 1.5) -> dict:

@@ -230,9 +230,15 @@ class SnappyClient:
             # flight.rpc failpoint: `before` simulates a request that
             # never reached the server; `after` simulates a response
             # lost AFTER the server applied (the lost-ack case the
-            # stmt_id dedup window exists for)
+            # stmt_id dedup window exists for).  The reliability
+            # registry's flight.send/flight.recv pair covers the same
+            # two seams for seeded storm schedules.
+            from snappydata_tpu.reliability import failpoints as rfail
+
             failpoints.hit("flight.rpc")
+            rfail.hit("flight.send")
             out = once()
+            rfail.hit("flight.recv")
             failpoints.hit("flight.rpc", phase="after")
             return out
 

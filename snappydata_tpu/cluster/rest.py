@@ -175,6 +175,17 @@ def _render_dashboard(svc) -> str:
     rows_mvc = "".join(
         f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
         for k, v in mv.items() if k != "views")
+    from snappydata_tpu.observability.stats_service import storage_snapshot
+
+    stg = storage_snapshot()
+    rows_stg = "".join(
+        f"<tr><td>{esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
+        for k, v in stg["tier"].items()) + "".join(
+        f"<tr><td>prefetch {esc(str(k))}</td><td>{esc(str(v))}</td></tr>"
+        for k, v in stg["prefetch"].items()) + (
+        f"<tr><td>failpoint fires</td>"
+        f"<td>{stg['failpoints']['fires']} "
+        f"({len(stg['failpoints']['armed'])} armed)</td></tr>")
     from snappydata_tpu.observability.stats_service import mvcc_snapshot
 
     mvc = mvcc_snapshot(svc.session.catalog)
@@ -257,6 +268,8 @@ tiled scans)</h2>
 <table>{rows_sv}</table>
 <table><tr><th>prepared sql</th><th>params</th><th>executes</th>
 <th>mode</th></tr>{rows_svh}</table>
+<h2>Storage (tier ladder / self-healing / prefetch workers)</h2>
+<table>{rows_stg}</table>
 <h2>Snapshot isolation (MVCC epochs / pins / retained bytes)</h2>
 <table>{rows_mvcc}</table>
 <table><tr><th>table</th><th>version</th><th>epoch</th><th>commit seq</th>
@@ -393,6 +406,15 @@ class RestService:
                     from snappydata_tpu.views import view_snapshot
 
                     self._send(view_snapshot(svc.session.catalog))
+                elif path == "/status/api/v1/storage":
+                    # tiered-storage health: per-rung resident bytes,
+                    # quarantine/rebuild ledger, prefetch-worker
+                    # liveness, armed failpoints — the self-healing
+                    # story as numbers
+                    from snappydata_tpu.observability.stats_service import \
+                        storage_snapshot
+
+                    self._send(storage_snapshot())
                 elif path == "/status/api/v1/mvcc":
                     # snapshot-isolation stats: epoch clock, active pins,
                     # per-table version vector + retained-epoch list and

@@ -173,6 +173,17 @@ class Properties:
     tier_device_bytes: int = 0
     tier_host_bytes: int = 0
     tier_prefetch_depth: int = 1
+    # Pressure-driven demotion (ROADMAP 4(c)): when admission measures
+    # residency above tier_pressure_watermark * memory_limit_bytes, a
+    # background pass walks the tier.demote ladder down toward the low
+    # watermark — relief starts BEFORE an allocation fails
+    # mid-statement, not only at statement boundaries.  0 disables the
+    # watcher (the synchronous high-watermark degrade still runs).
+    tier_pressure_watermark: float = 0.75
+    # Prefetch-worker supervision: how many times a crashed worker
+    # restarts (capped backoff) before the pass degrades to inline
+    # binds.  0 restores the old die-once behavior.
+    tier_prefetch_max_restarts: int = 3
 
     # Resource governor (resource/broker.py; ref: critical-heap-percentage
     # admission + LowMemoryException fail-fast). memory_limit_bytes is the

@@ -362,7 +362,12 @@ def run_partial(compiled, params: Tuple, probe_data, ctx,
         compiled._jitted_mesh[key] = fn
     n_merges = sum(1 for t in tags if t[0] != "key")
     from snappydata_tpu.parallel.mesh import dispatch_lock
+    from snappydata_tpu.reliability import failpoints as rfail
 
+    # mesh_dispatch entry seam — before the leaf lock (fenced region
+    # must acquire nothing), so an injected raise fails the statement
+    # before any collective rendezvous starts
+    rfail.hit("mesh.dispatch")
     with tracing.span("jit_compile" if first else "device_execute",
                       phase="mesh", devices=ctx.num_devices), \
             dispatch_lock:

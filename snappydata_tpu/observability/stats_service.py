@@ -336,6 +336,30 @@ def mvcc_snapshot(catalog=None) -> dict:
     return out
 
 
+def storage_snapshot() -> dict:
+    """Tiered-storage health for `/status/api/v1/storage` and the
+    dashboard's Storage section: bytes resident at each tier rung, the
+    self-healing ledger (quarantined tier files, rebuilds, bounded EIO
+    re-reads, pressure demotions), prefetch-worker liveness (restarts
+    vs silent degrade), and the failpoint registry's armed/fired state
+    — the observable surface of the fault-injection story."""
+    from snappydata_tpu.reliability import failpoints
+    from snappydata_tpu.storage import prefetch, tier
+
+    snap = global_registry().snapshot()
+    c = snap["counters"]
+    out = {"tier": tier.tier_snapshot(),
+           "prefetch": prefetch.worker_snapshot(),
+           "demotions_hbm": c.get("tier_demotions_hbm", 0),
+           "demotions_host": c.get("tier_demotions_host", 0),
+           "promotions": c.get("tier_promotions", 0),
+           "crc_verifies": c.get("tier_crc_verifies", 0),
+           "pressure_wakeups": c.get("tier_pressure_wakeups", 0),
+           "failpoints": {"armed": failpoints.snapshot(),
+                          "fires": c.get("failpoint_fires", 0)}}
+    return out
+
+
 def ha_snapshot(catalog=None, distributed=None) -> dict:
     """End-to-end request-reliability stats for `/status/api/v1/ha` and
     the dashboard's High-availability section: failovers, hedged reads,

@@ -155,6 +155,13 @@ def eager_fence():
     if MeshContext.current() is None:
         yield
         return
+    # mesh_dispatch ENTRY seam — fired BEFORE acquiring the leaf lock
+    # (fenced regions must acquire nothing): a sleep here widens the
+    # dispatch-interleave window a storm schedule probes, a raise fails
+    # the statement before any collective rendezvous starts
+    from snappydata_tpu.reliability import failpoints as rfail
+
+    rfail.hit("mesh.dispatch")
     # locklint: blocking-under-lock the fenced eager ops block on device
     # completion while holding the dispatch fence BY DESIGN — identical
     # to the compiled-dispatch holds above (the serialization IS the fix

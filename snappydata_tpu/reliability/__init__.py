@@ -219,6 +219,18 @@ def is_retryable(exc: BaseException) -> bool:
         pass
     if isinstance(exc, (ConnectionError, TimeoutError)):
         return True
+    # disk-tier EIO (memmap page-in / tier-file read): transient device
+    # errors are re-issuable — the tier layer already does one bounded
+    # re-read before giving up (tier_read_retries); a retry at statement
+    # scope re-drives promotion, which quarantines + rebuilds on
+    # persistent damage.  Same classification shape as the PR 9
+    # FlightCancelledError fix: a connection/device-shaped death is
+    # retryable, a semantic failure is not.
+    import errno as _errno
+
+    if isinstance(exc, OSError) \
+            and getattr(exc, "errno", None) == _errno.EIO:
+        return True
     # DistributedError carries failover context — the lead already
     # retried internally; another round trip may still succeed
     from snappydata_tpu.cluster.distributed import DistributedError

@@ -1,0 +1,313 @@
+"""Named-failpoint registry (gofail-style) with deterministic seeded
+triggers — the PR 17 fault-injection plane.
+
+The older ``fault/failpoints.py`` registry predates the reliability
+layer and covers the cluster seams (flight.rpc, locator.heartbeat,
+device.transfer) with its raise/latency/torn_write/drop vocabulary.
+This registry is the storage/self-healing generation: it adds the
+data-plane actions a *surviving* system needs to be tested against —
+
+  raise          raise an exception (``exc``: a class, or a family name
+                 from _EXC_FAMILIES; default InjectedFault, an IOError)
+  sleep          sleep ``param`` milliseconds, then continue
+  corrupt_bytes  data-plane: ``mangle()`` XOR-flips ``param`` bytes of
+                 the buffer at a seeded offset (CRC-detectable damage)
+  short_write    data-plane: ``mangle()`` truncates ``param`` bytes off
+                 the buffer's tail (torn-write crash shape)
+  kill_worker    raise WorkerKilled — background-worker bodies let it
+                 escape so their supervision (restart/backoff) engages
+  return_errno   raise OSError(param) — param is the errno (default
+                 EIO), the disk-tier read-failure shape
+
+Arming is per-test (``arm()``/``clear()``) or via the environment::
+
+    SNAPPY_FAILPOINTS="name=action[(param)][:count|:prob][;...]"
+
+``:N`` (integer) fires the first N eligible hits then lies dormant;
+``:0.25`` (float < 1) fires probabilistically off the registry RNG,
+which is SEEDED (``SNAPPY_FAILPOINT_SEED`` / ``reseed()``) so a chaos
+schedule replays byte-for-byte.  No trigger = fire every hit.
+
+Zero-cost when unarmed — the same discipline as the lockdep wrappers:
+``hit()``/``mangle()`` check one module-global dict for truthiness and
+return before touching any lock, any metric, or the RNG.  The serving
+point-lookup profile must not be able to measure the difference.
+
+Every fired action bumps ``failpoint_fires`` and
+``failpoint_fired_<name>`` so a storm harness can reconcile its
+schedule against what actually executed.  ``fired_counts()`` returns
+the same accounting programmatically.
+
+Lock: ``reliability.failpoints`` is a declared LEAF — hit() runs inside
+arbitrarily deep lock stacks (WAL drain under wal_io, tier writes under
+the table lock) and must never acquire anything that could invert.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno as _errno
+import os
+import random
+from typing import Dict, List, Optional, Union
+
+import numpy as np
+
+from snappydata_tpu.utils import locks
+
+
+class InjectedFault(IOError):
+    """Default exception of the `raise` action: IO-shaped, NOT blanket-
+    retryable — exactly like a real unclassified disk error."""
+
+
+class InjectedUnavailable(ConnectionError):
+    """Connection-shaped injected failure: `is_retryable` returns True,
+    so a storm arming it on a query-path seam yields a typed retryable
+    error by contract."""
+
+
+class WorkerKilled(RuntimeError):
+    """The kill_worker action: background-worker bodies (prefetch, WAL
+    flusher) let it escape their loop so supervision — restart with
+    capped backoff — takes over, exactly like an uncaught real death."""
+
+
+_EXC_FAMILIES = {
+    "io": InjectedFault,
+    "conn": InjectedUnavailable,
+    "runtime": RuntimeError,
+    "timeout": TimeoutError,
+    "oserror": OSError,
+}
+
+ACTIONS = ("raise", "sleep", "corrupt_bytes", "short_write",
+           "kill_worker", "return_errno")
+
+# data-plane actions are interpreted by mangle(); hit() treats an armed
+# one at a non-buffer site as a no-op rather than mis-firing
+_DATA_ACTIONS = ("corrupt_bytes", "short_write")
+
+# the seams wired through the engine (grep `rfail.hit`/`rfail.mangle`
+# for the live list) — documentation, not an allow-list: new hook sites
+# need no registry edit
+KNOWN_POINTS = (
+    "wal.append", "wal.fsync", "wal.salvage",
+    "checkpoint.write", "checkpoint.publish",
+    "tier.write", "tier.demote", "tier.promote", "tier.memmap_read",
+    "flight.send", "flight.recv",
+    "broker.admit", "prefetch.worker", "mesh.dispatch",
+)
+
+
+@dataclasses.dataclass
+class FailSpec:
+    name: str
+    action: str
+    param: float = 0.0            # ms / bytes / errno by action
+    exc: Union[str, type, None] = None
+    count: Optional[int] = None   # fire at most N times
+    prob: Optional[float] = None  # fire with probability (seeded RNG)
+    hits: int = 0
+    fired: int = 0
+
+    def to_dict(self) -> dict:
+        exc = self.exc.__name__ if isinstance(self.exc, type) else self.exc
+        d = {"name": self.name, "action": self.action,
+             "param": self.param, "exc": exc, "count": self.count,
+             "prob": self.prob, "hits": self.hits, "fired": self.fired}
+        return {k: v for k, v in d.items() if v is not None}
+
+
+# name -> [FailSpec]; the module global IS the zero-cost gate: hit()
+# returns on `if not _SPECS` before any lock — rebinding happens only
+# under _LOCK and clear() swaps in a fresh empty dict
+_SPECS: Dict[str, List[FailSpec]] = {}
+_LOCK = locks.named_rlock("reliability.failpoints")
+_SEED = int(os.environ.get("SNAPPY_FAILPOINT_SEED", "0") or 0)
+_RNG = random.Random(_SEED)
+
+
+def _reg():
+    from snappydata_tpu.observability.metrics import global_registry
+
+    return global_registry()
+
+
+def _resolve_exc(spec: FailSpec):
+    exc = spec.exc
+    if exc is None:
+        return InjectedFault
+    if isinstance(exc, type):
+        return exc
+    return _EXC_FAMILIES.get(str(exc).lower(), InjectedFault)
+
+
+# -- arming ----------------------------------------------------------------
+
+def arm(name: str, action: str, param: float = 0.0,
+        exc: Union[str, type, None] = None, count: Optional[int] = None,
+        prob: Optional[float] = None) -> FailSpec:
+    if action not in ACTIONS:
+        raise ValueError(f"unknown failpoint action {action!r}; "
+                         f"one of {ACTIONS}")
+    if isinstance(exc, str) and exc.lower() not in _EXC_FAMILIES:
+        raise ValueError(f"unknown exc family {exc!r}; "
+                         f"one of {tuple(_EXC_FAMILIES)}")
+    if action == "return_errno" and not param:
+        param = float(_errno.EIO)
+    spec = FailSpec(name, action, float(param), exc, count, prob)
+    with _LOCK:
+        _SPECS.setdefault(name, []).append(spec)
+    return spec
+
+
+def arm_from_spec(text: str) -> List[FailSpec]:
+    """Arm from the compact ``SNAPPY_FAILPOINTS`` grammar::
+
+        name=action[(param)][:count|:prob][;...]
+
+    ``tier.write=corrupt_bytes(3):1`` flips 3 bytes once;
+    ``wal.fsync=sleep(5):0.1`` sleeps 5 ms on 10% of hits (seeded);
+    ``broker.admit=raise`` fires every hit.
+    """
+    out: List[FailSpec] = []
+    for entry in text.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, sep, rest = entry.partition("=")
+        if not sep or not rest:
+            raise ValueError(f"bad failpoint entry {entry!r}: "
+                             f"expected name=action[(param)][:trigger]")
+        count = prob = None
+        action, _, trig = rest.partition(":")
+        if trig:
+            t = float(trig)
+            if t < 1.0 and "." in trig:
+                prob = t
+            else:
+                count = int(t)
+        param = 0.0
+        if action.endswith(")") and "(" in action:
+            action, _, p = action[:-1].partition("(")
+            param = float(p) if p else 0.0
+        out.append(arm(name.strip(), action.strip(), param=param,
+                       count=count, prob=prob))
+    return out
+
+
+def disarm(name: str) -> bool:
+    with _LOCK:
+        return _SPECS.pop(name, None) is not None
+
+
+def clear() -> None:
+    with _LOCK:
+        _SPECS.clear()
+
+
+def reseed(seed: int) -> None:
+    """Restart the trigger RNG — same seed + same hit sequence replays
+    the identical fault schedule (the storm harness's determinism)."""
+    global _SEED, _RNG
+    with _LOCK:
+        _SEED = int(seed)
+        _RNG = random.Random(_SEED)
+
+
+def snapshot() -> List[dict]:
+    with _LOCK:
+        return [s.to_dict() for specs in _SPECS.values() for s in specs]
+
+
+def fired_counts() -> Dict[str, int]:
+    """name -> times an armed action actually ran (fired), the ledger a
+    storm reconciles against recovered/retryable outcomes."""
+    with _LOCK:
+        return {nm: sum(s.fired for s in specs)
+                for nm, specs in _SPECS.items()
+                if any(s.fired for s in specs)}
+
+
+def _arm_env() -> None:
+    env = os.environ.get("SNAPPY_FAILPOINTS")
+    if env:
+        arm_from_spec(env)
+
+
+_arm_env()
+
+
+# -- the hooks -------------------------------------------------------------
+
+def _select(name: str, data_plane: bool) -> Optional[FailSpec]:
+    with _LOCK:
+        for spec in _SPECS.get(name, ()):
+            if (spec.action in _DATA_ACTIONS) != data_plane:
+                continue
+            if spec.count is not None and spec.fired >= spec.count:
+                continue
+            spec.hits += 1
+            if spec.prob is not None and _RNG.random() >= spec.prob:
+                continue
+            spec.fired += 1
+            return spec
+    return None
+
+
+def _account(spec: FailSpec) -> None:
+    reg = _reg()
+    reg.inc("failpoint_fires")
+    reg.inc(f"failpoint_fired_{spec.name.replace('.', '_')}")
+
+
+def hit(name: str) -> None:
+    """The control-plane hook production code calls at a seam.  Unarmed:
+    one falsy-dict check, nothing else.  Armed: raise / sleep / kill
+    per the triggering spec (data-plane specs are mangle()'s business
+    and never fire here)."""
+    if not _SPECS:               # hot-path gate: no lock, no call
+        return
+    spec = _select(name, data_plane=False)
+    if spec is None:
+        return
+    _account(spec)
+    if spec.action == "sleep":
+        import time
+
+        time.sleep(spec.param / 1000.0)
+        return
+    if spec.action == "kill_worker":
+        raise WorkerKilled(f"failpoint {name}: injected worker death")
+    if spec.action == "return_errno":
+        e = int(spec.param) or _errno.EIO
+        raise OSError(e, f"failpoint {name}: injected "
+                         f"{_errno.errorcode.get(e, e)}")
+    raise _resolve_exc(spec)(f"failpoint {name}: injected failure")
+
+
+def mangle(name: str, buf: bytes) -> bytes:
+    """The data-plane hook: write sites pass the exact bytes about to
+    land on disk/wire; an armed corrupt_bytes/short_write spec returns a
+    damaged copy (seeded offsets — deterministic), anything else returns
+    `buf` untouched."""
+    if not _SPECS:               # hot-path gate, mirror of hit()
+        return buf
+    spec = _select(name, data_plane=True)
+    if spec is None:
+        return buf
+    _account(spec)
+    n = max(1, int(spec.param))
+    if spec.action == "short_write":
+        return buf[:max(0, len(buf) - n)]
+    # corrupt_bytes: XOR-flip n bytes at a seeded offset inside the
+    # buffer body (skipping the first 8 bytes keeps the magic/header
+    # length readable, so the damage is CRC-caught, not frame-fatal —
+    # the quarantine path the self-healing story exercises)
+    arr = np.frombuffer(buf, dtype=np.uint8).copy()
+    lo = 8 if len(arr) > 8 + n else 0
+    with _LOCK:
+        off = _RNG.randrange(lo, max(lo + 1, len(arr) - n))
+    arr[off:off + n] ^= 0xFF
+    return arr.tobytes()

@@ -70,6 +70,20 @@ def _host_table_bytes(data) -> int:
     return total
 
 
+_pressure_warned = [False]
+
+
+def _pressure_log_once() -> None:
+    if not _pressure_warned[0]:
+        _pressure_warned[0] = True
+        import logging
+
+        logging.getLogger("snappydata_tpu.broker").warning(
+            "background pressure-demotion pass failed; synchronous "
+            "high-watermark degradation remains in force",
+            exc_info=True)
+
+
 class ResourceBroker:
     """One broker per process (see `global_broker()`); multi-node setups
     run one per member, exactly like the reference's per-JVM memory
@@ -96,6 +110,10 @@ class ResourceBroker:
         # and cancellable from the moment of submission
         self._watched: Dict[str, QueryContext] = {}
         self._measured_cache: Tuple[float, int, int] = (0.0, 0, 0)
+        # pressure-demotion watcher (ROADMAP 4(c)): one background
+        # thread at a time; the leaf lock guards only the running flag
+        self._pressure_lock = locks.named_lock("resource.pressure")
+        self._pressure_running = False
         reg = global_registry()
         reg.gauge("governor_inflight_bytes",
                   lambda: float(self._inflight_bytes))
@@ -120,6 +138,48 @@ class ResourceBroker:
 
     def _low_bytes(self, limit: int) -> float:
         return limit * float(self.props.memory_low_watermark)
+
+    def _pressure_bytes(self, limit: int) -> float:
+        wm = float(getattr(self.props, "tier_pressure_watermark", 0.0)
+                   or 0.0)
+        # 0 disables the watcher: the threshold sits above the high
+        # watermark so admission never crosses it first
+        return limit * wm if wm > 0 else float("inf")
+
+    # -- pressure-driven background demotion (ROADMAP 4(c)) -------------
+
+    def _kick_pressure_demote(self, limit: int) -> None:
+        """Start ONE background ladder pass toward the low watermark if
+        none is running.  Admission latency pays a flag check, never the
+        demotion itself."""
+        with self._pressure_lock:
+            if self._pressure_running:
+                return
+            self._pressure_running = True
+        global_registry().inc("tier_pressure_wakeups")
+        # relief target: UNDER the pressure watermark (the low watermark
+        # can legitimately sit above current residency when the pressure
+        # knob is set aggressively — demoting "up to" it would be a
+        # no-op exactly when the operator asked for early relief)
+        target = min(self._low_bytes(limit), self._pressure_bytes(limit))
+        threading.Thread(target=self._pressure_demote_body,
+                         args=(int(target),),
+                         name="snappy-pressure-demote",
+                         daemon=True).start()
+
+    def _pressure_demote_body(self, target_bytes: int) -> None:
+        from snappydata_tpu.storage import tier
+
+        try:
+            tier.pressure_demote(self, target_bytes)
+        # locklint: swallowed-exception the watcher is advisory relief —
+        # a failed background pass leaves the synchronous high-watermark
+        # degrade (and its loud LowMemoryException path) fully in force
+        except Exception:
+            _pressure_log_once()
+        finally:
+            with self._pressure_lock:
+                self._pressure_running = False
 
     # -- ledger ---------------------------------------------------------
 
@@ -288,6 +348,11 @@ class ResourceBroker:
             raise CancelException(
                 f"query {ctx.query_id} "
                 f"{ctx.cancel_reason or 'cancelled'} before admission")
+        from snappydata_tpu.reliability import failpoints as rfail
+
+        # admission entry seam — ahead of the limit check so the fault
+        # fires whether or not governor accounting is on
+        rfail.hit("broker.admit")
         limit = self._limit()
         if limit <= 0:
             # governor accounting off: admit unconditionally, but still
@@ -310,6 +375,13 @@ class ResourceBroker:
         host, device = self.measured_bytes(max_age_s=0.25)
         if host + device > self._high_bytes(limit):
             self._degrade(int(self._low_bytes(limit)), requester=ctx)
+        elif host + device > self._pressure_bytes(limit):
+            # below the high watermark but above the PRESSURE watermark:
+            # start background tier demotion NOW, while this statement
+            # still fits — by the time residency would hit the high
+            # watermark the ladder has already freed the cheap rungs
+            # (ROADMAP 4(c): relief before allocation fails mid-stmt)
+            self._kick_pressure_demote(limit)
         # a statement timeout covers queue time too (the reference's
         # query-cancel timer starts at submission, not first row):
         # the deadline is pinned NOW so ctx.start() cannot re-arm it

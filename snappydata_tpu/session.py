@@ -49,6 +49,11 @@ class SnappySession:
             from snappydata_tpu.storage.persistence import DiskStore
 
             self.disk_store = DiskStore(data_dir)
+            # the store's write-once batch files double as the tier
+            # quarantine's rebuild source (storage/tier.py self-healing)
+            from snappydata_tpu.storage import tier as _tier
+
+            _tier.attach_store(self.disk_store)
             if catalog is None and recover:
                 # recovery must replay against THIS session (not a
                 # throwaway) so anything it re-registers — stream queries

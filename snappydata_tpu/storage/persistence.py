@@ -40,6 +40,7 @@ import numpy as np
 
 from snappydata_tpu import types as T
 from snappydata_tpu.fault import failpoints
+from snappydata_tpu.reliability import failpoints as rfail
 from snappydata_tpu.storage.batch import ColumnBatch
 from snappydata_tpu.storage.encoding import (ColumnStats, EncodedColumn,
                                              Encoding)
@@ -326,6 +327,7 @@ def salvage_file(path: str, counter: str = "wal_corrupt_records") -> int:
     the number of quarantined bytes (0 = file was clean/absent)."""
     if not os.path.exists(path):
         return 0
+    rfail.hit("wal.salvage")
     valid_end, err = salvage_scan(path)
     size = os.path.getsize(path)
     if valid_end >= size:
@@ -504,6 +506,7 @@ class DiskStore:
         — the reference's oplog stores fsync before truncating. A power
         loss right after os.replace without these leaves an empty/partial
         file whose covering WAL records were already discarded."""
+        rfail.hit("checkpoint.write")
         spec = failpoints.hit("checkpoint.write")
         if spec is not None and spec.action == "torn_write":
             # crash mid-write of the checkpoint artifact: the tmp file
@@ -520,6 +523,10 @@ class DiskStore:
             # be atomic vs committers (journal >= state invariant); rare,
             # operator-paced
             os.fsync(fh.fileno())
+        # the PUBLISH seam: a fault here models a crash between the
+        # artifact fsync and the atomic rename — the previous artifact
+        # stays authoritative and the un-rotated WAL still covers it
+        rfail.hit("checkpoint.publish")
         os.replace(tmp, dst)
         dfd = os.open(os.path.dirname(dst) or ".", os.O_RDONLY)
         try:
@@ -845,6 +852,7 @@ class DiskStore:
         covering fsync is released by wal_sync(seq) — callers MUST gate
         their ack on it (session/_journal_then/flight do_put all do)."""
         mode, _group_s, buffer_bytes = self._wal_policy()
+        rfail.hit("wal.append")
         spec = failpoints.hit("wal.append")   # per-RECORD failpoint:
         # raise/latency fire here with the same hit cadence as before
         # group commit existed, so seeded chaos schedules keep coverage
@@ -1077,6 +1085,11 @@ class DiskStore:
                 fh = self._ensure_fh()
                 fh.write(data)
                 fh.flush()
+                # the fsync seam: a raise here is the fsync-failure
+                # crash shape (Postgres fsync-gate lesson) — INSIDE the
+                # try, so the group is poisoned and _wal_damaged fences
+                # checkpoints exactly like a real EIO from the kernel
+                rfail.hit("wal.fsync")
                 # locklint: blocking-under-lock the drain IS the group
                 # fsync (PR 3); see the torn-branch note above
                 os.fsync(fh.fileno())
@@ -1529,6 +1542,34 @@ class DiskStore:
             with mvcc.commit_scope(int(manifest.get("wal_seq", 0))):
                 data._publish(tuple(views))
         return manifest.get("wal_seq", 0)
+
+    def load_batch(self, table: str, batch_id: int
+                   ) -> Optional[ColumnBatch]:
+        """Re-read ONE checkpointed batch by id — the tier quarantine's
+        WAL+checkpoint rebuild source (storage/tier.py).  Batch files
+        are write-once immutable, so a clean read IS the batch as of
+        its last checkpoint; None when the table/batch has no durable
+        artifact (or that artifact is itself damaged — the caller's
+        typed-error path takes over)."""
+        tdir = os.path.join(self.path, "tables", table)
+        mpath = os.path.join(tdir, "manifest.json")
+        if not os.path.exists(mpath):
+            return None
+        try:
+            with open(mpath) as fh:
+                manifest = json.load(fh)
+        except (OSError, ValueError):
+            return None
+        for entry in manifest.get("batches", ()):
+            if int(entry.get("batch_id", -1)) != int(batch_id):
+                continue
+            fpath = os.path.join(tdir, entry["file"])
+            try:
+                batch, _names = self._read_batch(fpath, entry, None)
+            except (CorruptRecordError, OSError):
+                return None
+            return batch
+        return None
 
     def _read_batch(self, fpath: str, entry: dict, schema: T.Schema
                     ) -> Tuple[ColumnBatch, Optional[List[str]]]:

@@ -59,6 +59,26 @@ def _reg():
     return global_registry()
 
 
+# live worker threads (for the dashboard storage section); mutated only
+# under _pf_lock
+_LIVE_WORKERS: Set[int] = set()
+
+
+def worker_snapshot() -> dict:
+    """Point-in-time prefetch-worker health for observability: live
+    worker count plus the lifetime death/restart counters — the signal
+    that distinguishes 'prefetcher restarting through faults' from
+    'prefetcher silently degraded to inline binds'."""
+    reg = _reg()
+    with _pf_lock:
+        live = len(_LIVE_WORKERS)
+    return {"live_workers": live,
+            "worker_deaths": reg.counter("prefetch_worker_deaths"),
+            "worker_restarts": reg.counter("prefetch_worker_restarts"),
+            "errors": reg.counter("prefetch_errors"),
+            "windows_warmed": reg.counter("prefetch_windows_warmed")}
+
+
 class TilePrefetcher:
     """Warms tile windows of one (data, manifest, columns) scan ahead of
     the consumer.  Protocol (both tiled lanes use it identically):
@@ -225,17 +245,49 @@ class TilePrefetcher:
         self._worker.start()
 
     def _run(self) -> None:
+        """Worker body with SUPERVISION: an escaping exception (a real
+        bug, an injected kill_worker, an OOM) no longer degrades the
+        pass to inline binds forever — the worker restarts its loop with
+        capped exponential backoff up to `tier_prefetch_max_restarts`
+        times, and only an exhausted budget sets `_dead` (the bounded
+        inline fallback the consumer already handles)."""
+        from snappydata_tpu import config
+
+        max_restarts = int(getattr(config.global_properties(),
+                                   "tier_prefetch_max_restarts", 3))
+        reg = _reg()
+        tid = threading.get_ident()
+        with _pf_lock:
+            _LIVE_WORKERS.add(tid)
         try:
-            if self._mesh_ctx is not None:
-                with self._mesh_ctx:
-                    self._loop()
-            else:
-                self._loop()
-        except BaseException:
-            _reg().inc("prefetch_errors")
-            with self._cond:
-                self._dead = True
-                self._cond.notify_all()
+            attempt = 0
+            while True:
+                try:
+                    if self._mesh_ctx is not None:
+                        with self._mesh_ctx:
+                            self._loop()
+                    else:
+                        self._loop()
+                    return                       # clean stop
+                except BaseException:
+                    reg.inc("prefetch_errors")
+                    reg.inc("prefetch_worker_deaths")
+                    with self._cond:
+                        stopped = self._stop
+                    if stopped or attempt >= max_restarts:
+                        with self._cond:
+                            self._dead = True
+                            self._cond.notify_all()
+                        return
+                    attempt += 1
+                    reg.inc("prefetch_worker_restarts")
+                    # capped backoff: fast enough that a one-shot
+                    # injected death costs ~ms of look-ahead, slow
+                    # enough that a hard-crashing loop can't spin
+                    time.sleep(min(0.25, 0.02 * (2 ** (attempt - 1))))
+        finally:
+            with _pf_lock:
+                _LIVE_WORKERS.discard(tid)
 
     def _loop(self) -> None:
         from snappydata_tpu.parallel import mesh
@@ -256,15 +308,32 @@ class TilePrefetcher:
             hi = min(lo + self._tile_units, self._units)
             self._keep((lo, hi))
             t0 = time.perf_counter()
-            # the worker's scan_window contextvar is PER-THREAD: the
-            # consumer's window never sees this restriction
-            with device_mod.scan_window(self._data, lo, hi,
-                                        self._manifest,
-                                        tile_units=self._tile_units):
-                with mesh.prefetch_fence():
-                    device_mod.build_device_table(
-                        self._data, self._manifest, self._cols,
-                        code_ok=True)
+            try:
+                # the worker-body seam: kill_worker here escapes into
+                # _run's supervision (restart w/ backoff), exactly the
+                # uncaught-exception shape a real worker bug produces
+                from snappydata_tpu.reliability import \
+                    failpoints as rfail
+
+                rfail.hit("prefetch.worker")
+                # the worker's scan_window contextvar is PER-THREAD: the
+                # consumer's window never sees this restriction
+                with device_mod.scan_window(self._data, lo, hi,
+                                            self._manifest,
+                                            tile_units=self._tile_units):
+                    with mesh.prefetch_fence():
+                        device_mod.build_device_table(
+                            self._data, self._manifest, self._cols,
+                            code_ok=True)
+            except BaseException:
+                with self._cond:
+                    # the restarted loop must rebuild THIS window — the
+                    # consumer is (or will be) blocked on it; without
+                    # the rewind a restart would skip it and the
+                    # await_window deadline (30s) would pay for the kill
+                    self._next = min(self._next, lo)
+                    self._cond.notify_all()
+                raise
             ms = (time.perf_counter() - t0) * 1000.0
             reg.inc("prefetch_windows_warmed")
             with self._cond:

@@ -43,6 +43,7 @@ import atexit
 import dataclasses
 import itertools
 import json
+import logging
 import os
 import shutil
 import struct
@@ -52,7 +53,10 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from snappydata_tpu.reliability import failpoints as rfail
 from snappydata_tpu.utils import locks
+
+_log = logging.getLogger("snappydata_tpu.tier")
 
 _tier_lock = locks.named_lock("storage.tier")
 _files_lock = locks.named_lock("storage.tier_files")
@@ -60,6 +64,34 @@ _tier_dir: Optional[str] = None
 _tier_ids = itertools.count()
 _tier_file_bytes = 0
 _gauges_registered = False
+
+# disk stores whose checkpointed batch files can rebuild a quarantined
+# tier batch (WAL+checkpoint replay source); sessions attach theirs
+_STORES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+class TierQuarantinedError(IOError):
+    """A tier file failed its CRC at promotion, was quarantined (renamed
+    aside), and NO rebuild source existed — neither a resident twin in a
+    retained MVCC epoch nor a checkpointed batch file.  Typed so callers
+    can distinguish 'the data needs recovery' from a plain IO error."""
+
+
+class _TierFileDamaged(Exception):
+    """Internal promote_batch → promote_table signal: `path` failed
+    verification with `err`; the healing path quarantines + rebuilds."""
+
+    def __init__(self, path: str, err: BaseException):
+        super().__init__(f"{path}: {err}")
+        self.path = path
+        self.err = err
+
+
+def attach_store(store) -> None:
+    """Register a DiskStore as a quarantine-rebuild source: its
+    write-once checkpointed batch files re-materialize a tier batch
+    whose CRC-framed spill record rotted on disk."""
+    _STORES.add(store)
 
 # column arrays a batch spills, in frame order (hoststore's spill set:
 # dictionaries and object-dtype arrays stay resident — small, and not
@@ -151,6 +183,7 @@ def demote_batch(batch, table_name: str = "") -> Tuple[int, object]:
     resident numeric arrays for memmap views of the record's raw parts.
     Returns (resident_bytes_freed, new batch).  The file is unlinked
     when the new batch object is collected."""
+    rfail.hit("tier.demote")
     buf = frame_batch(batch, {"table": table_name})
     head, offsets, metas = _part_offsets(buf)
     freed = sum(
@@ -162,13 +195,31 @@ def demote_batch(batch, table_name: str = "") -> Tuple[int, object]:
         return 0, batch
     path = os.path.join(
         _dir(), f"tier_{next(_tier_ids)}_{batch.batch_id}.snt")
+    rfail.hit("tier.write")
+    # the data-plane failpoint damages the WIRE bytes only (geometry
+    # above parsed the clean frame): corrupt_bytes models NVMe bit rot
+    # the promote-side CRC must catch, short_write a torn spill
+    wire = rfail.mangle("tier.write", buf)
     with open(path, "wb") as fh:
-        fh.write(buf)
+        fh.write(wire)
         fh.flush()
         # locklint: blocking-under-lock the framed spill runs on the
         # degradation ladder under the table lock BY DESIGN (manifest
         # swap atomic vs mutation; the write IS the memory relief)
         os.fsync(fh.fileno())
+    if len(wire) < len(buf):
+        # short write detected (the kernel's write count is the seam a
+        # real ENOSPC/torn spill surfaces through): abort the spill —
+        # the batch simply stays resident; no memmap views may be built
+        # over a file shorter than the frame geometry says
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        _log.warning("tier spill of batch %s aborted: short write "
+                     "(%d of %d bytes)", batch.batch_id, len(wire),
+                     len(buf))
+        return 0, batch
     # ONE mapping (one fd) per tier file: every column array is a view
     # into this base.  A long schedule demotes thousands of small
     # batches, and an fd per array (np.memmap holds its descriptor for
@@ -208,13 +259,38 @@ def demote_batch(batch, table_name: str = "") -> Tuple[int, object]:
     return freed, new_batch
 
 
-def promote_batch(batch) -> Tuple[int, object]:
-    """disk → host: CRC-verify the batch's tier record and replace its
-    memmap views with resident copies.  Raises CorruptRecordError on a
-    damaged record — a faulting scan must fail loudly, never replay
-    flipped bits (the whole point of the framed format)."""
+def _read_tier_record(path: str):
+    """CRC-verified read of one tier record, with ONE bounded re-read on
+    an OS-level failure (EIO and friends are transient on real NVMe —
+    the same one-retry-then-classify shape as the Flight seams); CRC
+    damage is never retried (re-reading flipped bits re-reads flipped
+    bits) — it propagates to the quarantine path."""
     from snappydata_tpu.storage import persistence
 
+    try:
+        # the seam sits INSIDE the retry scope: an injected EIO must
+        # exercise the same bounded re-read a real one would
+        rfail.hit("tier.memmap_read")
+        with open(path, "rb") as fh:
+            # read_records re-runs the trailing-CRC pass — this IS the
+            # promote-side integrity check
+            return next(persistence.read_records(fh))
+    except persistence.CorruptRecordError:
+        raise
+    except OSError:
+        _reg().inc("tier_read_retries")
+        with open(path, "rb") as fh:
+            return next(persistence.read_records(fh))
+
+
+def promote_batch(batch) -> Tuple[int, object]:
+    """disk → host: CRC-verify the batch's tier record and replace its
+    memmap views with resident copies.  A damaged record raises
+    _TierFileDamaged for promote_table's quarantine+rebuild; direct
+    callers see the underlying CorruptRecordError via its `err`."""
+    from snappydata_tpu.storage import persistence
+
+    rfail.hit("tier.promote")
     paths = {a.filename for col in batch.columns
              for name in _SPILL_FIELDS for a in (getattr(col, name),)
              if isinstance(a, np.memmap)
@@ -223,10 +299,11 @@ def promote_batch(batch) -> Tuple[int, object]:
         return 0, batch
     verified: Dict[str, List[Optional[np.ndarray]]] = {}
     for path in paths:
-        with open(path, "rb") as fh:
-            # read_records re-runs the trailing-CRC pass — this IS the
-            # promote-side integrity check
-            header, arrays = next(persistence.read_records(fh))
+        try:
+            header, arrays = _read_tier_record(path)
+        except (persistence.CorruptRecordError, OSError, StopIteration) \
+                as e:
+            raise _TierFileDamaged(str(path), e) from e
         verified[path] = arrays
         _reg().inc("tier_crc_verifies")
     new_cols = []
@@ -248,18 +325,146 @@ def promote_batch(batch) -> Tuple[int, object]:
     return loaded, new_batch
 
 
+def _table_name_of(data) -> Optional[str]:
+    """Resolve a table data object back to its registered name through
+    the broker ledger (tier batches don't carry a back-pointer)."""
+    from snappydata_tpu.resource.broker import global_broker
+
+    for nm, d in global_broker()._iter_tables():
+        if d is data:
+            return nm
+    return None
+
+
+def _quarantine_file(path: str) -> None:
+    """Rename a CRC-failed tier file aside (`.quarantined`) so nothing
+    re-reads the rotten bytes; the original batch's finalizer keeps
+    owning the byte accounting (its unlink of the old name is a no-op).
+    The renamed file is evidence — it dies with the tier dir at exit."""
+    try:
+        os.replace(path, path + ".quarantined")
+    except OSError:
+        pass                       # already renamed / raced a finalizer
+    _reg().inc("tier_quarantined_files")
+    _log.error("tier file %s failed verification — quarantined to %s",
+               path, path + ".quarantined")
+
+
+def _rebuild_batch(data, batch, table_name: Optional[str]):
+    """Re-materialize a quarantined batch's spilled arrays from a
+    surviving source, cheapest first:
+
+    1. a resident TWIN in a retained MVCC epoch — `_publish` moved the
+       pre-demotion manifest (resident arrays and all) into
+       ``data._retained_epochs``, so a recent demotion usually still
+       has its source in RAM;
+    2. the checkpointed immutable batch file (``batch-<id>.col``) of an
+       attached DiskStore — the WAL+checkpoint replay source.
+
+    Returns the healed batch, or None when no source covers it."""
+    from snappydata_tpu.storage import mvcc
+
+    damaged = {}                   # (col idx, field) -> needs rebuild
+    for ci, col in enumerate(batch.columns):
+        for name in _SPILL_FIELDS:
+            a = getattr(col, name)
+            if isinstance(a, np.memmap) \
+                    and str(a.filename).endswith((".snt",
+                                                  ".snt.quarantined")):
+                damaged[(ci, name)] = True
+    if not damaged:
+        return batch
+
+    def _graft(source_batch):
+        """Replace the damaged memmap fields with the source's resident
+        arrays; refuse partial coverage (a half-healed batch is worse
+        than a typed error)."""
+        if source_batch is None \
+                or source_batch.num_rows != batch.num_rows \
+                or len(source_batch.columns) != len(batch.columns):
+            return None
+        new_cols = list(batch.columns)
+        for (ci, name) in damaged:
+            src = getattr(source_batch.columns[ci], name)
+            if src is None or (isinstance(src, np.memmap)
+                               and str(src.filename).endswith(
+                                   (".snt", ".snt.quarantined"))):
+                return None
+            new_cols[ci] = dataclasses.replace(
+                new_cols[ci], **{name: np.asarray(src)})
+        return dataclasses.replace(batch, columns=tuple(new_cols))
+
+    # 1. resident twin in a retained epoch (newest first: the epoch
+    #    published right before the demotion holds the freshest source)
+    with mvcc.clock():
+        retained = list(
+            (getattr(data, "_retained_epochs", None) or {}).items())
+    for _ver, manifest in sorted(retained, reverse=True):
+        for v in getattr(manifest, "views", ()):
+            if v.batch.batch_id != batch.batch_id:
+                continue
+            healed = _graft(v.batch)
+            if healed is not None:
+                return healed
+    # 2. checkpointed batch file through an attached disk store
+    if table_name:
+        for store in list(_STORES):
+            try:
+                healed = _graft(store.load_batch(table_name,
+                                                 batch.batch_id))
+            except Exception:
+                healed = None
+            if healed is not None:
+                return healed
+    return None
+
+
+def _heal_batch(data, batch, dmg: _TierFileDamaged,
+                table_name: Optional[str]):
+    """Quarantine the damaged tier file and rebuild the batch, or raise
+    the typed TierQuarantinedError when no source survives."""
+    reg = _reg()
+    _quarantine_file(dmg.path)
+    healed = _rebuild_batch(data, batch, table_name)
+    if healed is None:
+        reg.inc("tier_rebuild_failures")
+        raise TierQuarantinedError(
+            f"tier record of batch {batch.batch_id} "
+            f"({table_name or 'unknown table'}) quarantined after "
+            f"{dmg.err!r}; no rebuild source (no resident retained "
+            f"epoch, no checkpointed batch file) — recover the table "
+            f"from WAL+checkpoint") from dmg.err
+    reg.inc("tier_rebuilds")
+    _log.warning("rebuilt batch %s of %s from %s after quarantine",
+                 batch.batch_id, table_name or "?",
+                 "a surviving source")
+    return healed
+
+
 def promote_table(data) -> int:
     """Pull every disk-demoted batch of one table resident again
-    (CRC-verified).  Returns batches promoted."""
+    (CRC-verified).  A batch whose tier record fails verification is
+    QUARANTINED (file renamed aside, `tier_quarantined_files`) and
+    rebuilt from its host/HBM source or the checkpointed batch file —
+    the query never sees flipped bits, and only a batch with NO
+    surviving source raises (typed: TierQuarantinedError).
+    Returns batches promoted."""
     promoted = 0
     _ensure_gauges()
     with _tier_lock:
+        # resolved OUTSIDE the table lock: the broker registry walk
+        # must not nest under storage.column_table
+        tname = _table_name_of(data)
         # locklint: lock=storage.column_table (only column tables tier)
         with data._lock:
             m = data._manifest
             new_views = list(m.views)
             for i, v in enumerate(new_views):
-                loaded, nb = promote_batch(v.batch)
+                try:
+                    loaded, nb = promote_batch(v.batch)
+                except _TierFileDamaged as dmg:
+                    nb = _heal_batch(data, v.batch, dmg, tname)
+                    loaded = 1
                 if loaded:
                     new_views[i] = dataclasses.replace(v, batch=nb)
                     promoted += 1
@@ -377,6 +582,24 @@ def demote(tables, excess_bytes: int) -> int:
     return n
 
 
+def pressure_demote(broker, target_bytes: int) -> int:
+    """The background pressure-relief pass (ROADMAP 4(c)): demote the
+    ladder toward `target_bytes` of measured residency — called from the
+    broker's pressure watcher when admission sees the watermark crossed,
+    so relief starts BEFORE an allocation fails mid-statement.  Returns
+    entries+batches demoted."""
+    host, device = broker.measured_bytes()
+    excess = host + device - max(0, int(target_bytes))
+    if excess <= 0:
+        return 0
+    n = demote(broker._iter_tables(), excess)
+    if n:
+        # one increment per relief PASS (not per entry): the signal an
+        # operator correlates with pressure wakeups
+        _reg().inc("tier_pressure_demotions")
+    return n
+
+
 def maybe_demote() -> int:
     """Steady-state enforcement of the tier knobs (`tier_device_bytes`,
     `tier_host_bytes`), called from the tiled lane after a pass: when a
@@ -419,10 +642,18 @@ def tier_snapshot() -> dict:
                                                 global_broker)
     from snappydata_tpu.storage.device import device_cache_bytes_by_table
 
+    from snappydata_tpu.observability.metrics import global_registry
+
     _ensure_gauges()
     with _tier_lock:
         tables = global_broker()._iter_tables()
         device = sum(device_cache_bytes_by_table(tables).values())
         host = sum(_host_table_bytes(d) for _nm, d in tables)
+    reg = global_registry()
     return {"device_bytes": device, "host_pool_bytes": host,
-            "tier_file_bytes": tier_file_bytes()}
+            "tier_file_bytes": tier_file_bytes(),
+            "quarantined_files": reg.counter("tier_quarantined_files"),
+            "rebuilds": reg.counter("tier_rebuilds"),
+            "rebuild_failures": reg.counter("tier_rebuild_failures"),
+            "read_retries": reg.counter("tier_read_retries"),
+            "pressure_demotions": reg.counter("tier_pressure_demotions")}

@@ -1,0 +1,66 @@
+"""The seeded fault-storm scheduler (reliability/faultstorm.py): every
+fault the storm injects across the WAL / checkpoint / tier / prefetch /
+admission seams must be accounted as recovered-in-place or a typed
+retryable error followed by verified crash-recovery — never a wrong
+row, never an untyped failure.  Also the bench.py --check contract
+around the faultstorm detail record."""
+
+import pytest
+
+from snappydata_tpu.reliability import failpoints as rfail, faultstorm
+
+pytestmark = [pytest.mark.faults, pytest.mark.chaos]
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    rfail.clear()
+    yield
+    rfail.clear()
+
+
+def test_storm_fully_accounted(tmp_path):
+    res = faultstorm.run_storm(str(tmp_path), seed=1717, rounds=14)
+    assert res["injected"] > 0, "a 14-round storm must land some faults"
+    assert res["value_mismatches"] == 0, res["unexpected"]
+    assert res["unexpected"] == []
+    assert res["accounted"] == res["injected"], res
+    assert res["recovery_ratio"] == 1.0
+    assert res["rows_final"] > 0
+    # the controlled corruption phase must be exercised across seeds
+    # often enough that the ledger moves in a default run — but a
+    # single short seed isn't guaranteed to draw it, so only sanity-
+    # check the counters that did move are consistent
+    assert res["tier"]["tier_rebuild_failures"] == 0
+    assert res["tier"]["tier_quarantined_files"] == \
+        res["tier"]["tier_rebuilds"]
+
+
+def test_storm_is_seed_deterministic(tmp_path):
+    a = faultstorm.run_storm(str(tmp_path / "a"), seed=31, rounds=8)
+    b = faultstorm.run_storm(str(tmp_path / "b"), seed=31, rounds=8)
+    for key in ("injected", "recovered", "typed_errors",
+                "crash_recoveries", "rows_final", "fired_by_point"):
+        assert a[key] == b[key], (key, a[key], b[key])
+
+
+def test_bench_check_guards_faultstorm():
+    import bench
+
+    base = {"value": 1.0, "detail": {}}
+    good = {"value": 1.0, "detail": {"faultstorm": {
+        "injected": 9, "accounted": 9, "recovery_ratio": 1.0,
+        "value_mismatches": 0, "unexpected": []}}}
+    assert bench.check_regression(good, base) == []
+    wrong_rows = {"value": 1.0, "detail": {"faultstorm": {
+        "injected": 9, "accounted": 9, "recovery_ratio": 1.0,
+        "value_mismatches": 2, "unexpected": ["scan sum diverged"]}}}
+    fails = bench.check_regression(wrong_rows, base)
+    assert any("wrong rows" in f for f in fails)
+    unaccounted = {"value": 1.0, "detail": {"faultstorm": {
+        "injected": 10, "accounted": 8, "recovery_ratio": 0.8,
+        "value_mismatches": 0, "unexpected": []}}}
+    fails = bench.check_regression(unaccounted, base)
+    assert any("recovery ratio" in f for f in fails)
+    assert bench.check_regression(unaccounted, base,
+                                  fault_recovery=0.75) == []

@@ -1,0 +1,181 @@
+"""The NEW deterministic failpoint registry (reliability/failpoints.py):
+grammar parsing, count/prob triggers with seeded determinism, the
+data-plane mangle hooks (corrupt_bytes / short_write), the typed
+exception families, env-var arming, and the zero-cost-unarmed
+guarantee the production seams rely on."""
+
+import os
+import time
+
+import pytest
+
+from snappydata_tpu import reliability
+from snappydata_tpu.observability.metrics import global_registry
+from snappydata_tpu.reliability import failpoints as rfail
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    rfail.clear()
+    rfail.reseed(1234)
+    yield
+    rfail.clear()
+
+
+def _c(name):
+    return global_registry().counter(name)
+
+
+# -- arming / grammar ------------------------------------------------------
+
+def test_arm_and_fire_counts():
+    rfail.arm("wal.append", "raise", count=2)
+    f0 = _c("failpoint_fires")
+    for _ in range(2):
+        with pytest.raises(rfail.InjectedFault):
+            rfail.hit("wal.append")
+    rfail.hit("wal.append")          # count exhausted: no-op
+    assert _c("failpoint_fires") == f0 + 2
+    assert rfail.fired_counts() == {"wal.append": 2}
+
+
+def test_spec_grammar():
+    specs = rfail.arm_from_spec(
+        "wal.append=raise:3;tier.write=corrupt_bytes(8):0.5;"
+        "checkpoint.publish=sleep(12)")
+    by_name = {s.name: s for s in specs}
+    assert by_name["wal.append"].action == "raise"
+    assert by_name["wal.append"].count == 3
+    assert by_name["tier.write"].action == "corrupt_bytes"
+    assert by_name["tier.write"].param == 8
+    assert by_name["tier.write"].prob == 0.5
+    assert by_name["checkpoint.publish"].action == "sleep"
+    assert by_name["checkpoint.publish"].param == 12
+
+
+def test_env_arming(monkeypatch):
+    monkeypatch.setenv("SNAPPY_FAILPOINTS", "flight.send=raise:1")
+    rfail._arm_env()
+    with pytest.raises(rfail.InjectedFault):
+        rfail.hit("flight.send")
+    rfail.hit("flight.send")         # single-shot
+
+
+def test_unknown_action_rejected():
+    with pytest.raises(ValueError):
+        rfail.arm("wal.append", "explode")
+
+
+# -- determinism -----------------------------------------------------------
+
+def test_prob_trigger_is_seed_deterministic():
+    def pattern(seed):
+        rfail.clear()
+        rfail.reseed(seed)
+        rfail.arm("wal.append", "raise", prob=0.5)
+        out = []
+        for _ in range(40):
+            try:
+                rfail.hit("wal.append")
+                out.append(0)
+            except rfail.InjectedFault:
+                out.append(1)
+        return out
+
+    a, b = pattern(77), pattern(77)
+    assert a == b, "same seed must replay the identical fault schedule"
+    assert 0 < sum(a) < 40, "prob=0.5 should fire sometimes, not always"
+    assert pattern(78) != a, "a different seed should reshuffle"
+
+
+def test_corrupt_bytes_deterministic_and_crc_visible():
+    buf = bytes(range(256)) * 8
+    rfail.arm("tier.write", "corrupt_bytes", param=4, count=1)
+    w1 = rfail.mangle("tier.write", buf)
+    rfail.clear()
+    rfail.reseed(1234)
+    rfail.arm("tier.write", "corrupt_bytes", param=4, count=1)
+    w2 = rfail.mangle("tier.write", buf)
+    assert w1 == w2, "seeded corruption must be byte-identical"
+    assert w1 != buf and len(w1) == len(buf)
+    assert w1[:8] == buf[:8], "frame header stays parseable (CRC's job)"
+
+
+def test_short_write_truncates():
+    buf = b"x" * 1000
+    rfail.arm("tier.write", "short_write", param=64, count=1)
+    w = rfail.mangle("tier.write", buf)
+    assert w == buf[:-64]
+    assert rfail.mangle("tier.write", buf) == buf  # exhausted
+
+
+def test_data_plane_never_fires_in_hit():
+    rfail.arm("tier.write", "corrupt_bytes", param=4)
+    rfail.hit("tier.write")          # control-plane hook: must no-op
+    assert rfail.fired_counts() == {}
+
+
+# -- typed failures / retry contract ---------------------------------------
+
+def test_return_errno_is_retryable_eio():
+    rfail.arm("tier.memmap_read", "return_errno", count=1)
+    with pytest.raises(OSError) as ei:
+        rfail.hit("tier.memmap_read")
+    import errno
+
+    assert ei.value.errno == errno.EIO
+    assert reliability.is_retryable(ei.value)
+
+
+def test_exception_families():
+    rfail.arm("flight.recv", "raise", exc="conn", count=1)
+    with pytest.raises(ConnectionError) as ei:
+        rfail.hit("flight.recv")
+    assert reliability.is_retryable(ei.value)
+    rfail.arm("prefetch.worker", "kill_worker", count=1)
+    with pytest.raises(rfail.WorkerKilled):
+        rfail.hit("prefetch.worker")
+
+
+def test_sleep_action_delays():
+    rfail.arm("mesh.dispatch", "sleep", param=30, count=1)
+    t0 = time.perf_counter()
+    rfail.hit("mesh.dispatch")
+    assert time.perf_counter() - t0 >= 0.025
+
+
+# -- zero-cost unarmed -----------------------------------------------------
+
+def test_unarmed_hit_is_noop_and_cheap():
+    assert not rfail.snapshot()
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        rfail.hit("wal.append")
+    per_hit = (time.perf_counter() - t0) / n
+    # a falsy-dict check + call overhead: generous bound, but orders of
+    # magnitude under any IO the seams sit next to
+    assert per_hit < 5e-6, f"unarmed hit cost {per_hit * 1e9:.0f}ns"
+    buf = b"y" * 4096
+    assert rfail.mangle("tier.write", buf) is buf, \
+        "unarmed mangle must return the identical object (no copy)"
+
+
+def test_snapshot_and_disarm():
+    rfail.arm("wal.fsync", "return_errno")
+    snap = rfail.snapshot()
+    assert snap and snap[0]["name"] == "wal.fsync"
+    assert rfail.disarm("wal.fsync")
+    assert not rfail.disarm("wal.fsync")
+    assert not rfail.snapshot()
+
+
+def test_known_points_cover_the_seams():
+    for pt in ("wal.append", "wal.fsync", "wal.salvage",
+               "checkpoint.write", "checkpoint.publish",
+               "tier.write", "tier.demote", "tier.promote",
+               "tier.memmap_read", "flight.send", "flight.recv",
+               "broker.admit", "prefetch.worker", "mesh.dispatch"):
+        assert pt in rfail.KNOWN_POINTS

@@ -1,0 +1,283 @@
+"""Self-healing storage under injected faults (the tentpole's survival
+half): tier-file corruption is quarantined and the batch REBUILT from a
+surviving source (retained MVCC epoch, then the durable store) instead
+of failing the query; with no source left the failure is a typed
+`TierQuarantinedError`; memmap EIO gets one bounded re-read; a short
+write aborts the spill with the batch still resident; the prefetch
+worker self-restarts through injected deaths; and admission pressure
+kicks the demotion ladder in the background."""
+
+import os
+import time
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession, config
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.observability.metrics import global_registry
+from snappydata_tpu.reliability import failpoints as rfail
+from snappydata_tpu.storage import mvcc, tier
+
+pytestmark = [pytest.mark.faults, pytest.mark.outofcore]
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    rfail.clear()
+    rfail.reseed(4242)
+    yield
+    rfail.clear()
+
+
+@pytest.fixture
+def small_batches():
+    props = config.global_properties()
+    old = (props.column_batch_rows, props.column_max_delta_rows,
+           props.scan_tile_bytes,
+           props.tier_device_bytes, props.tier_host_bytes,
+           props.tier_prefetch_depth)
+    props.column_batch_rows = 256
+    props.column_max_delta_rows = 256
+    yield props
+    (props.column_batch_rows, props.column_max_delta_rows,
+     props.scan_tile_bytes,
+     props.tier_device_bytes, props.tier_host_bytes,
+     props.tier_prefetch_depth) = old
+
+
+def _load(sess, n=1200, seed=7):
+    rng = np.random.default_rng(seed)
+    sess.sql("CREATE TABLE big (k STRING, v DOUBLE, w BIGINT) USING column")
+    k = rng.choice(np.array(["a", "b", "c", "d"], dtype=object), n)
+    v = rng.normal(100.0, 10.0, n)
+    w = rng.integers(0, 1000, n, dtype=np.int64)
+    sess.catalog.describe("big").data.insert_arrays([k, v, w])
+    return k, v, w
+
+
+def _c(name):
+    return global_registry().counter(name)
+
+
+def _corrupt_first_batch(data):
+    col = data._manifest.views[0].batch.columns[1]  # v DOUBLE
+    assert isinstance(col.data, np.memmap)
+    path = str(col.data.filename)
+    with open(path, "r+b") as fh:   # flip one part byte under the CRC
+        fh.seek(col.data.offset)
+        b = fh.read(1)
+        fh.seek(col.data.offset)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    return path
+
+
+# -- quarantine + rebuild --------------------------------------------------
+
+def test_injected_corruption_heals_from_retained_epoch(small_batches):
+    """corrupt_bytes via the failpoint on the DEMOTE write; promotion's
+    CRC catches it, the file is quarantined, and the batch grafts back
+    from the retained pre-demotion epoch — values exact, query-visible
+    error: none."""
+    sess = SnappySession(catalog=Catalog())
+    _load(sess)
+    data = sess.catalog.describe("big").data
+    q = ("SELECT k, count(*), sum(v), min(w) FROM big "
+         "GROUP BY k ORDER BY k")
+    expected = sess.sql(q).rows()
+    rfail.arm("tier.write", "corrupt_bytes", param=4, count=1)
+    assert tier.demote_host([("big", data)], 1 << 40) > 0
+    rfail.clear()
+    q0, r0 = _c("tier_quarantined_files"), _c("tier_rebuilds")
+    assert tier.promote_table(data) > 0           # heals, no raise
+    assert _c("tier_quarantined_files") == q0 + 1
+    assert _c("tier_rebuilds") == r0 + 1
+    assert not any(isinstance(vw.batch.columns[1].data, np.memmap)
+                   for vw in data._manifest.views)
+    got = sess.sql(q).rows()
+    assert len(got) == len(expected)
+    for e, g in zip(expected, got):
+        assert g[0] == e[0] and g[1] == e[1] and g[3] == e[3]
+        assert g[2] == pytest.approx(e[2], rel=1e-9)
+
+
+def test_corruption_heals_from_durable_store(tmp_path, small_batches):
+    """With the retained epochs trimmed away, the rebuild falls through
+    to the checkpointed batch file in the session's DiskStore."""
+    sess = SnappySession(catalog=Catalog(), data_dir=str(tmp_path),
+                         recover=False)
+    _load(sess)
+    sess.checkpoint()                 # batch-<id>.col on disk
+    data = sess.catalog.describe("big").data
+    q = "SELECT count(*), sum(v) FROM big"
+    expected = sess.sql(q).rows()
+    assert tier.demote_host([("big", data)], 1 << 40) > 0
+    mvcc.trim_unpinned([("big", data)])   # drop the resident twin
+    assert not getattr(data, "_retained_epochs", None)
+    _corrupt_first_batch(data)
+    r0 = _c("tier_rebuilds")
+    assert tier.promote_table(data) > 0
+    assert _c("tier_rebuilds") == r0 + 1
+    got = sess.sql(q).rows()
+    assert int(got[0][0]) == int(expected[0][0])
+    assert float(got[0][1]) == pytest.approx(float(expected[0][1]),
+                                             rel=1e-9)
+    sess.disk_store.close()
+
+
+def test_corruption_without_source_raises_typed(small_batches):
+    """No retained epoch, no durable store: the quarantine still
+    happens, but the failure surfaces as the TYPED TierQuarantinedError
+    (operator-actionable), not a bare CorruptRecordError."""
+    sess = SnappySession(catalog=Catalog())
+    # a table name no earlier-checkpointed DiskStore in this process
+    # knows, so the durable-store fallback cannot accidentally serve
+    sess.sql("CREATE TABLE lone (k STRING, v DOUBLE, w BIGINT) "
+             "USING column")
+    rng = np.random.default_rng(7)
+    sess.catalog.describe("lone").data.insert_arrays(
+        [rng.choice(np.array(["a", "b"], dtype=object), 1200),
+         rng.normal(100.0, 10.0, 1200),
+         rng.integers(0, 1000, 1200, dtype=np.int64)])
+    data = sess.catalog.describe("lone").data
+    assert tier.demote_host([("lone", data)], 1 << 40) > 0
+    mvcc.trim_unpinned([("lone", data)])
+    path = _corrupt_first_batch(data)
+    f0, q0 = _c("tier_rebuild_failures"), _c("tier_quarantined_files")
+    with pytest.raises(tier.TierQuarantinedError):
+        tier.promote_table(data)
+    assert _c("tier_rebuild_failures") == f0 + 1
+    assert _c("tier_quarantined_files") == q0 + 1
+    assert os.path.exists(path + ".quarantined")
+    assert not os.path.exists(path)
+
+
+# -- bounded retry / graceful abort ----------------------------------------
+
+def test_memmap_eio_retried_once(small_batches):
+    sess = SnappySession(catalog=Catalog())
+    _load(sess)
+    data = sess.catalog.describe("big").data
+    q = "SELECT count(*), sum(v) FROM big"
+    expected = sess.sql(q).rows()
+    assert tier.demote_host([("big", data)], 1 << 40) > 0
+    rfail.arm("tier.memmap_read", "return_errno", count=1)
+    t0 = _c("tier_read_retries")
+    assert tier.promote_table(data) > 0    # one bounded re-read heals
+    assert _c("tier_read_retries") == t0 + 1
+    assert sess.sql(q).rows() == expected
+
+
+def test_short_write_aborts_spill_batch_stays_resident(small_batches):
+    sess = SnappySession(catalog=Catalog())
+    _load(sess)
+    data = sess.catalog.describe("big").data
+    q = "SELECT count(*), sum(v) FROM big"
+    expected = sess.sql(q).rows()
+    rfail.arm("tier.write", "short_write", param=64)
+    b0 = tier.tier_file_bytes()
+    tier.demote_host([("big", data)], 1 << 40)
+    rfail.clear()
+    # every spill aborted: nothing on disk, nothing memmapped, values up
+    assert tier.tier_file_bytes() == b0
+    assert not any(isinstance(vw.batch.columns[1].data, np.memmap)
+                   for vw in data._manifest.views)
+    assert sess.sql(q).rows() == expected
+
+
+# -- prefetch worker self-restart ------------------------------------------
+
+def test_prefetch_worker_restarts_after_injected_kill(small_batches):
+    from snappydata_tpu.storage import prefetch
+
+    sess = SnappySession(catalog=Catalog())
+    _load(sess, n=3000)
+    q = "SELECT k, count(*), sum(v) FROM big GROUP BY k ORDER BY k"
+    expected = sess.sql(q).rows()
+    small_batches.scan_tile_bytes = 2 * 256 * 32
+    rfail.arm("prefetch.worker", "kill_worker", count=1)
+    r0, d0 = _c("prefetch_worker_restarts"), _c("prefetch_worker_deaths")
+    w0 = _c("prefetch_windows_warmed")
+    got = sess.sql(q).rows()
+    assert _c("prefetch_worker_deaths") == d0 + 1
+    assert _c("prefetch_worker_restarts") == r0 + 1, \
+        "the supervised worker must restart, not degrade to inline"
+    assert _c("prefetch_windows_warmed") > w0, \
+        "the restarted worker should still warm look-ahead windows"
+    assert len(got) == len(expected)
+    for e, g in zip(expected, got):
+        assert g[0] == e[0] and g[1] == e[1]
+        assert g[2] == pytest.approx(e[2], rel=1e-9)
+    snap = prefetch.worker_snapshot()
+    assert snap["worker_restarts"] >= 1
+
+
+def test_prefetch_restart_cap(small_batches, monkeypatch):
+    """A worker that dies EVERY time exhausts tier_prefetch_max_restarts
+    and degrades to inline binds — bounded, never an infinite respawn
+    loop — with values still exact."""
+    from snappydata_tpu.storage.prefetch import TilePrefetcher
+
+    def boom(self):
+        raise RuntimeError("injected perma-death")
+
+    monkeypatch.setattr(TilePrefetcher, "_loop", boom)
+    sess = SnappySession(catalog=Catalog())
+    _load(sess, n=3000)
+    q = "SELECT count(*), sum(v) FROM big"
+    expected = sess.sql(q).rows()
+    small_batches.scan_tile_bytes = 2 * 256 * 32
+    r0 = _c("prefetch_worker_restarts")
+    cap = int(config.global_properties().tier_prefetch_max_restarts)
+    assert sess.sql(q).rows() == expected
+    assert _c("prefetch_worker_restarts") - r0 <= cap
+
+
+# -- pressure-driven background demotion -----------------------------------
+
+def test_pressure_demote_direct(small_batches):
+    from snappydata_tpu.resource.broker import global_broker
+
+    sess = SnappySession(catalog=Catalog())
+    _load(sess)
+    sess.sql("SELECT sum(v) FROM big")     # warm device plates
+    d0 = _c("tier_pressure_demotions")
+    n = tier.pressure_demote(global_broker(), target_bytes=0)
+    assert n > 0
+    assert _c("tier_pressure_demotions") == d0 + 1
+
+
+def test_admission_pressure_kicks_background_demotion(small_batches):
+    from snappydata_tpu.resource.broker import global_broker
+
+    props = config.global_properties()
+    saved = (props.memory_limit_bytes, props.tier_pressure_watermark)
+    sess = SnappySession(catalog=Catalog())
+    _load(sess)
+    sess.sql("SELECT sum(v) FROM big")
+    broker = global_broker()
+    try:
+        host, device = broker.measured_bytes(max_age_s=0.0)
+        measured = host + device
+        assert measured > 0
+        # land measured residency BETWEEN the pressure watermark and the
+        # high watermark: background relief, not synchronous degrade
+        props.memory_limit_bytes = int(measured * 4)
+        props.tier_pressure_watermark = 0.1
+        w0 = _c("tier_pressure_wakeups")
+        p0 = _c("tier_pressure_demotions")
+        sess.sql("SELECT count(*) FROM big")   # admission sees pressure
+        assert _c("tier_pressure_wakeups") == w0 + 1
+        deadline = time.time() + 10.0
+        while time.time() < deadline \
+                and _c("tier_pressure_demotions") == p0:
+            time.sleep(0.02)
+        assert _c("tier_pressure_demotions") > p0, \
+            "the background ladder pass never ran"
+        # single-flight: a second admission while nothing is running
+        # may wake again, but the flag must have been released
+        with broker._pressure_lock:
+            running = broker._pressure_running
+        assert not running
+    finally:
+        (props.memory_limit_bytes, props.tier_pressure_watermark) = saved

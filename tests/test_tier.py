@@ -89,12 +89,19 @@ def test_framed_demote_promote_roundtrip(small_batches):
             np.testing.assert_array_equal(np.asarray(col.data), before[ci])
 
 
-def test_corrupt_tier_record_fails_loudly(small_batches):
-    from snappydata_tpu.storage.persistence import CorruptRecordError
+def test_corrupt_tier_record_quarantined_and_healed(small_batches):
+    """A corrupted tier record no longer fails the query: promotion's
+    CRC catches it, the file is quarantined aside, and the batch is
+    REBUILT from the retained pre-demotion epoch — values exact (the
+    no-surviving-source case raises the typed TierQuarantinedError;
+    see test_self_healing.py)."""
+    import os
 
     sess = SnappySession(catalog=Catalog())
     _load(sess, n=1200)
     data = sess.catalog.describe("big").data
+    q = "SELECT count(*), sum(v) FROM big"
+    expected = sess.sql(q).rows()
     n0 = tier.demote_host([("big", data)], 1 << 40)
     assert n0 > 0
     col = data._manifest.views[0].batch.columns[1]  # v DOUBLE
@@ -105,8 +112,15 @@ def test_corrupt_tier_record_fails_loudly(small_batches):
         b = fh.read(1)
         fh.seek(col.data.offset)
         fh.write(bytes([b[0] ^ 0xFF]))
-    with pytest.raises(CorruptRecordError):
-        tier.promote_table(data)
+    q0, r0 = _c("tier_quarantined_files"), _c("tier_rebuilds")
+    assert tier.promote_table(data) > 0
+    assert _c("tier_quarantined_files") == q0 + 1
+    assert _c("tier_rebuilds") == r0 + 1
+    assert os.path.exists(path + ".quarantined")
+    got = sess.sql(q).rows()
+    assert int(got[0][0]) == int(expected[0][0])
+    assert float(got[0][1]) == pytest.approx(float(expected[0][1]),
+                                             rel=1e-9)
 
 
 # -- the ladder ------------------------------------------------------------
@@ -136,7 +150,9 @@ def test_demote_ladder_values_survive(small_batches):
     assert sess.sql(q).rows() == expected
     snap = tier.tier_snapshot()
     assert set(snap) == {"device_bytes", "host_pool_bytes",
-                         "tier_file_bytes"}
+                         "tier_file_bytes", "quarantined_files",
+                         "rebuilds", "rebuild_failures", "read_retries",
+                         "pressure_demotions"}
 
 
 def test_demotion_respects_mvcc_pins(small_batches):
