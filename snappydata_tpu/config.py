@@ -108,6 +108,40 @@ class Properties:
     # The knob rides the compiled plan's STATIC key like
     # agg_reduce_strategy: flipping it re-specializes, no cache flush.
     scan_compressed_domain: str = "auto"
+    # Aggregate-on-codes (engine/executor._emit_aggregate +
+    # ops/code_agg.py): SUM/AVG over a VALUE_DICT column reduces in
+    # DICTIONARY SPACE — one bincount over the small integer codes per
+    # (group, batch) then an O(D) dot with the per-batch dictionaries —
+    # instead of gathering N decoded values (the "GPU Acceleration of
+    # SQL Analytics on Compressed Data" formulation). Group keys that
+    # are dict/RLE-encoded already group by pure code arithmetic
+    # regardless of this knob (counted agg_code_domain); this knob only
+    # gates the value-side bincount-dot, whose win is bandwidth-bound
+    # (TPU) but scatter-bound on CPU XLA.
+    #   auto  engage on TPU backends, stay on the gather path on CPU
+    #   on    engage everywhere eligibility holds (bench uses this)
+    #   off   always gather decoded values
+    # Rides the compiled plan's static key: flipping re-specializes,
+    # no cache flush. Counted agg_dict_space per engaged execution.
+    agg_on_codes: str = "auto"
+    # Background compaction (storage/compact.py): a broker-scheduled
+    # single-flight pass that rewrites column batches UNDER live
+    # readers — folds update deltas + delete masks into fresh batches
+    # and re-encodes columns whose batches drifted to mixed encodings —
+    # then republishes via the normal MVCC manifest swap (pinned epochs
+    # keep old readers value-correct). Keeps the compressed fast path
+    # hot: compressed_fallback_{deltas,mixed_encoding} drain to zero
+    # under sustained mutation instead of permanently disqualifying hot
+    # columns.
+    compaction_enabled: bool = True
+    # Seconds between background compaction scans (per engine). The
+    # broker's admission path also kicks an early pass when per-table
+    # fallback counts cross compaction_min_fallbacks.
+    compaction_interval_s: float = 30.0
+    # Minimum per-table compressed-fallback count (deltas +
+    # mixed_encoding + not_encoded) before a table is considered worth
+    # compacting — avoids rewriting cold tables nobody scans.
+    compaction_min_fallbacks: int = 1
     # Pallas compensated-f32 kernel for global float SUM/AVG instead of
     # the emulated-f64 segment reduction on TPU (ops/pallas_reduce.py).
     # Default OFF until measured on hardware; bench.py reports the

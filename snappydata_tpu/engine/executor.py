@@ -34,6 +34,7 @@ from __future__ import annotations
 import dataclasses
 import functools
 import threading
+import weakref
 from snappydata_tpu.utils import locks
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -70,6 +71,17 @@ class RelOut:
 
     cols: Dict[int, DVal]
     valid: object  # traced bool array
+    # run-space purity of the row set w.r.t. ONE run partition (the
+    # RLE-aggregate alignment proof, threaded through the device tree):
+    #   "pure"        no filter applied yet — every scanned row survives,
+    #                 trivially aligned to ANY plate's runs
+    #   (ends, mask)  the surviving rows are exactly the expansion of
+    #                 per-run `mask` over cumulative run `ends` — the
+    #                 whole filter conjunction stayed in run space
+    #   None          impure (row-level predicate, join, null mask, …)
+    # Default None: only run_scan asserts purity, everything else must
+    # prove it survived.
+    runf: object = None
 
 
 class _RelationInput:
@@ -559,12 +571,36 @@ class CompiledPlan:
                     static, "single", fn,
                     (tuple(arrays), tuple(aux), pvals)))
             self._count_compressed(reg, static, ("single",))
-        note = self.agg_notes.get(static) if self.agg_notes else None
-        if note is not None:
-            reg.inc("agg_reduce_passes", note["passes"])
-            for s in note["strategies"]:
-                reg.inc("agg_strategy_" + s)
+        self._count_agg_notes(reg, static)
         return tables, outs
+
+    def _count_agg_notes(self, reg, static) -> None:
+        """Per-execution metrics from the trace-time aggregate notes:
+        reduction passes + strategies, the compressed-domain lanes the
+        plan engaged (agg_code_domain / agg_dict_space / agg_rle_runs),
+        and counted run-misalignment fallbacks — an RLE plate that was
+        ELIGIBLE but whose filter left run space never degrades
+        silently."""
+        note = self.agg_notes.get(static) if self.agg_notes else None
+        if note is None:
+            return
+        reg.inc("agg_reduce_passes", note["passes"])
+        for s in note["strategies"]:
+            reg.inc("agg_strategy_" + s)
+        lanes = note.get("lanes", ())
+        if "code_domain" in lanes:
+            reg.inc("agg_code_domain")
+        if "dict_space" in lanes:
+            reg.inc("agg_dict_space")
+        if "rle_runs" in lanes:
+            reg.inc("agg_rle_runs")
+        if note.get("rle_fallbacks"):
+            from snappydata_tpu.storage.device_decode import \
+                compressed_fallback
+
+            tref = note.get("table")
+            compressed_fallback("rle_agg", note["rle_fallbacks"],
+                                table=tref() if tref is not None else None)
 
     def execute(self, params: Tuple) -> Result:
         tables, outs = self._run_device(params)
@@ -642,11 +678,7 @@ class CompiledPlan:
             outs = self._noted_call(key, "vmap", fn,
                                     (tuple(arrays), aux, pvals))
         self._count_compressed(reg, key, ("vmap",))
-        note = self.agg_notes.get(static) if self.agg_notes else None
-        if note is not None:
-            reg.inc("agg_reduce_passes", note["passes"])
-            for s in note["strategies"]:
-                reg.inc("agg_strategy_" + s)
+        self._count_agg_notes(reg, static)
         # the whole batch comes home in ONE transfer — the amortization
         # the micro-batcher buys (vs one device_get per request)
         with tracing.span("transfer"):
@@ -810,6 +842,80 @@ def _strategy_token(props) -> int:
     stale trace."""
     s = str(props.get("agg_reduce_strategy", "auto") or "auto").lower()
     return _STRATEGY_NAMES.index(s) if s in _STRATEGY_NAMES else 0
+
+
+_CODE_AGG_TOKENS = {"off": 0, "auto": 1, "on": 2}
+
+
+def _code_agg_token(props) -> int:
+    """agg_on_codes as a small int on the compiled plan's STATIC key —
+    flipping the knob re-specializes, no plan-cache flush."""
+    s = str(props.get("agg_on_codes", "auto") or "auto").lower()
+    return _CODE_AGG_TOKENS.get(s, 1)
+
+
+def _numeric_domain_provider(info, ci: int, max_card: int):
+    """vdict key-domain provider for a direct numeric column of a base
+    COLUMN table, or None when the shape can't carry one."""
+    from snappydata_tpu.storage.table_store import RowTableData
+
+    data = info.data
+    if isinstance(data, RowTableData):
+        return None
+
+    def provider():
+        from snappydata_tpu.storage.device import numeric_key_domain
+
+        return numeric_key_domain(data, ci, max_card)
+
+    return provider
+
+
+def _vdict_card(dom, max_groups: int) -> int:
+    """Static card of a vdict key: padded domain size — or max_groups+1
+    when the domain declined (too many distincts / NaN), which pushes
+    shape_info off the fast path onto the generic hash group-by."""
+    return _padded_size(len(dom)) if dom is not None else max_groups + 1
+
+
+def _vdict_lut(dom) -> np.ndarray:
+    """Aux LUT of a vdict key: the sorted domain padded to its static
+    card by repeating the last value (stays sorted; searchsorted
+    side='left' maps the pad value to its first occurrence)."""
+    if dom is None or len(dom) == 0:
+        return np.zeros(1, dtype=np.float64)
+    pad = _padded_size(len(dom))
+    out = np.empty(pad, dtype=dom.dtype)
+    out[:len(dom)] = dom
+    out[len(dom):] = dom[-1]
+    return out
+
+
+def _rle_agg_ready(data) -> int:
+    """Static gate of the run-space aggregate lane: run arithmetic sums
+    WHOLE runs, so any delete mask (row-level holes runs can't see)
+    disqualifies the snapshot.  Deltas and row-buffer rows already
+    disqualify the compressed bind itself.  Rides the static key, so
+    background compaction folding the deletes flips the lane back on
+    with a re-specialize, no plan-cache flush."""
+    from snappydata_tpu.storage import mvcc
+    from snappydata_tpu.storage.table_store import RowTableData
+
+    if isinstance(data, RowTableData):
+        return 0
+    man = mvcc.snapshot_of(data)
+    return int(not any(v.delete_mask is not None for v in man.views))
+
+
+def _rle_run_mask(runf, rpl):
+    """Per-run survivor mask of `rpl` under the relation's run-space
+    filter state, or None when the alignment proof doesn't cover this
+    plate (filter over a different run partition, or impure)."""
+    if runf == "pure":
+        return jnp.ones(jnp.shape(rpl.ends), dtype=jnp.bool_)
+    if isinstance(runf, tuple) and runf[0] is rpl.ends:
+        return runf[1]
+    return None
 
 
 def _row_count_of(info) -> int:
@@ -1440,7 +1546,7 @@ class Compiler:
 
             def run_scan(ctx) -> RelOut:
                 cols, valid = ctx.rels[rel_idx]
-                return RelOut(dict(cols), valid)
+                return RelOut(dict(cols), valid, runf="pure")
 
             return run_scan, scope
 
@@ -1467,7 +1573,18 @@ class Compiler:
                 keep = p.value
                 if p.null is not None:
                     keep = keep & ~p.null
-                return RelOut(out.cols, out.valid & keep)
+                # run-space bookkeeping for the RLE aggregate lane: the
+                # filter stays pure only if THIS predicate survived in
+                # run space over the same run partition as every one
+                # before it
+                runf = None
+                if p.rmask is not None and p.null is None:
+                    if out.runf == "pure":
+                        runf = (p.rends, p.rmask)
+                    elif (isinstance(out.runf, tuple)
+                          and out.runf[0] is p.rends):
+                        runf = (p.rends, out.runf[1] & p.rmask)
+                return RelOut(out.cols, out.valid & keep, runf=runf)
 
             return run_filter, scope
 
@@ -1490,7 +1607,7 @@ class Compiler:
                         out_scope[i].dict_provider = dv.dictionary \
                             if callable(dv.dictionary) else (lambda d=dv.dictionary: d)
                     cols[i] = dv
-                return RelOut(cols, out.valid)
+                return RelOut(cols, out.valid, runf=out.runf)
 
             return run_project, out_scope
 
@@ -2135,6 +2252,15 @@ class Compiler:
         groups = list(plan.group_exprs)
         key_runs = [builder.emit(g) for g in groups]
 
+        # the single base COLUMN table behind a Filter*/alias* chain:
+        # the shape whose direct numeric keys can group in code space
+        # (vdict) and whose RLE plates can aggregate in run space
+        inner = plan.child
+        while isinstance(inner, (ast.SubqueryAlias, ast.Filter)):
+            inner = inner.child
+        base_info = self.relations[-1].info \
+            if isinstance(inner, ast.Relation) and self.relations else None
+
         # collect primitive agg slots (decomposing avg→sum+count etc.)
         slots: List[Tuple[str, Optional[ast.Expr]]] = []  # (kind, arg)
 
@@ -2233,7 +2359,31 @@ class Compiler:
             elif gt.name == "boolean":
                 key_infos.append(("bool", None, None))
             else:
-                key_infos.append(("generic", None, None))
+                # vdict: a direct numeric (non-decimal) key of a base
+                # column table groups through its table-global sorted
+                # value domain — dict-encoded plates remap per-batch
+                # CODES through it (no gather), decoded plates
+                # searchsorted their values.  The domain provider can
+                # decline per bind (cardinality/NaN), which pushes the
+                # static card past max_groups → generic hash path.
+                base_g = g.child if isinstance(g, ast.Alias) else g
+                vd = None
+                if (base_info is not None and isinstance(base_g, ast.Col)
+                        and base_g.index is not None
+                        and gt.name not in ("decimal", "string")
+                        and T.is_numeric(gt)):
+                    vd = _numeric_domain_provider(
+                        base_info, base_g.index, props.max_groups)
+                if vd is not None:
+                    mg = props.max_groups
+                    si = self._add_static(
+                        lambda p=vd, m=mg: _vdict_card(p(), m))
+                    aux_ix = len(self.aux_builders)
+                    self.aux_builders.append(
+                        lambda params, p=vd: _vdict_lut(p()))
+                    key_infos.append(("vdict", si, (vd, aux_ix)))
+                else:
+                    key_infos.append(("generic", None, None))
 
         max_groups = props.max_groups
         partial_raw = self.partial_raw
@@ -2256,6 +2406,17 @@ class Compiler:
         # agg_reduce_strategy re-specializes the executable, no plan
         # cache flush needed
         strategy_si = self._add_static(lambda p=props: _strategy_token(p))
+        # aggregate-on-codes knob + run-space readiness both ride the
+        # static key: knob flips and compaction folding the last delete
+        # mask re-specialize without a plan-cache flush
+        code_agg_si = self._add_static(lambda p=props: _code_agg_token(p))
+        rle_gate_si = self._add_static(
+            lambda d=base_info.data: _rle_agg_ready(d)) \
+            if base_info is not None else None
+        # weak: the plan cache must not keep a dropped table alive just
+        # to attribute its fallback counts
+        base_table_ref = weakref.ref(base_info.data) \
+            if base_info is not None else None
         notes = self._agg_notes = {}
 
         # post-aggregation expression evaluation over [G] arrays
@@ -2327,8 +2488,12 @@ class Compiler:
                                  _force=list(key_force_null)) -> int:
                     total = 1
                     for (kind, _si, prov), force in zip(_infos, _force):
-                        card = 2 if kind == "bool" \
-                            else _padded_size(len(prov()))
+                        if kind == "bool":
+                            card = 2
+                        elif kind == "vdict":
+                            card = _vdict_card(prov[0](), max_groups)
+                        else:
+                            card = _padded_size(len(prov()))
                         total *= card + (1 if force else 0)
                     return total
 
@@ -2341,7 +2506,7 @@ class Compiler:
             cards = []
             fast = True
             for (kind, si, _), kd in zip(key_infos, kdvals):
-                if kind == "dict":
+                if kind in ("dict", "vdict"):
                     cards.append(ctx.static[si])
                 elif kind == "bool":
                     cards.append(2)
@@ -2376,9 +2541,31 @@ class Compiler:
             fast, cards, eff_cards, num_groups = shape_info(ctx, kdvals, n)
             if fast:
                 gidx = jnp.zeros(n, dtype=jnp.int64)
-                for kd, card, ecard in zip(kdvals, cards, eff_cards):
-                    kv = _broadcast_to_mask(kd.value, out.valid) \
-                        .reshape(-1).astype(jnp.int64)
+                for kd, card, ecard, ki in zip(kdvals, cards, eff_cards,
+                                               key_infos):
+                    if ki[0] == "vdict":
+                        # group index straight from the table-global
+                        # value domain: a dict-encoded plate remaps its
+                        # per-batch CODES through the domain (pure code
+                        # arithmetic, value plate never gathered);
+                        # anything else searchsorts its values
+                        gd = jnp.asarray(ctx.aux[ki[2][1]])
+                        if (kd.cplate is not None
+                                and ctx.static[code_agg_si] != 0):
+                            remap = jnp.searchsorted(
+                                gd, kd.cplate.dicts).astype(jnp.int64)
+                            kv = jnp.take_along_axis(
+                                remap,
+                                kd.cplate.codes.astype(jnp.int32),
+                                axis=1).reshape(-1)
+                        else:
+                            vals = _broadcast_to_mask(
+                                kd.value, out.valid).reshape(-1)
+                            kv = jnp.searchsorted(gd, vals) \
+                                .astype(jnp.int64)
+                    else:
+                        kv = _broadcast_to_mask(kd.value, out.valid) \
+                            .reshape(-1).astype(jnp.int64)
                     if kd.null is not None:
                         nb = _broadcast_to_mask(kd.null, out.valid) \
                             .reshape(-1)
@@ -2439,7 +2626,7 @@ class Compiler:
             return valid, gidx, onehot, overflow
 
         def run_main(ctx, pre=None) -> tuple:
-            from snappydata_tpu.ops import reduction
+            from snappydata_tpu.ops import code_agg, reduction
 
             out = child(ctx)
             rt = Runtime(out.cols, ctx.params, ctx.aux_slice(builder))
@@ -2471,7 +2658,21 @@ class Compiler:
             # of this function — a concurrent execution of the same
             # plan must never iterate a set another thread's in-flight
             # trace is still mutating
-            note = {"passes": 0, "strategies": set()}
+            note = {"passes": 0, "strategies": set(), "lanes": set(),
+                    "rle_fallbacks": 0}
+            tok = ctx.static[code_agg_si]
+            # dictionary-space SUM is a scatter-heavy lane: auto keeps
+            # it off the (serial-scatter) CPU backend; "on" forces it
+            # everywhere, "off" kills it.  The code-domain group index
+            # and run-space lanes are cheap arithmetic — only "off"
+            # disables those.
+            code_agg_on = tok == 2 or (tok == 1 and backend != "cpu")
+            rle_ok = (tok != 0 and rle_gate_si is not None
+                      and bool(ctx.static[rle_gate_si])
+                      and jnp.ndim(out.valid) == 2)
+            if groups and fast and any(ki[0] in ("dict", "vdict")
+                                       for ki in key_infos):
+                note["lanes"].add("code_domain")
 
             # --- slots ---
             # Evaluate slot inputs once, dedup by argument expression:
@@ -2483,7 +2684,8 @@ class Compiler:
             arg_vw: Dict[object, tuple] = {}
             for (kind, arg), run in zip(slots, slot_arg_runs):
                 if run is None:  # count(*)
-                    evaluated.append(("count", None, valid, None, False))
+                    evaluated.append(("count", None, valid, None, False,
+                                      None, None))
                     continue
                 hit = arg_vw.get(arg)
                 if hit is None:
@@ -2498,8 +2700,14 @@ class Compiler:
                     # expressions can be Inf/NaN exactly where the
                     # filter excluded them (sum(a/b) WHERE b <> 0), so
                     # only bare columns may skip the matmul pre-mask
-                    hit = arg_vw[arg] = (v, w, dv.dtype,
-                                         isinstance(arg, ast.Col))
+                    raw = isinstance(arg, ast.Col)
+                    # the plates ride along so the sum/count slot loop
+                    # can aggregate in code/run space without decoding;
+                    # only bare columns carry them (an expression over
+                    # a plate is row-space math by definition)
+                    hit = arg_vw[arg] = (v, w, dv.dtype, raw,
+                                         dv.cplate if raw else None,
+                                         dv.rplate if raw else None)
                 evaluated.append((kind,) + hit)
 
             # Fused Pallas grouped path (the Q1 shape on TPU):
@@ -2518,11 +2726,20 @@ class Compiler:
             fused = []  # (slot_idx, kind, values|None, mask)
             fused_idx: set = set()
             if use_pg:
-                for i, (kind, v, w, sdt, _raw) in enumerate(evaluated):
+                for i, (kind, v, w, sdt, _raw, _cpl,
+                        _rpl) in enumerate(evaluated):
                     eligible = kind == "count" or (
                         kind in ("sum", "min", "max") and v is not None
                         and v.dtype == jnp.float32)
                     if not eligible:
+                        continue
+                    if (kind == "sum" and code_agg_on
+                            and _cpl is not None
+                            and code_agg.dict_space_cells(
+                                nseg, _cpl.codes.shape, _cpl.dicts.shape)
+                            <= code_agg.DICT_SPACE_MAX_CELLS):
+                        # the dictionary-space lane below takes this
+                        # slot — it never gathers the value plate
                         continue
                     pv = None if kind == "count" else v
                     cost = _pg.op_vmem_bytes(
@@ -2558,11 +2775,34 @@ class Compiler:
                     count_of[id(w)] = c
                 return c
 
-            for i, (kind, v, w, sdt, raw_col) in enumerate(evaluated):
+            for i, (kind, v, w, sdt, raw_col, cpl,
+                    rpl) in enumerate(evaluated):
                 if i in fused_idx:
                     continue
                 if kind == "count":
-                    count_users.append((i, count_col(w)))
+                    rm = None
+                    if (rle_ok and rpl is not None and not groups
+                            and w is valid):
+                        rm = _rle_run_mask(out.runf, rpl)
+                        if rm is None:
+                            # eligible plate, filter left run space —
+                            # COUNTED fallback, never silent
+                            note["rle_fallbacks"] += 1
+                    if rm is not None:
+                        # run-space COUNT: Σ run-length over surviving
+                        # runs.  batch-skip pad batches duplicate real
+                        # plates with an all-False validity window, so
+                        # mask whole dead batches out of the run mask.
+                        live = out.valid.any(axis=1)
+                        _tot, cnt = code_agg.run_space_sum_count(
+                            rpl.values, rpl.ends, rm & live[:, None])
+                        slot_arrays[i] = jnp.stack(
+                            [cnt, jnp.zeros((), cnt.dtype)])
+                        note["passes"] += 1
+                        note["strategies"].add("rle_runs")
+                        note["lanes"].add("rle_runs")
+                    else:
+                        count_users.append((i, count_col(w)))
                 elif kind == "count_distinct":
                     # exact: sort (group, value-bits) pairs, count group
                     # boundaries where the value changes (sort-based
@@ -2579,6 +2819,42 @@ class Compiler:
                         new.astype(jnp.int64), g_s, num_segments=nseg)
                     note["passes"] += 1
                 elif kind == "sum":
+                    acc_dt = _acc_dtype(sdt, jnp.asarray(v).dtype)
+                    # run-space SUM: Σ value·length over surviving runs
+                    # — O(runs), no row-space expansion.  f64-exact
+                    # accumulators only; exact int64 (decimal/integer)
+                    # sums stay on the packed path.
+                    rm = None
+                    if (rle_ok and rpl is not None and not groups
+                            and w is valid and acc_dt != jnp.int64):
+                        rm = _rle_run_mask(out.runf, rpl)
+                        if rm is None:
+                            note["rle_fallbacks"] += 1
+                    if rm is not None:
+                        live = out.valid.any(axis=1)
+                        total, _cnt = code_agg.run_space_sum_count(
+                            rpl.values, rpl.ends, rm & live[:, None])
+                        slot_arrays[i] = jnp.stack(
+                            [total, jnp.zeros((), total.dtype)])
+                        note["passes"] += 1
+                        note["strategies"].add("rle_runs")
+                        note["lanes"].add("rle_runs")
+                        continue
+                    # dictionary-space SUM: bincount codes into the
+                    # (group, batch, code) space, contract with the
+                    # dictionary stack — the value plate is never
+                    # gathered (ops/code_agg.py)
+                    if (cpl is not None and code_agg_on
+                            and acc_dt != jnp.int64
+                            and code_agg.dict_space_cells(
+                                nseg, cpl.codes.shape, cpl.dicts.shape)
+                            <= code_agg.DICT_SPACE_MAX_CELLS):
+                        slot_arrays[i] = code_agg.dict_space_sum(
+                            cpl.codes, cpl.dicts, gidx, w, nseg)
+                        note["passes"] += 1
+                        note["strategies"].add("dict_space")
+                        note["lanes"].add("dict_space")
+                        continue
                     if (not groups and v.dtype == jnp.float32
                             and config.global_properties().pallas_reduce):
                         # global f32 sum via the Pallas Kahan kernel:
@@ -2594,7 +2870,6 @@ class Compiler:
                         note["passes"] += 1
                         note["strategies"].add("pallas")
                         continue
-                    acc_dt = _acc_dtype(sdt, jnp.asarray(v).dtype)
                     acc = v.astype(acc_dt)
                     if acc_dt == jnp.int64:
                         if sdt is not None and sdt.name == "decimal":
@@ -2744,16 +3019,28 @@ class Compiler:
                         strides.append(acc)
                         acc *= ecard
                     strides = list(reversed(strides))
-                    for (card, ecard, stride, kd) in zip(
-                            cards, eff_cards, strides, key_vals):
+                    for (card, ecard, stride, kd, ki) in zip(
+                            cards, eff_cards, strides, key_vals,
+                            key_infos):
                         kv = ((ar // stride) % ecard)
                         if ecard > card:  # nullable key: code==card → NULL
                             key_nulls.append(kv == card)
                             kv = jnp.minimum(kv, card - 1)
                         else:
                             key_nulls.append(None)
-                        key_arrays.append(kv.astype(
-                            kd.dtype.device_dtype() if kd.dtype else jnp.int64))
+                        if ki[0] == "vdict":
+                            # domain code → key value via the aux LUT
+                            # (padded to the static card, so every code
+                            # is in range)
+                            gd = jnp.asarray(ctx.aux[ki[2][1]])
+                            vv = jnp.take(gd, kv)
+                            key_arrays.append(vv.astype(
+                                kd.dtype.device_dtype()
+                                if kd.dtype else vv.dtype))
+                        else:
+                            key_arrays.append(kv.astype(
+                                kd.dtype.device_dtype()
+                                if kd.dtype else jnp.int64))
                 else:
                     for kd in key_vals:
                         kv = _broadcast_to_mask(kd.value, out.valid).reshape(-1)
@@ -2789,8 +3076,12 @@ class Compiler:
             for run, dt in zip(post_runs, out_types):
                 dv = run(post_rt)
                 pairs.append((dv.value, dv.null))
-            notes[ctx.static] = {"passes": note["passes"],
-                                 "strategies": frozenset(note["strategies"])}
+            notes[ctx.static] = {
+                "passes": note["passes"],
+                "strategies": frozenset(note["strategies"]),
+                "lanes": frozenset(note["lanes"]),
+                "rle_fallbacks": note["rle_fallbacks"],
+                "table": base_table_ref}
             # nested data-dependent overflows (join expansion past its
             # bucket) ride the same flag: the executor reruns on host
             return gvalid, tuple(pairs), overflow | ctx.overflow

@@ -84,6 +84,16 @@ class DVal:
     # scalars take the code/run lanes below instead of touching values.
     cplate: object = None
     rplate: object = None
+    # run-space residency of a BOOLEAN DVal (RLE predicate results and
+    # their conjunctions): rmask is the per-RUN [B, R] bool mask whose
+    # _rle_expand over rends equals `value`, rends the cumulative run
+    # ends it is aligned to (identity-compared to prove two masks talk
+    # about the SAME run partition).  Set only when null is None — a
+    # row-level null mask breaks run purity.  This is the run-alignment
+    # proof the RLE aggregate lane consumes: a filter whose rmask
+    # survived the whole conjunction is run-aligned by construction.
+    rmask: object = None
+    rends: object = None
 
     @property
     def is_string(self) -> bool:
@@ -129,20 +139,26 @@ def _compressed_cmp(op: str, col: DVal, lit: DVal) -> Optional[DVal]:
     if lit.null is not None or jnp.ndim(lit.value) != 0:
         return None
     from snappydata_tpu.storage.device_decode import (code_cmp_mask,
-                                                      rle_cmp_mask)
+                                                      rle_expand_runs)
 
     if col.cplate is not None:
         m = code_cmp_mask(op, col.cplate, lit.value)
         _note_compressed("code_preds")
-    else:
-        fns = {"=": lambda a, b: a == b, "!=": lambda a, b: a != b,
-               "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
-               ">": lambda a, b: a > b, ">=": lambda a, b: a >= b}
-        cap = jnp.shape(col.value)[1]
-        m = rle_cmp_mask(lambda vals, v, _f=fns[op]: _f(vals, v),
-                         col.rplate, lit.value, cap)
-        _note_compressed("run_preds")
-    return DVal(m, _or_null(col.null, lit.null), T.BOOLEAN)
+        return DVal(m, _or_null(col.null, lit.null), T.BOOLEAN)
+    fns = {"=": lambda a, b: a == b, "!=": lambda a, b: a != b,
+           "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+           ">": lambda a, b: a > b, ">=": lambda a, b: a >= b}
+    cap = jnp.shape(col.value)[1]
+    run_mask = fns[op](col.rplate.values, lit.value)
+    m = rle_expand_runs(run_mask, col.rplate.ends, cap)
+    _note_compressed("run_preds")
+    out = DVal(m, _or_null(col.null, lit.null), T.BOOLEAN)
+    if out.null is None:
+        # the expanded mask is PROVABLY the expansion of run_mask over
+        # this run partition — carry the run form for the aggregate lane
+        out.rmask = run_mask
+        out.rends = col.rplate.ends
+    return out
 
 
 def _no_string_operands(dvals, name: str) -> None:
@@ -673,7 +689,17 @@ class ExprBuilder:
                     else:       # true or null = true
                         null = (an & bn) | (an & ~b.value) | (bn & ~a.value)
                     v = v & ~null if is_and else v
-                return DVal(v, null, T.BOOLEAN)
+                out = DVal(v, null, T.BOOLEAN)
+                # run-space conjunction: both sides run-resident over the
+                # SAME run partition (identity on ends) combines in O(R)
+                # run space — the alignment proof survives the whole
+                # filter tree this way
+                if (null is None and a.rmask is not None
+                        and b.rmask is not None and a.rends is b.rends):
+                    out.rmask = (a.rmask & b.rmask) if is_and \
+                        else (a.rmask | b.rmask)
+                    out.rends = a.rends
+                return out
 
             return run_logic
 

@@ -20,6 +20,7 @@ from snappydata_tpu.observability.metrics import global_registry
 # tracing_snapshot lives with the trace ring; re-exported here so every
 # status surface reads off one module like the other *_snapshot helpers
 from snappydata_tpu.observability.tracing import tracing_snapshot  # noqa: F401,E501
+from snappydata_tpu.storage.device_decode import table_fallbacks
 from snappydata_tpu.storage.table_store import RowTableData
 
 
@@ -71,8 +72,14 @@ def scan_snapshot(catalog=None) -> dict:
     batches_code_bound (columns resident encoded — the capacity lever),
     batches_skipped_dict (equality literals that missed a sorted
     dictionary), and every decode-first reroute itemized by reason
-    (compressed_fallback_*).  With `catalog`, per-table encoding mix and
-    at-rest vs decoded bytes ride along."""
+    (compressed_fallback_*).  The aggregate-lane block reports how much
+    of the AGGREGATE path ran compressed (agg_code_domain /
+    agg_dict_space / agg_rle_runs) and the background compaction
+    progress that keeps those lanes hot (passes, batches rewritten,
+    bytes reclaimed, itemized compaction_skip_* declines).  With
+    `catalog`, per-table encoding mix and at-rest vs decoded bytes ride
+    along (including each table's own compressed_fallbacks tally — the
+    compaction trigger)."""
     from snappydata_tpu import config
     from snappydata_tpu.storage import device_decode
 
@@ -113,6 +120,21 @@ def scan_snapshot(catalog=None) -> dict:
         "compressed_fallback_reasons": {
             k[len("compressed_fallback_"):]: v for k, v in sorted(c.items())
             if k.startswith("compressed_fallback_")},
+        # --- aggregate-on-codes lanes ----------------------------------
+        "agg_on_codes": props.get("agg_on_codes"),
+        "agg_code_domain": c.get("agg_code_domain", 0),
+        "agg_dict_space": c.get("agg_dict_space", 0),
+        "agg_rle_runs": c.get("agg_rle_runs", 0),
+        # --- background compaction (keeps the fast paths hot) ----------
+        "compaction_enabled": props.get("compaction_enabled"),
+        "compaction_passes": c.get("compaction_passes", 0),
+        "compaction_batches_rewritten":
+            c.get("compaction_batches_rewritten", 0),
+        "compaction_bytes_reclaimed":
+            c.get("compaction_bytes_reclaimed", 0),
+        "compaction_skips": {
+            k[len("compaction_skip_"):]: v for k, v in sorted(c.items())
+            if k.startswith("compaction_skip_")},
     }
     if catalog is not None:
         try:
@@ -170,6 +192,10 @@ def encoding_mix(catalog) -> Dict[str, dict]:
             "resident_bytes_per_row":
                 round(resident.get(info.name, 0) / rows, 2) if rows
                 else None,
+            # per-TABLE decode-first reroutes since the last compaction
+            # pass over this table — the triage view: which table keeps
+            # leaving the compressed domain, and WHY
+            "compressed_fallbacks": table_fallbacks(info.data),
         }
     return out
 

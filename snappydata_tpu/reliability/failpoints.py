@@ -97,6 +97,7 @@ KNOWN_POINTS = (
     "tier.write", "tier.demote", "tier.promote", "tier.memmap_read",
     "flight.send", "flight.recv",
     "broker.admit", "prefetch.worker", "mesh.dispatch",
+    "storage.compaction",
 )
 
 

@@ -166,6 +166,29 @@ class _Storm:
         """A deliberate kill→rejoin (exercises wal.salvage faults)."""
         self.crash_and_recover()
 
+    def op_compact(self) -> None:
+        """Manufacture mutation debris (an UPDATE delta + a DELETE mask
+        on committed batches), then force a synchronous compaction pass.
+        With `storage.compaction` armed the pass dies at the publish
+        seam INSIDE the table lock — the crash contract says the old
+        manifest stays live, the half-built batches stay unreferenced,
+        and the post-round verify_scan still proves every row carries
+        its key-implied value."""
+        from snappydata_tpu.storage import compact
+
+        ks = sorted(self.present)
+        if len(ks) >= 4:
+            ka, kd = ks[0], ks[1]
+            # same-value UPDATE: leaves a fold-worthy delta without
+            # disturbing the k -> k*0.5 self-verification invariant
+            self.session.sql(
+                f"UPDATE storm SET v = {ka * 0.5} WHERE k = {ka}")
+            # un-ack BEFORE the DELETE: if anything dies between here
+            # and durability, recovery legitimately adopts either state
+            del self.present[kd]
+            self.session.sql(f"DELETE FROM storm WHERE k = {kd}")
+        compact.run_compaction_pass(self.data, force=True)
+
     def op_corrupt_heal(self) -> None:
         """Controlled corruption phase: checkpoint (a rebuild source on
         disk), demote THROUGH the armed corrupt_bytes fault, then
@@ -226,6 +249,8 @@ _MENU = (
     ("tier.promote", "sleep", 2, "op_promote"),
     ("prefetch.worker", "kill_worker", 0, "op_scan"),
     ("broker.admit", "raise", 0, "op_scan"),
+    ("storage.compaction", "raise", 0, "op_compact"),
+    ("storage.compaction", "kill_worker", 0, "op_compact"),
 )
 
 

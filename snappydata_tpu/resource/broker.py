@@ -353,6 +353,12 @@ class ResourceBroker:
         # admission entry seam — ahead of the limit check so the fault
         # fires whether or not governor accounting is on
         rfail.hit("broker.admit")
+        # background-compaction kick (storage/compact.py): a flag check
+        # under a leaf lock; the rewrite itself never runs on the
+        # admission path
+        from snappydata_tpu.storage import compact
+
+        compact.maybe_kick(self)
         limit = self._limit()
         if limit <= 0:
             # governor accounting off: admit unconditionally, but still

@@ -119,7 +119,8 @@ class DeviceTable:
 
 def _compressed_mode(is_str: bool, dec_exact: bool, use_dd: bool,
                      cols_enc, any_delta: bool, has_row_chunks: bool,
-                     code_ok: bool, count: bool = False) -> Optional[str]:
+                     code_ok: bool, count: bool = False,
+                     table=None) -> Optional[str]:
     """Per-column compressed-domain decision: 'dict' | 'rle' | 'bitset'
     when the column can stay resident encoded, None for a decoded bind.
     With count=True (the cache-miss build), every decode-first reroute
@@ -142,12 +143,12 @@ def _compressed_mode(is_str: bool, dec_exact: bool, use_dd: bool,
         return None   # string codes ARE the compressed domain already
     if knob == "off" or knob not in ("on", "auto"):
         if count and compressible:
-            compressed_fallback("disabled")
+            compressed_fallback("disabled", table=table)
         return None
 
     def reject(reason: str, always: bool = False) -> None:
         if count and (compressible or (forced and always)):
-            compressed_fallback(reason)
+            compressed_fallback(reason, table=table)
 
     if dec_exact:
         reject("decimal_exact")
@@ -357,7 +358,7 @@ def build_device_table(data: ColumnTableData, manifest: Optional[Manifest],
             # reroute of a compressible column shows up
             _compressed_mode(is_str, dec_exact, use_dd_col,
                              cols_enc, any_delta, bool(row_chunks),
-                             code_ok, count=True)
+                             code_ok, count=True, table=data)
         if cd_mode and key not in cache:
             # compressed-domain bind: the column stays RESIDENT encoded;
             # predicates run on codes/runs, values decode lazily
@@ -579,6 +580,62 @@ def _dict_domain(views, cols_enc, ci: int, b: int):
             host[i, d.shape[0]:] = d[-1]
         sizes[i] = d.shape[0]
     return host, sizes
+
+
+def numeric_key_domain(data, ci: int, max_card: int):
+    """Table-global sorted value domain of a numeric column at the
+    current (pinned) snapshot — the code space of the vdict group-by
+    lane (engine/executor._emit_aggregate).  A group index computed as
+    searchsorted(domain, value) is dense and data-independent across
+    batches, so dict-encoded key plates group by PURE CODE ARITHMETIC
+    (per-batch codes remapped through this domain) with no gather.
+
+    Returned in the column's DEVICE dtype: the per-batch plate
+    dictionaries and decoded plates are cast to the same dtype from the
+    same host values, so searchsorted hits are exact even where f32
+    rounding collapses distinct f64 inputs (the decoded path would
+    merge those groups identically).
+
+    Returns None — the caller's cue to keep the generic hash group-by —
+    when the column exceeds `max_card` distinct values or the domain
+    contains NaN (NaN breaks searchsorted ordering).  Cached per
+    (manifest version, column); stale versions evict on access."""
+    from snappydata_tpu.storage import mvcc
+    from snappydata_tpu.storage.encoding import Encoding
+
+    man = mvcc.snapshot_of(data)
+    cache = data.__dict__.setdefault("_key_domain_cache", {})
+    key = (man.version, ci, max_card)
+    if key in cache:
+        return cache[key]
+    dt = data.schema.fields[ci].dtype.device_dtype()
+    parts = []
+    for v in man.views:
+        col = v.batch.columns[ci]
+        untouched = not any(d[0] == ci for d in v.deltas)
+        if untouched and col.encoding == Encoding.VALUE_DICT \
+                and col.dictionary is not None:
+            parts.append(np.asarray(col.dictionary))
+        elif untouched and col.encoding == Encoding.RUN_LENGTH:
+            parts.append(np.asarray(col.data))
+        else:
+            # mixed encodings / deltas: the domain must still cover the
+            # values a decoded fallback bind will group by
+            parts.append(np.asarray(v.decoded_column(ci)))
+    if man.row_count:
+        parts.append(np.asarray(man.row_arrays[ci][:man.row_count]))
+    if parts:
+        dom = np.unique(np.concatenate(
+            [p.astype(dt, copy=False).ravel() for p in parts]))
+    else:
+        dom = np.zeros(0, dtype=dt)
+    if len(dom) > max_card or (dom.dtype.kind == "f" and len(dom)
+                               and np.isnan(dom[-1])):
+        dom = None
+    for k in [k for k in cache if k[0] != man.version]:
+        del cache[k]
+    cache[key] = dom
+    return dom
 
 
 def map_device_eligible(dt) -> bool:

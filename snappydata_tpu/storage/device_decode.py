@@ -39,11 +39,14 @@ decode).
 from __future__ import annotations
 
 import functools
+import weakref
 from typing import Dict, NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from snappydata_tpu.utils import locks
 
 # bind-transfer accounting (powers the bench/device-decode metric and the
 # tests' "compressed bytes actually crossed the link" assertion).
@@ -202,15 +205,45 @@ def _next_pow2(n: int) -> int:
     return 1 if n <= 1 else 1 << (n - 1).bit_length()
 
 
-def compressed_fallback(reason: str, n: int = 1) -> None:
+def compressed_fallback(reason: str, n: int = 1, table=None) -> None:
     """Count a decode-first reroute (a column that did NOT bind in the
     compressed domain), itemized by reason so every reroute is visible
-    on the scan dashboard: compressed_fallback_<reason> + total."""
+    on the scan dashboard: compressed_fallback_<reason> + total.
+
+    With `table` (the ColumnTableData the reroute happened on) the count
+    also lands in a per-table registry — the background compactor's
+    trigger signal (storage/compact.py picks tables whose FOLDABLE
+    reasons keep firing) and the per-table triage view that
+    stats_service.encoding_mix surfaces."""
     from snappydata_tpu.observability.metrics import global_registry
 
     reg = global_registry()
     reg.inc("compressed_fallbacks", n)
     reg.inc("compressed_fallback_" + reason, n)
+    if table is not None:
+        with _table_fb_lock:
+            d = _table_fallbacks.setdefault(table, {})
+            d[reason] = d.get(reason, 0) + n
+
+
+# per-table fallback tallies: weak keys so a dropped table takes its
+# tally with it.  Guarded by a declared LEAF lock (nothing is acquired
+# under it), read by the compactor and the stats service.
+_table_fallbacks: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_table_fb_lock = locks.named_lock("storage.table_fallbacks")
+
+
+def table_fallbacks(table) -> Dict[str, int]:
+    """Per-table compressed-fallback counts since the last reset."""
+    with _table_fb_lock:
+        return dict(_table_fallbacks.get(table, ()))
+
+
+def reset_table_fallbacks(table) -> None:
+    """Zero a table's tally — the compactor calls this after a rewrite
+    pass so the next window measures only post-compaction reroutes."""
+    with _table_fb_lock:
+        _table_fallbacks.pop(table, None)
 
 
 def code_plates(vd_cols, b: int, cap: int, dt, place=jnp.asarray):
@@ -351,6 +384,13 @@ def rle_cmp_mask(fn, plate: RlePlate, lit, cap: int) -> jnp.ndarray:
     full-width value plate is never produced."""
     run_mask = fn(plate.values, lit)
     return _rle_expand(run_mask, plate.ends, cap)
+
+
+def rle_expand_runs(run_array: jnp.ndarray, ends: jnp.ndarray,
+                    cap: int) -> jnp.ndarray:
+    """Expand any per-run [B, R] array (values, boolean run masks) to
+    row space [B, cap] over the given cumulative end offsets."""
+    return _rle_expand(run_array, ends, cap)
 
 
 def rle_run_lengths(ends: jnp.ndarray) -> jnp.ndarray:

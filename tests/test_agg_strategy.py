@@ -303,13 +303,23 @@ def test_tile_merges_stay_on_device(props):
     assert _counter("scan_tile_device_merges") - d0 == tiles - 1
     assert _counter("scan_tile_host_merges") == h0
     assert got == untiled
-    # generic (non-dict) group keys can't align across tiles: they fall
-    # back to the host merge, exactly
+    # a direct numeric key now groups through its table-global value
+    # domain (vdict): the group-index space is data-independent across
+    # tiles, so the merge stays on device too — with identical values
     q2 = "SELECT v, count(*) FROM big GROUP BY v ORDER BY v LIMIT 3"
+    props.scan_tile_bytes = 0
+    flat2 = s.sql(q2).rows()
+    props.scan_tile_bytes = 4 * 256 * 16
     h1 = _counter("scan_tile_host_merges")
+    assert s.sql(q2).rows() == flat2
+    assert _counter("scan_tile_host_merges") == h1
+    # an EXPRESSION key has no table-global domain: generic hash path,
+    # host merge, exactly once
+    q3 = "SELECT v + 0.5, count(*) FROM big GROUP BY v + 0.5 LIMIT 3"
+    h2 = _counter("scan_tile_host_merges")
     d1 = _counter("scan_tile_device_merges")
-    s.sql(q2)
-    assert _counter("scan_tile_host_merges") == h1 + 1
+    s.sql(q3)
+    assert _counter("scan_tile_host_merges") == h2 + 1
     assert _counter("scan_tile_device_merges") == d1
     s.stop()
 

@@ -1,0 +1,301 @@
+"""Background compaction (PR 19): fold MVCC mutation debris (update
+deltas, delete masks, mixed encodings, row-buffer tails) back into clean
+encoded batches so the compressed-domain fast paths stay hot — and prove
+the crash contract at the `storage.compaction` failpoint: a raise/kill
+at the publish seam leaves the OLD manifest live and every value exact,
+while pinned readers hold their pre-rewrite snapshot throughout."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+from snappydata_tpu import SnappySession, config
+from snappydata_tpu.catalog import Catalog
+from snappydata_tpu.observability.metrics import global_registry
+from snappydata_tpu.reliability import failpoints as rfail
+from snappydata_tpu.storage import compact, mvcc
+from snappydata_tpu.storage.device_decode import table_fallbacks
+
+pytestmark = pytest.mark.faults
+
+
+def _props():
+    return config.global_properties()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    rfail.clear()
+    saved = (_props().get("agg_on_codes"),
+             _props().get("compaction_enabled"))
+    yield
+    rfail.clear()
+    _props().set("agg_on_codes", saved[0])
+    _props().set("compaction_enabled", saved[1])
+
+
+def _counters():
+    return dict(global_registry().snapshot()["counters"])
+
+
+def _session(n=6000, seed=11):
+    """Low-cardinality columns so every batch encodes compressibly; k is
+    the self-verifying key (v == k * 0.5 always)."""
+    s = SnappySession(catalog=Catalog())
+    s.sql("CREATE TABLE ct (k BIGINT, q DOUBLE, v DOUBLE) USING column")
+    rng = np.random.default_rng(seed)
+    k = np.arange(n, dtype=np.int64)
+    q = rng.choice(np.array([0.5, 1.25, 2.0, 3.75]), n)
+    s.insert_arrays("ct", [k, q, k * 0.5])
+    data = s.catalog.describe("ct").data
+    data.force_rollover()
+    return s, data
+
+
+def _debris(s, data):
+    """Manufacture every foldable residue: update deltas, a delete mask,
+    and an undersized stub batch."""
+    s.sql("UPDATE ct SET q = 2.0 WHERE k < 40")
+    s.sql("DELETE FROM ct WHERE k >= 5900")
+    s.sql("INSERT INTO ct VALUES (100000, 1.25, 50000.0)")
+    data.force_rollover()
+    man = data.snapshot()
+    assert any(v.deltas or v.delete_mask is not None for v in man.views)
+    return man
+
+
+def _expected(s):
+    return s.sql("SELECT count(*), sum(q), sum(v), sum(k) FROM ct").rows()
+
+
+def _host_sums(man):
+    """count/sum(q)/sum(v) recomputed host-side from a manifest's views
+    + row buffer — how a pinned reader sees the table."""
+    cnt, sq, sv = 0, 0.0, 0.0
+    for view in man.views:
+        live = view.live_mask()
+        cnt += int(live.sum())
+        sq += float(view.decoded_column(1)[live].sum())
+        sv += float(view.decoded_column(2)[live].sum())
+    if man.row_count:
+        cnt += man.row_count
+        sq += float(np.asarray(man.row_arrays[1]).sum())
+        sv += float(np.asarray(man.row_arrays[2]).sum())
+    return cnt, sq, sv
+
+
+def test_pass_folds_debris_and_preserves_values():
+    s, data = _session()
+    _debris(s, data)
+    before = _expected(s)
+    c0 = _counters()
+    out = compact.run_compaction_pass(data, force=True)
+    assert out["rewritten"] > 0 and out["produced"] > 0
+    man = data.snapshot()
+    assert all(not v.deltas and v.delete_mask is None for v in man.views)
+    # the stub merged away: every batch but the last is at capacity
+    assert all(v.batch.num_rows == data.capacity for v in man.views[:-1])
+    after = _expected(s)
+    assert after == before
+    c1 = _counters()
+    assert c1.get("compaction_passes", 0) > c0.get("compaction_passes", 0)
+    assert c1.get("compaction_batches_rewritten", 0) >= \
+        c0.get("compaction_batches_rewritten", 0) + out["rewritten"]
+    assert c1.get("compaction_bytes_reclaimed", 0) >= \
+        c0.get("compaction_bytes_reclaimed", 0)
+    # a second immediate pass declines itemized, never silently
+    out2 = compact.run_compaction_pass(data, force=True)
+    assert out2["rewritten"] == 0 and out2["skipped"]
+    s.stop()
+
+
+@pytest.mark.parametrize("action,param", [
+    ("raise", 0), ("kill_worker", 0), ("return_errno", 0)],
+    ids=["raise", "kill", "errno"])
+def test_crash_at_publish_leaves_old_manifest_live(action, param):
+    """The crash matrix cell for the compaction seam: the failpoint sits
+    inside the table lock immediately before `_publish` — dying there
+    must leave the old manifest (same version, same view objects, debris
+    intact) serving exact values, and a retry must heal cleanly."""
+    s, data = _session()
+    _debris(s, data)
+    before = _expected(s)
+    man0 = data.snapshot()
+    ids0 = [id(v) for v in man0.views]
+    rfail.arm("storage.compaction", action, param=param, count=1)
+    with pytest.raises(Exception) as ei:
+        compact.run_compaction_pass(data, force=True)
+    assert isinstance(ei.value, (OSError, rfail.WorkerKilled))
+    assert rfail.fired_counts().get("storage.compaction") == 1
+    man1 = data.snapshot()
+    assert man1.version == man0.version, "a dead pass must not publish"
+    assert [id(v) for v in man1.views] == ids0
+    assert any(v.deltas or v.delete_mask is not None for v in man1.views)
+    assert _expected(s) == before
+    # disarmed retry folds everything the dead pass left behind
+    rfail.clear()
+    out = compact.run_compaction_pass(data, force=True)
+    assert out["rewritten"] > 0
+    assert all(not v.deltas and v.delete_mask is None
+               for v in data.snapshot().views)
+    assert _expected(s) == before
+    s.stop()
+
+
+def test_raced_pass_aborts_instead_of_resurrecting_rows():
+    """If a concurrent update replaces a selected view (dataclasses.
+    replace => new object identity) between selection and publish, the
+    pass must abort COUNTED — publishing would resurrect pre-mutation
+    rows.  The race is simulated deterministically at the failpoint
+    seam, which runs under the table lock exactly where a real pass sits
+    right before `_publish`."""
+    s, data = _session()
+    _debris(s, data)
+    before = _expected(s)
+    man0 = data.snapshot()
+
+    def swap(name):
+        if name != "storage.compaction":
+            return
+        cur = data._manifest
+        views = (dataclasses.replace(cur.views[0]),) + cur.views[1:]
+        data._manifest = dataclasses.replace(cur, views=views)
+
+    orig = rfail.hit
+    rfail.hit = swap
+    try:
+        c0 = _counters()
+        out = compact.run_compaction_pass(data, force=True)
+    finally:
+        rfail.hit = orig
+    assert out["rewritten"] == 0
+    assert out["skipped"].get("raced", 0) > 0
+    c1 = _counters()
+    assert c1.get("compaction_skip_raced", 0) > \
+        c0.get("compaction_skip_raced", 0)
+    assert data.snapshot().version == man0.version
+    assert _expected(s) == before
+    s.stop()
+
+
+def test_chaos_drain_fallbacks_reach_zero_with_pinned_reader():
+    """Sustained mutations accumulate counted compressed-domain
+    fallbacks; at most TWO compaction passes drain the table's foldable
+    tally to zero, a re-run of the same queries counts NO new foldable
+    fallbacks, and a reader pinned across the rewrite keeps its
+    pre-compaction snapshot value-exact the whole way."""
+    s, data = _session(n=8000)
+    _props().set("agg_on_codes", "on")
+    queries = ["SELECT count(*), sum(q), sum(v), sum(k) FROM ct",
+               "SELECT q, count(*), sum(v) FROM ct GROUP BY q ORDER BY q"]
+    rng = np.random.default_rng(3)
+    for round_ in range(4):
+        lo = int(rng.integers(0, 7000))
+        s.sql(f"UPDATE ct SET q = 3.75 WHERE k >= {lo} AND k < {lo + 30}")
+        s.sql(f"DELETE FROM ct WHERE k = {7200 + round_}")
+        s.insert_arrays("ct", [
+            np.arange(20, dtype=np.int64) + 50_000 + round_ * 100,
+            np.full(20, 0.5),
+            (np.arange(20) + 50_000 + round_ * 100) * 0.5])
+        for qy in queries:
+            s.sql(qy).rows()
+    assert compact.foldable_fallbacks(data) > 0, \
+        "sustained mutations must accumulate foldable fallbacks"
+    before = [s.sql(qy).rows() for qy in queries]
+
+    pin = mvcc.SnapshotPin()
+    pin.pin_many([data])
+    pinned_ver = pin.manifest_for(data).version
+    pinned_sums = _host_sums(pin.manifest_for(data))
+
+    passes = 0
+    while compact.foldable_fallbacks(data) > 0 and passes < 2:
+        compact.run_compaction_pass(data, force=True)
+        passes += 1
+    assert compact.foldable_fallbacks(data) == 0, \
+        f"foldable fallbacks not drained after {passes} passes: " \
+        f"{table_fallbacks(data)}"
+    assert passes <= 2
+
+    # the SAME queries now run without counting a single new foldable
+    # fallback for this table, and with identical values
+    after = [s.sql(qy).rows() for qy in queries]
+    for a, b in zip(after, before):
+        assert a == b
+    fb = {r: n for r, n in table_fallbacks(data).items()
+          if r in compact.FOLDABLE_REASONS}
+    assert not fb, f"re-run still falls back: {fb}"
+
+    # the pinned reader's world never moved
+    assert pin.manifest_for(data).version == pinned_ver
+    assert pin.manifest_for(data).version < data.snapshot().version
+    assert _host_sums(pin.manifest_for(data)) == \
+        pytest.approx(pinned_sums)
+    pin.release()
+    s.stop()
+
+
+def test_broker_sweep_and_kick_gating():
+    """The admission-path kick: disabled knob => no kick; the sweep body
+    compacts exactly the tables whose foldable tally crossed
+    `compaction_min_fallbacks`, through the broker's registry."""
+    from snappydata_tpu.resource.broker import global_broker
+
+    s, data = _session()
+    broker = global_broker()
+    assert any(d is data for _nm, d in broker._iter_tables()), \
+        "column table must be registered with the broker"
+    _props().set("compaction_enabled", False)
+    assert compact.maybe_kick(broker) is False
+    _props().set("compaction_enabled", True)
+
+    _debris(s, data)
+    before = _expected(s)
+    s.sql("SELECT count(*), sum(q) FROM ct").rows()   # count the fallback
+    assert compact.foldable_fallbacks(data) >= 1
+    compact._sweep_body(broker)   # the thread body, run synchronously
+    assert all(not v.deltas and v.delete_mask is None
+               for v in data.snapshot().views)
+    assert compact.foldable_fallbacks(data) == 0
+    assert _expected(s) == before
+    s.stop()
+
+
+def test_stats_surface_reports_lanes_and_compaction():
+    """Dashboard / REST surface: the scan snapshot carries the aggregate
+    lane counters and compaction progress; encoding_mix itemizes each
+    table's OWN fallback tally (the compaction trigger)."""
+    from snappydata_tpu.observability.stats_service import (encoding_mix,
+                                                            scan_snapshot)
+
+    s, data = _session()
+    _props().set("agg_on_codes", "on")
+    s.sql("SELECT q, count(*), sum(v) FROM ct GROUP BY q ORDER BY q")
+    _debris(s, data)
+    s.sql("SELECT count(*), sum(q) FROM ct").rows()
+    snap = scan_snapshot(s.catalog)
+    assert snap["agg_code_domain"] > 0
+    assert snap["agg_dict_space"] > 0
+    assert "agg_rle_runs" in snap and snap["agg_on_codes"] == "on"
+    assert snap["compaction_enabled"] in (True, False)
+    fb = encoding_mix(s.catalog)["ct"]["compressed_fallbacks"]
+    assert fb.get("deltas", 0) > 0, fb
+    compact.run_compaction_pass(data, force=True)
+    snap = scan_snapshot(s.catalog)
+    assert snap["compaction_passes"] > 0
+    assert snap["compaction_batches_rewritten"] > 0
+    assert encoding_mix(s.catalog)["ct"]["compressed_fallbacks"] == {}
+    s.stop()
+
+
+def test_faultstorm_menu_covers_compaction():
+    """Satellite (a): the storm menu injects at the compaction seam, and
+    the storm op both manufactures debris and forces the pass."""
+    from snappydata_tpu.reliability import faultstorm
+
+    points = {m[0] for m in faultstorm._MENU}
+    assert "storage.compaction" in points
+    assert {m[3] for m in faultstorm._MENU
+            if m[0] == "storage.compaction"} == {"op_compact"}
+    assert hasattr(faultstorm._Storm, "op_compact")
